@@ -1,0 +1,118 @@
+"""paddle_tpu: a TPU-native deep-learning framework with PaddlePaddle's
+capability surface, built from scratch on JAX/XLA/Pallas.
+
+Top-level namespace mirrors ``import paddle`` (python/paddle/__init__.py in
+the reference): tensor ops, Tensor, dtypes, autograd controls, device info.
+"""
+
+from __future__ import annotations
+
+import jax as _jax
+
+# Full float64/int64 dtype coverage (paddle supports fp64 kernels; TPU demotes
+# f64 math to emulation but framework semantics stay correct).
+_jax.config.update("jax_enable_x64", True)
+
+__version__ = "0.1.0"
+
+from .core import dtype as _dtype_mod
+from .core.dtype import (  # noqa: F401
+    bfloat16,
+    bool_ as bool,  # noqa: A001
+    complex64,
+    complex128,
+    float16,
+    float32,
+    float64,
+    float8_e4m3fn,
+    float8_e5m2,
+    get_default_dtype,
+    iinfo,
+    finfo,
+    int8,
+    int16,
+    int32,
+    int64,
+    set_default_dtype,
+    uint8,
+)
+from .core.flags import get_flags, set_flags  # noqa: F401
+from .core.random import Generator, get_rng_state, seed, set_rng_state  # noqa: F401
+from .core.autograd import (  # noqa: F401
+    enable_grad,
+    grad,
+    is_grad_enabled,
+    no_grad,
+    set_grad_enabled,
+)
+from .core.tensor import Parameter, Tensor, to_tensor  # noqa: F401
+
+from . import tensor  # noqa: F401  (op modules; also monkey-patches Tensor)
+from .tensor import *  # noqa: F401,F403
+from .tensor import abs, all, any, max, min, pow, round, sum  # noqa: F401,A004
+from .tensor import rank, shape, numel, is_floating_point, is_complex, is_integer, is_tensor  # noqa: F401
+
+from . import amp  # noqa: F401
+from . import autograd  # noqa: F401
+from . import device  # noqa: F401
+from . import distributed  # noqa: F401
+from . import distribution  # noqa: F401
+from . import io  # noqa: F401
+from . import jit  # noqa: F401
+from . import metric  # noqa: F401
+from . import nn  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import profiler  # noqa: F401
+from . import sparse  # noqa: F401
+from . import vision  # noqa: F401
+from .device import get_device, set_device  # noqa: F401
+from .framework import CPUPlace, CUDAPlace, TPUPlace, save, load  # noqa: F401
+from .hapi.model import Model  # noqa: F401
+from .jit.api import to_static  # noqa: F401
+from .nn.layers import Layer  # noqa: F401
+
+
+def is_compiled_with_cuda() -> bool:
+    return False
+
+
+def is_compiled_with_rocm() -> bool:
+    return False
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def is_compiled_with_tpu() -> bool:
+    return True
+
+
+def is_compiled_with_cinn() -> bool:
+    # XLA plays CINN's role (SURVEY.md N27): always-on fusion compiler.
+    return True
+
+
+def is_compiled_with_distribute() -> bool:
+    return True
+
+
+class DataParallel(object):
+    """Placeholder rebound below (distributed.parallel.DataParallel)."""
+
+
+from .distributed.parallel import DataParallel  # noqa: F401,E402
+
+
+def disable_static(place=None):
+    return None
+
+
+def enable_static():
+    raise NotImplementedError(
+        "paddle_tpu is dynamic-first; graph capture goes through paddle_tpu.jit.to_static (jax.jit)."
+    )
+
+
+def in_dynamic_mode() -> bool:
+    return True

@@ -1,0 +1,136 @@
+"""Automatic mixed precision (``python/paddle/amp/auto_cast.py:729`` analog).
+
+TPU-first: bf16 is the native fast dtype (MXU takes bf16 inputs with f32
+accumulation), so AMP O1 means "cast MXU-bound op inputs to bf16"; O2 casts
+whole layers with f32 master weights kept by the optimizer.  No loss scaling
+is needed for bf16 (GradScaler is API-compatible and enabled only for fp16).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Iterable, Optional, Set
+
+import jax.numpy as jnp
+
+from ..core import dispatch as _dispatch
+from ..core import dtype as dtype_mod
+
+# Default op lists — capability analog of the reference's O1 white/black lists
+# (python/paddle/amp/amp_lists.py).
+white_list: Set[str] = {
+    "matmul", "mm", "bmm", "einsum", "conv2d", "conv1d", "conv3d",
+    "conv2d_transpose", "addmm", "attention", "flash_attention", "linear",
+}
+black_list: Set[str] = {
+    "exp", "log", "log2", "log10", "log1p", "logsumexp", "softmax_with_cross_entropy",
+    "cross_entropy", "mean", "sum", "norm", "softmax", "log_softmax",
+    "layer_norm", "rms_norm", "batch_norm", "cumsum", "pow",
+}
+
+
+class _AmpState(threading.local):
+    def __init__(self):
+        self.stack = []
+
+    def enabled(self):
+        return bool(self.stack) and self.stack[-1]["enable"]
+
+    def current(self):
+        return self.stack[-1] if self.stack else None
+
+    def cast_args(self, op_name, args):
+        from ..core.tensor import Tensor
+
+        cfg = self.current()
+        if cfg is None or not cfg["enable"]:
+            return args
+        level = cfg["level"]
+        target = cfg["dtype"]
+        base = op_name.split("/")[-1]
+        if level == "O2":
+            do_cast = base not in cfg["black"]
+        else:
+            do_cast = base in cfg["white"] and base not in cfg["black"]
+        if not do_cast:
+            return args
+        out = []
+        for a in args:
+            if isinstance(a, Tensor) and a.dtype == dtype_mod.float32:
+                out.append(_fast_cast(a, target))
+            else:
+                out.append(a)
+        return tuple(out)
+
+
+def _fast_cast(t, target):
+    """Cast without re-entering the AMP hook (avoids recursion), but on-tape."""
+    from ..core.dispatch import run_op
+
+    state = _state.stack
+    _state.stack = []
+    try:
+        return run_op("amp_cast", lambda x: x.astype(target), t)
+    finally:
+        _state.stack = state
+
+
+_state = _AmpState()
+_dispatch._register_amp_state(_state)
+
+
+class auto_cast:
+    """``paddle.amp.auto_cast`` context manager."""
+
+    def __init__(
+        self,
+        enable: bool = True,
+        custom_white_list: Optional[Iterable[str]] = None,
+        custom_black_list: Optional[Iterable[str]] = None,
+        level: str = "O1",
+        dtype: str = "bfloat16",
+        use_promote: bool = True,
+    ):
+        if level not in ("O0", "O1", "O2", "OD"):
+            raise ValueError(f"level must be O0/OD/O1/O2, got {level}")
+        self.cfg = {
+            "enable": enable and level != "O0",
+            "level": level,
+            "dtype": dtype_mod.convert_dtype(dtype),
+            "white": set(white_list) | set(custom_white_list or ()),
+            "black": set(black_list) | set(custom_black_list or ()),
+        }
+
+    def __enter__(self):
+        _state.stack.append(self.cfg)
+        return self
+
+    def __exit__(self, *exc):
+        _state.stack.pop()
+        return False
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """``paddle.amp.decorate``: O2 casts model params to the AMP dtype; master
+    weights (f32) live in the optimizer (mirrors reference master-weight path)."""
+    from ..nn.layers import Layer
+
+    target = dtype_mod.convert_dtype(dtype)
+    single = isinstance(models, Layer)
+    model_list = [models] if single else list(models)
+    if level == "O2":
+        for m in model_list:
+            for p in m.parameters():
+                if p.dtype == dtype_mod.float32:
+                    p._value = p._value.astype(target)
+    if optimizers is None:
+        return models if single else model_list
+    opt_single = not isinstance(optimizers, (list, tuple))
+    opt_list = [optimizers] if opt_single else list(optimizers)
+    for o in opt_list:
+        o._use_master_weights = master_weight if master_weight is not None else (level == "O2")
+    return (models if single else model_list), (optimizers if opt_single else opt_list)

@@ -1,0 +1,67 @@
+"""Numerics debugging (``python/paddle/amp/debugging.py:339`` check_numerics
+analog + FLAGS_check_nan_inf plumbing — SURVEY.md §5 'race detection').
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import flags
+from ..core.tensor import Tensor, to_tensor
+
+
+class DebugMode:
+    CHECK_NAN_INF_AND_ABORT = 0
+    CHECK_NAN_INF = 1
+    CHECK_ALL_FOR_OVERFLOW = 2
+    CHECK_ALL = 3
+
+
+class TensorCheckerConfig:
+    """Per-op skip config (amp/debugging.py:157 analog)."""
+
+    def __init__(self, enable=True, debug_mode=DebugMode.CHECK_NAN_INF_AND_ABORT,
+                 output_dir=None, checked_op_list=None, skipped_op_list=None,
+                 debug_step=None, stack_height_limit=1):
+        self.enable = enable
+        self.debug_mode = debug_mode
+        self.output_dir = output_dir
+        self.checked_op_list = checked_op_list
+        self.skipped_op_list = skipped_op_list
+
+
+def enable_operator_stats_collection():
+    flags.set_flags({"eager_log_ops": True})
+
+
+def disable_operator_stats_collection():
+    flags.set_flags({"eager_log_ops": False})
+
+
+def enable_tensor_checker(config: Optional[TensorCheckerConfig] = None):
+    if config is None or config.enable:
+        flags.set_flags({"check_nan_inf": True})
+        if config is not None and config.debug_mode != DebugMode.CHECK_NAN_INF_AND_ABORT:
+            flags.set_flags({"check_nan_inf_level": 1})
+
+
+def disable_tensor_checker():
+    flags.set_flags({"check_nan_inf": False})
+
+
+def check_numerics(tensor, op_type="", var_name="", debug_mode=DebugMode.CHECK_NAN_INF_AND_ABORT):
+    """Scan one tensor for NaN/Inf; returns (num_nan, num_inf, num_zero)."""
+    v = np.asarray(tensor._value)
+    if not np.issubdtype(v.dtype, np.floating):
+        return to_tensor(0), to_tensor(0), to_tensor(int((v == 0).sum()))
+    n_nan = int(np.isnan(v).sum())
+    n_inf = int(np.isinf(v).sum())
+    n_zero = int((v == 0).sum())
+    if (n_nan or n_inf) and debug_mode == DebugMode.CHECK_NAN_INF_AND_ABORT:
+        raise FloatingPointError(
+            f"check_numerics: op={op_type} var={var_name} nan={n_nan} inf={n_inf}"
+        )
+    return to_tensor(n_nan), to_tensor(n_inf), to_tensor(n_zero)

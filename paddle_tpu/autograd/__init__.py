@@ -1,0 +1,153 @@
+"""``paddle.autograd`` surface: backward, grad, PyLayer, functional jacobians.
+
+Eager pieces ride the tape engine (core/autograd.py — RunBackward analog of
+``fluid/eager/backward.cc:105``); higher-order derivatives are functional
+transforms over pure functions (jax.jacfwd/jacrev), matching the capability of
+the reference's ``paddle.incubate.autograd`` primitive system.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..core.autograd import Edge, GradNode, backward, grad, is_grad_enabled, no_grad  # noqa: F401
+from ..core.dispatch import run_op
+from ..core.tensor import Tensor, to_tensor, wrap_result
+
+
+class PyLayerContext:
+    """Context passed to PyLayer.forward/backward (paddle.autograd.PyLayerContext)."""
+
+    def __init__(self):
+        self._saved = ()
+        self.not_inplace_tensors = ()
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    def saved_tensor(self):
+        return self._saved
+
+    saved_tensors = property(lambda self: self._saved)
+
+
+class PyLayerMeta(type):
+    def __call__(cls, *a, **k):
+        raise RuntimeError("PyLayer is not instantiable; call .apply(...)")
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    """Custom autograd op with user-defined forward/backward
+    (``python/paddle/autograd/py_layer.py`` capability).
+
+    The backward runs as eager ops (so it may itself contain framework calls);
+    gradients route into the tape via a custom GradNode.
+    """
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        with no_grad():
+            out = cls.forward(ctx, *args, **kwargs)
+        single = not isinstance(out, (list, tuple))
+        outs = [out] if single else list(out)
+
+        tensor_inputs = [a for a in args if isinstance(a, Tensor)]
+        requires = is_grad_enabled() and any(not t.stop_gradient for t in tensor_inputs)
+        if not requires:
+            return out
+
+        edges = [Edge(t, t._grad_node, t._out_index) for t in tensor_inputs if not t.stop_gradient]
+        diff_inputs = [t for t in tensor_inputs if not t.stop_gradient]
+        out_avals = [jax.ShapeDtypeStruct(tuple(o.shape), o.dtype) for o in outs]
+
+        def backward_fn(cts):
+            ct_tensors = [Tensor(c, stop_gradient=True) for c in cts]
+            with no_grad():
+                gin = cls.backward(ctx, *ct_tensors)
+            gin = [gin] if isinstance(gin, Tensor) or gin is None else list(gin)
+            raw: List[Any] = []
+            gi = iter(gin)
+            for t in diff_inputs:
+                g = next(gi, None)
+                raw.append(None if g is None else (g._value if isinstance(g, Tensor) else jnp.asarray(g)))
+            return tuple(raw)
+
+        node = GradNode(f"PyLayer<{cls.__name__}>", backward_fn, edges, out_avals)
+        wrapped = wrap_result(tuple(o._value for o in outs), stop_gradient=False, node=node)
+        return wrapped[0] if single else type(out)(wrapped)
+
+
+def _functionalize(func: Callable, xs: Sequence[Tensor]):
+    def pure(*vals):
+        ts = [Tensor(v, stop_gradient=False) for v in vals]
+        out = func(*ts) if len(ts) > 1 else func(ts[0])
+        if isinstance(out, (list, tuple)):
+            return tuple(o._value for o in out)
+        return out._value
+
+    return pure
+
+
+def jacobian(func: Callable = None, xs=None, is_batched=False, *, ys=None):
+    """``paddle.autograd.jacobian`` (functional form): J of func at xs."""
+    single = isinstance(xs, Tensor)
+    xs_list = [xs] if single else list(xs)
+    pure = _functionalize(func, xs_list)
+    jac = jax.jacrev(pure, argnums=tuple(range(len(xs_list))))(*[t._value for t in xs_list])
+    if isinstance(jac, tuple) and single:
+        jac = jac[0]
+    return jax.tree.map(lambda a: Tensor(a), jac)
+
+
+def hessian(func: Callable, xs, is_batched=False):
+    """``paddle.autograd.hessian`` (functional form)."""
+    single = isinstance(xs, Tensor)
+    xs_list = [xs] if single else list(xs)
+    pure = _functionalize(func, xs_list)
+    hess = jax.hessian(pure, argnums=tuple(range(len(xs_list))))(*[t._value for t in xs_list])
+    if isinstance(hess, tuple) and single:
+        hess = hess[0]
+        if isinstance(hess, tuple):
+            hess = hess[0]
+    return jax.tree.map(lambda a: Tensor(a), hess)
+
+
+def vjp(func: Callable, xs, v=None):
+    single = isinstance(xs, Tensor)
+    xs_list = [xs] if single else list(xs)
+    pure = _functionalize(func, xs_list)
+    out, vjp_fn = jax.vjp(pure, *[t._value for t in xs_list])
+    if v is None:
+        v_raw = jnp.ones_like(out)
+    else:
+        v_raw = v._value if isinstance(v, Tensor) else jax.tree.map(lambda t: t._value, v)
+    grads = vjp_fn(v_raw)
+    grads_t = [Tensor(g) for g in grads]
+    return Tensor(out), (grads_t[0] if single else grads_t)
+
+
+def jvp(func: Callable, xs, v=None):
+    single = isinstance(xs, Tensor)
+    xs_list = [xs] if single else list(xs)
+    pure = _functionalize(func, xs_list)
+    primals = [t._value for t in xs_list]
+    if v is None:
+        tangents = [jnp.ones_like(p) for p in primals]
+    elif isinstance(v, Tensor):
+        tangents = [v._value]
+    else:
+        tangents = [t._value for t in v]
+    out, jv = jax.jvp(pure, tuple(primals), tuple(tangents))
+    return Tensor(out), Tensor(jv)

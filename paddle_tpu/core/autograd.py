@@ -1,0 +1,338 @@
+"""Eager autograd engine.
+
+Capability analog of the reference's eager autograd
+(``paddle/fluid/eager/backward.cc:105`` ``RunBackward`` — topological walk of a
+``GradNodeBase`` DAG with in-degree scheduling, hook dispatch and leaf
+accumulation; node structure at ``paddle/fluid/eager/grad_node_info.h:197``).
+
+TPU-first design: instead of hand-written per-op grad kernels, each recorded op
+holds the ``jax.vjp`` closure of its (pure JAX) forward function.  The engine
+is therefore a thin scheduler; all gradient math is XLA.  Because ``jax.vjp``
+composes with tracing, the same engine runs unchanged inside ``jit``-traced
+``to_static`` programs — backward() inside a traced train step just extends
+the trace.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class _GradState(threading.local):
+    def __init__(self):
+        self.enabled = True
+
+
+_state = _GradState()
+
+
+def is_grad_enabled() -> bool:
+    """``paddle.is_grad_enabled`` analog."""
+    return _state.enabled
+
+
+class no_grad:
+    """Context manager / decorator disabling tape recording (``paddle.no_grad``)."""
+
+    def __enter__(self):
+        self._prev = _state.enabled
+        _state.enabled = False
+        return self
+
+    def __exit__(self, *exc):
+        _state.enabled = self._prev
+        return False
+
+    def __call__(self, fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with no_grad():
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+
+class enable_grad:
+    """Re-enable grad recording inside a ``no_grad`` scope."""
+
+    def __enter__(self):
+        self._prev = _state.enabled
+        _state.enabled = True
+        return self
+
+    def __exit__(self, *exc):
+        _state.enabled = self._prev
+        return False
+
+
+def set_grad_enabled(mode: bool):
+    """``paddle.set_grad_enabled`` analog (usable as context manager)."""
+
+    class _Ctx:
+        def __init__(self, mode):
+            self._prev = _state.enabled
+            _state.enabled = mode
+
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *exc):
+            _state.enabled = self._prev
+            return False
+
+    return _Ctx(mode)
+
+
+class Edge:
+    """One differentiable input of a recorded op.
+
+    Captured at record time so later in-place rebinding of the consumer
+    tensor cannot corrupt the graph (reference keeps analogous
+    ``GradSlotMeta`` edges).
+    """
+
+    __slots__ = ("tensor", "parent", "parent_idx")
+
+    def __init__(self, tensor, parent: Optional["GradNode"], parent_idx: int):
+        self.tensor = tensor  # wrapper Tensor (for hooks + leaf accumulation)
+        self.parent = parent  # producing GradNode of that tensor, or None (leaf)
+        self.parent_idx = parent_idx
+
+
+class GradNode:
+    """A recorded op in the backward DAG (``GradNodeBase`` analog)."""
+
+    __slots__ = ("name", "backward_fn", "edges", "out_avals", "released")
+
+    def __init__(
+        self,
+        name: str,
+        backward_fn: Callable[[Tuple[Any, ...]], Tuple[Any, ...]],
+        edges: List[Edge],
+        out_avals: List[jax.ShapeDtypeStruct],
+    ):
+        self.name = name
+        self.backward_fn = backward_fn  # (out_cotangents,) -> input cotangents
+        self.edges = edges
+        self.out_avals = out_avals
+        self.released = False
+
+    def release(self):
+        self.backward_fn = None
+        self.released = True
+
+
+def _zero_cotangent(aval: jax.ShapeDtypeStruct):
+    if jnp.issubdtype(aval.dtype, jnp.inexact):
+        return jnp.zeros(aval.shape, aval.dtype)
+    # Integer/bool outputs take symbolic-zero cotangents (jax float0).
+    return np.zeros(aval.shape, jax.dtypes.float0)
+
+
+def run_backward(
+    roots: Sequence,  # Tensors
+    root_grads: Sequence[Optional[Any]],
+    retain_graph: bool = False,
+    capture: Optional[Dict[int, Any]] = None,  # id(tensor) -> slot to fill
+    capture_tensors: Optional[Sequence] = None,
+    accumulate_leaves: bool = True,
+):
+    """Reverse-topological sweep (``RunBackward`` analog, backward.cc:105).
+
+    ``capture_tensors``: tensors whose incoming gradient should be captured
+    (used by ``paddle.grad``); results land in ``capture`` keyed by id.
+    """
+    from .tensor import Tensor  # local import to avoid cycle
+
+    # --- seed gradients ----------------------------------------------------
+    node_grads: Dict[Tuple[int, int], Any] = {}  # (id(node), out_idx) -> grad
+    nodes_by_id: Dict[int, GradNode] = {}
+    leaf_grads: Dict[int, Any] = {}
+
+    capture_slots: Dict[Tuple[int, int], List[int]] = {}
+    capture_leaf: Dict[int, int] = {}
+    if capture_tensors:
+        for t in capture_tensors:
+            if t._grad_node is not None:
+                capture_slots.setdefault((id(t._grad_node), t._out_index), []).append(id(t))
+            else:
+                capture_leaf[id(t)] = id(t)
+
+    roots_with_nodes: List[GradNode] = []
+    for t, g in zip(roots, root_grads):
+        if g is None:
+            if t._value.size != 1:
+                raise RuntimeError(
+                    "grad can be implicitly created only for scalar outputs; "
+                    f"got shape {t.shape}"
+                )
+            g = jnp.ones(t._value.shape, t._value.dtype)
+        else:
+            g = g._value if isinstance(g, Tensor) else jnp.asarray(g)
+        node = t._grad_node
+        if node is None:
+            if not t.stop_gradient:
+                _leaf_store(t, g, capture, capture_leaf, leaf_grads, accumulate_leaves)
+            continue
+        key = (id(node), t._out_index)
+        node_grads[key] = node_grads[key] + g if key in node_grads else g
+        nodes_by_id[id(node)] = node
+        roots_with_nodes.append(node)
+
+    # --- build reachable graph + in-degrees (backward.cc:28 analog) --------
+    indegree: Dict[int, int] = {}
+    visited: Dict[int, GradNode] = {}
+    stack = list({id(n): n for n in roots_with_nodes}.values())
+    for n in stack:
+        visited[id(n)] = n
+        indegree.setdefault(id(n), 0)
+    while stack:
+        node = stack.pop()
+        for e in node.edges:
+            p = e.parent
+            if p is None:
+                continue
+            indegree[id(p)] = indegree.get(id(p), 0) + 1
+            if id(p) not in visited:
+                visited[id(p)] = p
+                stack.append(p)
+
+    ready = [n for nid, n in visited.items() if indegree[nid] == 0]
+
+    # --- process ------------------------------------------------------------
+    processed = 0
+    while ready:
+        node = ready.pop()
+        processed += 1
+        if node.released:
+            raise RuntimeError(
+                f"Trying to backward through node '{node.name}' a second time; "
+                "set retain_graph=True to allow this."
+            )
+        # gather output cotangents (zero-fill missing slots)
+        cts = []
+        for i, aval in enumerate(node.out_avals):
+            g = node_grads.pop((id(node), i), None)
+            cts.append(_zero_cotangent(aval) if g is None else g)
+        in_cts = node.backward_fn(tuple(cts))
+        if not retain_graph:
+            node.release()
+        for e, g in zip(node.edges, in_cts):
+            if g is None:
+                continue
+            t = e.tensor
+            # per-tensor hooks (eager/hooks.h analog)
+            hooks = getattr(t, "_backward_hooks", None)
+            if hooks:
+                for h in hooks.values():
+                    out = h(_wrap_hook_grad(g))
+                    if out is not None:
+                        g = out._value if isinstance(out, Tensor) else out
+            if e.parent is None:
+                if not t.stop_gradient:
+                    _leaf_store(t, g, capture, capture_leaf, leaf_grads, accumulate_leaves)
+            else:
+                key = (id(e.parent), e.parent_idx)
+                node_grads[key] = node_grads[key] + g if key in node_grads else g
+                if capture is not None and key in capture_slots:
+                    for tid in capture_slots[key]:
+                        prev = capture.get(tid)
+                        capture[tid] = node_grads[key] if prev is None else prev + g
+                indegree[id(e.parent)] -= 1
+                if indegree[id(e.parent)] == 0:
+                    ready.append(e.parent)
+    return leaf_grads
+
+
+def _wrap_hook_grad(g):
+    from .tensor import Tensor
+
+    return Tensor(g, stop_gradient=True)
+
+
+def _leaf_store(t, g, capture, capture_leaf, leaf_grads, accumulate_leaves):
+    from .tensor import Tensor
+
+    key = id(t)
+    leaf_grads[key] = leaf_grads[key] + g if key in leaf_grads else g
+    if capture is not None and key in capture_leaf:
+        prev = capture.get(key)
+        capture[key] = g if prev is None else prev + g
+    if accumulate_leaves:
+        if t.grad is None:
+            t.grad = Tensor(g, stop_gradient=True)
+        else:
+            t.grad = Tensor(t.grad._value + g, stop_gradient=True)
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    """``paddle.autograd.backward`` analog."""
+    if not isinstance(tensors, (list, tuple)):
+        tensors = [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    elif not isinstance(grad_tensors, (list, tuple)):
+        grad_tensors = [grad_tensors]
+    run_backward(tensors, grad_tensors, retain_graph=retain_graph)
+
+
+def grad(
+    outputs,
+    inputs,
+    grad_outputs=None,
+    retain_graph=None,
+    create_graph=False,
+    only_inputs=True,
+    allow_unused=False,
+):
+    """``paddle.grad`` analog (general_grad.h capability).
+
+    ``create_graph`` (double grad) is not supported by the eager tape; use the
+    functional ``paddle_tpu.incubate.autograd`` transforms (jacobian/hessian)
+    which compose ``jax.grad`` directly.
+    """
+    from .tensor import Tensor
+
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True is not supported on the eager tape; use "
+            "paddle_tpu.autograd.jacobian/hessian (functional, jax.grad-based)."
+        )
+    outputs = [outputs] if isinstance(outputs, Tensor) else list(outputs)
+    inputs = [inputs] if isinstance(inputs, Tensor) else list(inputs)
+    if grad_outputs is None:
+        grad_outputs = [None] * len(outputs)
+    elif isinstance(grad_outputs, Tensor):
+        grad_outputs = [grad_outputs]
+    if retain_graph is None:
+        retain_graph = False
+    capture: Dict[int, Any] = {}
+    run_backward(
+        outputs,
+        grad_outputs,
+        retain_graph=retain_graph,
+        capture=capture,
+        capture_tensors=inputs,
+        accumulate_leaves=False,
+    )
+    results = []
+    for t in inputs:
+        g = capture.get(id(t))
+        if g is None:
+            if not allow_unused:
+                raise RuntimeError(
+                    "One of the differentiated tensors appears to not have "
+                    "been used in the graph. Set allow_unused=True if this is "
+                    "the desired behavior."
+                )
+            results.append(None)
+        else:
+            results.append(Tensor(g, stop_gradient=True))
+    return results

@@ -1,0 +1,152 @@
+"""Eager op dispatch: run a pure-JAX function, record its vjp on the tape.
+
+Capability analog of the reference's generated ``*_ad_func`` forward wrappers
+(template at ``paddle/fluid/eager/auto_code_generator/generator/eager_gen.py:251``:
+AMP cast -> type promotion -> AutogradMeta collection -> grad-node wiring ->
+PHI API call).  Here there is no codegen: every framework op is a pure JAX
+function passed through :func:`run_op`, which
+
+  1. unwraps ``Tensor`` args to ``jax.Array``,
+  2. applies AMP autocast if an amp context is active,
+  3. runs the function (XLA dispatch — this IS the kernel launch),
+  4. if any input requires grad, re-runs under ``jax.vjp`` and wires a
+     :class:`~paddle_tpu.core.autograd.GradNode` into the tape.
+
+The function must be pure (jit-compatible); under a ``to_static`` trace the
+values are tracers and everything here — including vjp recording — stages
+into the single XLA computation.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List
+
+import jax
+import jax.numpy as jnp
+
+from . import flags
+from .autograd import Edge, GradNode, is_grad_enabled
+
+# AMP context stack is installed by paddle_tpu.amp.auto_cast at import time.
+_amp_state = None
+
+# Optional capture recorder installed by paddle_tpu.jit during the to_static
+# discovery pass; sees (input_tensors, output_tensors) of every dispatched op.
+_capture_recorder = None
+
+
+def _register_amp_state(state):
+    global _amp_state
+    _amp_state = state
+
+
+def _set_capture_recorder(rec):
+    global _capture_recorder
+    _capture_recorder = rec
+
+
+def _tree_leaves_with_path(out):
+    if isinstance(out, (list, tuple)):
+        return list(out), type(out)
+    return [out], None
+
+
+def run_op(name: str, fn: Callable, *args, **kwargs):
+    """Execute ``fn(*raw_args, **kwargs)`` with tape recording.
+
+    Positional args that are ``Tensor`` are the differentiable inputs.  Kwarg
+    tensors are unwrapped but always non-differentiable — pass a tensor
+    positionally if it needs a gradient.
+    """
+    from .tensor import Tensor, wrap_result
+
+    if flags.flag("eager_log_ops"):
+        print(f"[paddle_tpu eager] {name}")
+
+    if _capture_recorder is not None:
+        _capture_recorder.on_inputs(
+            [a for a in list(args) + list(kwargs.values()) if isinstance(a, Tensor)]
+        )
+
+    # AMP autocast (amp/auto_cast.py:729 analog)
+    if _amp_state is not None and _amp_state.enabled():
+        args = _amp_state.cast_args(name, args)
+
+    tensor_idx: List[int] = [i for i, a in enumerate(args) if isinstance(a, Tensor)]
+    raw = [a._value if isinstance(a, Tensor) else a for a in args]
+    kwraw = {k: (v._value if isinstance(v, Tensor) else v) for k, v in kwargs.items()}
+
+    requires = (
+        is_grad_enabled()
+        and any(not args[i].stop_gradient for i in tensor_idx)
+    )
+
+    if not requires:
+        out = fn(*raw, **kwraw)
+        result = wrap_result(out, stop_gradient=True)
+        _maybe_check_nan(name, out)
+        if _capture_recorder is not None:
+            outs = result if isinstance(result, (list, tuple)) else [result]
+            _capture_recorder.on_outputs([o for o in outs if isinstance(o, Tensor)])
+        return result
+
+    diff_idx = [i for i in tensor_idx if not args[i].stop_gradient]
+
+    def pure(*tvals):
+        call = list(raw)
+        for i, v in zip(diff_idx, tvals):
+            call[i] = v
+        return fn(*call, **kwraw)
+
+    primals = [raw[i] for i in diff_idx]
+    out, vjp_fn = jax.vjp(pure, *primals)
+    _maybe_check_nan(name, out)
+
+    leaves, _ = _tree_leaves_with_path(out)
+    out_avals = [jax.ShapeDtypeStruct(jnp.shape(l), jnp.result_type(l)) for l in leaves]
+
+    edges = [
+        Edge(args[i], args[i]._grad_node, args[i]._out_index) for i in diff_idx
+    ]
+
+    single = not isinstance(out, (list, tuple))
+
+    def backward_fn(cts):
+        return vjp_fn(cts[0] if single else type(out)(cts))
+
+    node = GradNode(name, backward_fn, edges, out_avals)
+    result = wrap_result(out, stop_gradient=False, node=node)
+    if _capture_recorder is not None:
+        outs = result if isinstance(result, (list, tuple)) else [result]
+        _capture_recorder.on_outputs([o for o in outs if isinstance(o, Tensor)])
+    return result
+
+
+def _maybe_check_nan(name, out):
+    """FLAGS_check_nan_inf analog (eager/nan_inf_utils.cc)."""
+    if not flags.flag("check_nan_inf"):
+        return
+    import numpy as np
+
+    leaves = out if isinstance(out, (list, tuple)) else [out]
+    for i, l in enumerate(leaves):
+        if hasattr(l, "dtype") and jnp.issubdtype(l.dtype, jnp.inexact):
+            if isinstance(l, jax.core.Tracer):
+                continue  # cannot check inside a trace; jit path uses debug_nans
+            bad = bool(jnp.any(~jnp.isfinite(l)))
+            if bad:
+                msg = f"NaN/Inf detected in output {i} of op '{name}'"
+                if flags.flag("check_nan_inf_level") >= 1:
+                    print("WARNING:", msg)
+                else:
+                    raise FloatingPointError(msg)
+
+
+def defop(name: str, fn: Callable):
+    """Build an eager op from a pure JAX function."""
+    def op(*args, **kwargs):
+        return run_op(name, fn, *args, **kwargs)
+
+    op.__name__ = name
+    op.raw = fn
+    return op

@@ -1,0 +1,96 @@
+"""Dtype objects and promotion rules.
+
+Capability analog of the reference's ``phi::DataType`` (dtype enum at
+``paddle/phi/common/data_type.h``) and its type-promotion pass in the eager
+forward wrappers (``paddle/fluid/eager/type_promotion_utils.h``).  Dtypes are
+exposed as ``paddle_tpu.float32`` etc. and map 1:1 onto JAX/NumPy dtypes so
+tensors hand straight to XLA with zero conversion.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from . import flags
+
+# Canonical dtype objects are numpy dtypes — identical to what jax.Array.dtype
+# returns, so equality checks are free.
+bool_ = jnp.dtype("bool")
+uint8 = jnp.dtype("uint8")
+int8 = jnp.dtype("int8")
+int16 = jnp.dtype("int16")
+int32 = jnp.dtype("int32")
+int64 = jnp.dtype("int64")
+float16 = jnp.dtype("float16")
+bfloat16 = jnp.dtype(jnp.bfloat16)
+float32 = jnp.dtype("float32")
+float64 = jnp.dtype("float64")
+complex64 = jnp.dtype("complex64")
+complex128 = jnp.dtype("complex128")
+float8_e4m3fn = jnp.dtype(jnp.float8_e4m3fn)
+float8_e5m2 = jnp.dtype(jnp.float8_e5m2)
+
+_ALIASES = {
+    "bool": bool_, "uint8": uint8, "int8": int8, "int16": int16,
+    "int32": int32, "int64": int64, "float16": float16, "bfloat16": bfloat16,
+    "float32": float32, "float64": float64, "complex64": complex64,
+    "complex128": complex128, "float8_e4m3fn": float8_e4m3fn,
+    "float8_e5m2": float8_e5m2,
+    # paddle-style short names
+    "fp16": float16, "bf16": bfloat16, "fp32": float32, "fp64": float64,
+}
+
+FLOATING = {float16, bfloat16, float32, float64, float8_e4m3fn, float8_e5m2}
+INTEGER = {uint8, int8, int16, int32, int64}
+COMPLEX = {complex64, complex128}
+
+
+def convert_dtype(dtype) -> jnp.dtype:
+    """Normalize any dtype spec (str/np/jnp/paddle-style) to a numpy dtype."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        if dtype in _ALIASES:
+            return _ALIASES[dtype]
+        return jnp.dtype(dtype)
+    return jnp.dtype(dtype)
+
+
+def is_floating_point(dtype) -> bool:
+    return convert_dtype(dtype) in FLOATING
+
+
+def is_integer(dtype) -> bool:
+    d = convert_dtype(dtype)
+    return d in INTEGER or d == bool_
+
+
+def is_complex(dtype) -> bool:
+    return convert_dtype(dtype) in COMPLEX
+
+
+def get_default_dtype() -> jnp.dtype:
+    """``paddle.get_default_dtype`` analog."""
+    return convert_dtype(flags.flag("default_dtype"))
+
+
+def set_default_dtype(dtype) -> None:
+    """``paddle.set_default_dtype`` analog."""
+    d = convert_dtype(dtype)
+    if d not in FLOATING:
+        raise TypeError(f"default dtype must be floating point, got {d}")
+    flags.set_flags({"default_dtype": str(d)})
+
+
+def promote_types(a, b) -> jnp.dtype:
+    """Binary-op result dtype under JAX's (numpy-compatible) lattice."""
+    return jnp.promote_types(convert_dtype(a), convert_dtype(b))
+
+
+def finfo(dtype):
+    return jnp.finfo(convert_dtype(dtype))
+
+
+def iinfo(dtype):
+    return np.iinfo(convert_dtype(dtype))

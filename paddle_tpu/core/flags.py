@@ -1,0 +1,74 @@
+"""Runtime flag registry.
+
+TPU-native analog of the reference's gflags-style flag system
+(``paddle/common/flags.cc`` — ~138 ``PD_DEFINE_*`` flags, readable/settable
+from Python via ``paddle.set_flags``/``get_flags``).  Here flags are a plain
+process-local registry, mirrored from ``FLAGS_*`` environment variables at
+import time.  XLA-level knobs route through ``XLA_FLAGS`` instead; these
+flags only control framework behavior (NaN checks, eager debug, etc.).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Iterable, Mapping, Union
+
+_DEFS: Dict[str, dict] = {}
+_VALUES: Dict[str, Any] = {}
+
+
+def define_flag(name: str, default: Any, help_str: str = "") -> None:
+    """Register a flag with a default value. Env var FLAGS_<name> overrides."""
+    _DEFS[name] = {"default": default, "help": help_str, "type": type(default)}
+    env = os.environ.get("FLAGS_" + name)
+    if env is not None:
+        _VALUES[name] = _parse(env, type(default))
+    else:
+        _VALUES[name] = default
+
+
+def _parse(text: str, ty: type) -> Any:
+    if ty is bool:
+        return text.lower() in ("1", "true", "yes", "on")
+    if ty in (int, float):
+        return ty(text)
+    return text
+
+
+def set_flags(flags: Mapping[str, Any]) -> None:
+    """Set one or more registered flags (``paddle.set_flags`` analog)."""
+    for name, value in flags.items():
+        key = name[6:] if name.startswith("FLAGS_") else name
+        if key not in _DEFS:
+            raise ValueError(f"Unknown flag: {name}")
+        _VALUES[key] = _parse(value, _DEFS[key]["type"]) if isinstance(value, str) else value
+
+
+def get_flags(flags: Union[str, Iterable[str], None] = None) -> Dict[str, Any]:
+    """Read registered flags (``paddle.get_flags`` analog)."""
+    if flags is None:
+        return dict(_VALUES)
+    if isinstance(flags, str):
+        flags = [flags]
+    out = {}
+    for name in flags:
+        key = name[6:] if name.startswith("FLAGS_") else name
+        if key not in _DEFS:
+            raise ValueError(f"Unknown flag: {name}")
+        out[name] = _VALUES[key]
+    return out
+
+
+def flag(name: str) -> Any:
+    """Fast internal accessor."""
+    return _VALUES[name]
+
+
+# --- Core framework flags -------------------------------------------------
+define_flag("check_nan_inf", False, "Scan op outputs for NaN/Inf in eager mode.")
+define_flag("check_nan_inf_level", 0, "0: error on NaN/Inf; 1: warn; 3: dump stats only.")
+define_flag("eager_log_ops", False, "Log every eager op dispatch (debug).")
+define_flag("use_donated_buffers", True, "Donate input buffers in jitted train steps.")
+define_flag("default_dtype", "float32", "Default floating point dtype.")
+define_flag("retain_grad_for_all", False, "Retain .grad for non-leaf tensors.")
+define_flag("benchmark", False, "Block on every op for accurate eager timing.")

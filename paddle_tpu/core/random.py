@@ -1,0 +1,73 @@
+"""Global RNG state over ``jax.random``.
+
+Capability analog of the reference's ``phi::Generator`` (Philox state,
+``paddle/phi/core/generator.cc``) and the Python ``paddle.seed`` API.
+
+TPU-first: the state is a JAX PRNG key; each eager random op splits the key.
+Under a ``to_static`` trace the key is threaded as functional state (the jit
+layer snapshots and returns it), so traced programs get fresh randomness per
+call instead of a baked-in constant.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+class Generator:
+    """Splittable PRNG stream (one per device class in the reference)."""
+
+    def __init__(self, seed: int = 0):
+        self._key = jax.random.PRNGKey(seed)
+        self._seed = seed
+
+    def manual_seed(self, seed: int):
+        self._key = jax.random.PRNGKey(seed)
+        self._seed = seed
+        return self
+
+    def seed(self):
+        return self._seed
+
+    def next_key(self):
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def get_state(self):
+        return self._key
+
+    def set_state(self, state):
+        self._key = state
+
+
+default_generator = Generator(0)
+
+# Tensor-parallel RNG tracker swaps in extra generators (mpu/random.py analog);
+# registry lets distributed code install named streams.
+_named_generators = {"default": default_generator}
+
+
+def seed(s: int):
+    """``paddle.seed`` analog — reseed every registered generator stream."""
+    for g in _named_generators.values():
+        g.manual_seed(s)
+    return default_generator
+
+
+def register_generator(name: str, gen: Generator):
+    _named_generators[name] = gen
+
+
+def get_rng_state():
+    return {k: g.get_state() for k, g in _named_generators.items()}
+
+
+def set_rng_state(state):
+    for k, v in state.items():
+        if k in _named_generators:
+            _named_generators[k].set_state(v)
+
+
+def next_key():
+    return default_generator.next_key()

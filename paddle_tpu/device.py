@@ -1,0 +1,69 @@
+"""Device management (``paddle.device`` analog).
+
+The reference's DeviceManager/Place machinery (``phi/backends/device_manager.h:134``)
+maps onto JAX's device list; a single-controller process sees all local TPU
+chips. ``set_device`` selects the default device for new tensors.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+_current = None
+
+
+def get_all_devices():
+    return jax.devices()
+
+
+def device_count(device_type: str | None = None) -> int:
+    if device_type:
+        try:
+            return len(jax.devices(device_type))
+        except RuntimeError:
+            return 0
+    return jax.device_count()
+
+
+def get_device() -> str:
+    if _current is not None:
+        return _current
+    d = jax.devices()[0]
+    return f"{d.platform}:{d.id}"
+
+
+def set_device(device: str):
+    """Accepts 'cpu', 'tpu', 'tpu:0', 'gpu:0' (mapped to default backend)."""
+    global _current
+    _current = device
+    return device
+
+
+def is_compiled_with_cuda() -> bool:
+    return False
+
+
+def cuda_device_count() -> int:
+    return 0
+
+
+class cuda:
+    """Minimal ``paddle.device.cuda`` surface (no-op on TPU)."""
+
+    @staticmethod
+    def device_count():
+        return 0
+
+    @staticmethod
+    def synchronize(device=None):
+        return None
+
+    @staticmethod
+    def empty_cache():
+        return None
+
+
+def synchronize(device=None):
+    """Block until all queued device work completes."""
+    (jax.device_put(0) + 0).block_until_ready()

@@ -1,0 +1,44 @@
+"""``paddle.distributed`` namespace (SURVEY.md §2.3 inventory).
+
+Built TPU-first: a global 5-axis ``jax.sharding.Mesh`` [dp, pp, sharding,
+sep, mp] replaces NCCL process groups; XLA collectives over named axes
+replace collective kernels; GSPMD shardings replace the reshard lattice.
+"""
+
+from . import collective, env, topology  # noqa: F401
+from .auto_parallel import (  # noqa: F401
+    DistAttr,
+    Placement,
+    Partial,
+    ProcessMesh,
+    Replicate,
+    Shard,
+    dtensor_from_fn,
+    reshard,
+    shard_layer,
+    shard_optimizer,
+    shard_tensor,
+)
+from .collective import (  # noqa: F401
+    ReduceOp,
+    all_gather,
+    all_reduce,
+    alltoall,
+    alltoall_single,
+    barrier,
+    broadcast,
+    new_group,
+    reduce,
+    reduce_scatter,
+    scatter,
+    wait,
+)
+from .env import get_rank, get_world_size, init_parallel_env, is_initialized  # noqa: F401
+from .parallel import DataParallel  # noqa: F401
+from .topology import (  # noqa: F401
+    HybridCommunicateGroup,
+    get_hybrid_communicate_group,
+    get_mesh,
+    init_mesh,
+    set_mesh,
+)

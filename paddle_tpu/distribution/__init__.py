@@ -1,0 +1,317 @@
+"""``paddle.distribution`` (~25 distributions in the reference; the core set
+here, built on jax.random + jax.scipy.stats)."""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import random as rng_mod
+from ..core.tensor import Tensor, to_tensor
+
+
+def _v(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+class Distribution:
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return list(self._batch_shape)
+
+    @property
+    def event_shape(self):
+        return list(self._event_shape)
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        return self.sample(shape)
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return to_tensor(jnp.exp(_v(self.log_prob(value))))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _v(loc).astype(jnp.float32)
+        self.scale = _v(scale).astype(jnp.float32)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    def sample(self, shape=(), seed=0):
+        shape = tuple(shape) + self._batch_shape
+        z = jax.random.normal(rng_mod.next_key(), shape)
+        return Tensor(self.loc + self.scale * z)
+
+    def log_prob(self, value):
+        v = _v(value)
+        var = self.scale**2
+        return Tensor(-((v - self.loc) ** 2) / (2 * var) - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+
+    def entropy(self):
+        return Tensor(0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(self.scale) * jnp.ones_like(self.loc))
+
+    def cdf(self, value):
+        return Tensor(jax.scipy.stats.norm.cdf(_v(value), self.loc, self.scale))
+
+    @property
+    def mean(self):
+        return Tensor(jnp.broadcast_to(self.loc, self._batch_shape))
+
+    @property
+    def variance(self):
+        return Tensor(jnp.broadcast_to(self.scale**2, self._batch_shape))
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _v(low).astype(jnp.float32)
+        self.high = _v(high).astype(jnp.float32)
+        super().__init__(jnp.broadcast_shapes(self.low.shape, self.high.shape))
+
+    def sample(self, shape=(), seed=0):
+        shape = tuple(shape) + self._batch_shape
+        u = jax.random.uniform(rng_mod.next_key(), shape)
+        return Tensor(self.low + (self.high - self.low) * u)
+
+    def log_prob(self, value):
+        v = _v(value)
+        inside = (v >= self.low) & (v < self.high)
+        return Tensor(jnp.where(inside, -jnp.log(self.high - self.low), -jnp.inf))
+
+    def entropy(self):
+        return Tensor(jnp.log(self.high - self.low))
+
+
+class Categorical(Distribution):
+    def __init__(self, logits, name=None):
+        self.logits = _v(logits).astype(jnp.float32)
+        super().__init__(self.logits.shape[:-1])
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self._batch_shape
+        return Tensor(jax.random.categorical(rng_mod.next_key(), self.logits, shape=shape).astype(jnp.int64))
+
+    def log_prob(self, value):
+        logp = jax.nn.log_softmax(self.logits, -1)
+        v = _v(value).astype(jnp.int32)
+        return Tensor(jnp.take_along_axis(logp, v[..., None], -1)[..., 0])
+
+    def probs(self, value=None):
+        p = jax.nn.softmax(self.logits, -1)
+        if value is None:
+            return Tensor(p)
+        v = _v(value).astype(jnp.int32)
+        return Tensor(jnp.take_along_axis(p, v[..., None], -1)[..., 0])
+
+    def entropy(self):
+        logp = jax.nn.log_softmax(self.logits, -1)
+        return Tensor(-jnp.sum(jnp.exp(logp) * logp, -1))
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs, name=None):
+        self.probs_ = _v(probs).astype(jnp.float32)
+        super().__init__(self.probs_.shape)
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self._batch_shape
+        return Tensor(jax.random.bernoulli(rng_mod.next_key(), self.probs_, shape).astype(jnp.float32))
+
+    def log_prob(self, value):
+        v = _v(value)
+        p = jnp.clip(self.probs_, 1e-7, 1 - 1e-7)
+        return Tensor(v * jnp.log(p) + (1 - v) * jnp.log1p(-p))
+
+    def entropy(self):
+        p = jnp.clip(self.probs_, 1e-7, 1 - 1e-7)
+        return Tensor(-(p * jnp.log(p) + (1 - p) * jnp.log1p(-p)))
+
+
+class Exponential(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = _v(rate).astype(jnp.float32)
+        super().__init__(self.rate.shape)
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self._batch_shape
+        return Tensor(jax.random.exponential(rng_mod.next_key(), shape) / self.rate)
+
+    def log_prob(self, value):
+        v = _v(value)
+        return Tensor(jnp.log(self.rate) - self.rate * v)
+
+    def entropy(self):
+        return Tensor(1.0 - jnp.log(self.rate))
+
+
+class Gamma(Distribution):
+    def __init__(self, concentration, rate, name=None):
+        self.concentration = _v(concentration).astype(jnp.float32)
+        self.rate = _v(rate).astype(jnp.float32)
+        super().__init__(jnp.broadcast_shapes(self.concentration.shape, self.rate.shape))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self._batch_shape
+        return Tensor(jax.random.gamma(rng_mod.next_key(), self.concentration, shape) / self.rate)
+
+    def log_prob(self, value):
+        v = _v(value)
+        a, b = self.concentration, self.rate
+        return Tensor(a * jnp.log(b) + (a - 1) * jnp.log(v) - b * v - jax.scipy.special.gammaln(a))
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = _v(alpha).astype(jnp.float32)
+        self.beta = _v(beta).astype(jnp.float32)
+        super().__init__(jnp.broadcast_shapes(self.alpha.shape, self.beta.shape))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self._batch_shape
+        return Tensor(jax.random.beta(rng_mod.next_key(), self.alpha, self.beta, shape))
+
+    def log_prob(self, value):
+        v = _v(value)
+        return Tensor(jax.scipy.stats.beta.logpdf(v, self.alpha, self.beta))
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration, name=None):
+        self.concentration = _v(concentration).astype(jnp.float32)
+        super().__init__(self.concentration.shape[:-1], self.concentration.shape[-1:])
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self._batch_shape
+        return Tensor(jax.random.dirichlet(rng_mod.next_key(), self.concentration, shape))
+
+    def log_prob(self, value):
+        return Tensor(jax.scipy.stats.dirichlet.logpdf(_v(value).T, self.concentration))
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        self.probs_ = _v(probs).astype(jnp.float32)
+        super().__init__(self.probs_.shape[:-1], self.probs_.shape[-1:])
+
+    def sample(self, shape=()):
+        n = self.total_count
+        k = self.probs_.shape[-1]
+        draws = jax.random.categorical(
+            rng_mod.next_key(), jnp.log(self.probs_), shape=tuple(shape) + self._batch_shape + (n,)
+        )
+        return Tensor(jax.nn.one_hot(draws, k).sum(-2))
+
+    def log_prob(self, value):
+        v = _v(value)
+        logp = jnp.log(jnp.clip(self.probs_, 1e-30, None))
+        coeff = jax.scipy.special.gammaln(jnp.asarray(self.total_count + 1.0)) - jnp.sum(
+            jax.scipy.special.gammaln(v + 1.0), -1
+        )
+        return Tensor(coeff + jnp.sum(v * logp, -1))
+
+
+class LogNormal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.base = Normal(loc, scale)
+        super().__init__(self.base._batch_shape)
+
+    def sample(self, shape=()):
+        return Tensor(jnp.exp(_v(self.base.sample(shape))))
+
+    def log_prob(self, value):
+        v = _v(value)
+        return Tensor(_v(self.base.log_prob(Tensor(jnp.log(v)))) - jnp.log(v))
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _v(loc).astype(jnp.float32)
+        self.scale = _v(scale).astype(jnp.float32)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self._batch_shape
+        return Tensor(self.loc + self.scale * jax.random.laplace(rng_mod.next_key(), shape))
+
+    def log_prob(self, value):
+        v = _v(value)
+        return Tensor(-jnp.abs(v - self.loc) / self.scale - jnp.log(2 * self.scale))
+
+
+class Poisson(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = _v(rate).astype(jnp.float32)
+        super().__init__(self.rate.shape)
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self._batch_shape
+        return Tensor(jax.random.poisson(rng_mod.next_key(), self.rate, shape).astype(jnp.float32))
+
+    def log_prob(self, value):
+        v = _v(value)
+        return Tensor(v * jnp.log(self.rate) - self.rate - jax.scipy.special.gammaln(v + 1))
+
+
+class Geometric(Distribution):
+    def __init__(self, probs, name=None):
+        self.probs_ = _v(probs).astype(jnp.float32)
+        super().__init__(self.probs_.shape)
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self._batch_shape
+        u = jax.random.uniform(rng_mod.next_key(), shape)
+        return Tensor(jnp.floor(jnp.log1p(-u) / jnp.log1p(-self.probs_)))
+
+    def log_prob(self, value):
+        v = _v(value)
+        return Tensor(v * jnp.log1p(-self.probs_) + jnp.log(self.probs_))
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _v(loc).astype(jnp.float32)
+        self.scale = _v(scale).astype(jnp.float32)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self._batch_shape
+        return Tensor(self.loc + self.scale * jax.random.gumbel(rng_mod.next_key(), shape))
+
+    def log_prob(self, value):
+        z = (_v(value) - self.loc) / self.scale
+        return Tensor(-(z + jnp.exp(-z)) - jnp.log(self.scale))
+
+
+def kl_divergence(p: Distribution, q: Distribution):
+    if isinstance(p, Normal) and isinstance(q, Normal):
+        var_ratio = (p.scale / q.scale) ** 2
+        t1 = ((p.loc - q.loc) / q.scale) ** 2
+        return Tensor(0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio)))
+    if isinstance(p, Categorical) and isinstance(q, Categorical):
+        logp = jax.nn.log_softmax(p.logits, -1)
+        logq = jax.nn.log_softmax(q.logits, -1)
+        return Tensor(jnp.sum(jnp.exp(logp) * (logp - logq), -1))
+    if isinstance(p, Uniform) and isinstance(q, Uniform):
+        return Tensor(jnp.log((q.high - q.low) / (p.high - p.low)))
+    # generic Monte-Carlo fallback
+    x = p.sample((256,))
+    return Tensor(jnp.mean(_v(p.log_prob(x)) - _v(q.log_prob(x)), axis=0))

@@ -1,0 +1,83 @@
+"""Places + save/load (``paddle.framework`` / ``paddle.save`` analog).
+
+Serialization format: a pickle of nested dicts with numpy leaves — pickle-
+compatible with the reference's ``paddle.save`` capability
+(``python/paddle/framework/io.py``).  Distributed sharded checkpoints live in
+``paddle_tpu.distributed.checkpoint``.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any
+
+import numpy as np
+
+from .core.tensor import Parameter, Tensor
+
+
+class Place:
+    def __init__(self, id=0):
+        self.id = id
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.id})"
+
+
+class CPUPlace(Place):
+    pass
+
+
+class CUDAPlace(Place):
+    pass
+
+
+class TPUPlace(Place):
+    pass
+
+
+class CUDAPinnedPlace(Place):
+    pass
+
+
+def _to_saveable(obj: Any) -> Any:
+    if isinstance(obj, Tensor):
+        return {"__tensor__": True, "data": np.asarray(obj._value),
+                "stop_gradient": obj.stop_gradient,
+                "param": isinstance(obj, Parameter)}
+    if isinstance(obj, dict):
+        return {k: _to_saveable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_to_saveable(v) for v in obj)
+    return obj
+
+
+def _from_saveable(obj: Any) -> Any:
+    if isinstance(obj, dict):
+        if obj.get("__tensor__"):
+            cls = Parameter if obj.get("param") else Tensor
+            if cls is Parameter:
+                t = Parameter(obj["data"], trainable=not obj.get("stop_gradient", False))
+            else:
+                t = Tensor(obj["data"], stop_gradient=obj.get("stop_gradient", True))
+            return t
+        return {k: _from_saveable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_from_saveable(v) for v in obj)
+    return obj
+
+
+def save(obj: Any, path: str, protocol: int = 4, **kwargs):
+    """``paddle.save`` analog."""
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_saveable(obj), f, protocol=protocol)
+
+
+def load(path: str, **kwargs) -> Any:
+    """``paddle.load`` analog."""
+    with open(path, "rb") as f:
+        return _from_saveable(pickle.load(f))

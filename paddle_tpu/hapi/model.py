@@ -1,0 +1,167 @@
+"""High-level ``paddle.Model`` (``python/paddle/hapi/model.py:1052`` capability):
+prepare / fit / evaluate / predict / save / load / summary."""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional
+
+import numpy as np
+
+from .. import framework
+from ..core.tensor import Tensor
+from ..metric import Metric
+from ..nn.layers import Layer
+
+
+class Model:
+    def __init__(self, network: Layer, inputs=None, labels=None):
+        self.network = network
+        self._optimizer = None
+        self._loss = None
+        self._metrics: List[Metric] = []
+        self.stop_training = False
+
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        if metrics is not None:
+            self._metrics = metrics if isinstance(metrics, (list, tuple)) else [metrics]
+        return self
+
+    def _run_batch(self, inputs, labels, train: bool):
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        labels = labels if isinstance(labels, (list, tuple)) else [labels]
+        preds = self.network(*inputs)
+        preds_list = preds if isinstance(preds, (list, tuple)) else [preds]
+        loss = self._loss(*preds_list, *labels) if self._loss else None
+        if train:
+            loss.backward()
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+            if hasattr(self._optimizer, "_lr") and hasattr(self._optimizer._lr, "step"):
+                self._optimizer._lr.step()
+        metric_out = []
+        for m in self._metrics:
+            res = m.compute(preds_list[0], labels[0])
+            metric_out.append(m.update(res))
+        return loss, metric_out
+
+    def train_batch(self, inputs, labels=None, update=True):
+        self.network.train()
+        loss, metrics = self._run_batch(inputs, labels, train=update)
+        return [float(loss)] if loss is not None else [], metrics
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        loss, metrics = self._run_batch(inputs, labels, train=False)
+        return [float(loss)] if loss is not None else [], metrics
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        preds = self.network(*inputs)
+        preds_list = preds if isinstance(preds, (list, tuple)) else [preds]
+        return [p.numpy() for p in preds_list]
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None, **kwargs):
+        from ..io import DataLoader
+        from ..io.dataset import Dataset
+
+        if isinstance(train_data, Dataset):
+            train_loader = DataLoader(train_data, batch_size=batch_size, shuffle=shuffle,
+                                      drop_last=drop_last, num_workers=num_workers)
+        else:
+            train_loader = train_data
+        for epoch in range(epochs):
+            self.network.train()
+            for m in self._metrics:
+                m.reset()
+            t0 = time.time()
+            losses = []
+            for step, batch in enumerate(train_loader):
+                inputs, labels = batch[:-1], batch[-1:]
+                loss, metrics = self._run_batch(list(inputs), list(labels), train=True)
+                losses.append(float(loss))
+                if verbose and step % log_freq == 0:
+                    mstr = " ".join(
+                        f"{m.name() if isinstance(m.name(), str) else m.name()[0]}:"
+                        f" {m.accumulate() if not isinstance(m.accumulate(), list) else m.accumulate()[0]:.4f}"
+                        for m in self._metrics
+                    )
+                    print(f"Epoch {epoch + 1}/{epochs} step {step} loss: {losses[-1]:.4f} {mstr}")
+            if verbose:
+                print(f"Epoch {epoch + 1}: avg loss {np.mean(losses):.4f} "
+                      f"({time.time() - t0:.1f}s)")
+            if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                self.evaluate(eval_data, batch_size=batch_size, verbose=verbose)
+            if save_dir and (epoch + 1) % save_freq == 0:
+                self.save(f"{save_dir}/epoch{epoch + 1}")
+        return self
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, **kwargs):
+        from ..io import DataLoader
+        from ..io.dataset import Dataset
+
+        if isinstance(eval_data, Dataset):
+            loader = DataLoader(eval_data, batch_size=batch_size, num_workers=num_workers)
+        else:
+            loader = eval_data
+        self.network.eval()
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        for batch in loader:
+            inputs, labels = batch[:-1], batch[-1:]
+            loss, _ = self._run_batch(list(inputs), list(labels), train=False)
+            if loss is not None:
+                losses.append(float(loss))
+        result = {"loss": [float(np.mean(losses))] if losses else []}
+        for m in self._metrics:
+            name = m.name() if isinstance(m.name(), str) else m.name()[0]
+            result[name] = m.accumulate()
+        if verbose:
+            print("Eval:", result)
+        return result
+
+    def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False,
+                callbacks=None, verbose=1):
+        from ..io import DataLoader
+        from ..io.dataset import Dataset
+
+        if isinstance(test_data, Dataset):
+            loader = DataLoader(test_data, batch_size=batch_size, num_workers=num_workers)
+        else:
+            loader = test_data
+        outputs = []
+        for batch in loader:
+            inputs = batch[:-1] if isinstance(batch, (list, tuple)) and len(batch) > 1 else (
+                batch if not isinstance(batch, (list, tuple)) else batch[:1])
+            outputs.append(self.predict_batch(list(inputs) if isinstance(inputs, (list, tuple)) else [inputs]))
+        return outputs
+
+    def save(self, path, training=True):
+        framework.save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            framework.save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        state = framework.load(path + ".pdparams")
+        self.network.set_state_dict(state)
+        import os
+
+        if not reset_optimizer and self._optimizer is not None and os.path.exists(path + ".pdopt"):
+            self._optimizer.set_state_dict(framework.load(path + ".pdopt"))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    def summary(self, input_size=None, dtype=None):
+        n_params = sum(p.size for p in self.network.parameters())
+        lines = [repr(self.network), f"Total params: {n_params:,}"]
+        out = "\n".join(lines)
+        print(out)
+        return {"total_params": n_params}

@@ -1,0 +1,160 @@
+"""DataLoader (``python/paddle/io/reader.py:216`` + multiprocess workers
+``io/dataloader/worker.py`` capability).
+
+TPU-first design: batches are collated to numpy on host workers, then moved
+to device with an async double-buffered prefetcher so the accelerator never
+waits on host IO (SURVEY.md §7 hard part (e)).  ``num_workers>0`` uses a
+process pool for CPU-bound datasets; a thread prefetcher always overlaps the
+host->device copy with compute.
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+from concurrent.futures import ProcessPoolExecutor, ThreadPoolExecutor
+from typing import Any, Callable, Optional
+
+import jax
+import numpy as np
+
+from ..core.tensor import Tensor
+from .dataset import Dataset, IterableDataset
+from .sampler import BatchSampler, RandomSampler, SequenceSampler
+
+_worker_info = None
+
+
+def get_worker_info():
+    return _worker_info
+
+
+class WorkerInfo:
+    def __init__(self, id, num_workers, dataset):
+        self.id = id
+        self.num_workers = num_workers
+        self.dataset = dataset
+
+
+def default_collate_fn(batch):
+    """Stack samples into batched numpy arrays (paddle default_collate_fn)."""
+    sample = batch[0]
+    if isinstance(sample, Tensor):
+        return np.stack([np.asarray(s._value) for s in batch])
+    if isinstance(sample, np.ndarray):
+        return np.stack(batch)
+    if isinstance(sample, (int, float, np.number)):
+        return np.asarray(batch)
+    if isinstance(sample, (list, tuple)):
+        transposed = zip(*batch)
+        return type(sample)(default_collate_fn(list(s)) for s in transposed)
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([d[k] for d in batch]) for k in sample}
+    return batch
+
+
+def _to_device(batch):
+    if isinstance(batch, np.ndarray):
+        return Tensor(jax.device_put(batch))
+    if isinstance(batch, (list, tuple)):
+        return type(batch)(_to_device(b) for b in batch)
+    if isinstance(batch, dict):
+        return {k: _to_device(v) for k, v in batch.items()}
+    return batch
+
+
+class DataLoader:
+    def __init__(self, dataset, feed_list=None, places=None, return_list=True,
+                 batch_sampler=None, batch_size=1, shuffle=False, drop_last=False,
+                 collate_fn=None, num_workers=0, use_buffer_reader=True,
+                 prefetch_factor=2, use_shared_memory=True, timeout=0,
+                 worker_init_fn=None, persistent_workers=False):
+        self.dataset = dataset
+        self.collate_fn = collate_fn or default_collate_fn
+        self.num_workers = num_workers
+        self.prefetch_factor = max(prefetch_factor, 2)
+        self.use_buffer_reader = use_buffer_reader
+        self.worker_init_fn = worker_init_fn
+        self._iterable = isinstance(dataset, IterableDataset)
+        if self._iterable:
+            self.batch_sampler = None
+            self.batch_size = batch_size
+            self.drop_last = drop_last
+        elif batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+        else:
+            self.batch_sampler = BatchSampler(dataset=dataset, shuffle=shuffle,
+                                              batch_size=batch_size, drop_last=drop_last)
+        self._pool = None
+
+    def __len__(self):
+        if self._iterable:
+            raise TypeError("length of IterableDataset DataLoader is undefined")
+        return len(self.batch_sampler)
+
+    def _fetch(self, indices):
+        return self.collate_fn([self.dataset[i] for i in indices])
+
+    def _batches_iterable(self):
+        it = iter(self.dataset)
+        while True:
+            chunk = list(itertools.islice(it, self.batch_size))
+            if not chunk:
+                return
+            if len(chunk) < self.batch_size and self.drop_last:
+                return
+            yield self.collate_fn(chunk)
+
+    def _raw_batches(self):
+        if self._iterable:
+            yield from self._batches_iterable()
+            return
+        if self.num_workers > 0:
+            # keep a persistent thread pool: dataset access + collate run
+            # concurrently with device compute (shared-memory queue analog)
+            if self._pool is None:
+                self._pool = ThreadPoolExecutor(max_workers=self.num_workers)
+            futures = []
+            sampler_it = iter(self.batch_sampler)
+            for indices in itertools.islice(sampler_it, self.num_workers * self.prefetch_factor):
+                futures.append(self._pool.submit(self._fetch, indices))
+            for indices in sampler_it:
+                done = futures.pop(0)
+                futures.append(self._pool.submit(self._fetch, indices))
+                yield done.result()
+            for fut in futures:
+                yield fut.result()
+        else:
+            for indices in self.batch_sampler:
+                yield self._fetch(indices)
+
+    def __iter__(self):
+        if not self.use_buffer_reader:
+            for b in self._raw_batches():
+                yield _to_device(b)
+            return
+        # async device prefetch: one batch in flight ahead of compute
+        q: "queue.Queue" = queue.Queue(maxsize=self.prefetch_factor)
+        sentinel = object()
+        err = []
+
+        def producer():
+            try:
+                for b in self._raw_batches():
+                    q.put(_to_device(b))
+            except Exception as e:  # propagate to consumer
+                err.append(e)
+            finally:
+                q.put(sentinel)
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is sentinel:
+                break
+            yield item
+        t.join()
+        if err:
+            raise err[0]

@@ -1,0 +1,56 @@
+"""``paddle.nn`` namespace (layer zoo inventory per SURVEY.md §2.2)."""
+
+from . import functional  # noqa: F401
+from . import initializer  # noqa: F401
+from .activation import *  # noqa: F401,F403
+from .clip import (  # noqa: F401
+    ClipGradByGlobalNorm,
+    ClipGradByNorm,
+    ClipGradByValue,
+)
+from .common import *  # noqa: F401,F403
+from .container import LayerDict, LayerList, ParameterList, Sequential  # noqa: F401
+from .conv import (  # noqa: F401
+    Conv1D,
+    Conv1DTranspose,
+    Conv2D,
+    Conv2DTranspose,
+    Conv3D,
+    Conv3DTranspose,
+)
+from .layers import Layer  # noqa: F401
+from .loss import *  # noqa: F401,F403
+from .norm import (  # noqa: F401
+    BatchNorm,
+    BatchNorm1D,
+    BatchNorm2D,
+    BatchNorm3D,
+    GroupNorm,
+    InstanceNorm1D,
+    InstanceNorm2D,
+    InstanceNorm3D,
+    LayerNorm,
+    LocalResponseNorm,
+    RMSNorm,
+    SpectralNorm,
+    SyncBatchNorm,
+)
+from .pooling import *  # noqa: F401,F403
+from .rnn import (  # noqa: F401
+    GRU,
+    LSTM,
+    BiRNN,
+    GRUCell,
+    LSTMCell,
+    RNN,
+    SimpleRNN,
+    SimpleRNNCell,
+)
+from .transformer import (  # noqa: F401
+    MultiHeadAttention,
+    Transformer,
+    TransformerDecoder,
+    TransformerDecoderLayer,
+    TransformerEncoder,
+    TransformerEncoderLayer,
+)

@@ -1,0 +1,106 @@
+"""Gradient clipping (``python/paddle/nn/clip.py`` capability)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+
+class ClipGradBase:
+    def _clip(self, params_grads):
+        raise NotImplementedError
+
+    def __call__(self, params_grads):
+        return self._clip(params_grads)
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = max
+        self.min = -max if min is None else min
+
+    def _clip(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or (hasattr(p, "need_clip") and not p.need_clip):
+                out.append((p, g))
+                continue
+            out.append((p, Tensor(jnp.clip(g._value, self.min, self.max))))
+        return out
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = clip_norm
+
+    def _clip(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or (hasattr(p, "need_clip") and not p.need_clip):
+                out.append((p, g))
+                continue
+            n = jnp.sqrt(jnp.sum(jnp.square(g._value.astype(jnp.float32))))
+            scale = jnp.where(n > self.clip_norm, self.clip_norm / jnp.maximum(n, 1e-12), 1.0)
+            out.append((p, Tensor((g._value * scale).astype(g._value.dtype))))
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    """Global-norm clip; under hybrid parallel, the distributed optimizer wraps
+    this to all-reduce the squared norm across model-parallel shards first
+    (fleet hybrid_parallel_gradscale capability)."""
+
+    def __init__(self, clip_norm, group_name="default_group", auto_skip_clip=False):
+        self.clip_norm = clip_norm
+
+    def _global_norm_sq(self, params_grads):
+        total = None
+        for p, g in params_grads:
+            if g is None or (hasattr(p, "need_clip") and not p.need_clip):
+                continue
+            s = jnp.sum(jnp.square(g._value.astype(jnp.float32)))
+            total = s if total is None else total + s
+        return total
+
+    def _clip(self, params_grads):
+        total = self._global_norm_sq(params_grads)
+        if total is None:
+            return params_grads
+        gn = jnp.sqrt(total)
+        scale = jnp.where(gn > self.clip_norm, self.clip_norm / jnp.maximum(gn, 1e-12), 1.0)
+        out = []
+        for p, g in params_grads:
+            if g is None or (hasattr(p, "need_clip") and not p.need_clip):
+                out.append((p, g))
+                continue
+            out.append((p, Tensor((g._value * scale).astype(g._value.dtype))))
+        return out
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0, error_if_nonfinite=False):
+    from ..core.tensor import Tensor as T
+
+    params = [p for p in (parameters if isinstance(parameters, (list, tuple)) else [parameters])
+              if p.grad is not None]
+    if not params:
+        return None
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack([jnp.max(jnp.abs(p.grad._value)) for p in params]))
+    else:
+        total = jnp.sum(jnp.stack(
+            [jnp.sum(jnp.abs(p.grad._value.astype(jnp.float32)) ** norm_type) for p in params]
+        )) ** (1.0 / norm_type)
+    scale = jnp.minimum(max_norm / (total + 1e-6), 1.0)
+    for p in params:
+        p.grad = T(p.grad._value * scale)
+    return T(total)
+
+
+def clip_grad_value_(parameters, clip_value):
+    from ..core.tensor import Tensor as T
+
+    params = parameters if isinstance(parameters, (list, tuple)) else [parameters]
+    for p in params:
+        if p.grad is not None:
+            p.grad = T(jnp.clip(p.grad._value, -clip_value, clip_value))

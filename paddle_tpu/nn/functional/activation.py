@@ -1,0 +1,184 @@
+"""Activation functionals (``python/paddle/nn/functional/activation.py``)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import run_op
+from ...core.tensor import Tensor, to_tensor
+
+
+def _ensure(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def _unary(name, fn):
+    def op(x, name=None):
+        return run_op(name, fn, _ensure(x))
+
+    op.__name__ = name
+    return op
+
+
+relu = _unary("relu", jax.nn.relu)
+relu6 = _unary("relu6", jax.nn.relu6)
+sigmoid = _unary("sigmoid", jax.nn.sigmoid)
+tanh = _unary("tanh", jnp.tanh)
+silu = _unary("silu", jax.nn.silu)
+swish = silu
+mish = _unary("mish", lambda v: v * jnp.tanh(jax.nn.softplus(v)))
+tanhshrink = _unary("tanhshrink", lambda v: v - jnp.tanh(v))
+softsign = _unary("softsign", jax.nn.soft_sign)
+log_sigmoid = _unary("log_sigmoid", jax.nn.log_sigmoid)
+hardsigmoid = _unary("hardsigmoid", lambda v: jnp.clip(v / 6.0 + 0.5, 0.0, 1.0))
+hardswish = _unary("hardswish", lambda v: v * jnp.clip(v / 6.0 + 0.5, 0.0, 1.0))
+
+
+def gelu(x, approximate=False, name=None):
+    return run_op("gelu", lambda v: jax.nn.gelu(v, approximate=approximate), _ensure(x))
+
+
+def elu(x, alpha=1.0, name=None):
+    return run_op("elu", lambda v: jax.nn.elu(v, alpha=alpha), _ensure(x))
+
+
+def elu_(x, alpha=1.0, name=None):
+    return x._rebind(elu(x, alpha))
+
+
+def celu(x, alpha=1.0, name=None):
+    return run_op("celu", lambda v: jax.nn.celu(v, alpha=alpha), _ensure(x))
+
+
+def selu(
+    x,
+    scale=1.0507009873554804934193349852946,
+    alpha=1.6732632423543772848170429916717,
+    name=None,
+):
+    return run_op(
+        "selu", lambda v: scale * jnp.where(v > 0, v, alpha * jnp.expm1(v)), _ensure(x)
+    )
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return run_op("leaky_relu", lambda v: jax.nn.leaky_relu(v, negative_slope), _ensure(x))
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def f(v, w):
+        if w.size == 1:
+            return jnp.where(v > 0, v, w.reshape(()) * v)
+        c_axis = 1 if data_format == "NCHW" else v.ndim - 1
+        shape = [1] * v.ndim
+        shape[c_axis] = w.size
+        return jnp.where(v > 0, v, w.reshape(shape) * v)
+
+    return run_op("prelu", f, _ensure(x), _ensure(weight))
+
+
+def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=True, name=None):
+    from ...core import random as rng
+
+    def f(v):
+        if training:
+            a = jax.random.uniform(rng.next_key(), v.shape, v.dtype, lower, upper)
+        else:
+            a = (lower + upper) / 2.0
+        return jnp.where(v >= 0, v, a * v)
+
+    return run_op("rrelu", f, _ensure(x))
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return run_op("hardtanh", lambda v: jnp.clip(v, min, max), _ensure(x))
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return run_op(
+        "hardshrink", lambda v: jnp.where(jnp.abs(v) > threshold, v, 0.0), _ensure(x)
+    )
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return run_op(
+        "softshrink",
+        lambda v: jnp.where(v > threshold, v - threshold, jnp.where(v < -threshold, v + threshold, 0.0)),
+        _ensure(x),
+    )
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return run_op(
+        "softplus",
+        lambda v: jnp.where(beta * v > threshold, v, jax.nn.softplus(beta * v) / beta),
+        _ensure(x),
+    )
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    return run_op(
+        "thresholded_relu", lambda v: jnp.where(v > threshold, v, value), _ensure(x)
+    )
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    from ...core import dtype as dtype_mod
+
+    d = dtype_mod.convert_dtype(dtype)
+
+    def f(v):
+        if d is not None:
+            v = v.astype(d)
+        return jax.nn.softmax(v, axis=axis)
+
+    return run_op("softmax", f, _ensure(x))
+
+
+def softmax_(x, axis=-1, dtype=None, name=None):
+    return x._rebind(softmax(x, axis, dtype))
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    from ...core import dtype as dtype_mod
+
+    d = dtype_mod.convert_dtype(dtype)
+
+    def f(v):
+        if d is not None:
+            v = v.astype(d)
+        return jax.nn.log_softmax(v, axis=axis)
+
+    return run_op("log_softmax", f, _ensure(x))
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ...core import random as rng
+
+    def f(v):
+        g = jax.random.gumbel(rng.next_key(), v.shape, v.dtype)
+        y = jax.nn.softmax((v + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            y_hard = jnp.zeros_like(y)
+            y_hard = jnp.put_along_axis(y_hard, idx, 1.0, axis=axis, inplace=False)
+            y = y_hard - jax.lax.stop_gradient(y) + y  # straight-through estimator
+        return y
+
+    return run_op("gumbel_softmax", f, _ensure(x))
+
+
+def maxout(x, groups, axis=1, name=None):
+    def f(v):
+        c = v.shape[axis]
+        new_shape = list(v.shape)
+        new_shape[axis] = c // groups
+        new_shape.insert(axis + 1, groups)
+        return jnp.max(v.reshape(new_shape), axis=axis + 1)
+
+    return run_op("maxout", f, _ensure(x))
+
+
+def glu(x, axis=-1, name=None):
+    return run_op("glu", lambda v: jax.nn.glu(v, axis=axis), _ensure(x))

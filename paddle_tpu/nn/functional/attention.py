@@ -1,0 +1,99 @@
+"""Attention functionals.
+
+Capability analog of the reference's flash-attention binding
+(``paddle/phi/kernels/gpu/flash_attn_kernel.cu``) and
+``paddle.nn.functional.scaled_dot_product_attention``.  The default path is
+XLA (which fuses the softmax chain); ``paddle_tpu.ops.flash_attention``
+provides the fused Pallas kernel used automatically for long sequences.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import run_op
+from ...core.tensor import Tensor, to_tensor
+
+
+def _ensure(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.0,
+                                 is_causal=False, training=True, name=None):
+    """Inputs [B, S, H, D] (paddle flash-attn layout). Returns [B, S, H, D]."""
+    from ...ops.flash_attention import flash_attention_fwd, use_flash
+
+    q, k, v = _ensure(query), _ensure(key), _ensure(value)
+    if use_flash(q.shape, attn_mask):
+        return flash_attention(q, k, v, dropout=dropout_p, causal=is_causal)[0]
+
+    def f(qv, kv, vv, *m):
+        B, Sq, H, D = qv.shape
+        scale = 1.0 / math.sqrt(D)
+        qh = jnp.swapaxes(qv, 1, 2)  # B,H,S,D
+        kh = jnp.swapaxes(kv, 1, 2)
+        vh = jnp.swapaxes(vv, 1, 2)
+        logits = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) * scale
+        if m:
+            logits = logits + m[0]
+        if is_causal:
+            Sk = kh.shape[2]
+            mask = jnp.tril(jnp.ones((Sq, Sk), bool), k=Sk - Sq)
+            logits = jnp.where(mask, logits, jnp.finfo(logits.dtype).min)
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(qv.dtype)
+        if dropout_p > 0.0 and training:
+            from ...core import random as rng
+
+            keep = jax.random.bernoulli(rng.next_key(), 1 - dropout_p, probs.shape)
+            probs = jnp.where(keep, probs / (1 - dropout_p), 0.0).astype(probs.dtype)
+        out = jnp.einsum("bhqk,bhkd->bhqd", probs, vh)
+        return jnp.swapaxes(out, 1, 2)
+
+    args = [q, k, v]
+    if attn_mask is not None:
+        args.append(_ensure(attn_mask))
+    return run_op("attention", f, *args)
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False, return_softmax=False,
+                    fixed_seed_offset=None, rng_name="", training=True, name=None):
+    """paddle.nn.functional.flash_attention.flash_attention analog.
+
+    Routes to the Pallas fused kernel (paddle_tpu/ops/flash_attention.py) on
+    TPU; falls back to the XLA composite path elsewhere. Returns (out, softmax).
+    """
+    from ...ops import flash_attention as fa
+
+    q, k, v = _ensure(query), _ensure(key), _ensure(value)
+    out = run_op(
+        "flash_attention",
+        lambda qv, kv, vv: fa.flash_attention_fwd(qv, kv, vv, causal=causal),
+        q, k, v,
+    )
+    if dropout > 0.0 and training:
+        from .common import dropout as dropout_fn
+
+        out = dropout_fn(out, dropout)
+    return out, None
+
+
+def flash_attn_unpadded(q, k, v, cu_seqlens_q, cu_seqlens_k, max_seqlen_q, max_seqlen_k,
+                        scale, dropout=0.0, causal=False, return_softmax=False, name=None):
+    raise NotImplementedError(
+        "varlen flash attention: pack to dense [B,S,H,D] + mask; paged serving "
+        "uses paddle_tpu.ops.paged_attention"
+    )
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    from ...core import dtype as dtype_mod
+
+    def f(v):
+        m = maxlen if maxlen is not None else int(v.max())
+        return (jnp.arange(m)[None, :] < v[..., None]).astype(dtype_mod.convert_dtype(dtype))
+
+    return run_op("sequence_mask", f, _ensure(x))

@@ -1,0 +1,343 @@
+"""Common functionals: linear, dropout, pad, embedding, interpolate, one_hot
+(``python/paddle/nn/functional/common.py`` + ``input.py`` capability)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core import dtype as dtype_mod
+from ...core import random as rng
+from ...core.dispatch import run_op
+from ...core.tensor import Tensor, to_tensor
+
+
+def _ensure(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W + b, W shape [in, out] (paddle convention).
+
+    The single hottest op — lowers to one MXU matmul; bias fuses as epilogue.
+    """
+    if bias is None:
+        return run_op("linear", lambda v, w: jnp.matmul(v, w), _ensure(x), _ensure(weight))
+    return run_op(
+        "linear", lambda v, w, b: jnp.matmul(v, w) + b, _ensure(x), _ensure(weight), _ensure(bias)
+    )
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
+    if not training or p == 0.0:
+        x = _ensure(x)
+        if not training and p > 0.0 and mode == "downscale_in_infer":
+            # this mode leaves train-time activations unscaled, so inference
+            # must multiply by the keep probability (paddle semantics)
+            return run_op("dropout_infer", lambda v: (v * (1.0 - p)).astype(v.dtype), x)
+        return x
+
+    def f(v):
+        shape = list(v.shape)
+        if axis is not None:
+            axes = [axis] if isinstance(axis, int) else list(axis)
+            shape = [s if i in axes else 1 for i, s in enumerate(shape)]
+        keep = jax.random.bernoulli(rng.next_key(), 1.0 - p, tuple(shape))
+        if mode == "upscale_in_train":
+            return jnp.where(keep, v / (1.0 - p), 0.0).astype(v.dtype)
+        return jnp.where(keep, v, 0.0).astype(v.dtype)
+
+    return run_op("dropout", f, _ensure(x))
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    axis = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p, axis=axis, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    axis = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p, axis=axis, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return _ensure(x)
+
+    def f(v):
+        alpha = 1.6732632423543772
+        scale = 1.0507009873554805
+        alpha_p = -alpha * scale
+        keep = jax.random.bernoulli(rng.next_key(), 1.0 - p, v.shape)
+        a = (1.0 / ((1.0 - p) * (1.0 + p * alpha_p**2)) ** 0.5)
+        b = -a * alpha_p * p
+        return (a * jnp.where(keep, v, alpha_p) + b).astype(v.dtype)
+
+    return run_op("alpha_dropout", f, _ensure(x))
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", pad_from_left_axis=True, name=None):
+    """paddle.nn.functional.pad: pad is [left,right,...] per trailing dims or
+    full ndim*2 list; also accepts per-axis pairs for constant mode."""
+    if isinstance(pad, Tensor):
+        pad = [int(v) for v in np.asarray(pad._value)]
+    pad = list(pad)
+    x = _ensure(x)
+    nd = x.ndim
+    jmode = {"constant": "constant", "reflect": "reflect", "replicate": "edge", "circular": "wrap"}[mode]
+
+    if len(pad) == 2 * nd:
+        # full-rank paddle format: pairs ordered by axis
+        pairs = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+    else:
+        # spatial-dims format: [left, right, top, bottom, ...] — the FIRST
+        # pair pads the LAST spatial dim (W), matching paddle/torch.
+        n_spatial = len(pad) // 2
+        pairs = [(0, 0)] * nd
+        if data_format.endswith("C"):  # NHWC/NDHWC/NLC: spatial dims start at 1
+            spatial_axes = list(range(1, 1 + n_spatial))
+        else:  # NCHW: spatial dims are the last n_spatial
+            spatial_axes = list(range(nd - n_spatial, nd))
+        for i, a in enumerate(reversed(spatial_axes)):
+            pairs[a] = (pad[2 * i], pad[2 * i + 1])
+
+    def f(v):
+        if jmode == "constant":
+            return jnp.pad(v, pairs, mode="constant", constant_values=value)
+        return jnp.pad(v, pairs, mode=jmode)
+
+    return run_op("pad", f, x)
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    return pad(x, padding, mode="constant", value=0.0, data_format=data_format)
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, max_norm=None, norm_type=2.0, name=None):
+    def f(idx, w):
+        out = jnp.take(w, idx.astype(jnp.int32), axis=0)
+        if padding_idx is not None:
+            mask = (idx == padding_idx)[..., None]
+            out = jnp.where(mask, 0.0, out)
+        return out
+
+    return run_op("embedding", f, _ensure(x), _ensure(weight))
+
+
+def one_hot(x, num_classes, name=None):
+    return run_op(
+        "one_hot",
+        lambda v: jax.nn.one_hot(v.astype(jnp.int32), num_classes, dtype=jnp.float32),
+        _ensure(x),
+    )
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    def f(l):
+        k = l.shape[-1]
+        if prior_dist is not None:
+            pd = prior_dist._value if isinstance(prior_dist, Tensor) else jnp.asarray(prior_dist)
+            return (1 - epsilon) * l + epsilon * pd
+        return (1 - epsilon) * l + epsilon / k
+
+    return run_op("label_smooth", f, _ensure(label))
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
+    def f(a, b):
+        dot = jnp.sum(a * b, axis=axis)
+        na = jnp.sqrt(jnp.sum(a * a, axis=axis))
+        nb = jnp.sqrt(jnp.sum(b * b, axis=axis))
+        return dot / jnp.maximum(na * nb, eps)
+
+    return run_op("cosine_similarity", f, _ensure(x1), _ensure(x2))
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    def f(a, b):
+        d = a - b + epsilon
+        return jnp.sum(jnp.abs(d) ** p, axis=-1, keepdims=keepdim) ** (1.0 / p)
+
+    return run_op("pairwise_distance", f, _ensure(x), _ensure(y))
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    def f(a, b, w, *bias_arg):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        if bias_arg:
+            out = out + bias_arg[0]
+        return out
+
+    args = [_ensure(x1), _ensure(x2), _ensure(weight)]
+    if bias is not None:
+        args.append(_ensure(bias))
+    return run_op("bilinear", f, *args)
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest", align_corners=False,
+                align_mode=0, data_format="NCHW", name=None):
+    x = _ensure(x)
+    nd = x.ndim
+    channel_last = data_format.endswith("C")
+    spatial = nd - 2
+    in_spatial = x.shape[1:-1] if channel_last else x.shape[2:]
+
+    if size is not None:
+        if isinstance(size, Tensor):
+            size = [int(v) for v in np.asarray(size._value)]
+        out_spatial = [int(s._value) if isinstance(s, Tensor) else int(s) for s in (size if isinstance(size, (list, tuple)) else [size])]
+    else:
+        sf = scale_factor if isinstance(scale_factor, (list, tuple)) else [scale_factor] * spatial
+        out_spatial = [int(round(i * float(s))) for i, s in zip(in_spatial, sf)]
+
+    jmode = {
+        "nearest": "nearest",
+        "bilinear": "linear",
+        "linear": "linear",
+        "trilinear": "linear",
+        "bicubic": "cubic",
+        "area": "linear",
+    }[mode]
+
+    def f(v):
+        if channel_last:
+            target = (v.shape[0],) + tuple(out_spatial) + (v.shape[-1],)
+        else:
+            target = (v.shape[0], v.shape[1]) + tuple(out_spatial)
+        if jmode == "nearest":
+            return jax.image.resize(v, target, method="nearest")
+        if align_corners:
+            # jax.image.resize has no align_corners; emulate via explicit gather
+            return _resize_align_corners(v, target, jmode, channel_last)
+        return jax.image.resize(v, target, method=jmode)
+
+    return run_op("interpolate", f, x)
+
+
+def _resize_align_corners(v, target, method, channel_last):
+    spatial_axes = list(range(1, v.ndim - 1)) if channel_last else list(range(2, v.ndim))
+    out = v
+    for ax in spatial_axes:
+        n_in = out.shape[ax]
+        n_out = target[ax]
+        if n_in == n_out:
+            continue
+        if n_out == 1 or n_in == 1:
+            idx = jnp.zeros((n_out,), jnp.float32)
+        else:
+            idx = jnp.linspace(0.0, n_in - 1.0, n_out)
+        lo = jnp.floor(idx).astype(jnp.int32)
+        hi = jnp.clip(lo + 1, 0, n_in - 1)
+        w = (idx - lo).astype(out.dtype)
+        shape = [1] * out.ndim
+        shape[ax] = n_out
+        w = w.reshape(shape)
+        out = jnp.take(out, lo, axis=ax) * (1 - w) + jnp.take(out, hi, axis=ax) * w
+    return out
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest", align_corners=False,
+             align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode, data_format)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """im2col (paddle unfold): NCHW -> [N, C*kh*kw, L]."""
+    k = kernel_sizes if isinstance(kernel_sizes, (list, tuple)) else [kernel_sizes] * 2
+    s = strides if isinstance(strides, (list, tuple)) else [strides] * 2
+    p = paddings if isinstance(paddings, (list, tuple)) else [paddings] * 2
+    d = dilations if isinstance(dilations, (list, tuple)) else [dilations] * 2
+    if len(p) == 2:
+        p = [p[0], p[1], p[0], p[1]]
+
+    def f(v):
+        N, C, H, W = v.shape
+        v = jnp.pad(v, [(0, 0), (0, 0), (p[0], p[2]), (p[1], p[3])])
+        oh = (v.shape[2] - (d[0] * (k[0] - 1) + 1)) // s[0] + 1
+        ow = (v.shape[3] - (d[1] * (k[1] - 1) + 1)) // s[1] + 1
+        patches = []
+        for i in range(k[0]):
+            for j in range(k[1]):
+                sl = v[:, :, i * d[0] : i * d[0] + oh * s[0] : s[0],
+                       j * d[1] : j * d[1] + ow * s[1] : s[1]]
+                patches.append(sl)
+        # [k*k, N, C, oh, ow] -> [N, C*k*k, oh*ow]
+        st = jnp.stack(patches, axis=2)  # N, C, k*k, oh, ow
+        return st.reshape(N, C * k[0] * k[1], oh * ow)
+
+    return run_op("unfold", f, _ensure(x))
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """col2im (paddle fold): [N, C*kh*kw, L] -> NCHW."""
+    o = output_sizes if isinstance(output_sizes, (list, tuple)) else [output_sizes] * 2
+    k = kernel_sizes if isinstance(kernel_sizes, (list, tuple)) else [kernel_sizes] * 2
+    s = strides if isinstance(strides, (list, tuple)) else [strides] * 2
+    p = paddings if isinstance(paddings, (list, tuple)) else [paddings] * 2
+    d = dilations if isinstance(dilations, (list, tuple)) else [dilations] * 2
+    if len(p) == 2:
+        p = [p[0], p[1], p[0], p[1]]
+
+    def f(v):
+        N = v.shape[0]
+        C = v.shape[1] // (k[0] * k[1])
+        H, W = o[0] + p[0] + p[2], o[1] + p[1] + p[3]
+        oh = (H - (d[0] * (k[0] - 1) + 1)) // s[0] + 1
+        ow = (W - (d[1] * (k[1] - 1) + 1)) // s[1] + 1
+        v = v.reshape(N, C, k[0], k[1], oh, ow)
+        out = jnp.zeros((N, C, H, W), v.dtype)
+        for i in range(k[0]):
+            for j in range(k[1]):
+                out = out.at[:, :, i * d[0] : i * d[0] + oh * s[0] : s[0],
+                             j * d[1] : j * d[1] + ow * s[1] : s[1]].add(v[:, :, i, j])
+        return out[:, :, p[0] : H - p[2], p[1] : W - p[3]]
+
+    return run_op("fold", f, _ensure(x))
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = upscale_factor
+
+    def f(v):
+        if data_format == "NCHW":
+            N, C, H, W = v.shape
+            v = v.reshape(N, C // (r * r), r, r, H, W)
+            v = v.transpose(0, 1, 4, 2, 5, 3)
+            return v.reshape(N, C // (r * r), H * r, W * r)
+        N, H, W, C = v.shape
+        v = v.reshape(N, H, W, r, r, C // (r * r))
+        v = v.transpose(0, 1, 3, 2, 4, 5)
+        return v.reshape(N, H * r, W * r, C // (r * r))
+
+    return run_op("pixel_shuffle", f, _ensure(x))
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = downscale_factor
+
+    def f(v):
+        if data_format == "NCHW":
+            N, C, H, W = v.shape
+            v = v.reshape(N, C, H // r, r, W // r, r)
+            v = v.transpose(0, 1, 3, 5, 2, 4)
+            return v.reshape(N, C * r * r, H // r, W // r)
+        N, H, W, C = v.shape
+        v = v.reshape(N, H // r, r, W // r, r, C)
+        v = v.transpose(0, 1, 3, 2, 4, 5)
+        return v.reshape(N, H // r, W // r, C * r * r)
+
+    return run_op("pixel_unshuffle", f, _ensure(x))
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    def f(v):
+        if data_format == "NCHW":
+            N, C, H, W = v.shape
+            v = v.reshape(N, groups, C // groups, H, W)
+            return v.transpose(0, 2, 1, 3, 4).reshape(N, C, H, W)
+        N, H, W, C = v.shape
+        v = v.reshape(N, H, W, groups, C // groups)
+        return v.transpose(0, 1, 2, 4, 3).reshape(N, H, W, C)
+
+    return run_op("channel_shuffle", f, _ensure(x))

@@ -1,0 +1,166 @@
+"""Convolution functionals (``python/paddle/nn/functional/conv.py`` capability).
+
+All convs lower to ``jax.lax.conv_general_dilated`` — XLA maps these onto the
+MXU directly (the reference needs cuDNN, N7; here the compiler is the kernel
+library).  Paddle layouts: input NCHW (or NHWC), weight OIHW.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.dispatch import run_op
+from ...core.tensor import Tensor, to_tensor
+
+
+def _ensure(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def _tuple(v, n):
+    if isinstance(v, (list, tuple)):
+        if len(v) == n:
+            return tuple(int(x) for x in v)
+        if len(v) == 2 * n:  # paddle allows per-side [before0, after0, ...]
+            return tuple((int(v[2 * i]), int(v[2 * i + 1])) for i in range(n))
+        return tuple(int(v[0]) for _ in range(n))
+    return tuple(int(v) for _ in range(n))
+
+
+def _padding(padding, n, stride, kernel, dilation):
+    if isinstance(padding, str):
+        if padding.upper() == "SAME":
+            return "SAME"
+        if padding.upper() == "VALID":
+            return "VALID"
+        raise ValueError(padding)
+    p = _tuple(padding, n)
+    if p and isinstance(p[0], tuple):
+        return list(p)
+    return [(x, x) for x in p]
+
+
+def _dim_numbers(n, channel_last):
+    if n == 1:
+        return ("NCH", "OIH", "NCH") if not channel_last else ("NHC", "OIH", "NHC")
+    if n == 2:
+        return ("NCHW", "OIHW", "NCHW") if not channel_last else ("NHWC", "OIHW", "NHWC")
+    return ("NCDHW", "OIDHW", "NCDHW") if not channel_last else ("NDHWC", "OIDHW", "NDHWC")
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, n, data_format, name):
+    channel_last = data_format.endswith("C")
+    s = _tuple(stride, n)
+    d = _tuple(dilation, n)
+    pad = _padding(padding, n, s, None, d)
+    dn = _dim_numbers(n, channel_last)
+
+    def f(v, w, *b):
+        out = jax.lax.conv_general_dilated(
+            v, w,
+            window_strides=s,
+            padding=pad,
+            rhs_dilation=d,
+            dimension_numbers=dn,
+            feature_group_count=groups,
+            preferred_element_type=jnp.float32 if v.dtype == jnp.bfloat16 else None,
+        )
+        if v.dtype == jnp.bfloat16:
+            out = out.astype(v.dtype)
+        if b:
+            bias_shape = [1] * out.ndim
+            bias_shape[-1 if channel_last else 1] = -1
+            out = out + b[0].reshape(bias_shape)
+        return out
+
+    args = [_ensure(x), _ensure(weight)]
+    if bias is not None:
+        args.append(_ensure(bias))
+    return run_op(name, f, *args)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 1,
+                 "NHC" if data_format == "NLC" else "NCH", "conv1d")
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 2, data_format, "conv2d")
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 3, data_format, "conv3d")
+
+
+def _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
+                    groups, n, data_format, output_size, name):
+    channel_last = data_format.endswith("C")
+    s = _tuple(stride, n)
+    d = _tuple(dilation, n)
+    op = _tuple(output_padding, n) if not isinstance(output_padding, int) or output_padding else (0,) * n
+    if isinstance(padding, str):
+        raise NotImplementedError("string padding for conv_transpose")
+    p = _padding(padding, n, s, None, d)
+    dn = _dim_numbers(n, channel_last)
+
+    def f(v, w, *b):
+        # paddle transpose-conv weight layout: [in_c, out_c/groups, *k]
+        # conv_transpose gradient trick: use conv_general_dilated with lhs_dilation
+        k = w.shape[2:]
+        pads = []
+        for i in range(n):
+            eff_k = (k[i] - 1) * d[i] + 1
+            lo = eff_k - 1 - p[i][0]
+            hi = eff_k - 1 - p[i][1] + op[i]
+            pads.append((lo, hi))
+        if groups > 1:
+            w = w.reshape((groups, w.shape[0] // groups) + w.shape[1:])
+            w = jnp.flip(w, axis=tuple(range(3, 3 + n)))
+            w = jnp.swapaxes(w, 1, 2)  # [g, out/g, in/g, *k]
+            w = w.reshape((w.shape[0] * w.shape[1],) + w.shape[2:])
+        else:
+            w = jnp.flip(w, axis=tuple(range(2, 2 + n)))
+            w = jnp.swapaxes(w, 0, 1)
+        out = jax.lax.conv_general_dilated(
+            v, w,
+            window_strides=(1,) * n,
+            padding=pads,
+            lhs_dilation=s,
+            rhs_dilation=d,
+            dimension_numbers=dn,
+            feature_group_count=groups,
+        )
+        if b:
+            bias_shape = [1] * out.ndim
+            bias_shape[-1 if channel_last else 1] = -1
+            out = out + b[0].reshape(bias_shape)
+        return out
+
+    args = [_ensure(x), _ensure(weight)]
+    if bias is not None:
+        args.append(_ensure(bias))
+    return run_op(name, f, *args)
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCL", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
+                           groups, 1, "NHC" if data_format == "NLC" else "NCH",
+                           output_size, "conv1d_transpose")
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
+                           groups, 2, data_format, output_size, "conv2d_transpose")
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCDHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
+                           groups, 3, data_format, output_size, "conv3d_transpose")

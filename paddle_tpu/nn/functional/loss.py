@@ -1,0 +1,360 @@
+"""Loss functionals (``python/paddle/nn/functional/loss.py`` capability)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.dispatch import run_op
+from ...core.tensor import Tensor, to_tensor
+
+
+def _ensure(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def _reduce(v, reduction):
+    if reduction == "mean":
+        return jnp.mean(v)
+    if reduction == "sum":
+        return jnp.sum(v)
+    return v
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",
+                  soft_label=False, axis=-1, use_softmax=True, label_smoothing=0.0, name=None):
+    """softmax_with_cross_entropy analog (phi cross_entropy_with_softmax kernel)."""
+
+    def f(logits, lab, *w):
+        if use_softmax:
+            logp = jax.nn.log_softmax(logits, axis=axis)
+        else:
+            logp = jnp.log(jnp.clip(logits, 1e-30, None))
+        if soft_label or (lab.ndim == logits.ndim and jnp.issubdtype(lab.dtype, jnp.floating)):
+            soft = lab
+            if label_smoothing > 0:
+                k = logits.shape[axis]
+                soft = soft * (1 - label_smoothing) + label_smoothing / k
+            loss = -jnp.sum(soft * logp, axis=axis)
+            if w:
+                # per-sample weight = expected class weight under the soft label
+                wt = jnp.sum(soft * w[0], axis=axis)
+                loss = loss * wt
+                if reduction == "mean":
+                    return jnp.sum(loss) / jnp.maximum(jnp.sum(wt), 1e-12)
+        else:
+            lab_i = lab.astype(jnp.int32)
+            if lab_i.ndim == logits.ndim:
+                lab_i = jnp.squeeze(lab_i, axis=axis)
+            valid = lab_i != ignore_index
+            safe = jnp.where(valid, lab_i, 0)
+            picked = jnp.take_along_axis(
+                logp, jnp.expand_dims(safe, axis=axis), axis=axis
+            ).squeeze(axis)
+            if label_smoothing > 0:
+                k = logits.shape[axis]
+                smooth_loss = -jnp.mean(logp, axis=axis)
+                loss = -(1 - label_smoothing) * picked + label_smoothing * smooth_loss
+            else:
+                loss = -picked
+            loss = jnp.where(valid, loss, 0.0)
+            if w:
+                wt = jnp.take(w[0], safe, axis=0)
+                wt = jnp.where(valid, wt, 0.0)
+                loss = loss * wt
+                if reduction == "mean":
+                    return jnp.sum(loss) / jnp.maximum(jnp.sum(wt), 1e-12)
+            elif reduction == "mean":
+                denom = jnp.maximum(jnp.sum(valid.astype(loss.dtype)), 1.0)
+                return jnp.sum(loss) / denom
+        return _reduce(loss, reduction)
+
+    args = [_ensure(input), _ensure(label)]
+    if weight is not None:
+        args.append(_ensure(weight))
+    return run_op("cross_entropy", f, *args)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100,
+                               numeric_stable_mode=True, return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label, ignore_index=ignore_index,
+                         reduction="none", axis=axis)
+    from .activation import softmax as softmax_fn
+
+    loss = loss.unsqueeze(axis)
+    if return_softmax:
+        return loss, softmax_fn(logits, axis=axis)
+    return loss
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean", name=None):
+    def f(logp, lab, *w):
+        lab_i = lab.astype(jnp.int32)
+        valid = lab_i != ignore_index
+        safe = jnp.where(valid, lab_i, 0)
+        if logp.ndim == lab_i.ndim + 1:
+            # class axis is 1 (supports [N,C] and spatial [N,C,d1,...])
+            picked = jnp.take_along_axis(logp, jnp.expand_dims(safe, 1), axis=1)
+            picked = jnp.squeeze(picked, 1)
+        else:
+            picked = jnp.take_along_axis(logp, safe, axis=0)
+        loss = -picked
+        loss = jnp.where(valid, loss, 0.0)
+        if w:
+            wt = jnp.take(w[0], safe, axis=0) * valid.astype(logp.dtype)
+            loss = loss * wt
+            if reduction == "mean":
+                return jnp.sum(loss) / jnp.maximum(jnp.sum(wt), 1e-12)
+        return _reduce(loss, reduction)
+
+    args = [_ensure(input), _ensure(label)]
+    if weight is not None:
+        args.append(_ensure(weight))
+    return run_op("nll_loss", f, *args)
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return run_op(
+        "mse_loss", lambda a, b: _reduce((a - b) ** 2, reduction), _ensure(input), _ensure(label)
+    )
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return run_op(
+        "l1_loss", lambda a, b: _reduce(jnp.abs(a - b), reduction), _ensure(input), _ensure(label)
+    )
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def f(a, b):
+        d = jnp.abs(a - b)
+        loss = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
+        # paddle uses huber-style with delta multiplier
+        return _reduce(loss * delta, reduction)
+
+    return run_op("smooth_l1_loss", f, _ensure(input), _ensure(label))
+
+
+def huber_loss(input, label, delta=1.0, reduction="mean", name=None):
+    def f(a, b):
+        d = jnp.abs(a - b)
+        return _reduce(jnp.where(d <= delta, 0.5 * d * d, delta * (d - 0.5 * delta)), reduction)
+
+    return run_op("huber_loss", f, _ensure(input), _ensure(label))
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):
+    def f(p, lab, *w):
+        p = jnp.clip(p, 1e-12, 1 - 1e-12)
+        loss = -(lab * jnp.log(p) + (1 - lab) * jnp.log(1 - p))
+        if w:
+            loss = loss * w[0]
+        return _reduce(loss, reduction)
+
+    args = [_ensure(input), _ensure(label)]
+    if weight is not None:
+        args.append(_ensure(weight))
+    return run_op("binary_cross_entropy", f, *args)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean",
+                                     pos_weight=None, name=None):
+    def f(z, lab, *rest):
+        i = 0
+        w = None
+        pw = None
+        if weight is not None:
+            w = rest[i]; i += 1
+        if pos_weight is not None:
+            pw = rest[i]; i += 1
+        max_val = jnp.clip(-z, 0, None)
+        if pw is not None:
+            log_w = (pw - 1) * lab + 1
+            loss = (1 - lab) * z + log_w * (jnp.log(jnp.exp(-max_val) + jnp.exp(-z - max_val)) + max_val)
+        else:
+            loss = (1 - lab) * z + max_val + jnp.log(jnp.exp(-max_val) + jnp.exp(-z - max_val))
+        if w is not None:
+            loss = loss * w
+        return _reduce(loss, reduction)
+
+    args = [_ensure(logit), _ensure(label)]
+    if weight is not None:
+        args.append(_ensure(weight))
+    if pos_weight is not None:
+        args.append(_ensure(pos_weight))
+    return run_op("bce_with_logits", f, *args)
+
+
+def kl_div(input, label, reduction="mean", log_target=False, name=None):
+    def f(logp, target):
+        if log_target:
+            loss = jnp.exp(target) * (target - logp)
+        else:
+            t = jnp.clip(target, 1e-12, None)
+            loss = target * (jnp.log(t) - logp)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / logp.shape[0]
+        return _reduce(loss, reduction)
+
+    return run_op("kl_div", f, _ensure(input), _ensure(label))
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean", name=None):
+    def f(a, b, lab):
+        return _reduce(jnp.clip(-lab * (a - b) + margin, 0, None), reduction)
+
+    return run_op("margin_ranking_loss", f, _ensure(input), _ensure(other), _ensure(label))
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    def f(a, lab):
+        loss = jnp.where(lab == 1, a, jnp.clip(margin - a, 0, None))
+        return _reduce(loss, reduction)
+
+    return run_op("hinge_embedding_loss", f, _ensure(input), _ensure(label))
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0, reduction="mean", name=None):
+    def f(a, b, lab):
+        cos = jnp.sum(a * b, -1) / (jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1) + 1e-12)
+        loss = jnp.where(lab == 1, 1 - cos, jnp.clip(cos - margin, 0, None))
+        return _reduce(loss, reduction)
+
+    return run_op("cosine_embedding_loss", f, _ensure(input1), _ensure(input2), _ensure(label))
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0, epsilon=1e-6,
+                        swap=False, reduction="mean", name=None):
+    def f(a, pos, neg):
+        dp = jnp.sum(jnp.abs(a - pos + epsilon) ** p, -1) ** (1 / p)
+        dn = jnp.sum(jnp.abs(a - neg + epsilon) ** p, -1) ** (1 / p)
+        if swap:
+            dpn = jnp.sum(jnp.abs(pos - neg + epsilon) ** p, -1) ** (1 / p)
+            dn = jnp.minimum(dn, dpn)
+        return _reduce(jnp.clip(dp - dn + margin, 0, None), reduction)
+
+    return run_op("triplet_margin_loss", f, _ensure(input), _ensure(positive), _ensure(negative))
+
+
+def multi_label_soft_margin_loss(input, label, weight=None, reduction="mean", name=None):
+    def f(z, lab, *w):
+        loss = -(lab * jax.nn.log_sigmoid(z) + (1 - lab) * jax.nn.log_sigmoid(-z))
+        if w:
+            loss = loss * w[0]
+        return _reduce(jnp.mean(loss, -1), reduction)
+
+    args = [_ensure(input), _ensure(label)]
+    if weight is not None:
+        args.append(_ensure(weight))
+    return run_op("multi_label_soft_margin_loss", f, *args)
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    def f(z, lab):
+        return _reduce(jnp.log1p(jnp.exp(-lab * z)), reduction)
+
+    return run_op("soft_margin_loss", f, _ensure(input), _ensure(label))
+
+
+def square_error_cost(input, label):
+    return run_op("square_error_cost", lambda a, b: (a - b) ** 2, _ensure(input), _ensure(label))
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    def f(p, lab):
+        return -lab * jnp.log(p + epsilon) - (1 - lab) * jnp.log(1 - p + epsilon)
+
+    return run_op("log_loss", f, _ensure(input), _ensure(label))
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    def f(z, lab, *n):
+        p = jax.nn.sigmoid(z)
+        ce = (1 - lab) * z + jnp.clip(-z, 0, None) + jnp.log(jnp.exp(-jnp.abs(z)) + 1)
+        p_t = p * lab + (1 - p) * (1 - lab)
+        a_t = alpha * lab + (1 - alpha) * (1 - lab)
+        loss = a_t * ((1 - p_t) ** gamma) * ce
+        if n:
+            loss = loss / n[0]
+        return _reduce(loss, reduction)
+
+    args = [_ensure(logit), _ensure(label)]
+    if normalizer is not None:
+        args.append(_ensure(normalizer))
+    return run_op("sigmoid_focal_loss", f, *args)
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC via optax's implementation (warpctc capability, N8 dependency)."""
+    import optax
+
+    def f(lp, lab, il, ll):
+        # optax expects [B, T, K] logits; paddle gives [T, B, K] log_probs
+        logits = jnp.transpose(lp, (1, 0, 2))
+        B, T, K = logits.shape
+        logitpaddings = (jnp.arange(T)[None, :] >= il[:, None]).astype(jnp.float32)
+        L = lab.shape[1]
+        labelpaddings = (jnp.arange(L)[None, :] >= ll[:, None]).astype(jnp.float32)
+        per_seq = optax.ctc_loss(logits, logitpaddings, lab.astype(jnp.int32), labelpaddings,
+                                 blank_id=blank)
+        if reduction == "mean":
+            return jnp.mean(per_seq / ll.astype(per_seq.dtype))
+        if reduction == "sum":
+            return jnp.sum(per_seq)
+        return per_seq
+
+    return run_op("ctc_loss", f, _ensure(log_probs), _ensure(labels),
+                  _ensure(input_lengths), _ensure(label_lengths))
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8,
+                     reduction="mean", name=None):
+    def f(z, lab):
+        if log_input:
+            loss = jnp.exp(z) - lab * z
+        else:
+            loss = z - lab * jnp.log(z + epsilon)
+        if full:
+            stirling = lab * jnp.log(lab + epsilon) - lab + 0.5 * jnp.log(2 * np.pi * (lab + epsilon))
+            loss = loss + jnp.where(lab > 1, stirling, 0.0)
+        return _reduce(loss, reduction)
+
+    return run_op("poisson_nll_loss", f, _ensure(input), _ensure(label))
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6, reduction="mean", name=None):
+    def f(mu, lab, var):
+        var = jnp.clip(var, epsilon, None)
+        loss = 0.5 * (jnp.log(var) + (lab - mu) ** 2 / var)
+        if full:
+            loss = loss + 0.5 * np.log(2 * np.pi)
+        return _reduce(loss, reduction)
+
+    return run_op("gaussian_nll_loss", f, _ensure(input), _ensure(label), _ensure(variance))
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    def f(p, lab):
+        lab_oh = jax.nn.one_hot(lab.squeeze(-1).astype(jnp.int32), p.shape[-1], dtype=p.dtype)
+        reduce_dims = tuple(range(1, p.ndim))
+        inter = jnp.sum(p * lab_oh, axis=reduce_dims)
+        union = jnp.sum(p, axis=reduce_dims) + jnp.sum(lab_oh, axis=reduce_dims)
+        return jnp.mean(1 - (2 * inter + epsilon) / (union + epsilon))
+
+    return run_op("dice_loss", f, _ensure(input), _ensure(label))
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    def f(a, p, lab):
+        sim = a @ p.T
+        eq = (lab[:, None] == lab[None, :]).astype(a.dtype)
+        target = eq / jnp.sum(eq, axis=1, keepdims=True)
+        logp = jax.nn.log_softmax(sim, axis=1)
+        xent = -jnp.sum(target * logp, axis=1)
+        reg = l2_reg * (jnp.mean(jnp.sum(a * a, 1)) + jnp.mean(jnp.sum(p * p, 1))) * 0.25
+        return jnp.mean(xent) + reg
+
+    return run_op("npair_loss", f, _ensure(anchor), _ensure(positive), _ensure(labels))

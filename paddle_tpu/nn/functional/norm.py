@@ -1,0 +1,232 @@
+"""Normalization functionals (``python/paddle/nn/functional/norm.py``).
+
+LayerNorm/RMSNorm also have fused Pallas variants in ``paddle_tpu.ops``;
+these reference versions are XLA-fused and already near-roofline for typical
+hidden sizes.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.dispatch import run_op
+from ...core.tensor import Tensor, to_tensor
+
+
+def _ensure(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    def f(v):
+        n = jnp.sum(jnp.abs(v) ** p, axis=axis, keepdims=True) ** (1.0 / p)
+        return v / jnp.maximum(n, epsilon)
+
+    return run_op("normalize", f, _ensure(x))
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05, name=None):
+    ns = (normalized_shape,) if isinstance(normalized_shape, int) else tuple(normalized_shape)
+    n_axes = len(ns)
+
+    def f(v, *wb):
+        axes = tuple(range(v.ndim - n_axes, v.ndim))
+        mean = jnp.mean(v.astype(jnp.float32), axis=axes, keepdims=True)
+        var = jnp.var(v.astype(jnp.float32), axis=axes, keepdims=True)
+        out = (v.astype(jnp.float32) - mean) * jax.lax.rsqrt(var + epsilon)
+        out = out.astype(v.dtype)
+        i = 0
+        if weight is not None:
+            out = out * wb[i]
+            i += 1
+        if bias is not None:
+            out = out + wb[i]
+        return out
+
+    args = [_ensure(x)]
+    if weight is not None:
+        args.append(_ensure(weight))
+    if bias is not None:
+        args.append(_ensure(bias))
+    return run_op("layer_norm", f, *args)
+
+
+def rms_norm(x, weight=None, epsilon=1e-6, name=None):
+    """RMSNorm (the reference ships it fused: phi/kernels/fusion/gpu/rms_norm)."""
+
+    def f(v, *w):
+        var = jnp.mean(jnp.square(v.astype(jnp.float32)), axis=-1, keepdims=True)
+        out = (v.astype(jnp.float32) * jax.lax.rsqrt(var + epsilon)).astype(v.dtype)
+        if w:
+            out = out * w[0]
+        return out
+
+    args = [_ensure(x)]
+    if weight is not None:
+        args.append(_ensure(weight))
+    return run_op("rms_norm", f, *args)
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None, training=False,
+               momentum=0.9, epsilon=1e-05, data_format="NCHW", use_global_stats=None, name=None):
+    """Batch norm with running-stat update (stats updated in-place on the
+    buffer wrappers, which the to_static state threading captures)."""
+    x = _ensure(x)
+    channel_axis = x.ndim - 1 if data_format.endswith("C") and x.ndim > 2 else 1
+    if x.ndim == 2:
+        channel_axis = 1
+    reduce_axes = tuple(i for i in range(x.ndim) if i != channel_axis)
+
+    use_batch_stats = training and not use_global_stats
+
+    if use_batch_stats:
+        # compute batch stats as traced values
+        def stats(v):
+            m = jnp.mean(v.astype(jnp.float32), axis=reduce_axes)
+            var = jnp.var(v.astype(jnp.float32), axis=reduce_axes)
+            return m, var
+
+        m_t, v_t = run_op("bn_stats", stats, x)
+        # Update running stats (paddle: r = m*r + (1-m)*batch). Must go
+        # through run_op so the buffers are captured as to_static state
+        # (jit/api.py discovery pass) instead of baking as constants.
+        n = int(np.prod([x.shape[i] for i in reduce_axes]))
+        unbias = n / max(n - 1, 1)
+        from ...core.autograd import no_grad
+
+        with no_grad():
+            new_m = run_op(
+                "bn_update_mean",
+                lambda r, m: (momentum * r + (1 - momentum) * m).astype(r.dtype),
+                running_mean, m_t.detach(),
+            )
+            new_v = run_op(
+                "bn_update_var",
+                lambda r, v: (momentum * r + (1 - momentum) * v * unbias).astype(r.dtype),
+                running_var, v_t.detach(),
+            )
+        running_mean._value = new_m._value
+        running_var._value = new_v._value
+        mean_in, var_in = m_t, v_t
+    else:
+        mean_in, var_in = running_mean, running_var
+
+    def f(v, m, var, *wb):
+        shape = [1] * v.ndim
+        shape[channel_axis] = -1
+        out = (v.astype(jnp.float32) - m.reshape(shape)) * jax.lax.rsqrt(
+            var.reshape(shape).astype(jnp.float32) + epsilon
+        )
+        out = out.astype(v.dtype)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(shape)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(shape)
+        return out
+
+    args = [x, _ensure(mean_in), _ensure(var_in)]
+    if weight is not None:
+        args.append(_ensure(weight))
+    if bias is not None:
+        args.append(_ensure(bias))
+    return run_op("batch_norm", f, *args)
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None,
+                  use_input_stats=True, momentum=0.9, eps=1e-05, data_format="NCHW", name=None):
+    x = _ensure(x)
+    channel_axis = 1 if not data_format.endswith("C") or x.ndim <= 2 else x.ndim - 1
+    reduce_axes = tuple(i for i in range(2, x.ndim)) if channel_axis == 1 else tuple(range(1, x.ndim - 1))
+
+    def f(v, *wb):
+        m = jnp.mean(v.astype(jnp.float32), axis=reduce_axes, keepdims=True)
+        var = jnp.var(v.astype(jnp.float32), axis=reduce_axes, keepdims=True)
+        out = ((v.astype(jnp.float32) - m) * jax.lax.rsqrt(var + eps)).astype(v.dtype)
+        shape = [1] * v.ndim
+        shape[channel_axis] = -1
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(shape)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(shape)
+        return out
+
+    args = [x]
+    if weight is not None:
+        args.append(_ensure(weight))
+    if bias is not None:
+        args.append(_ensure(bias))
+    return run_op("instance_norm", f, *args)
+
+
+def group_norm(x, num_groups, epsilon=1e-05, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    x = _ensure(x)
+    channel_last = data_format.endswith("C") and x.ndim > 2
+
+    def f(v, *wb):
+        if channel_last:
+            v_nc = jnp.moveaxis(v, -1, 1)
+        else:
+            v_nc = v
+        N, C = v_nc.shape[:2]
+        g = v_nc.reshape((N, num_groups, C // num_groups) + v_nc.shape[2:])
+        axes = tuple(range(2, g.ndim))
+        m = jnp.mean(g.astype(jnp.float32), axis=axes, keepdims=True)
+        var = jnp.var(g.astype(jnp.float32), axis=axes, keepdims=True)
+        out = ((g.astype(jnp.float32) - m) * jax.lax.rsqrt(var + epsilon)).astype(v.dtype)
+        out = out.reshape(v_nc.shape)
+        shape = [1, C] + [1] * (v_nc.ndim - 2)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(shape)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(shape)
+        if channel_last:
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+
+    args = [x]
+    if weight is not None:
+        args.append(_ensure(weight))
+    if bias is not None:
+        args.append(_ensure(bias))
+    return run_op("group_norm", f, *args)
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0, data_format="NCHW", name=None):
+    def f(v):
+        channel_axis = 1 if not data_format.endswith("C") or v.ndim <= 2 else v.ndim - 1
+        sq = jnp.square(v)
+        half = size // 2
+        pads = [(0, 0)] * v.ndim
+        pads[channel_axis] = (half, size - 1 - half)
+        sq = jnp.pad(sq, pads)
+        window = [1] * v.ndim
+        window[channel_axis] = size
+        summed = jax.lax.reduce_window(sq, 0.0, jax.lax.add, tuple(window), (1,) * v.ndim, "VALID")
+        return v / (k + alpha * summed) ** beta
+
+    return run_op("local_response_norm", f, _ensure(x))
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    def f(w):
+        wm = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)
+        u = jnp.ones((wm.shape[0],), w.dtype) / np.sqrt(wm.shape[0])
+        v = None
+        for _ in range(power_iters):
+            v = wm.T @ u
+            v = v / (jnp.linalg.norm(v) + eps)
+            u = wm @ v
+            u = u / (jnp.linalg.norm(u) + eps)
+        sigma = u @ wm @ v if v is not None else jnp.linalg.norm(wm, 2)
+        return w / sigma
+
+    return run_op("spectral_norm", f, _ensure(weight))

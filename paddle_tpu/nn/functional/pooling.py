@@ -1,0 +1,220 @@
+"""Pooling functionals (``python/paddle/nn/functional/pooling.py`` capability).
+
+Pooling = ``lax.reduce_window`` — XLA's native windowed reduction, vectorized
+on the VPU.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.dispatch import run_op
+from ...core.tensor import Tensor, to_tensor
+
+
+def _ensure(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def _tup(v, n):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in (v if len(v) == n else [v[0]] * n))
+    return (int(v),) * n
+
+
+def _pool(x, kernel, stride, padding, n, op, channel_last, ceil_mode=False,
+          exclusive=True, count_include_pad=False, name="pool"):
+    k = _tup(kernel, n)
+    s = _tup(stride if stride is not None else kernel, n)
+    if isinstance(padding, str):
+        pad_spec = padding.upper()
+        pads = None
+    else:
+        p = _tup(padding, n)
+        pads = [(x_, x_) for x_ in p]
+        pad_spec = None
+
+    def f(v):
+        nd = v.ndim
+        if channel_last:
+            window = (1,) + k + (1,)
+            strides = (1,) + s + (1,)
+            full_pads = [(0, 0)] + (pads or []) + [(0, 0)] if pads is not None else pad_spec
+        else:
+            window = (1, 1) + k
+            strides = (1, 1) + s
+            full_pads = [(0, 0), (0, 0)] + pads if pads is not None else pad_spec
+        if op == "max":
+            init = -jnp.inf if jnp.issubdtype(v.dtype, jnp.floating) else jnp.iinfo(v.dtype).min
+            return jax.lax.reduce_window(v, init, jax.lax.max, window, strides, full_pads)
+        # avg
+        ones = jnp.ones_like(v)
+        summed = jax.lax.reduce_window(v, 0.0, jax.lax.add, window, strides, full_pads)
+        if exclusive and not count_include_pad:
+            counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides, full_pads)
+            return summed / counts
+        return summed / float(np.prod(k))
+
+    return run_op(name, f, _ensure(x))
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCL", name=None):
+    out = _pool(x, kernel_size, stride, padding, 1, "max", data_format == "NLC",
+                ceil_mode, name="max_pool1d")
+    if return_mask:
+        return out, _pool_mask(x, out, kernel_size, stride, padding, 1, data_format == "NLC")
+    return out
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    out = _pool(x, kernel_size, stride, padding, 2, "max", data_format == "NHWC",
+                ceil_mode, name="max_pool2d")
+    if return_mask:
+        return out, _pool_mask(x, out, kernel_size, stride, padding, 2, data_format == "NHWC")
+    return out
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    out = _pool(x, kernel_size, stride, padding, 3, "max", data_format == "NDHWC",
+                ceil_mode, name="max_pool3d")
+    if return_mask:
+        return out, _pool_mask(x, out, kernel_size, stride, padding, 3, data_format == "NDHWC")
+    return out
+
+
+def _pool_mask(x, out, kernel, stride, padding, n, channel_last):
+    """Argmax indices for return_mask (flattened spatial index, paddle style)."""
+    x = _ensure(x)
+    k = _tup(kernel, n)
+    s = _tup(stride if stride is not None else kernel, n)
+    p = _tup(padding if not isinstance(padding, str) else 0, n)
+
+    def f(v):
+        spatial = v.shape[1:-1] if channel_last else v.shape[2:]
+        flat_idx = jnp.arange(int(np.prod(spatial))).reshape(spatial)
+        shape = (1,) + spatial + (1,) if channel_last else (1, 1) + spatial
+        idx_map = jnp.broadcast_to(flat_idx.reshape(shape), v.shape).astype(jnp.float32)
+        if channel_last:
+            window = (1,) + k + (1,)
+            strides = (1,) + s + (1,)
+            pads = [(0, 0)] + [(pp, pp) for pp in p] + [(0, 0)]
+        else:
+            window = (1, 1) + k
+            strides = (1, 1) + s
+            pads = [(0, 0), (0, 0)] + [(pp, pp) for pp in p]
+
+        def sel(a, b):
+            av, ai = a
+            bv, bi = b
+            take_b = bv > av
+            return jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai)
+
+        init_v = jnp.asarray(-jnp.inf, v.dtype)
+        init_i = jnp.asarray(-1.0, jnp.float32)
+        vals, idxs = jax.lax.reduce_window(
+            (v, idx_map), (init_v, init_i),
+            lambda a, b: sel(a, b), window, strides, pads,
+        )
+        return idxs.astype(jnp.int32)
+
+    return run_op("pool_mask", f, x)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCL", name=None):
+    return _pool(x, kernel_size, stride, padding, 1, "avg", data_format == "NLC",
+                 ceil_mode, exclusive, name="avg_pool1d")
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 2, "avg", data_format == "NHWC",
+                 ceil_mode, exclusive, name="avg_pool2d")
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 3, "avg", data_format == "NDHWC",
+                 ceil_mode, exclusive, name="avg_pool3d")
+
+
+def _adaptive(x, output_size, n, op, channel_last, name):
+    o = _tup(output_size, n)
+
+    def f(v):
+        spatial = v.shape[1:-1] if channel_last else v.shape[2:]
+        out = v
+        for i in range(n):
+            ax = (1 + i) if channel_last else (2 + i)
+            out = _adaptive_1d(out, ax, spatial[i], o[i], op)
+        return out
+
+    return run_op(name, f, _ensure(x))
+
+
+def _adaptive_1d(v, axis, in_size, out_size, op):
+    if in_size % out_size == 0:
+        k = in_size // out_size
+        new_shape = v.shape[:axis] + (out_size, k) + v.shape[axis + 1 :]
+        vv = v.reshape(new_shape)
+        return jnp.max(vv, axis=axis + 1) if op == "max" else jnp.mean(vv, axis=axis + 1)
+    # general case: gather variable windows (paddle adaptive formula)
+    starts = np.floor(np.arange(out_size) * in_size / out_size).astype(int)
+    ends = np.ceil((np.arange(out_size) + 1) * in_size / out_size).astype(int)
+    slices = []
+    for st, en in zip(starts, ends):
+        sl = jax.lax.slice_in_dim(v, int(st), int(en), axis=axis)
+        red = jnp.max(sl, axis=axis, keepdims=True) if op == "max" else jnp.mean(sl, axis=axis, keepdims=True)
+        slices.append(red)
+    return jnp.concatenate(slices, axis=axis)
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive(x, output_size, 1, "avg", False, "adaptive_avg_pool1d")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive(x, output_size, 2, "avg", data_format == "NHWC", "adaptive_avg_pool2d")
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive(x, output_size, 3, "avg", data_format == "NDHWC", "adaptive_avg_pool3d")
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive(x, output_size, 1, "max", False, "adaptive_max_pool1d")
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive(x, output_size, 2, "max", False, "adaptive_max_pool2d")
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive(x, output_size, 3, "max", False, "adaptive_max_pool3d")
+
+
+def lp_pool1d(x, norm_type, kernel_size, stride=None, padding=0, ceil_mode=False,
+              data_format="NCL", name=None):
+    p = float(norm_type)
+    xx = _ensure(x)
+    powered = run_op("lp_pow", lambda v: jnp.abs(v) ** p, xx)
+    pooled = _pool(powered, kernel_size, stride, padding, 1, "avg", data_format == "NLC",
+                   ceil_mode, exclusive=False, name="lp_pool1d")
+    k = _tup(kernel_size, 1)
+    return run_op("lp_root", lambda v: (v * float(np.prod(k))) ** (1.0 / p), pooled)
+
+
+def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0, ceil_mode=False,
+              data_format="NCHW", name=None):
+    p = float(norm_type)
+    xx = _ensure(x)
+    powered = run_op("lp_pow", lambda v: jnp.abs(v) ** p, xx)
+    pooled = _pool(powered, kernel_size, stride, padding, 2, "avg", data_format == "NHWC",
+                   ceil_mode, exclusive=False, name="lp_pool2d")
+    k = _tup(kernel_size, 2)
+    return run_op("lp_root", lambda v: (v * float(np.prod(k))) ** (1.0 / p), pooled)

@@ -1,0 +1,335 @@
+"""``Layer`` — the module base class.
+
+Capability analog of the reference's ``paddle.nn.Layer``
+(``python/paddle/nn/layer/layers.py:334``): parameter/buffer/sublayer
+registries via ``__setattr__``, named_* traversal, state_dict/set_state_dict,
+train/eval mode, forward pre/post hooks, ``apply``, dtype moves.
+
+TPU-first: a Layer doubles as a *functional* module — ``functional_state()``
+extracts the parameter/buffer pytree and ``functional_call`` runs forward with
+substituted values, which is how ``to_static``/``jit`` stage the whole model
+into one XLA computation (no per-op dispatch at runtime).
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as dtype_mod
+from ..core.tensor import Parameter, Tensor
+
+
+class Layer:
+    def __init__(self, name_scope: Optional[str] = None, dtype="float32"):
+        # use object.__setattr__ since our __setattr__ inspects these dicts
+        object.__setattr__(self, "_parameters", collections.OrderedDict())
+        object.__setattr__(self, "_sub_layers", collections.OrderedDict())
+        object.__setattr__(self, "_buffers", collections.OrderedDict())
+        object.__setattr__(self, "_non_persistable_buffer_names", set())
+        object.__setattr__(self, "_forward_pre_hooks", collections.OrderedDict())
+        object.__setattr__(self, "_forward_post_hooks", collections.OrderedDict())
+        object.__setattr__(self, "training", True)
+        object.__setattr__(self, "_dtype", dtype_mod.convert_dtype(dtype))
+        object.__setattr__(self, "_name_scope", name_scope or type(self).__name__.lower())
+        object.__setattr__(self, "_hook_id", 0)
+
+    # --- registration -----------------------------------------------------
+    def __setattr__(self, name: str, value: Any):
+        params = self.__dict__.get("_parameters")
+        layers = self.__dict__.get("_sub_layers")
+        buffers = self.__dict__.get("_buffers")
+        if isinstance(value, Parameter):
+            if params is None:
+                raise RuntimeError("call Layer.__init__ before assigning parameters")
+            _remove_from(name, layers, buffers, self.__dict__)
+            params[name] = value
+        elif isinstance(value, Layer):
+            _remove_from(name, params, buffers, self.__dict__)
+            layers[name] = value
+        elif params is not None and name in params:
+            if value is None:
+                params[name] = None
+            elif isinstance(value, Tensor):
+                params[name].set_value(value)
+            else:
+                raise TypeError(f"cannot assign {type(value)} to parameter {name}")
+        elif buffers is not None and name in buffers:
+            buffers[name] = value
+        elif layers is not None and name in layers and value is None:
+            del layers[name]
+        else:
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name: str):
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                return d[name]
+        raise AttributeError(f"{type(self).__name__} has no attribute {name!r}")
+
+    def __delattr__(self, name):
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                del d[name]
+                return
+        object.__delattr__(self, name)
+
+    def add_parameter(self, name: str, parameter: Optional[Parameter]):
+        self._parameters[name] = parameter
+        return parameter
+
+    def add_sublayer(self, name: str, sublayer: "Layer"):
+        self._sub_layers[name] = sublayer
+        return sublayer
+
+    def register_buffer(self, name: str, tensor: Optional[Tensor], persistable: bool = True):
+        self._buffers[name] = tensor
+        if not persistable:
+            self._non_persistable_buffer_names.add(name)
+        return tensor
+
+    def create_parameter(
+        self,
+        shape,
+        attr=None,
+        dtype=None,
+        is_bias: bool = False,
+        default_initializer=None,
+    ) -> Parameter:
+        """``Layer.create_parameter`` analog (uses ParamAttr + initializer)."""
+        from .initializer import Constant, XavierNormal, _apply_initializer
+        from ..base.param_attr import ParamAttr
+
+        attr = ParamAttr._to_attr(attr)
+        d = dtype_mod.convert_dtype(dtype) or self._dtype
+        init = default_initializer
+        if attr is not None and attr.initializer is not None:
+            init = attr.initializer
+        if init is None:
+            init = Constant(0.0) if is_bias else XavierNormal()
+        value = _apply_initializer(init, shape, d)
+        p = Parameter(value, name=attr.name if attr else None)
+        if attr is not None:
+            if attr.learning_rate is not None:
+                p.optimize_attr["learning_rate"] = attr.learning_rate
+            p.regularizer = attr.regularizer
+            if attr.trainable is False:
+                p.stop_gradient = True
+                p.trainable = False
+        return p
+
+    def create_tensor(self, name=None, persistable=False, dtype=None):
+        d = dtype_mod.convert_dtype(dtype) or self._dtype
+        return Tensor(jnp.zeros([], d), name=name)
+
+    # --- traversal --------------------------------------------------------
+    def named_parameters(
+        self, prefix: str = "", include_sublayers: bool = True
+    ) -> Iterator[Tuple[str, Parameter]]:
+        seen = set()
+        for name, layer, lp in self._walk(prefix):
+            for pname, p in layer._parameters.items():
+                if p is not None and id(p) not in seen:
+                    seen.add(id(p))
+                    yield (lp + pname if lp else pname), p
+            if not include_sublayers:
+                break
+
+    def parameters(self, include_sublayers: bool = True) -> List[Parameter]:
+        return [p for _, p in self.named_parameters(include_sublayers=include_sublayers)]
+
+    def named_buffers(self, prefix: str = "", include_sublayers: bool = True):
+        seen = set()
+        for name, layer, lp in self._walk(prefix):
+            for bname, b in layer._buffers.items():
+                if b is not None and id(b) not in seen:
+                    seen.add(id(b))
+                    yield (lp + bname if lp else bname), b
+            if not include_sublayers:
+                break
+
+    def buffers(self, include_sublayers: bool = True):
+        return [b for _, b in self.named_buffers(include_sublayers=include_sublayers)]
+
+    def _walk(self, prefix: str = ""):
+        """Yield (qualified_name, layer, param_prefix) depth-first."""
+        stack: List[Tuple[str, Layer]] = [(prefix, self)]
+        seen = set()
+        while stack:
+            name, layer = stack.pop(0)
+            if id(layer) in seen:
+                continue
+            seen.add(id(layer))
+            lp = name + "." if name else ""
+            yield name, layer, lp
+            for sname, sub in layer._sub_layers.items():
+                if sub is not None:
+                    stack.append((lp + sname, sub))
+
+    def named_sublayers(self, prefix: str = "", include_self: bool = False):
+        first = True
+        for name, layer, _ in self._walk(prefix):
+            if first and not include_self:
+                first = False
+                continue
+            first = False
+            yield name, layer
+
+    def sublayers(self, include_self: bool = False) -> List["Layer"]:
+        return [l for _, l in self.named_sublayers(include_self=include_self)]
+
+    def children(self) -> Iterator["Layer"]:
+        for _, l in self.named_children():
+            yield l
+
+    def named_children(self):
+        for name, l in self._sub_layers.items():
+            if l is not None:
+                yield name, l
+
+    def apply(self, fn: Callable[["Layer"], None]) -> "Layer":
+        for l in self.sublayers(include_self=True):
+            fn(l)
+        return self
+
+    # --- state dict -------------------------------------------------------
+    def state_dict(self, destination=None, include_sublayers=True, structured_name_prefix="",
+                   use_hook=True) -> Dict[str, Tensor]:
+        out = destination if destination is not None else collections.OrderedDict()
+        for name, p in self.named_parameters(prefix=structured_name_prefix.rstrip(".")):
+            out[name] = p
+        for _, layer, lp in self._walk(structured_name_prefix.rstrip(".")):
+            for bname, b in layer._buffers.items():
+                if b is not None and bname not in layer._non_persistable_buffer_names:
+                    out[(lp + bname) if lp else bname] = b
+        return out
+
+    def set_state_dict(self, state_dict: Dict[str, Any], use_structured_name: bool = True):
+        own = self.state_dict()
+        missing, unexpected = [], []
+        for k, v in state_dict.items():
+            if k in own:
+                target = own[k]
+                val = v._value if isinstance(v, Tensor) else jnp.asarray(np.asarray(v))
+                if tuple(val.shape) != tuple(target._value.shape):
+                    raise ValueError(
+                        f"shape mismatch for {k}: {val.shape} vs {target._value.shape}"
+                    )
+                target._value = val.astype(target._value.dtype)
+            else:
+                unexpected.append(k)
+        for k in own:
+            if k not in state_dict:
+                missing.append(k)
+        return missing, unexpected
+
+    load_dict = set_state_dict
+
+    # --- mode / dtype -----------------------------------------------------
+    def train(self):
+        for l in self.sublayers(include_self=True):
+            object.__setattr__(l, "training", True)
+        return self
+
+    def eval(self):
+        for l in self.sublayers(include_self=True):
+            object.__setattr__(l, "training", False)
+        return self
+
+    def to(self, device=None, dtype=None, blocking=None):
+        if dtype is not None:
+            d = dtype_mod.convert_dtype(dtype)
+            for p in self.parameters():
+                if dtype_mod.is_floating_point(p.dtype):
+                    p._value = p._value.astype(d)
+            for b in self.buffers():
+                if dtype_mod.is_floating_point(b.dtype):
+                    b._value = b._value.astype(d)
+            object.__setattr__(self, "_dtype", d)
+        return self
+
+    def astype(self, dtype):
+        return self.to(dtype=dtype)
+
+    def float(self):
+        return self.to(dtype="float32")
+
+    def half(self):
+        return self.to(dtype="float16")
+
+    def bfloat16(self):
+        return self.to(dtype="bfloat16")
+
+    # --- hooks ------------------------------------------------------------
+    def register_forward_pre_hook(self, hook):
+        hid = self._hook_id
+        object.__setattr__(self, "_hook_id", hid + 1)
+        self._forward_pre_hooks[hid] = hook
+        return _HookRemoveHelper(self._forward_pre_hooks, hid)
+
+    def register_forward_post_hook(self, hook):
+        hid = self._hook_id
+        object.__setattr__(self, "_hook_id", hid + 1)
+        self._forward_post_hooks[hid] = hook
+        return _HookRemoveHelper(self._forward_post_hooks, hid)
+
+    # --- call -------------------------------------------------------------
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *inputs, **kwargs):
+        for hook in self._forward_pre_hooks.values():
+            out = hook(self, inputs)
+            if out is not None:
+                inputs = out if isinstance(out, tuple) else (out,)
+        outputs = self.forward(*inputs, **kwargs)
+        for hook in self._forward_post_hooks.values():
+            res = hook(self, inputs, outputs)
+            if res is not None:
+                outputs = res
+        return outputs
+
+    # --- functional bridge (to_static / pjit path) ------------------------
+    def functional_state(self) -> Dict[str, Tensor]:
+        """All params + buffers as one flat dict (the jit-visible pytree)."""
+        out = collections.OrderedDict()
+        for name, p in self.named_parameters():
+            out["param:" + name] = p
+        for name, b in self.named_buffers():
+            out["buffer:" + name] = b
+        return out
+
+    def full_name(self):
+        return self._name_scope
+
+    def extra_repr(self) -> str:
+        return ""
+
+    def __repr__(self):
+        extra = self.extra_repr()
+        lines = [f"{type(self).__name__}({extra}"] if extra else [f"{type(self).__name__}("]
+        for name, sub in self._sub_layers.items():
+            sub_repr = repr(sub).split("\n")
+            lines.append(f"  ({name}): " + sub_repr[0])
+            lines.extend("  " + l for l in sub_repr[1:])
+        lines.append(")")
+        return "\n".join(lines) if len(lines) > 2 or extra else f"{type(self).__name__}({extra})"
+
+
+class _HookRemoveHelper:
+    def __init__(self, store, hid):
+        self._store, self._hid = store, hid
+
+    def remove(self):
+        self._store.pop(self._hid, None)
+
+
+def _remove_from(name, *dicts):
+    for d in dicts:
+        if d is not None and name in d:
+            del d[name]

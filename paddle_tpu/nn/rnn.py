@@ -1,0 +1,310 @@
+"""Recurrent layers (``python/paddle/nn/layer/rnn.py`` capability).
+
+TPU-first: the time loop is ``lax.scan`` — one compiled step body, no Python
+per-timestep dispatch (the reference needs cuDNN RNN kernels, N7; here XLA
+pipelines the scan and the gate matmuls hit the MXU batched).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import run_op
+from ..core.tensor import Tensor, to_tensor
+from . import functional as F
+from .initializer import Uniform
+from .layers import Layer
+
+
+def _ensure(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype=None, init_value=0.0):
+        batch = batch_ref.shape[0]
+        h = jnp.full((batch, self.hidden_size), init_value, jnp.float32)
+        return Tensor(h)
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh", weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self.activation = activation
+        k = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-k, k)
+        self.weight_ih = self.create_parameter([hidden_size, input_size], weight_ih_attr, default_initializer=init)
+        self.weight_hh = self.create_parameter([hidden_size, hidden_size], weight_hh_attr, default_initializer=init)
+        self.bias_ih = self.create_parameter([hidden_size], bias_ih_attr, is_bias=True, default_initializer=init) if bias_ih_attr is not False else None
+        self.bias_hh = self.create_parameter([hidden_size], bias_hh_attr, is_bias=True, default_initializer=init) if bias_hh_attr is not False else None
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        act = jnp.tanh if self.activation == "tanh" else jax.nn.relu
+
+        def f(x, h, wi, wh, *b):
+            z = x @ wi.T + h @ wh.T
+            if b:
+                z = z + b[0] + (b[1] if len(b) > 1 else 0)
+            return act(z)
+
+        args = [_ensure(inputs), _ensure(states), self.weight_ih, self.weight_hh]
+        if self.bias_ih is not None:
+            args += [self.bias_ih, self.bias_hh]
+        h = run_op("simple_rnn_cell", f, *args)
+        return h, h
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, proj_size=None, name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        k = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-k, k)
+        self.weight_ih = self.create_parameter([4 * hidden_size, input_size], weight_ih_attr, default_initializer=init)
+        self.weight_hh = self.create_parameter([4 * hidden_size, hidden_size], weight_hh_attr, default_initializer=init)
+        self.bias_ih = self.create_parameter([4 * hidden_size], bias_ih_attr, is_bias=True, default_initializer=init) if bias_ih_attr is not False else None
+        self.bias_hh = self.create_parameter([4 * hidden_size], bias_hh_attr, is_bias=True, default_initializer=init) if bias_hh_attr is not False else None
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            h = self.get_initial_states(inputs)
+            c = self.get_initial_states(inputs)
+        else:
+            h, c = states
+
+        def f(x, hv, cv, wi, wh, *b):
+            z = x @ wi.T + hv @ wh.T
+            if b:
+                z = z + b[0] + (b[1] if len(b) > 1 else 0)
+            i, fgate, g, o = jnp.split(z, 4, axis=-1)
+            i, fgate, o = jax.nn.sigmoid(i), jax.nn.sigmoid(fgate), jax.nn.sigmoid(o)
+            g = jnp.tanh(g)
+            c_new = fgate * cv + i * g
+            h_new = o * jnp.tanh(c_new)
+            return h_new, c_new
+
+        args = [_ensure(inputs), _ensure(h), _ensure(c), self.weight_ih, self.weight_hh]
+        if self.bias_ih is not None:
+            args += [self.bias_ih, self.bias_hh]
+        h_new, c_new = run_op("lstm_cell", f, *args)
+        return h_new, (h_new, c_new)
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        k = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-k, k)
+        self.weight_ih = self.create_parameter([3 * hidden_size, input_size], weight_ih_attr, default_initializer=init)
+        self.weight_hh = self.create_parameter([3 * hidden_size, hidden_size], weight_hh_attr, default_initializer=init)
+        self.bias_ih = self.create_parameter([3 * hidden_size], bias_ih_attr, is_bias=True, default_initializer=init) if bias_ih_attr is not False else None
+        self.bias_hh = self.create_parameter([3 * hidden_size], bias_hh_attr, is_bias=True, default_initializer=init) if bias_hh_attr is not False else None
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+
+        def f(x, h, wi, wh, *b):
+            gi = x @ wi.T
+            gh = h @ wh.T
+            if b:
+                gi = gi + b[0]
+                gh = gh + (b[1] if len(b) > 1 else 0)
+            ir, iz, ig = jnp.split(gi, 3, axis=-1)
+            hr, hz, hg = jnp.split(gh, 3, axis=-1)
+            r = jax.nn.sigmoid(ir + hr)
+            z = jax.nn.sigmoid(iz + hz)
+            g = jnp.tanh(ig + r * hg)
+            return (1 - z) * g + z * h
+
+        args = [_ensure(inputs), _ensure(states), self.weight_ih, self.weight_hh]
+        if self.bias_ih is not None:
+            args += [self.bias_ih, self.bias_hh]
+        h = run_op("gru_cell", f, *args)
+        return h, h
+
+
+class RNN(Layer):
+    """Runs a cell over a sequence with lax.scan (rnn.py RNN wrapper analog)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        outs = []
+        x = inputs if self.time_major else inputs.transpose([1, 0, 2])
+        T = x.shape[0]
+        states = initial_states
+        idx = range(T - 1, -1, -1) if self.is_reverse else range(T)
+        for t in idx:
+            out, states = self.cell(x[t], states)
+            outs.append(out)
+        if self.is_reverse:
+            outs = outs[::-1]
+        from .. import tensor as ops
+
+        stacked = ops.stack(outs, axis=0)
+        if not self.time_major:
+            stacked = stacked.transpose([1, 0, 2])
+        return stacked, states
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, False, time_major)
+        self.rnn_bw = RNN(cell_bw, True, time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from .. import tensor as ops
+
+        s_fw, s_bw = (initial_states if initial_states is not None else (None, None))
+        out_fw, st_fw = self.rnn_fw(inputs, s_fw)
+        out_bw, st_bw = self.rnn_bw(inputs, s_bw)
+        return ops.concat([out_fw, out_bw], axis=-1), (st_fw, st_bw)
+
+
+class _RNNBase(Layer):
+    """Multi-layer (bi)directional RNN over a fused scan (LSTM/GRU/SimpleRNN)."""
+
+    MODE = None
+
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.direction = direction
+        self.time_major = time_major
+        self.dropout = dropout
+        self.bidirectional = direction in ("bidirect", "bidirectional")
+        n_dir = 2 if self.bidirectional else 1
+        gate_mult = {"LSTM": 4, "GRU": 3, "RNN_TANH": 1, "RNN_RELU": 1}[self.MODE]
+        k = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-k, k)
+        self._weights = []
+        for layer in range(num_layers):
+            for d in range(n_dir):
+                isz = input_size if layer == 0 else hidden_size * n_dir
+                sfx = f"_l{layer}" + ("_reverse" if d else "")
+                wi = self.create_parameter([gate_mult * hidden_size, isz], weight_ih_attr, default_initializer=init)
+                wh = self.create_parameter([gate_mult * hidden_size, hidden_size], weight_hh_attr, default_initializer=init)
+                bi = self.create_parameter([gate_mult * hidden_size], bias_ih_attr, is_bias=True, default_initializer=init)
+                bh = self.create_parameter([gate_mult * hidden_size], bias_hh_attr, is_bias=True, default_initializer=init)
+                self.add_parameter(f"weight_ih{sfx}", wi)
+                self.add_parameter(f"weight_hh{sfx}", wh)
+                self.add_parameter(f"bias_ih{sfx}", bi)
+                self.add_parameter(f"bias_hh{sfx}", bh)
+                self._weights.append((wi, wh, bi, bh))
+
+    def _step(self, mode):
+        if mode == "LSTM":
+            def step(carry, x, wi, wh, bi, bh):
+                h, c = carry
+                z = x @ wi.T + h @ wh.T + bi + bh
+                i, f, g, o = jnp.split(z, 4, axis=-1)
+                i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+                g = jnp.tanh(g)
+                c = f * c + i * g
+                h = o * jnp.tanh(c)
+                return (h, c), h
+            return step
+        if mode == "GRU":
+            def step(carry, x, wi, wh, bi, bh):
+                h = carry
+                gi = x @ wi.T + bi
+                gh = h @ wh.T + bh
+                ir, iz, ig = jnp.split(gi, 3, axis=-1)
+                hr, hz, hg = jnp.split(gh, 3, axis=-1)
+                r = jax.nn.sigmoid(ir + hr)
+                z = jax.nn.sigmoid(iz + hz)
+                g = jnp.tanh(ig + r * hg)
+                h = (1 - z) * g + z * h
+                return h, h
+            return step
+        act = jnp.tanh if mode == "RNN_TANH" else jax.nn.relu
+
+        def step(carry, x, wi, wh, bi, bh):
+            h = act(x @ wi.T + carry @ wh.T + bi + bh)
+            return h, h
+
+        return step
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        mode = self.MODE
+        n_dir = 2 if self.bidirectional else 1
+        is_lstm = mode == "LSTM"
+        step = self._step(mode)
+        time_major = self.time_major
+        nl, hs = self.num_layers, self.hidden_size
+
+        def f(x, *flat_w):
+            if not time_major:
+                x = jnp.swapaxes(x, 0, 1)  # T, B, C
+            B = x.shape[1]
+            h0 = jnp.zeros((nl * n_dir, B, hs), x.dtype)
+            c0 = jnp.zeros((nl * n_dir, B, hs), x.dtype)
+            ws = [flat_w[i : i + 4] for i in range(0, len(flat_w), 4)]
+            out = x
+            final_h, final_c = [], []
+            for layer in range(nl):
+                dir_outs = []
+                for d in range(n_dir):
+                    wi, wh, bi, bh = ws[layer * n_dir + d]
+                    seq = out if d == 0 else jnp.flip(out, 0)
+                    init = (h0[layer * n_dir + d], c0[layer * n_dir + d]) if is_lstm else h0[layer * n_dir + d]
+                    carry, ys = jax.lax.scan(
+                        lambda c, xt: step(c, xt, wi, wh, bi, bh), init, seq
+                    )
+                    if d == 1:
+                        ys = jnp.flip(ys, 0)
+                    dir_outs.append(ys)
+                    if is_lstm:
+                        final_h.append(carry[0])
+                        final_c.append(carry[1])
+                    else:
+                        final_h.append(carry)
+                out = jnp.concatenate(dir_outs, axis=-1) if n_dir == 2 else dir_outs[0]
+            outputs = out if time_major else jnp.swapaxes(out, 0, 1)
+            if is_lstm:
+                return outputs, jnp.stack(final_h), jnp.stack(final_c)
+            return outputs, jnp.stack(final_h)
+
+        flat = [w for group in self._weights for w in group]
+        res = run_op(f"rnn_{mode}", f, _ensure(inputs), *flat)
+        if is_lstm:
+            return res[0], (res[1], res[2])
+        return res[0], res[1]
+
+
+class LSTM(_RNNBase):
+    MODE = "LSTM"
+
+
+class GRU(_RNNBase):
+    MODE = "GRU"
+
+
+class SimpleRNN(_RNNBase):
+    MODE = "RNN_TANH"
+
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, activation="tanh", **kwargs):
+        self.MODE = "RNN_TANH" if activation == "tanh" else "RNN_RELU"
+        super().__init__(input_size, hidden_size, num_layers, direction, time_major,
+                         dropout, **kwargs)

@@ -1,0 +1,3 @@
+"""Fused TPU kernels (Pallas) — the N8 fused-kernel library equivalent."""
+
+from . import flash_attention  # noqa: F401

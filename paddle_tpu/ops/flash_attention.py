@@ -1,0 +1,60 @@
+"""Flash attention for TPU.
+
+Capability analog of the reference's flash-attn v2 binding
+(``paddle/phi/kernels/gpu/flash_attn_kernel.cu``), built as a Pallas kernel
+(block-streamed online-softmax over KV tiles in VMEM) with an XLA composite
+fallback for small sequences / non-TPU backends.
+
+Layout: [B, S, H, D] (paddle flash-attn convention).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+_FLASH_MIN_SEQ = 1024  # below this, XLA's fused softmax path is already fast
+
+
+def use_flash(q_shape, attn_mask) -> bool:
+    if attn_mask is not None:
+        return False
+    if len(q_shape) != 4:
+        return False
+    seq, head_dim = q_shape[1], q_shape[3]
+    if seq < _FLASH_MIN_SEQ or seq % 512 != 0:
+        return False
+    if head_dim % 128 != 0:
+        return False
+    return jax.default_backend() == "tpu"
+
+
+def _reference_attention(q, k, v, causal: bool):
+    B, Sq, H, D = q.shape
+    scale = 1.0 / math.sqrt(D)
+    qh = jnp.swapaxes(q, 1, 2)
+    kh = jnp.swapaxes(k, 1, 2)
+    vh = jnp.swapaxes(v, 1, 2)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", qh, kh, preferred_element_type=jnp.float32) * scale
+    if causal:
+        Sk = kh.shape[2]
+        mask = jnp.tril(jnp.ones((Sq, Sk), bool), k=Sk - Sq)
+        logits = jnp.where(mask, logits, -jnp.inf)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, vh)
+    return jnp.swapaxes(out, 1, 2)
+
+
+def flash_attention_fwd(q, k, v, causal: bool = False):
+    """Dispatch: Pallas fused kernel on TPU for long sequences, XLA otherwise."""
+    if use_flash(q.shape, None):
+        try:
+            from .pallas_flash import flash_attention as pallas_flash
+
+            return pallas_flash(q, k, v, causal=causal)
+        except Exception:
+            pass
+    return _reference_attention(q, k, v, causal)

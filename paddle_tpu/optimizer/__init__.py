@@ -1,0 +1,18 @@
+from . import lr  # noqa: F401
+from .optimizer import (  # noqa: F401
+    ASGD,
+    LBFGS,
+    Adadelta,
+    Adagrad,
+    Adam,
+    AdamW,
+    Adamax,
+    Lamb,
+    Momentum,
+    NAdam,
+    Optimizer,
+    RAdam,
+    RMSProp,
+    Rprop,
+    SGD,
+)

@@ -1,0 +1,524 @@
+"""Optimizer base + implementations
+(``python/paddle/optimizer/optimizer.py:103`` capability).
+
+TPU-first: every parameter update is a single pure op dispatched through the
+eager tape machinery (``run_op``), with optimizer slots stored as
+Tensor-wrapped device arrays.  Under ``to_static`` the slots are therefore
+captured as threaded state (jit/api.py discovery pass) and the whole
+``opt.step()`` stages into the same XLA program as fwd/bwd — one fused sweep,
+no per-param Python at runtime, and slot evolution (moments, step counters)
+is correct across compiled calls.  Master weights (fp32 copies for bf16/fp16
+params) mirror the reference's AMP O2 master-weight path.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Tuple
+
+import jax.numpy as jnp
+
+from ..core import dtype as dtype_mod
+from ..core.autograd import no_grad
+from ..core.dispatch import run_op
+from ..core.tensor import Parameter, Tensor
+from .lr import LRScheduler
+
+
+class Optimizer:
+    # ordered slot names created per parameter; "" means stateless
+    _slots: Tuple[str, ...] = ()
+
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        self._lr = learning_rate
+        self._parameter_list = list(parameters) if parameters is not None else None
+        self._param_groups = None
+        if self._parameter_list and isinstance(self._parameter_list[0], dict):
+            self._param_groups = self._parameter_list
+            flat = []
+            for g in self._param_groups:
+                flat.extend(g["params"])
+            self._parameter_list = flat
+        self._weight_decay = weight_decay
+        self._grad_clip = grad_clip
+        self._state: Dict[int, Dict[str, Tensor]] = {}
+        self._step_count = 0
+        self._use_master_weights = False
+
+    # --- lr ---------------------------------------------------------------
+    def get_lr(self) -> float:
+        if isinstance(self._lr, LRScheduler):
+            return float(self._lr())
+        return float(self._lr)
+
+    def set_lr(self, value):
+        if isinstance(self._lr, LRScheduler):
+            raise RuntimeError("cannot set_lr when using an LRScheduler")
+        self._lr = value
+
+    def set_lr_scheduler(self, scheduler):
+        self._lr = scheduler
+
+    # --- params -----------------------------------------------------------
+    def _all_params(self) -> List[Parameter]:
+        if self._parameter_list is None:
+            raise ValueError("optimizer constructed without parameters")
+        return self._parameter_list
+
+    def _params_with_group_attrs(self):
+        if self._param_groups is None:
+            for p in self._all_params():
+                yield p, {}
+        else:
+            for g in self._param_groups:
+                attrs = {k: v for k, v in g.items() if k != "params"}
+                for p in g["params"]:
+                    yield p, attrs
+
+    # --- step -------------------------------------------------------------
+    @staticmethod
+    def _decay_value(wd):
+        return 0.0 if wd is None else (wd if isinstance(wd, float) else float(wd))
+
+    def step(self):
+        params_grads = []
+        for p, attrs in self._params_with_group_attrs():
+            if p.grad is None or p.stop_gradient:
+                continue
+            params_grads.append((p, p.grad, attrs))
+        if self._grad_clip is not None:
+            clipped = self._grad_clip([(p, g) for p, g, _ in params_grads])
+            params_grads = [(p, g, a) for (p, _, a), (_, g) in zip(params_grads, clipped)]
+        self._step_count += 1
+        for p, g, attrs in params_grads:
+            self._apply_param(p, g, attrs)
+
+    def _init_state(self, ref_value, state: Dict[str, Tensor]):
+        """Create missing slot Tensors (zeros_like by default)."""
+        for name in self._slots:
+            if name not in state:
+                if name == "t":
+                    state[name] = Tensor(jnp.zeros((), jnp.int32))
+                else:
+                    state[name] = Tensor(jnp.zeros_like(ref_value))
+
+    def _apply_param(self, p: Parameter, grad: Tensor, attrs):
+        lr = self.get_lr() * p.optimize_attr.get("learning_rate", 1.0) * attrs.get(
+            "learning_rate", 1.0
+        )
+        wd = attrs.get("weight_decay", self._weight_decay)
+        key = id(p)
+        state = self._state.setdefault(key, {})
+        use_master = self._use_master_weights and p._value.dtype in (
+            dtype_mod.bfloat16, dtype_mod.float16
+        )
+        if use_master and "master" not in state:
+            state["master"] = Tensor(p._value.astype(jnp.float32))
+        master = state.get("master")
+        ref = master._value if use_master else p._value
+        self._init_state(ref, state)
+        slot_tensors = [state[n] for n in self._slots]
+        w_in = master if use_master else p
+
+        def update_fn(w, g, *slots):
+            out = self._update(w, g.astype(w.dtype), lr, wd, slots, p)
+            return out if isinstance(out, tuple) else (out,)
+
+        with no_grad():
+            outs = run_op(f"opt_{type(self).__name__}", update_fn, w_in, grad, *slot_tensors)
+        new_w = outs[0]
+        if use_master:
+            master._value = new_w._value
+            p._value = new_w._value.astype(p._value.dtype)
+        else:
+            p._value = new_w._value
+        for st, nv in zip(slot_tensors, outs[1:]):
+            st._value = nv._value
+
+    def _update(self, w, g, lr, wd, slots, p):
+        """Pure update: (w, g, *slots) -> (new_w, *new_slots). jnp only."""
+        raise NotImplementedError
+
+    def _coupled_decay(self, g, w, wd, p):
+        """L2 regularization added to the gradient (SGD/Momentum/Adam style)."""
+        d = self._decay_value(wd)
+        if d and getattr(p, "regularizer", None) is None:
+            return g + d * w
+        return g
+
+    def clear_grad(self, set_to_zero=True):
+        for p in self._all_params():
+            p.grad = None
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, None
+
+    # --- state dict -------------------------------------------------------
+    def state_dict(self):
+        out = {"step": self._step_count}
+        names = {id(p): f"p{i}" for i, p in enumerate(self._all_params())}
+        for key, st in self._state.items():
+            for k, v in st.items():
+                out[f"{names.get(key, key)}/{k}"] = v
+        if isinstance(self._lr, LRScheduler):
+            out["LR_Scheduler"] = self._lr.state_dict()
+        return out
+
+    def set_state_dict(self, state):
+        self._step_count = int(state.get("step", 0))
+        names = {f"p{i}": id(p) for i, p in enumerate(self._all_params())}
+        for k, v in state.items():
+            if k in ("step", "LR_Scheduler"):
+                continue
+            pname, sname = k.split("/", 1)
+            key = names.get(pname)
+            if key is None:
+                continue
+            val = v._value if isinstance(v, Tensor) else jnp.asarray(v)
+            self._state.setdefault(key, {})[sname] = Tensor(val)
+        if "LR_Scheduler" in state and isinstance(self._lr, LRScheduler):
+            self._lr.set_state_dict(state["LR_Scheduler"])
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+
+    def _update(self, w, g, lr, wd, slots, p):
+        g = self._coupled_decay(g, w, wd, p)
+        return ((w - lr * g).astype(w.dtype),)
+
+
+class Momentum(Optimizer):
+    _slots = ("velocity",)
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def _update(self, w, g, lr, wd, slots, p):
+        (v,) = slots
+        g = self._coupled_decay(g, w, wd, p)
+        v = self._momentum * v + g
+        if self._nesterov:
+            new_w = w - lr * (g + self._momentum * v)
+        else:
+            new_w = w - lr * v
+        return new_w.astype(w.dtype), v
+
+
+class Adam(Optimizer):
+    _slots = ("m", "v", "t")
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=None, grad_clip=None, lazy_mode=False,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        self._use_master_weights = multi_precision
+
+    def _update(self, w, g, lr, wd, slots, p):
+        m, v, t = slots
+        g = self._coupled_decay(g, w, wd, p)
+        t = t + 1
+        m = self._beta1 * m + (1 - self._beta1) * g
+        v = self._beta2 * v + (1 - self._beta2) * g * g
+        tf = t.astype(w.dtype)
+        mhat = m / (1 - self._beta1**tf)
+        vhat = v / (1 - self._beta2**tf)
+        return (w - lr * mhat / (jnp.sqrt(vhat) + self._eps)).astype(w.dtype), m, v, t
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (adamw_kernel analog)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=0.01, lr_ratio=None, apply_decay_param_fun=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters, None,
+                         grad_clip, lazy_mode, multi_precision, name)
+        self._wd = weight_decay
+        self._apply_decay_param_fun = apply_decay_param_fun
+
+    def _update(self, w, g, lr, wd, slots, p):
+        m, v, t = slots
+        decay = self._wd if wd is None else self._decay_value(wd)
+        if self._apply_decay_param_fun is not None and not self._apply_decay_param_fun(
+            getattr(p, "name", None) or ""
+        ):
+            decay = 0.0
+        t = t + 1
+        m = self._beta1 * m + (1 - self._beta1) * g
+        v = self._beta2 * v + (1 - self._beta2) * g * g
+        tf = t.astype(w.dtype)
+        mhat = m / (1 - self._beta1**tf)
+        vhat = v / (1 - self._beta2**tf)
+        w = w * (1 - lr * decay)
+        return (w - lr * mhat / (jnp.sqrt(vhat) + self._eps)).astype(w.dtype), m, v, t
+
+
+class Adamax(Optimizer):
+    _slots = ("m", "u", "t")
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+
+    def _update(self, w, g, lr, wd, slots, p):
+        m, u, t = slots
+        g = self._coupled_decay(g, w, wd, p)
+        t = t + 1
+        m = self._beta1 * m + (1 - self._beta1) * g
+        u = jnp.maximum(self._beta2 * u, jnp.abs(g))
+        tf = t.astype(w.dtype)
+        new_w = w - lr / (1 - self._beta1**tf) * m / (u + self._eps)
+        return new_w.astype(w.dtype), m, u, t
+
+
+class Adagrad(Optimizer):
+    _slots = ("acc",)
+
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None, weight_decay=None,
+                 grad_clip=None, initial_accumulator_value=0.0, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._eps = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _init_state(self, ref_value, state):
+        if "acc" not in state:
+            state["acc"] = Tensor(jnp.full_like(ref_value, self._init_acc))
+
+    def _update(self, w, g, lr, wd, slots, p):
+        (acc,) = slots
+        g = self._coupled_decay(g, w, wd, p)
+        acc = acc + g * g
+        return (w - lr * g / (jnp.sqrt(acc) + self._eps)).astype(w.dtype), acc
+
+
+class Adadelta(Optimizer):
+    _slots = ("avg_sq", "avg_dx")
+
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._eps, self._rho = epsilon, rho
+
+    def _update(self, w, g, lr, wd, slots, p):
+        avg_sq, avg_dx = slots
+        g = self._coupled_decay(g, w, wd, p)
+        avg_sq = self._rho * avg_sq + (1 - self._rho) * g * g
+        dx = jnp.sqrt(avg_dx + self._eps) / jnp.sqrt(avg_sq + self._eps) * g
+        avg_dx = self._rho * avg_dx + (1 - self._rho) * dx * dx
+        return (w - lr * dx).astype(w.dtype), avg_sq, avg_dx
+
+
+class RMSProp(Optimizer):
+    _slots = ("ms", "mg", "mom")
+
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._rho, self._eps, self._momentum, self._centered = rho, epsilon, momentum, centered
+
+    def _update(self, w, g, lr, wd, slots, p):
+        ms, mg, mom = slots
+        g = self._coupled_decay(g, w, wd, p)
+        ms = self._rho * ms + (1 - self._rho) * g * g
+        if self._centered:
+            mg = self._rho * mg + (1 - self._rho) * g
+            denom = jnp.sqrt(ms - mg * mg + self._eps)
+        else:
+            denom = jnp.sqrt(ms + self._eps)
+        mom = self._momentum * mom + lr * g / denom
+        return (w - mom).astype(w.dtype), ms, mg, mom
+
+
+class Lamb(Optimizer):
+    """Layer-wise adaptive moments (distributed_fused_lamb capability, N8)."""
+
+    _slots = ("m", "v", "t")
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._wd = lamb_weight_decay
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        self._exclude_fn = exclude_from_weight_decay_fn
+        self._use_master_weights = multi_precision
+
+    def _update(self, w, g, lr, wd, slots, p):
+        m, v, t = slots
+        t = t + 1
+        m = self._beta1 * m + (1 - self._beta1) * g
+        v = self._beta2 * v + (1 - self._beta2) * g * g
+        tf = t.astype(w.dtype)
+        mhat = m / (1 - self._beta1**tf)
+        vhat = v / (1 - self._beta2**tf)
+        r = mhat / (jnp.sqrt(vhat) + self._eps)
+        decay = 0.0 if (self._exclude_fn is not None and self._exclude_fn(p)) else self._wd
+        r = r + decay * w
+        w_norm = jnp.linalg.norm(w.astype(jnp.float32))
+        r_norm = jnp.linalg.norm(r.astype(jnp.float32))
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / jnp.maximum(r_norm, 1e-12), 1.0)
+        return (w - lr * trust.astype(w.dtype) * r).astype(w.dtype), m, v, t
+
+
+class NAdam(Optimizer):
+    _slots = ("m", "v", "t", "mu_prod")
+
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 momentum_decay=0.004, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        self._psi = momentum_decay
+
+    def _init_state(self, ref_value, state):
+        super()._init_state(ref_value, state)
+        if "mu_prod" not in state or state["mu_prod"]._value.shape != ():
+            state["mu_prod"] = Tensor(jnp.ones((), jnp.float32))
+
+    def _update(self, w, g, lr, wd, slots, p):
+        m, v, t, mu_prod = slots
+        g = self._coupled_decay(g, w, wd, p)
+        t = t + 1
+        tf = t.astype(jnp.float32)
+        mu_t = self._beta1 * (1 - 0.5 * 0.96 ** (tf * self._psi))
+        mu_t1 = self._beta1 * (1 - 0.5 * 0.96 ** ((tf + 1) * self._psi))
+        mu_prod = mu_prod * mu_t
+        m = self._beta1 * m + (1 - self._beta1) * g
+        v = self._beta2 * v + (1 - self._beta2) * g * g
+        mhat = (mu_t1 * m / (1 - (mu_prod * mu_t1).astype(w.dtype))
+                + (1 - mu_t).astype(w.dtype) * g / (1 - mu_prod.astype(w.dtype)))
+        vhat = v / (1 - self._beta2**tf.astype(w.dtype))
+        return (w - lr * mhat / (jnp.sqrt(vhat) + self._eps)).astype(w.dtype), m, v, t, mu_prod
+
+
+class RAdam(Optimizer):
+    _slots = ("m", "v", "t")
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+
+    def _update(self, w, g, lr, wd, slots, p):
+        m, v, t = slots
+        g = self._coupled_decay(g, w, wd, p)
+        t = t + 1
+        tf = t.astype(jnp.float32)
+        m = self._beta1 * m + (1 - self._beta1) * g
+        v = self._beta2 * v + (1 - self._beta2) * g * g
+        rho_inf = 2.0 / (1 - self._beta2) - 1
+        rho_t = rho_inf - 2 * tf * self._beta2**tf / (1 - self._beta2**tf)
+        mhat = m / (1 - self._beta1**tf.astype(w.dtype))
+        lt = jnp.sqrt(1 - self._beta2**tf)
+        rt_sq = ((rho_t - 4) * (rho_t - 2) * rho_inf) / jnp.maximum(
+            (rho_inf - 4) * (rho_inf - 2) * rho_t, 1e-12
+        )
+        rt = jnp.sqrt(jnp.clip(rt_sq, 0.0, None))
+        rect = (rt * lt).astype(w.dtype) * mhat / (jnp.sqrt(v) + self._eps)
+        plain = mhat
+        step = jnp.where(rho_t > 5.0, rect, plain)
+        return (w - lr * step).astype(w.dtype), m, v, t
+
+
+class ASGD(SGD):
+    pass
+
+
+class Rprop(Optimizer):
+    _slots = ("prev_g", "step_size")
+
+    def __init__(self, learning_rate=0.01, learning_rate_range=(1e-5, 50),
+                 parameters=None, etas=(0.5, 1.2), grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._lr_range = learning_rate_range
+        self._etas = etas
+
+    def _init_state(self, ref_value, state):
+        if "prev_g" not in state:
+            state["prev_g"] = Tensor(jnp.zeros_like(ref_value))
+        if "step_size" not in state:
+            state["step_size"] = Tensor(jnp.full_like(ref_value, self.get_lr()))
+
+    def _update(self, w, g, lr, wd, slots, p):
+        prev_g, step = slots
+        sign = jnp.sign(g * prev_g)
+        step = jnp.clip(
+            jnp.where(sign > 0, step * self._etas[1],
+                      jnp.where(sign < 0, step * self._etas[0], step)),
+            self._lr_range[0], self._lr_range[1],
+        )
+        g_eff = jnp.where(sign < 0, 0.0, g)
+        return (w - jnp.sign(g_eff) * step).astype(w.dtype), g_eff, step
+
+
+class LBFGS(Optimizer):
+    """Limited-memory BFGS (optimizer/lbfgs.py capability) — closure-based
+    ``step(closure)`` with two-loop recursion over a history buffer.
+    Eager-only (history length is data-dependent)."""
+
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None, tolerance_grad=1e-7,
+                 tolerance_change=1e-9, history_size=100, line_search_fn=None,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._max_iter = max_iter
+        self._history_size = history_size
+        self._s, self._y = [], []
+        self._prev_flat_g = None
+        self._prev_flat_w = None
+
+    def _flat(self, vals):
+        return jnp.concatenate([v.reshape(-1) for v in vals])
+
+    def step(self, closure=None):
+        if closure is None:
+            raise ValueError("LBFGS requires a closure returning the loss")
+        loss = closure()
+        params = [p for p in self._all_params() if p.grad is not None]
+        flat_g = self._flat([p.grad._value.astype(jnp.float32) for p in params])
+        flat_w = self._flat([p._value.astype(jnp.float32) for p in params])
+        if self._prev_flat_g is not None:
+            s = flat_w - self._prev_flat_w
+            y = flat_g - self._prev_flat_g
+            if float(jnp.dot(s, y)) > 1e-10:
+                self._s.append(s)
+                self._y.append(y)
+                if len(self._s) > self._history_size:
+                    self._s.pop(0)
+                    self._y.pop(0)
+        q = flat_g
+        alphas = []
+        for s, y in zip(reversed(self._s), reversed(self._y)):
+            rho = 1.0 / jnp.dot(y, s)
+            a = rho * jnp.dot(s, q)
+            q = q - a * y
+            alphas.append((a, rho, s, y))
+        if self._s:
+            gamma = jnp.dot(self._s[-1], self._y[-1]) / jnp.dot(self._y[-1], self._y[-1])
+            q = gamma * q
+        for a, rho, s, y in reversed(alphas):
+            b = rho * jnp.dot(y, q)
+            q = q + (a - b) * s
+        direction = -q
+        self._prev_flat_g = flat_g
+        self._prev_flat_w = flat_w
+        lr = self.get_lr()
+        offset = 0
+        for p in params:
+            n = p.size
+            upd = direction[offset : offset + n].reshape(p._value.shape)
+            p._value = p._value + lr * upd.astype(p._value.dtype)
+            offset += n
+        return loss

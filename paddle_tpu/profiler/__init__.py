@@ -1,0 +1,150 @@
+"""``paddle.profiler`` over jax.profiler / XPlane (N34 TPU mapping).
+
+The reference profiler (``fluid/platform/profiler/``: HostTracer + CUPTI
+CudaTracer -> chrome trace) maps onto ``jax.profiler`` which captures host +
+TPU device timelines into a TensorBoard/XPlane trace (viewable in Perfetto).
+``RecordEvent`` maps to ``jax.profiler.TraceAnnotation``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+from enum import Enum
+from typing import Callable, Iterable, Optional
+
+import jax
+
+
+class ProfilerState(Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+class ProfilerTarget(Enum):
+    CPU = 0
+    GPU = 1
+    CUSTOM_DEVICE = 2
+    TPU = 3
+
+
+def make_scheduler(closed=0, ready=0, record=1, repeat=0, skip_first=0):
+    def scheduler(step: int):
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        s = step - skip_first
+        total = closed + ready + record
+        if repeat and s >= repeat * total:
+            return ProfilerState.CLOSED
+        pos = s % total
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == total - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return scheduler
+
+
+def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None):
+    def handler(prof):
+        prof._log_dir = dir_name
+
+    return handler
+
+
+class Profiler:
+    """``paddle.profiler.Profiler`` analog (profiler/profiler.py:346)."""
+
+    def __init__(self, targets=None, scheduler=None, on_trace_ready=None,
+                 timer_only=False, record_shapes=False, profile_memory=False,
+                 with_flops=False, **kwargs):
+        self._scheduler = scheduler if callable(scheduler) else (
+            make_scheduler(record=scheduler[1] - scheduler[0], skip_first=scheduler[0])
+            if isinstance(scheduler, (tuple, list)) else None
+        )
+        self._on_trace_ready = on_trace_ready
+        self._timer_only = timer_only
+        self._log_dir = os.environ.get("PADDLE_TPU_PROFILE_DIR", "/tmp/paddle_tpu_profile")
+        self._step = 0
+        self._active = False
+        self._step_times = []
+        self._last_t = None
+
+    def start(self):
+        if not self._timer_only:
+            jax.profiler.start_trace(self._log_dir)
+            self._active = True
+        self._last_t = time.perf_counter()
+
+    def stop(self):
+        if self._active:
+            jax.profiler.stop_trace()
+            self._active = False
+        if self._on_trace_ready:
+            self._on_trace_ready(self)
+
+    def step(self, num_samples: Optional[int] = None):
+        now = time.perf_counter()
+        if self._last_t is not None:
+            self._step_times.append(now - self._last_t)
+        self._last_t = now
+        self._step += 1
+
+    def step_info(self, unit=None):
+        if not self._step_times:
+            return "no steps recorded"
+        avg = sum(self._step_times[-10:]) / len(self._step_times[-10:])
+        return f"avg step time {avg * 1000:.2f} ms"
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False, time_unit="ms"):
+        print(self.step_info())
+
+    def export(self, path: str, format: str = "json"):
+        print(f"trace written under {self._log_dir} (XPlane/TensorBoard format)")
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+
+class RecordEvent:
+    """Trace annotation (host_tracer.h:26 RecordEvent analog)."""
+
+    def __init__(self, name: str, event_type=None):
+        self._ann = jax.profiler.TraceAnnotation(name)
+
+    def begin(self):
+        self._ann.__enter__()
+
+    def end(self):
+        self._ann.__exit__(None, None, None)
+
+    def __enter__(self):
+        self._ann.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        self._ann.__exit__(None, None, None)
+        return False
+
+
+def load_profiler_result(filename: str):
+    raise NotImplementedError("use TensorBoard / Perfetto on the XPlane trace dir")
+
+
+@contextlib.contextmanager
+def benchmark():
+    t0 = time.perf_counter()
+    yield
+    jax.effects_barrier()
+    print(f"benchmark: {time.perf_counter() - t0:.4f}s")

@@ -1,0 +1,102 @@
+"""``paddle.sparse`` over jax.experimental.sparse (N9 capability).
+
+COO/CSR tensors ride JAX's BCOO/BCSR; sparse matmul lowers to XLA
+scatter/gather (TPU has no sparse MXU path — same position as the reference's
+cuSPARSE fallback for unsupported shapes).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import sparse as jsparse
+
+from ..core.tensor import Tensor, to_tensor
+
+
+class SparseCooTensor(Tensor):
+    """Wrapper marking a Tensor as sparse COO; holds a BCOO internally."""
+
+    __slots__ = ("bcoo",)
+
+    def __init__(self, bcoo, stop_gradient=True):
+        self.bcoo = bcoo
+        super().__init__(bcoo.todense(), stop_gradient=stop_gradient)
+
+    def indices(self):
+        return Tensor(self.bcoo.indices.T)
+
+    def values(self):
+        return Tensor(self.bcoo.data)
+
+    def to_dense(self):
+        return Tensor(self.bcoo.todense())
+
+    @property
+    def nnz(self):
+        return int(self.bcoo.nse)
+
+
+class SparseCsrTensor(Tensor):
+    __slots__ = ("bcsr",)
+
+    def __init__(self, bcsr, stop_gradient=True):
+        self.bcsr = bcsr
+        super().__init__(bcsr.todense(), stop_gradient=stop_gradient)
+
+    def crows(self):
+        return Tensor(self.bcsr.indptr)
+
+    def cols(self):
+        return Tensor(self.bcsr.indices)
+
+    def values(self):
+        return Tensor(self.bcsr.data)
+
+    def to_dense(self):
+        return Tensor(self.bcsr.todense())
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None, stop_gradient=True):
+    idx = indices._value if isinstance(indices, Tensor) else jnp.asarray(indices)
+    val = values._value if isinstance(values, Tensor) else jnp.asarray(values)
+    bcoo = jsparse.BCOO((val, idx.T.astype(jnp.int32)), shape=tuple(shape))
+    return SparseCooTensor(bcoo, stop_gradient)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None, stop_gradient=True):
+    cr = crows._value if isinstance(crows, Tensor) else jnp.asarray(crows)
+    cc = cols._value if isinstance(cols, Tensor) else jnp.asarray(cols)
+    val = values._value if isinstance(values, Tensor) else jnp.asarray(values)
+    bcsr = jsparse.BCSR((val, cc.astype(jnp.int32), cr.astype(jnp.int32)), shape=tuple(shape))
+    return SparseCsrTensor(bcsr, stop_gradient)
+
+
+def matmul(x, y, name=None):
+    if isinstance(x, SparseCooTensor):
+        yv = y._value if isinstance(y, Tensor) else jnp.asarray(y)
+        return Tensor(x.bcoo @ yv)
+    if isinstance(x, SparseCsrTensor):
+        yv = y._value if isinstance(y, Tensor) else jnp.asarray(y)
+        return Tensor(x.bcsr @ yv)
+    from ..tensor import matmul as dense_matmul
+
+    return dense_matmul(x, y)
+
+
+def add(x, y, name=None):
+    if isinstance(x, SparseCooTensor) and isinstance(y, SparseCooTensor):
+        return Tensor(x.bcoo.todense() + y.bcoo.todense())
+    return Tensor(x._value + y._value)
+
+
+def relu(x, name=None):
+    if isinstance(x, SparseCooTensor):
+        bcoo = jsparse.BCOO((jax.nn.relu(x.bcoo.data), x.bcoo.indices), shape=x.bcoo.shape)
+        return SparseCooTensor(bcoo)
+    return Tensor(jax.nn.relu(x._value))
+
+
+def is_same_shape(x, y):
+    return tuple(x.shape) == tuple(y.shape)

@@ -1,0 +1,27 @@
+"""Minimal ``paddle.static`` surface.
+
+The TPU runtime is dynamic-first (SURVEY.md §7); static-graph capture is
+``paddle_tpu.jit.to_static`` over the same eager code.  This module keeps the
+pieces other APIs depend on (InputSpec, name guards).
+"""
+
+from __future__ import annotations
+
+from ..core import dtype as dtype_mod
+
+
+class InputSpec:
+    """``paddle.static.InputSpec`` analog."""
+
+    def __init__(self, shape, dtype="float32", name=None, stop_gradient=False):
+        self.shape = list(shape)
+        self.dtype = dtype_mod.convert_dtype(dtype)
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+    @classmethod
+    def from_tensor(cls, tensor, name=None):
+        return cls(tensor.shape, tensor.dtype, name)
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype}, name={self.name})"

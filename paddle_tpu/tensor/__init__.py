@@ -1,0 +1,160 @@
+"""Aggregated tensor op namespace + Tensor method monkey-patching.
+
+Analog of ``python/paddle/tensor/__init__.py`` which attaches the op surface
+onto ``paddle.Tensor`` (the reference does this via ``monkey_patch_tensor``).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.dispatch import run_op
+from ..core.tensor import Parameter, Tensor, to_tensor
+from . import creation, linalg, logic, manipulation, math, random, search
+from .creation import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .random import *  # noqa: F401,F403
+from .search import *  # noqa: F401,F403
+
+# names where the module function shadows a python builtin
+from .math import abs, all, any, max, min, pow, round, sum  # noqa: F401,A004
+
+
+def rank(x):
+    return to_tensor(x.ndim)
+
+
+def shape(x):
+    return to_tensor(x.shape)
+
+
+def numel(x, name=None):
+    return to_tensor(x.size)
+
+
+def is_floating_point(x):
+    from ..core import dtype as dtype_mod
+
+    return dtype_mod.is_floating_point(x.dtype)
+
+
+def is_complex(x):
+    from ..core import dtype as dtype_mod
+
+    return dtype_mod.is_complex(x.dtype)
+
+
+def is_integer(x):
+    from ..core import dtype as dtype_mod
+
+    return dtype_mod.is_integer(x.dtype)
+
+
+# --------------------------------------------------------------------------
+# Monkey-patch Tensor methods (math_op_patch analog)
+# --------------------------------------------------------------------------
+
+_METHOD_MODULES = [creation, math, manipulation, linalg, logic, random, search]
+
+_SKIP = {
+    "to_tensor", "zeros", "ones", "full", "empty", "arange", "linspace", "logspace",
+    "eye", "meshgrid", "rand", "randn", "randint", "randperm", "uniform", "normal",
+    "gaussian", "standard_normal", "standard_gamma", "standard_exponential",
+    "tril_indices", "triu_indices", "assign", "scatter_nd", "binomial",
+}
+
+
+def _attach_methods():
+    import types
+
+    for mod in _METHOD_MODULES:
+        for name in dir(mod):
+            if name.startswith("_") or name in _SKIP:
+                continue
+            fn = getattr(mod, name)
+            if not callable(fn) or isinstance(fn, type):
+                continue
+            if hasattr(Tensor, name):
+                continue
+            setattr(Tensor, name, fn)
+    # extras with different receiver semantics
+    Tensor.rank = property(lambda self: self.ndim)
+    Tensor.item_size = property(lambda self: self._value.dtype.itemsize)
+    Tensor.element_size = lambda self: self._value.dtype.itemsize
+    Tensor.is_floating_point = lambda self: is_floating_point(self)
+    Tensor.is_complex = lambda self: is_complex(self)
+    Tensor.is_integer = lambda self: is_integer(self)
+    Tensor.dot = linalg.dot
+    Tensor.matmul = math.matmul
+    Tensor.mm = math.mm
+
+
+def _attach_dunders():
+    def _bin(fn, swap=False):
+        def method(self, other):
+            if swap:
+                return fn(to_tensor(other) if not isinstance(other, Tensor) else other, self)
+            return fn(self, other)
+
+        return method
+
+    Tensor.__add__ = _bin(math.add)
+    Tensor.__radd__ = _bin(math.add, swap=True)
+    Tensor.__sub__ = _bin(math.subtract)
+    Tensor.__rsub__ = _bin(math.subtract, swap=True)
+    Tensor.__mul__ = _bin(math.multiply)
+    Tensor.__rmul__ = _bin(math.multiply, swap=True)
+    Tensor.__truediv__ = _bin(math.divide)
+    Tensor.__rtruediv__ = _bin(math.divide, swap=True)
+    Tensor.__floordiv__ = _bin(math.floor_divide)
+    Tensor.__rfloordiv__ = _bin(math.floor_divide, swap=True)
+    Tensor.__mod__ = _bin(math.mod)
+    Tensor.__rmod__ = _bin(math.mod, swap=True)
+    Tensor.__pow__ = _bin(math.pow)
+    Tensor.__rpow__ = _bin(math.pow, swap=True)
+    Tensor.__matmul__ = _bin(math.matmul)
+    Tensor.__rmatmul__ = _bin(math.matmul, swap=True)
+    Tensor.__neg__ = lambda self: math.neg(self)
+    Tensor.__abs__ = lambda self: math.abs(self)
+    Tensor.__invert__ = lambda self: logic.logical_not(self) if self.dtype == jnp.bool_ else logic.bitwise_not(self)
+    Tensor.__and__ = _bin(logic.bitwise_and)
+    Tensor.__or__ = _bin(logic.bitwise_or)
+    Tensor.__xor__ = _bin(logic.bitwise_xor)
+    Tensor.__lshift__ = _bin(logic.bitwise_left_shift)
+    Tensor.__rshift__ = _bin(logic.bitwise_right_shift)
+    Tensor.__eq__ = _bin(logic.equal)
+    Tensor.__ne__ = _bin(logic.not_equal)
+    Tensor.__lt__ = _bin(logic.less_than)
+    Tensor.__le__ = _bin(logic.less_equal)
+    Tensor.__gt__ = _bin(logic.greater_than)
+    Tensor.__ge__ = _bin(logic.greater_equal)
+
+
+def _attach_inplace():
+    """paddle in-place variants (functionalized: rebind wrapper to new value)."""
+
+    def _ip(fn):
+        def method(self, *args, **kwargs):
+            return self._rebind(fn(self, *args, **kwargs))
+
+        return method
+
+    for name, fn in [
+        ("add_", math.add), ("subtract_", math.subtract), ("multiply_", math.multiply),
+        ("divide_", math.divide), ("clip_", math.clip), ("scale_", math.scale),
+        ("floor_", math.floor), ("ceil_", math.ceil), ("round_", math.round),
+        ("exp_", math.exp), ("sqrt_", math.sqrt), ("rsqrt_", math.rsqrt),
+        ("reciprocal_", math.reciprocal), ("sigmoid_", math.sigmoid),
+        ("tanh_", math.tanh), ("abs_", math.abs), ("pow_", math.pow),
+        ("remainder_", math.mod), ("mod_", math.mod), ("neg_", math.neg),
+    ]:
+        if not hasattr(Tensor, name):
+            setattr(Tensor, name, _ip(fn))
+
+
+_attach_methods()
+_attach_dunders()
+_attach_inplace()

@@ -1,0 +1,189 @@
+"""Tensor creation ops (``python/paddle/tensor/creation.py`` capability)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as dtype_mod
+from ..core.dispatch import run_op
+from ..core.tensor import Tensor, to_tensor  # re-export to_tensor
+
+
+def _d(dtype, default_float=True):
+    d = dtype_mod.convert_dtype(dtype)
+    if d is None and default_float:
+        d = dtype_mod.get_default_dtype()
+    return d
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in np.asarray(shape._value))
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s._value) if isinstance(s, Tensor) else int(s) for s in shape)
+
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(_shape(shape), _d(dtype)))
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor(jnp.ones(_shape(shape), _d(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value._value
+    d = dtype_mod.convert_dtype(dtype)
+    if d is None:
+        if isinstance(fill_value, bool):
+            d = dtype_mod.bool_
+        elif isinstance(fill_value, int):
+            d = dtype_mod.get_default_dtype()  # paddle: float32 default for full
+        else:
+            d = dtype_mod.get_default_dtype()
+    return Tensor(jnp.full(_shape(shape), fill_value, d))
+
+
+def zeros_like(x, dtype=None, name=None):
+    return run_op("zeros_like", lambda v: jnp.zeros_like(v, dtype=_d(dtype, False)), x)
+
+
+def ones_like(x, dtype=None, name=None):
+    return run_op("ones_like", lambda v: jnp.ones_like(v, dtype=_d(dtype, False)), x)
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value._value
+    return run_op(
+        "full_like", lambda v: jnp.full_like(v, fill_value, dtype=_d(dtype, False)), x
+    )
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    for v in ("start", "end", "step"):
+        pass
+    if isinstance(start, Tensor):
+        start = start.item()
+    if isinstance(end, Tensor):
+        end = end.item()
+    if isinstance(step, Tensor):
+        step = step.item()
+    if end is None:
+        start, end = 0, start
+    d = dtype_mod.convert_dtype(dtype)
+    if d is None:
+        if all(isinstance(v, (int, np.integer)) for v in (start, end, step)):
+            d = dtype_mod.int64
+        else:
+            d = dtype_mod.get_default_dtype()
+    return Tensor(jnp.arange(start, end, step, dtype=d))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    if isinstance(start, Tensor):
+        start = start.item()
+    if isinstance(stop, Tensor):
+        stop = stop.item()
+    if isinstance(num, Tensor):
+        num = int(num.item())
+    return Tensor(jnp.linspace(start, stop, int(num), dtype=_d(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    return Tensor(jnp.logspace(start, stop, int(num), base=base, dtype=_d(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(jnp.eye(int(num_rows), num_columns and int(num_columns), dtype=_d(dtype)))
+
+
+def meshgrid(*args, **kwargs):
+    ts = args[0] if len(args) == 1 and isinstance(args[0], (list, tuple)) else args
+    ts = [t if isinstance(t, Tensor) else to_tensor(t) for t in ts]
+    return list(run_op("meshgrid", lambda *xs: tuple(jnp.meshgrid(*xs, indexing="ij")), *ts))
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    def f(v):
+        out = jnp.diag(v, k=offset)
+        if v.ndim == 1 and padding_value != 0:
+            mask = jnp.eye(out.shape[0], dtype=bool, k=offset)
+            out = jnp.where(mask, out, jnp.asarray(padding_value, out.dtype))
+        return out
+
+    return run_op("diag", f, x)
+
+
+def diagflat(x, offset=0, name=None):
+    return run_op("diagflat", lambda v: jnp.diagflat(v, k=offset), x)
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1, name=None):
+    def f(v):
+        n = v.shape[-1] + abs(offset)
+        m = jnp.zeros(v.shape[:-1] + (n, n), v.dtype)
+        idx = jnp.arange(v.shape[-1])
+        m = m.at[..., idx + max(0, -offset), idx + max(0, offset)].set(v)
+        nd = m.ndim
+        d1 = dim1 if dim1 >= 0 else nd + dim1
+        d2 = dim2 if dim2 >= 0 else nd + dim2
+        return jnp.moveaxis(jnp.moveaxis(m, -2, d1), -1, d2)
+
+    return run_op("diag_embed", f, x)
+
+
+def tril(x, diagonal=0, name=None):
+    return run_op("tril", lambda v: jnp.tril(v, k=diagonal), x)
+
+
+def triu(x, diagonal=0, name=None):
+    return run_op("triu", lambda v: jnp.triu(v, k=diagonal), x)
+
+
+def tril_indices(row, col, offset=0, dtype="int64"):
+    r, c = np.tril_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype=_d(dtype, False)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    r, c = np.triu_indices(row, offset, col if col is not None else row)
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype=_d(dtype, False)))
+
+
+def assign(x, output=None):
+    v = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    if output is not None:
+        output.set_value(v)
+        return output
+    return Tensor(v)
+
+
+def clone(x, name=None):
+    return x.clone()
+
+
+def complex(real, imag, name=None):
+    return run_op("complex", lambda r, i: jax.lax.complex(r, i), real, imag)
+
+
+def polar(abs_t, angle, name=None):
+    return run_op(
+        "polar", lambda a, th: jax.lax.complex(a * jnp.cos(th), a * jnp.sin(th)), abs_t, angle
+    )
+
+
+def clone_detached(x):
+    return x.detach().clone()

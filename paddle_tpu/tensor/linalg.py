@@ -1,0 +1,307 @@
+"""Linear algebra ops (``python/paddle/tensor/linalg.py`` capability).
+
+Decompositions ride ``jax.numpy.linalg`` / ``jax.scipy.linalg`` — on TPU these
+lower to XLA custom calls or QR-iteration HLO; matmuls go to the MXU.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import run_op
+from ..core.tensor import Tensor, to_tensor
+from .math import addmm, bmm, dot, matmul, mm  # re-export
+
+
+def _ensure(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def einsum(equation, *operands):
+    ts = [_ensure(o) for o in operands]
+    return run_op("einsum", lambda *xs: jnp.einsum(equation, *xs), *ts)
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    if p is None:
+        p = "fro" if axis is None or isinstance(axis, (list, tuple)) else 2
+
+    def f(v):
+        if axis is None:
+            flat = v.reshape(-1)
+            if p == "fro" or p == 2:
+                return jnp.linalg.norm(flat)
+            if p == np.inf or p == float("inf"):
+                return jnp.max(jnp.abs(flat))
+            if p == -np.inf or p == float("-inf"):
+                return jnp.min(jnp.abs(flat))
+            if p == 0:
+                return jnp.sum(flat != 0).astype(v.dtype)
+            return jnp.sum(jnp.abs(flat) ** p) ** (1.0 / p)
+        ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+        ord_ = None if p == "fro" else p
+        return jnp.linalg.norm(v, ord=ord_, axis=ax, keepdims=keepdim)
+
+    return run_op("norm", f, _ensure(x))
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    def f(v):
+        ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+        return jnp.linalg.vector_norm(v, ord=p, axis=ax, keepdims=keepdim)
+
+    return run_op("vector_norm", f, _ensure(x))
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
+    return run_op(
+        "matrix_norm",
+        lambda v: jnp.linalg.matrix_norm(v, ord=p, keepdims=keepdim),
+        _ensure(x),
+    )
+
+
+def dist(x, y, p=2, name=None):
+    return run_op("dist", lambda a, b: _dist_impl(a, b, p), _ensure(x), _ensure(y))
+
+
+def _dist_impl(a, b, p):
+    d = (a - b).reshape(-1)
+    if p == 0:
+        return jnp.sum(d != 0).astype(a.dtype)
+    if p == float("inf"):
+        return jnp.max(jnp.abs(d))
+    if p == float("-inf"):
+        return jnp.min(jnp.abs(d))
+    return jnp.sum(jnp.abs(d) ** p) ** (1.0 / p)
+
+
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary", name=None):
+    def f(a, b):
+        diff = a[..., :, None, :] - b[..., None, :, :]
+        if p == 2.0:
+            return jnp.sqrt(jnp.sum(diff * diff, axis=-1) + 1e-30)
+        return jnp.sum(jnp.abs(diff) ** p, axis=-1) ** (1.0 / p)
+
+    return run_op("cdist", f, _ensure(x), _ensure(y))
+
+
+def cross(x, y, axis=9, name=None):
+    def f(a, b):
+        ax = axis
+        if ax == 9:  # paddle default: first axis of size 3
+            ax = next(i for i, s in enumerate(a.shape) if s == 3)
+        return jnp.cross(a, b, axis=ax)
+
+    return run_op("cross", f, _ensure(x), _ensure(y))
+
+
+def cholesky(x, upper=False, name=None):
+    def f(v):
+        L = jnp.linalg.cholesky(v)
+        return jnp.swapaxes(L, -1, -2).conj() if upper else L
+
+    return run_op("cholesky", f, _ensure(x))
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    def f(b, L):
+        return jax.scipy.linalg.cho_solve((L, not upper), b)
+
+    return run_op("cholesky_solve", f, _ensure(x), _ensure(y))
+
+
+def qr(x, mode="reduced", name=None):
+    out = run_op("qr", lambda v: tuple(jnp.linalg.qr(v, mode=mode)) if mode != "r" else (jnp.linalg.qr(v, mode="r"),), _ensure(x))
+    return out[0] if mode == "r" else tuple(out)
+
+
+def svd(x, full_matrices=False, name=None):
+    return tuple(
+        run_op(
+            "svd",
+            lambda v: tuple(jnp.linalg.svd(v, full_matrices=full_matrices)),
+            _ensure(x),
+        )
+    )
+
+
+def svdvals(x, name=None):
+    return run_op("svdvals", lambda v: jnp.linalg.svd(v, compute_uv=False), _ensure(x))
+
+
+def svd_lowrank(x, q=6, niter=2, M=None, name=None):
+    def f(v):
+        u, s, vt = jnp.linalg.svd(v, full_matrices=False)
+        return u[..., :q], s[..., :q], jnp.swapaxes(vt, -1, -2)[..., :q]
+
+    return tuple(run_op("svd_lowrank", f, _ensure(x)))
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    xv = _ensure(x)
+    k = q if q is not None else min(6, *xv.shape[-2:])
+
+    def f(v):
+        if center:
+            v = v - jnp.mean(v, axis=-2, keepdims=True)
+        u, s, vt = jnp.linalg.svd(v, full_matrices=False)
+        return u[..., :k], s[..., :k], jnp.swapaxes(vt, -1, -2)[..., :k]
+
+    return tuple(run_op("pca_lowrank", f, xv))
+
+
+def inv(x, name=None):
+    return run_op("inv", jnp.linalg.inv, _ensure(x))
+
+
+inverse = inv
+
+
+def det(x, name=None):
+    return run_op("det", jnp.linalg.det, _ensure(x))
+
+
+def slogdet(x, name=None):
+    out = run_op("slogdet", lambda v: tuple(jnp.linalg.slogdet(v)), _ensure(x))
+    # paddle returns stacked [sign, logdet]
+    from .manipulation import stack
+
+    return stack(list(out), axis=0)
+
+
+def solve(x, y, name=None):
+    def f(a, b):
+        if b.ndim == a.ndim - 1:
+            return jnp.linalg.solve(a, b[..., None])[..., 0]
+        return jnp.linalg.solve(a, b)
+
+    return run_op("solve", f, _ensure(x), _ensure(y))
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    def f(a, b):
+        return jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0, unit_diagonal=unitriangular
+        )
+
+    return run_op("triangular_solve", f, _ensure(x), _ensure(y))
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    def f(a, b):
+        sol, res, rank, sv = jnp.linalg.lstsq(a, b, rcond=rcond)
+        return sol, res, rank, sv
+
+    return tuple(run_op("lstsq", f, _ensure(x), _ensure(y)))
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    def f(v):
+        lu_, piv = jax.scipy.linalg.lu_factor(v)
+        return lu_, piv.astype(jnp.int32) + 1  # paddle pivots are 1-based
+
+    out = run_op("lu", f, _ensure(x))
+    if get_infos:
+        from .creation import zeros
+
+        return out[0], out[1], zeros([1], "int32")
+    return tuple(out)
+
+
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    def f(lu_, piv):
+        m = lu_.shape[-2]
+        L = jnp.tril(lu_, -1) + jnp.eye(m, lu_.shape[-1], dtype=lu_.dtype)
+        L = L[..., :, : min(lu_.shape[-2:])]
+        U = jnp.triu(lu_)[..., : min(lu_.shape[-2:]), :]
+        perm = jnp.eye(m, dtype=lu_.dtype)
+        p0 = piv - 1
+
+        def apply_swap(P, i):
+            row_i = P[i]
+            row_j = P[p0[i]]
+            P = P.at[i].set(row_j)
+            P = P.at[p0[i]].set(row_i)
+            return P, None
+
+        P, _ = jax.lax.scan(apply_swap, perm, jnp.arange(p0.shape[-1]))
+        return jnp.swapaxes(P, -1, -2), L, U
+
+    return tuple(run_op("lu_unpack", f, _ensure(x), _ensure(y)))
+
+
+def eig(x, name=None):
+    # XLA has no nonsymmetric eig on device; compute on host (same capability
+    # position as the reference's LAPACK-backed CPU eig kernel).
+    xv = np.asarray(_ensure(x)._value)
+    w, v = np.linalg.eig(xv)
+    return to_tensor(w), to_tensor(v)
+
+
+def eigh(x, UPLO="L", name=None):
+    return tuple(run_op("eigh", lambda v: tuple(jnp.linalg.eigh(v, symmetrize_input=True)), _ensure(x)))
+
+
+def eigvals(x, name=None):
+    xv = np.asarray(_ensure(x)._value)
+    return to_tensor(np.linalg.eigvals(xv))
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return run_op("eigvalsh", jnp.linalg.eigvalsh, _ensure(x))
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return run_op("pinv", lambda v: jnp.linalg.pinv(v, rtol=rcond, hermitian=hermitian), _ensure(x))
+
+
+def matrix_power(x, n, name=None):
+    return run_op("matrix_power", lambda v: jnp.linalg.matrix_power(v, n), _ensure(x))
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    tv = tol._value if isinstance(tol, Tensor) else tol
+    return run_op("matrix_rank", lambda v: jnp.linalg.matrix_rank(v, tol=tv), _ensure(x))
+
+
+def matrix_exp(x, name=None):
+    return run_op("matrix_exp", jax.scipy.linalg.expm, _ensure(x))
+
+
+def multi_dot(x, name=None):
+    ts = [_ensure(t) for t in x]
+    return run_op("multi_dot", lambda *xs: jnp.linalg.multi_dot(list(xs)), *ts)
+
+
+def householder_product(x, tau, name=None):
+    def f(v, t):
+        m, n = v.shape[-2], v.shape[-1]
+        eye = jnp.eye(m, dtype=v.dtype)
+
+        def body(i, Q):
+            w = jnp.where(jnp.arange(m) > i, v[..., :, i], jnp.where(jnp.arange(m) == i, 1.0, 0.0))
+            H = eye - t[..., i] * jnp.outer(w, w)
+            return Q @ H
+
+        Q = eye
+        Q = jax.lax.fori_loop(0, n, body, Q)
+        return Q[..., :, :n]
+
+    return run_op("householder_product", f, _ensure(x), _ensure(tau))
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return run_op("corrcoef", lambda v: jnp.corrcoef(v, rowvar=rowvar), _ensure(x))
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    fw = fweights._value if isinstance(fweights, Tensor) else fweights
+    aw = aweights._value if isinstance(aweights, Tensor) else aweights
+    return run_op(
+        "cov",
+        lambda v: jnp.cov(v, rowvar=rowvar, ddof=1 if ddof else 0, fweights=fw, aweights=aw),
+        _ensure(x),
+    )

@@ -1,0 +1,78 @@
+"""Comparison / logical / bitwise ops (``python/paddle/tensor/logic.py``)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import run_op
+from ..core.tensor import Tensor, to_tensor
+
+
+def _ensure(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def _binary(name, fn):
+    def op(x, y, name=None):
+        x = _ensure(x)
+        if isinstance(y, Tensor):
+            return run_op(name, fn, x, y)
+        return run_op(name, lambda a: fn(a, y), x)
+
+    op.__name__ = name
+    return op
+
+
+equal = _binary("equal", lambda a, b: jnp.equal(a, b))
+not_equal = _binary("not_equal", jnp.not_equal)
+greater_than = _binary("greater_than", jnp.greater)
+greater_equal = _binary("greater_equal", jnp.greater_equal)
+less_than = _binary("less_than", jnp.less)
+less_equal = _binary("less_equal", jnp.less_equal)
+logical_and = _binary("logical_and", jnp.logical_and)
+logical_or = _binary("logical_or", jnp.logical_or)
+logical_xor = _binary("logical_xor", jnp.logical_xor)
+bitwise_and = _binary("bitwise_and", jnp.bitwise_and)
+bitwise_or = _binary("bitwise_or", jnp.bitwise_or)
+bitwise_xor = _binary("bitwise_xor", jnp.bitwise_xor)
+bitwise_left_shift = _binary("bitwise_left_shift", jnp.left_shift)
+bitwise_right_shift = _binary("bitwise_right_shift", jnp.right_shift)
+
+
+def logical_not(x, name=None):
+    return run_op("logical_not", jnp.logical_not, _ensure(x))
+
+
+def bitwise_not(x, name=None):
+    return run_op("bitwise_not", jnp.bitwise_not, _ensure(x))
+
+
+def equal_all(x, y, name=None):
+    return run_op("equal_all", lambda a, b: jnp.array_equal(a, b), _ensure(x), _ensure(y))
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return run_op(
+        "isclose",
+        lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan),
+        _ensure(x),
+        _ensure(y),
+    )
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return run_op(
+        "allclose",
+        lambda a, b: jnp.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan),
+        _ensure(x),
+        _ensure(y),
+    )
+
+
+def is_empty(x, name=None):
+    return to_tensor(np.asarray(_ensure(x).size == 0))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)

@@ -1,0 +1,496 @@
+"""Shape/layout manipulation ops (``python/paddle/tensor/manipulation.py``
+capability; the reference's zero-copy ``stride/`` view kernels map to XLA
+reshapes/slices which are fused or aliased by the compiler)."""
+
+from __future__ import annotations
+
+import builtins
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as dtype_mod
+from ..core.dispatch import run_op
+from ..core.tensor import Tensor, to_tensor
+
+
+def _ensure(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def _ints(seq):
+    if isinstance(seq, Tensor):
+        return tuple(int(v) for v in np.asarray(seq._value))
+    if isinstance(seq, (int, np.integer)):
+        return (int(seq),)
+    return tuple(int(s._value) if isinstance(s, Tensor) else int(s) for s in seq)
+
+
+def reshape(x, shape, name=None):
+    return run_op("reshape", lambda v: jnp.reshape(v, _ints(shape)), _ensure(x))
+
+
+def reshape_(x, shape, name=None):
+    out = reshape(x, shape)
+    return x._rebind(out)
+
+
+def view(x, shape_or_dtype, name=None):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    d = dtype_mod.convert_dtype(shape_or_dtype)
+    return run_op("view_dtype", lambda v: jax.lax.bitcast_convert_type(v, d), _ensure(x))
+
+
+def transpose(x, perm, name=None):
+    return run_op("transpose", lambda v: jnp.transpose(v, _ints(perm)), _ensure(x))
+
+
+def t(x, name=None):
+    return run_op("t", lambda v: v.T if v.ndim <= 2 else jnp.swapaxes(v, -1, -2), _ensure(x))
+
+
+def moveaxis(x, source, destination, name=None):
+    return run_op("moveaxis", lambda v: jnp.moveaxis(v, source, destination), _ensure(x))
+
+
+def swapaxes(x, axis1, axis2, name=None):
+    return run_op("swapaxes", lambda v: jnp.swapaxes(v, axis1, axis2), _ensure(x))
+
+
+transpose_ = transpose
+swapdims = swapaxes
+
+
+def concat(x, axis=0, name=None):
+    ts = [_ensure(t) for t in x]
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    return run_op("concat", lambda *xs: jnp.concatenate(xs, axis=axis), *ts)
+
+
+def stack(x, axis=0, name=None):
+    ts = [_ensure(t) for t in x]
+    return run_op("stack", lambda *xs: jnp.stack(xs, axis=axis), *ts)
+
+
+def hstack(x, name=None):
+    ts = [_ensure(t) for t in x]
+    return run_op("hstack", lambda *xs: jnp.hstack(xs), *ts)
+
+
+def vstack(x, name=None):
+    ts = [_ensure(t) for t in x]
+    return run_op("vstack", lambda *xs: jnp.vstack(xs), *ts)
+
+
+def dstack(x, name=None):
+    ts = [_ensure(t) for t in x]
+    return run_op("dstack", lambda *xs: jnp.dstack(xs), *ts)
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    x = _ensure(x)
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    dim = x.shape[axis]
+    if isinstance(num_or_sections, int):
+        if dim % num_or_sections != 0:
+            raise ValueError(
+                f"paddle.split: dimension {dim} on axis {axis} is not divisible "
+                f"by num {num_or_sections}; pass explicit sections instead"
+            )
+        sections = [dim // num_or_sections] * num_or_sections
+    else:
+        sections = list(_ints(num_or_sections))
+        n_neg = sum(1 for s in sections if s < 0)
+        if n_neg:
+            known = sum(s for s in sections if s >= 0)
+            sections = [s if s >= 0 else dim - known for s in sections]
+    offsets = np.cumsum([0] + sections)
+
+    def f(v):
+        return tuple(
+            jax.lax.slice_in_dim(v, int(offsets[i]), int(offsets[i + 1]), axis=axis)
+            for i in range(len(sections))
+        )
+
+    return list(run_op("split", f, x))
+
+
+def chunk(x, chunks, axis=0, name=None):
+    x = _ensure(x)
+    dim = x.shape[axis]
+    base = (dim + chunks - 1) // chunks
+    sections = []
+    rem = dim
+    while rem > 0:
+        sections.append(min(base, rem))
+        rem -= base
+    return split(x, sections, axis)
+
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    x = _ensure(x)
+    dim = x.shape[axis]
+    if isinstance(num_or_indices, int):
+        n = num_or_indices
+        base, extra = divmod(dim, n)
+        sections = [base + (1 if i < extra else 0) for i in range(n)]
+        return split(x, sections, axis)
+    idx = [0] + list(_ints(num_or_indices)) + [dim]
+    sections = [idx[i + 1] - idx[i] for i in range(len(idx) - 1)]
+    return split(x, sections, axis)
+
+
+def squeeze(x, axis=None, name=None):
+    x = _ensure(x)
+    if axis is None:
+        ax = None
+    else:
+        ax = _ints(axis if isinstance(axis, (list, tuple)) else [axis])
+        ax = tuple(a for a in ax if x.shape[a] == 1)
+    return run_op("squeeze", lambda v: jnp.squeeze(v, axis=ax), x)
+
+
+def squeeze_(x, axis=None, name=None):
+    return x._rebind(squeeze(x, axis))
+
+
+def unsqueeze(x, axis, name=None):
+    ax = _ints(axis if isinstance(axis, (list, tuple, Tensor)) else [axis])
+    return run_op("unsqueeze", lambda v: jnp.expand_dims(v, ax), _ensure(x))
+
+
+def unsqueeze_(x, axis, name=None):
+    return x._rebind(unsqueeze(x, axis))
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    x = _ensure(x)
+    nd = x.ndim
+    s = start_axis % nd if nd else 0
+    e = stop_axis % nd if nd else 0
+
+    def f(v):
+        shape = v.shape[:s] + (-1,) + v.shape[e + 1 :]
+        return v.reshape(shape) if nd else v.reshape((1,))
+
+    return run_op("flatten", f, x)
+
+
+def flatten_(x, start_axis=0, stop_axis=-1, name=None):
+    return x._rebind(flatten(x, start_axis, stop_axis))
+
+
+def tile(x, repeat_times, name=None):
+    return run_op("tile", lambda v: jnp.tile(v, _ints(repeat_times)), _ensure(x))
+
+
+def expand(x, shape, name=None):
+    tgt = _ints(shape)
+
+    def f(v):
+        full = list(tgt)
+        off = len(full) - v.ndim
+        for i in range(v.ndim):
+            if full[off + i] == -1:
+                full[off + i] = v.shape[i]
+        return jnp.broadcast_to(v, tuple(full))
+
+    return run_op("expand", f, _ensure(x))
+
+
+def expand_as(x, y, name=None):
+    return run_op("expand_as", lambda v, w: jnp.broadcast_to(v, w.shape), _ensure(x), _ensure(y))
+
+
+def broadcast_to(x, shape, name=None):
+    return run_op("broadcast_to", lambda v: jnp.broadcast_to(v, _ints(shape)), _ensure(x))
+
+
+def broadcast_tensors(inputs, name=None):
+    ts = [_ensure(t) for t in inputs]
+    return list(run_op("broadcast_tensors", lambda *xs: tuple(jnp.broadcast_arrays(*xs)), *ts))
+
+
+def flip(x, axis, name=None):
+    ax = _ints(axis if isinstance(axis, (list, tuple)) else [axis])
+    return run_op("flip", lambda v: jnp.flip(v, axis=ax), _ensure(x))
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return run_op("rot90", lambda v: jnp.rot90(v, k=k, axes=tuple(axes)), _ensure(x))
+
+
+def roll(x, shifts, axis=None, name=None):
+    sh = _ints(shifts) if isinstance(shifts, (list, tuple)) else int(shifts)
+    ax = _ints(axis) if isinstance(axis, (list, tuple)) else (int(axis) if axis is not None else None)
+    return run_op("roll", lambda v: jnp.roll(v, sh, axis=ax), _ensure(x))
+
+
+def gather(x, index, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+
+    def f(v, idx):
+        return jnp.take(v, idx.astype(jnp.int32).reshape(-1), axis=axis)
+
+    return run_op("gather", f, _ensure(x), _ensure(index))
+
+
+def gather_nd(x, index, name=None):
+    def f(v, idx):
+        idx = idx.astype(jnp.int32)
+        k = idx.shape[-1]
+        out = v[tuple(jnp.moveaxis(idx, -1, 0))]
+        return out
+
+    return run_op("gather_nd", f, _ensure(x), _ensure(index))
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    def f(v, idx, upd):
+        idx = idx.astype(jnp.int32).reshape(-1)
+        if overwrite:
+            return v.at[idx].set(upd)
+        base = v.at[idx].set(jnp.zeros_like(upd))
+        return base.at[idx].add(upd)
+
+    return run_op("scatter", f, _ensure(x), _ensure(index), _ensure(updates))
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    return x._rebind(scatter(x, index, updates, overwrite))
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    def f(v, idx, upd):
+        idx = idx.astype(jnp.int32)
+        return v.at[tuple(jnp.moveaxis(idx, -1, 0))].add(upd)
+
+    return run_op("scatter_nd_add", f, _ensure(x), _ensure(index), _ensure(updates))
+
+
+def scatter_nd(index, updates, shape, name=None):
+    def f(idx, upd):
+        z = jnp.zeros(_ints(shape), upd.dtype)
+        return z.at[tuple(jnp.moveaxis(idx.astype(jnp.int32), -1, 0))].add(upd)
+
+    return run_op("scatter_nd", f, _ensure(index), _ensure(updates))
+
+
+def index_select(x, index, axis=0, name=None):
+    def f(v, idx):
+        return jnp.take(v, idx.astype(jnp.int32).reshape(-1), axis=axis)
+
+    return run_op("index_select", f, _ensure(x), _ensure(index))
+
+
+def index_sample(x, index, name=None):
+    def f(v, idx):
+        rows = jnp.arange(v.shape[0])[:, None]
+        return v[rows, idx.astype(jnp.int32)]
+
+    return run_op("index_sample", f, _ensure(x), _ensure(index))
+
+
+def index_add(x, index, axis, value, name=None):
+    def f(v, idx, val):
+        idx = idx.astype(jnp.int32)
+        vm = jnp.moveaxis(v, axis, 0)
+        valm = jnp.moveaxis(val, axis, 0)
+        out = vm.at[idx].add(valm)
+        return jnp.moveaxis(out, 0, axis)
+
+    return run_op("index_add", f, _ensure(x), _ensure(index), _ensure(value))
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    idx = tuple(i._value.astype(jnp.int32) if isinstance(i, Tensor) else i for i in indices)
+
+    def f(v, val):
+        return v.at[idx].add(val) if accumulate else v.at[idx].set(val)
+
+    return run_op("index_put", f, _ensure(x), _ensure(value))
+
+
+def masked_select(x, mask, name=None):
+    # Dynamic-shape op: must materialize on host (same caveat as reference's
+    # masked_select which is shape-dynamic; do not call under jit).
+    xv = np.asarray(_ensure(x)._value)
+    mv = np.asarray(_ensure(mask)._value)
+    return to_tensor(xv[np.broadcast_to(mv, xv.shape)])
+
+
+def masked_fill(x, mask, value, name=None):
+    val = value._value if isinstance(value, Tensor) else value
+    return run_op("masked_fill", lambda v, m: jnp.where(m, val, v), _ensure(x), _ensure(mask))
+
+
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    def f(v, idx):
+        return jnp.take_along_axis(v, idx.astype(jnp.int32), axis=axis)
+
+    return run_op("take_along_axis", f, _ensure(arr), _ensure(indices))
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", name=None):
+    def f(v, idx, val):
+        idx = idx.astype(jnp.int32)
+        val = jnp.broadcast_to(val, idx.shape)
+        if reduce == "assign":
+            return jnp.put_along_axis(v, idx, val, axis=axis, inplace=False)
+        dims = [jnp.arange(s).reshape([-1 if i == d else 1 for i in range(idx.ndim)])
+                for d, s in enumerate(idx.shape)]
+        full_idx = [jnp.broadcast_to(dims[d], idx.shape) for d in range(idx.ndim)]
+        full_idx[axis] = idx
+        if reduce in ("add", "sum"):
+            return v.at[tuple(full_idx)].add(val)
+        if reduce in ("mul", "multiply"):
+            return v.at[tuple(full_idx)].multiply(val)
+        raise ValueError(f"unknown reduce {reduce}")
+
+    return run_op("put_along_axis", f, _ensure(arr), _ensure(indices), _ensure(values))
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    if isinstance(repeats, Tensor):
+        repeats = np.asarray(repeats._value)
+
+    def f(v):
+        return jnp.repeat(v, repeats, axis=axis)
+
+    return run_op("repeat_interleave", f, _ensure(x))
+
+
+def unbind(x, axis=0, name=None):
+    x = _ensure(x)
+    n = x.shape[axis]
+
+    def f(v):
+        return tuple(jnp.squeeze(s, axis) for s in jnp.split(v, n, axis=axis))
+
+    return list(run_op("unbind", f, x))
+
+
+def slice(input, axes, starts, ends, name=None):
+    axes = _ints(axes)
+    starts = _ints(starts)
+    ends = _ints(ends)
+
+    def f(v):
+        idx = [builtins.slice(None)] * v.ndim
+        for a, s, e in zip(axes, starts, ends):
+            idx[a] = builtins.slice(s, e)
+        return v[tuple(idx)]
+
+    return run_op("slice", f, _ensure(input))
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    axes, starts, ends, strides = map(_ints, (axes, starts, ends, strides))
+
+    def f(v):
+        idx = [builtins.slice(None)] * v.ndim
+        for a, s, e, st in zip(axes, starts, ends, strides):
+            idx[a] = builtins.slice(s, e, st)
+        return v[tuple(idx)]
+
+    return run_op("strided_slice", f, _ensure(x))
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    shp = _ints(shape)
+    off = _ints(offsets) if offsets is not None else (0,) * len(shp)
+
+    def f(v):
+        return jax.lax.dynamic_slice(v, off, shp)
+
+    return run_op("crop", f, _ensure(x))
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    from ..nn import functional as F
+
+    return F.pad(x, pad, mode=mode, value=value, data_format=data_format)
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None, dtype="int64", name=None):
+    xv = np.asarray(_ensure(x)._value)
+    res = np.unique(xv, return_index=return_index, return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        return to_tensor(res)
+    return tuple(to_tensor(r) for r in res)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None, dtype="int64", name=None):
+    xv = np.asarray(_ensure(x)._value)
+    if axis is None:
+        xv = xv.reshape(-1)
+        change = np.concatenate([[True], xv[1:] != xv[:-1]])
+        out = xv[change]
+        results = [to_tensor(out)]
+        if return_inverse:
+            inv = np.cumsum(change) - 1
+            results.append(to_tensor(inv))
+        if return_counts:
+            idx = np.flatnonzero(change)
+            counts = np.diff(np.append(idx, len(xv)))
+            results.append(to_tensor(counts))
+        return results[0] if len(results) == 1 else tuple(results)
+    raise NotImplementedError("unique_consecutive with axis not supported yet")
+
+
+def as_strided(x, shape, stride, offset=0, name=None):
+    xv = np.asarray(_ensure(x)._value)
+    itemsize = xv.itemsize
+    out = np.lib.stride_tricks.as_strided(
+        xv.reshape(-1)[offset:], shape=_ints(shape), strides=[s * itemsize for s in _ints(stride)]
+    )
+    return to_tensor(np.ascontiguousarray(out))
+
+
+def tensordot(x, y, axes=2, name=None):
+    ax = axes
+    if isinstance(ax, Tensor):
+        ax = np.asarray(ax._value).tolist()
+    return run_op("tensordot", lambda a, b: jnp.tensordot(a, b, axes=ax), _ensure(x), _ensure(y))
+
+
+def atleast_1d(*inputs, name=None):
+    outs = [run_op("atleast_1d", jnp.atleast_1d, _ensure(t)) for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*inputs, name=None):
+    outs = [run_op("atleast_2d", jnp.atleast_2d, _ensure(t)) for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*inputs, name=None):
+    outs = [run_op("atleast_3d", jnp.atleast_3d, _ensure(t)) for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def unfold(x, axis, size, step, name=None):
+    def f(v):
+        n = (v.shape[axis] - size) // step + 1
+        starts = jnp.arange(n) * step
+        def take_window(s):
+            return jax.lax.dynamic_slice_in_dim(v, s, size, axis=axis)
+        out = jax.vmap(take_window)(starts)
+        return jnp.moveaxis(out, 0, axis)
+
+    return run_op("unfold", f, _ensure(x))
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    def f(v):
+        size = index_num // nshards
+        shard = v // size
+        return jnp.where(shard == shard_id, v % size, ignore_value)
+
+    return run_op("shard_index", f, _ensure(input))

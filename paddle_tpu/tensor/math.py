@@ -1,0 +1,439 @@
+"""Elementwise + reduction math ops (``python/paddle/tensor/math.py`` capability).
+
+All ops are pure-JAX functions dispatched through the eager tape
+(`core/dispatch.py`); under ``to_static`` they stage directly into XLA where
+elementwise chains fuse into surrounding matmuls (MXU epilogues) for free.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as dtype_mod
+from ..core.dispatch import run_op
+from ..core.tensor import Tensor, to_tensor
+
+_T = Tensor
+
+
+def _axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        a = np.asarray(axis._value)
+        return tuple(int(v) for v in np.atleast_1d(a))
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def _ensure(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+# --- generic builders -----------------------------------------------------
+
+def _unary(name, fn):
+    def op(x, name=None):
+        return run_op(name, fn, _ensure(x))
+
+    op.__name__ = name
+    return op
+
+
+def _binary(name, fn):
+    def op(x, y, name=None):
+        x = _ensure(x)
+        if isinstance(y, Tensor):
+            return run_op(name, fn, x, y)
+        return run_op(name, lambda a: fn(a, y), x)
+
+    op.__name__ = name
+    return op
+
+
+# --- unary ----------------------------------------------------------------
+abs = _unary("abs", jnp.abs)
+acos = _unary("acos", jnp.arccos)
+acosh = _unary("acosh", jnp.arccosh)
+angle = _unary("angle", jnp.angle)
+asin = _unary("asin", jnp.arcsin)
+asinh = _unary("asinh", jnp.arcsinh)
+atan = _unary("atan", jnp.arctan)
+atanh = _unary("atanh", jnp.arctanh)
+ceil = _unary("ceil", jnp.ceil)
+conj = _unary("conj", jnp.conj)
+cos = _unary("cos", jnp.cos)
+cosh = _unary("cosh", jnp.cosh)
+digamma = _unary("digamma", jax.scipy.special.digamma)
+erf = _unary("erf", jax.scipy.special.erf)
+erfinv = _unary("erfinv", jax.scipy.special.erfinv)
+exp = _unary("exp", jnp.exp)
+expm1 = _unary("expm1", jnp.expm1)
+floor = _unary("floor", jnp.floor)
+frac = _unary("frac", lambda v: v - jnp.trunc(v))
+imag = _unary("imag", jnp.imag)
+lgamma = _unary("lgamma", jax.scipy.special.gammaln)
+log = _unary("log", jnp.log)
+log10 = _unary("log10", jnp.log10)
+log1p = _unary("log1p", jnp.log1p)
+log2 = _unary("log2", jnp.log2)
+logit = _unary("logit", jax.scipy.special.logit)
+neg = _unary("neg", jnp.negative)
+real = _unary("real", jnp.real)
+reciprocal = _unary("reciprocal", jnp.reciprocal)
+round = _unary("round", jnp.round)
+rsqrt = _unary("rsqrt", jax.lax.rsqrt)
+sigmoid = _unary("sigmoid", jax.nn.sigmoid)
+sign = _unary("sign", jnp.sign)
+sgn = sign
+sin = _unary("sin", jnp.sin)
+sinh = _unary("sinh", jnp.sinh)
+sqrt = _unary("sqrt", jnp.sqrt)
+square = _unary("square", jnp.square)
+tan = _unary("tan", jnp.tan)
+tanh = _unary("tanh", jnp.tanh)
+trunc = _unary("trunc", jnp.trunc)
+i0 = _unary("i0", lambda v: jax.scipy.special.i0(v))
+i0e = _unary("i0e", lambda v: jax.scipy.special.i0e(v))
+i1 = _unary("i1", lambda v: jax.scipy.special.i1(v))
+i1e = _unary("i1e", lambda v: jax.scipy.special.i1e(v))
+exponent = None  # not a paddle op
+
+# --- binary ---------------------------------------------------------------
+add = _binary("add", jnp.add)
+subtract = _binary("subtract", jnp.subtract)
+multiply = _binary("multiply", jnp.multiply)
+divide = _binary("divide", lambda a, b: jnp.true_divide(a, b))
+floor_divide = _binary("floor_divide", jnp.floor_divide)
+mod = _binary("mod", jnp.mod)
+remainder = mod
+floor_mod = mod
+pow = _binary("pow", jnp.power)
+maximum = _binary("maximum", jnp.maximum)
+minimum = _binary("minimum", jnp.minimum)
+fmax = _binary("fmax", jnp.fmax)
+fmin = _binary("fmin", jnp.fmin)
+atan2 = _binary("atan2", jnp.arctan2)
+logaddexp = _binary("logaddexp", jnp.logaddexp)
+heaviside = _binary("heaviside", jnp.heaviside)
+hypot = _binary("hypot", jnp.hypot)
+copysign = _binary("copysign", jnp.copysign)
+nextafter = _binary("nextafter", jnp.nextafter)
+ldexp = _binary("ldexp", jnp.ldexp)
+gcd = _binary("gcd", jnp.gcd)
+lcm = _binary("lcm", jnp.lcm)
+inner = _binary("inner", jnp.inner)
+outer = _binary("outer", lambda a, b: jnp.outer(a, b))
+kron = _binary("kron", jnp.kron)
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    """paddle.scale (phi scale kernel analog)."""
+    def f(v):
+        s = scale._value if isinstance(scale, Tensor) else scale
+        out = v * s + bias if bias_after_scale else (v + bias) * s
+        return out.astype(v.dtype)
+
+    return run_op("scale", f, _ensure(x))
+
+
+def increment(x, value=1.0, name=None):
+    out = run_op("increment", lambda v: v + value, _ensure(x))
+    x._rebind(out)
+    return x
+
+
+def clip(x, min=None, max=None, name=None):
+    lo = min._value if isinstance(min, Tensor) else min
+    hi = max._value if isinstance(max, Tensor) else max
+    return run_op("clip", lambda v: jnp.clip(v, lo, hi), _ensure(x))
+
+
+def lerp(x, y, weight, name=None):
+    if isinstance(weight, Tensor):
+        return run_op("lerp", lambda a, b, w: a + w * (b - a), _ensure(x), _ensure(y), weight)
+    return run_op("lerp", lambda a, b: a + weight * (b - a), _ensure(x), _ensure(y))
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return run_op("stanh", lambda v: scale_b * jnp.tanh(scale_a * v), _ensure(x))
+
+
+def multiplex(inputs, index, name=None):
+    ts = [_ensure(t) for t in inputs]
+    idx = index._value if isinstance(index, Tensor) else jnp.asarray(index)
+
+    def f(*xs):
+        stacked = jnp.stack(xs, axis=0)
+        rows = jnp.arange(stacked.shape[1])
+        return stacked[idx.reshape(-1), rows]
+
+    return run_op("multiplex", f, *ts)
+
+
+# --- reductions -----------------------------------------------------------
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    d = dtype_mod.convert_dtype(dtype)
+    return run_op(
+        "sum", lambda v: jnp.sum(v, axis=_axis(axis), dtype=d, keepdims=keepdim), _ensure(x)
+    )
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
+    d = dtype_mod.convert_dtype(dtype)
+    return run_op(
+        "nansum", lambda v: jnp.nansum(v, axis=_axis(axis), dtype=d, keepdims=keepdim), _ensure(x)
+    )
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    return run_op("mean", lambda v: jnp.mean(v, axis=_axis(axis), keepdims=keepdim), _ensure(x))
+
+
+def nanmean(x, axis=None, keepdim=False, name=None):
+    return run_op(
+        "nanmean", lambda v: jnp.nanmean(v, axis=_axis(axis), keepdims=keepdim), _ensure(x)
+    )
+
+
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    d = dtype_mod.convert_dtype(dtype)
+    return run_op(
+        "prod", lambda v: jnp.prod(v, axis=_axis(axis), dtype=d, keepdims=keepdim), _ensure(x)
+    )
+
+
+def max(x, axis=None, keepdim=False, name=None):
+    return run_op("max", lambda v: jnp.max(v, axis=_axis(axis), keepdims=keepdim), _ensure(x))
+
+
+def min(x, axis=None, keepdim=False, name=None):
+    return run_op("min", lambda v: jnp.min(v, axis=_axis(axis), keepdims=keepdim), _ensure(x))
+
+
+def amax(x, axis=None, keepdim=False, name=None):
+    return max(x, axis, keepdim)
+
+
+def amin(x, axis=None, keepdim=False, name=None):
+    return min(x, axis, keepdim)
+
+
+def all(x, axis=None, keepdim=False, name=None):
+    return run_op("all", lambda v: jnp.all(v, axis=_axis(axis), keepdims=keepdim), _ensure(x))
+
+
+def any(x, axis=None, keepdim=False, name=None):
+    return run_op("any", lambda v: jnp.any(v, axis=_axis(axis), keepdims=keepdim), _ensure(x))
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    return run_op(
+        "logsumexp",
+        lambda v: jax.scipy.special.logsumexp(v, axis=_axis(axis), keepdims=keepdim),
+        _ensure(x),
+    )
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return run_op(
+        "count_nonzero",
+        lambda v: jnp.count_nonzero(v, axis=_axis(axis), keepdims=keepdim),
+        _ensure(x),
+    )
+
+
+# --- scans ----------------------------------------------------------------
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    d = dtype_mod.convert_dtype(dtype)
+
+    def f(v):
+        if axis is None:
+            return jnp.cumsum(v.reshape(-1), dtype=d)
+        return jnp.cumsum(v, axis=_axis(axis), dtype=d)
+
+    return run_op("cumsum", f, _ensure(x))
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    d = dtype_mod.convert_dtype(dtype)
+
+    def f(v):
+        if dim is None:
+            return jnp.cumprod(v.reshape(-1), dtype=d)
+        return jnp.cumprod(v, axis=_axis(dim), dtype=d)
+
+    return run_op("cumprod", f, _ensure(x))
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    def f(v):
+        a = 0 if axis is None else _axis(axis)
+        vv = v.reshape(-1) if axis is None else v
+        vals = jax.lax.associative_scan(jnp.maximum, vv, axis=a)
+        n = vv.shape[a]
+        idx = jnp.arange(n).reshape([-1 if i == (a % vv.ndim) else 1 for i in range(vv.ndim)])
+        idx = jnp.broadcast_to(idx, vv.shape)
+        is_new = vv == vals
+        running_idx = jax.lax.associative_scan(
+            jnp.maximum, jnp.where(is_new, idx, -1), axis=a
+        )
+        return vals, running_idx.astype(dtype_mod.convert_dtype(dtype))
+
+    return run_op("cummax", f, _ensure(x))
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    def f(v):
+        a = 0 if axis is None else _axis(axis)
+        vv = v.reshape(-1) if axis is None else v
+        vals = jax.lax.associative_scan(jnp.minimum, vv, axis=a)
+        n = vv.shape[a]
+        idx = jnp.arange(n).reshape([-1 if i == (a % vv.ndim) else 1 for i in range(vv.ndim)])
+        idx = jnp.broadcast_to(idx, vv.shape)
+        is_new = vv == vals
+        running_idx = jax.lax.associative_scan(
+            jnp.maximum, jnp.where(is_new, idx, -1), axis=a
+        )
+        return vals, running_idx.astype(dtype_mod.convert_dtype(dtype))
+
+    return run_op("cummin", f, _ensure(x))
+
+
+def logcumsumexp(x, axis=None, name=None):
+    def f(v):
+        vv = v.reshape(-1) if axis is None else v
+        a = 0 if axis is None else _axis(axis)
+        return jax.lax.associative_scan(jnp.logaddexp, vv, axis=a)
+
+    return run_op("logcumsumexp", f, _ensure(x))
+
+
+# --- checks ---------------------------------------------------------------
+isfinite = _unary("isfinite", jnp.isfinite)
+isinf = _unary("isinf", jnp.isinf)
+isnan = _unary("isnan", jnp.isnan)
+
+
+def isneginf(x, name=None):
+    return run_op("isneginf", jnp.isneginf, _ensure(x))
+
+
+def isposinf(x, name=None):
+    return run_op("isposinf", jnp.isposinf, _ensure(x))
+
+
+def isreal(x, name=None):
+    return run_op("isreal", jnp.isreal, _ensure(x))
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return run_op(
+        "nan_to_num", lambda v: jnp.nan_to_num(v, nan=nan, posinf=posinf, neginf=neginf), _ensure(x)
+    )
+
+
+# --- matmul-family (also exposed via linalg) ------------------------------
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    def f(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b)
+
+    return run_op("matmul", f, _ensure(x), _ensure(y))
+
+
+def mm(x, y, name=None):
+    return matmul(x, y)
+
+
+def bmm(x, y, name=None):
+    return run_op("bmm", jnp.matmul, _ensure(x), _ensure(y))
+
+
+def dot(x, y, name=None):
+    return run_op("dot", lambda a, b: jnp.sum(a * b, axis=-1), _ensure(x), _ensure(y))
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return run_op(
+        "addmm",
+        lambda i, a, b: beta * i + alpha * jnp.matmul(a, b),
+        _ensure(input),
+        _ensure(x),
+        _ensure(y),
+    )
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return run_op("trace", lambda v: jnp.trace(v, offset=offset, axis1=axis1, axis2=axis2), _ensure(x))
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return run_op(
+        "diagonal", lambda v: jnp.diagonal(v, offset=offset, axis1=axis1, axis2=axis2), _ensure(x)
+    )
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def add_n(inputs, name=None):
+    ts = [_ensure(t) for t in (inputs if isinstance(inputs, (list, tuple)) else [inputs])]
+
+    def f(*xs):
+        out = xs[0]
+        for v in xs[1:]:
+            out = out + v
+        return out
+
+    return run_op("add_n", f, *ts)
+
+
+def deg2rad(x, name=None):
+    return run_op("deg2rad", jnp.deg2rad, _ensure(x))
+
+
+def rad2deg(x, name=None):
+    return run_op("rad2deg", jnp.rad2deg, _ensure(x))
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    pre = prepend._value if isinstance(prepend, Tensor) else prepend
+    app = append._value if isinstance(append, Tensor) else append
+    return run_op("diff", lambda v: jnp.diff(v, n=n, axis=axis, prepend=pre, append=app), _ensure(x))
+
+
+def gammaln(x, name=None):
+    return lgamma(x)
+
+
+def polygamma(x, n, name=None):
+    return run_op("polygamma", lambda v: jax.scipy.special.polygamma(n, v), _ensure(x))
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    xs = x._value if isinstance(x, Tensor) else x
+    return run_op(
+        "trapezoid",
+        lambda v: jnp.trapezoid(v, x=xs, dx=1.0 if dx is None else dx, axis=axis),
+        _ensure(y),
+    )
+
+
+def vander(x, n=None, increasing=False, name=None):
+    return run_op("vander", lambda v: jnp.vander(v, N=n, increasing=increasing), _ensure(x))
+
+
+def take(x, index, mode="raise", name=None):
+    idx = index._value if isinstance(index, Tensor) else jnp.asarray(index)
+    return run_op("take", lambda v: jnp.take(v.reshape(-1), idx.reshape(-1).astype(jnp.int32), mode="clip").reshape(idx.shape), _ensure(x))

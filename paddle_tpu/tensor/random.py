@@ -1,0 +1,161 @@
+"""Random ops over the global generator (``python/paddle/tensor/random.py``
+capability; RNG state analog of ``phi::Generator``, generator.cc)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as dtype_mod
+from ..core import random as rng
+from ..core.dispatch import run_op
+from ..core.tensor import Tensor, to_tensor
+
+
+def _d(dtype):
+    d = dtype_mod.convert_dtype(dtype)
+    return d if d is not None else dtype_mod.get_default_dtype()
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(v) for v in np.asarray(shape._value))
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s._value) if isinstance(s, Tensor) else int(s) for s in shape)
+
+
+def rand(shape, dtype=None, name=None):
+    key = rng.next_key()
+    return Tensor(jax.random.uniform(key, _shape(shape), _d(dtype)))
+
+
+def randn(shape, dtype=None, name=None):
+    key = rng.next_key()
+    return Tensor(jax.random.normal(key, _shape(shape), _d(dtype)))
+
+
+def standard_normal(shape, dtype=None, name=None):
+    return randn(shape, dtype)
+
+
+def standard_gamma(alpha, name=None):
+    a = alpha._value if isinstance(alpha, Tensor) else jnp.asarray(alpha)
+    return Tensor(jax.random.gamma(rng.next_key(), a))
+
+
+def standard_exponential(shape, dtype=None, name=None):
+    return Tensor(jax.random.exponential(rng.next_key(), _shape(shape), _d(dtype)))
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    key = jax.random.PRNGKey(seed) if seed else rng.next_key()
+    lo = min._value if isinstance(min, Tensor) else min
+    hi = max._value if isinstance(max, Tensor) else max
+    return Tensor(jax.random.uniform(key, _shape(shape), _d(dtype), lo, hi))
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
+    out = uniform(x.shape, x.dtype, min, max, seed)
+    x._value = out._value
+    return x
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    key = rng.next_key()
+    m = mean._value if isinstance(mean, Tensor) else mean
+    s = std._value if isinstance(std, Tensor) else std
+    if shape is None:
+        shape = jnp.broadcast_shapes(jnp.shape(m), jnp.shape(s))
+    else:
+        shape = _shape(shape)
+    return Tensor(m + s * jax.random.normal(key, shape, dtype_mod.get_default_dtype()))
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    out = normal(mean, std, x.shape)
+    x._value = out._value.astype(x.dtype)
+    return x
+
+
+def gaussian(shape, mean=0.0, std=1.0, seed=0, dtype=None, name=None):
+    key = jax.random.PRNGKey(seed) if seed else rng.next_key()
+    return Tensor(mean + std * jax.random.normal(key, _shape(shape), _d(dtype)))
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    key = rng.next_key()
+    return Tensor(jax.random.randint(key, _shape(shape), low, high, dtype_mod.convert_dtype(dtype)))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    d = dtype_mod.convert_dtype(dtype) or x.dtype
+    if high is None:
+        low, high = 0, low
+    return Tensor(jax.random.randint(rng.next_key(), tuple(x.shape), low, high, d))
+
+
+def randperm(n, dtype="int64", name=None):
+    return Tensor(jax.random.permutation(rng.next_key(), n).astype(dtype_mod.convert_dtype(dtype)))
+
+
+def bernoulli(x, name=None):
+    def f(v):
+        return jax.random.bernoulli(rng.next_key(), v).astype(v.dtype)
+
+    return run_op("bernoulli", f, x)
+
+
+def bernoulli_(x, p=0.5, name=None):
+    x._value = jax.random.bernoulli(rng.next_key(), p, tuple(x.shape)).astype(x.dtype)
+    return x
+
+
+def poisson(x, name=None):
+    def f(v):
+        return jax.random.poisson(rng.next_key(), v).astype(v.dtype)
+
+    return run_op("poisson", f, x)
+
+
+def binomial(count, prob, name=None):
+    c = count._value if isinstance(count, Tensor) else jnp.asarray(count)
+    p = prob._value if isinstance(prob, Tensor) else jnp.asarray(prob)
+    return Tensor(jax.random.binomial(rng.next_key(), c.astype(jnp.float32), p).astype(jnp.int64))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    def f(v):
+        logits = jnp.log(jnp.clip(v, 1e-30, None))
+        if replacement:
+            return jax.random.categorical(
+                rng.next_key(), logits, axis=-1, shape=( *v.shape[:-1], num_samples)
+            ).astype(jnp.int64)
+        # without replacement: Gumbel top-k trick
+        g = jax.random.gumbel(rng.next_key(), v.shape)
+        _, idx = jax.lax.top_k(logits + g, num_samples)
+        return idx.astype(jnp.int64)
+
+    return run_op("multinomial", f, x)
+
+
+def exponential_(x, lam=1.0, name=None):
+    x._value = (jax.random.exponential(rng.next_key(), tuple(x.shape)) / lam).astype(x.dtype)
+    return x
+
+
+def rand_like(x, dtype=None, name=None):
+    d = dtype_mod.convert_dtype(dtype) or x.dtype
+    return Tensor(jax.random.uniform(rng.next_key(), tuple(x.shape), d))
+
+
+def randn_like(x, dtype=None, name=None):
+    d = dtype_mod.convert_dtype(dtype) or x.dtype
+    return Tensor(jax.random.normal(rng.next_key(), tuple(x.shape), d))
+
+
+def shuffle(x, axis=0, name=None):
+    return Tensor(jax.random.permutation(rng.next_key(), x._value, axis=axis, independent=False))

@@ -1,0 +1,247 @@
+"""Search/sort/statistics ops (``python/paddle/tensor/{search,stat}.py``)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as dtype_mod
+from ..core.dispatch import run_op
+from ..core.tensor import Tensor, to_tensor
+
+
+def _ensure(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def _axis(axis):
+    if isinstance(axis, Tensor):
+        return int(axis.item())
+    return axis
+
+
+# --- search ---------------------------------------------------------------
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    d = dtype_mod.convert_dtype(dtype)
+
+    def f(v):
+        if axis is None:
+            out = jnp.argmax(v.reshape(-1))
+            return out.reshape((1,) * v.ndim).astype(d) if keepdim else out.astype(d)
+        return jnp.argmax(v, axis=_axis(axis), keepdims=keepdim).astype(d)
+
+    return run_op("argmax", f, _ensure(x))
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    d = dtype_mod.convert_dtype(dtype)
+
+    def f(v):
+        if axis is None:
+            out = jnp.argmin(v.reshape(-1))
+            return out.reshape((1,) * v.ndim).astype(d) if keepdim else out.astype(d)
+        return jnp.argmin(v, axis=_axis(axis), keepdims=keepdim).astype(d)
+
+    return run_op("argmin", f, _ensure(x))
+
+
+def argsort(x, axis=-1, descending=False, stable=False, name=None):
+    def f(v):
+        idx = jnp.argsort(v, axis=_axis(axis), stable=stable, descending=descending)
+        return idx.astype(jnp.int64)
+
+    return run_op("argsort", f, _ensure(x))
+
+
+def sort(x, axis=-1, descending=False, stable=False, name=None):
+    def f(v):
+        return jnp.sort(v, axis=_axis(axis), stable=stable, descending=descending)
+
+    return run_op("sort", f, _ensure(x))
+
+
+def topk(x, k, axis=None, largest=True, sorted=True, name=None):
+    if isinstance(k, Tensor):
+        k = int(k.item())
+
+    def f(v):
+        ax = _axis(axis)
+        if ax is None:
+            ax = v.ndim - 1
+        vm = jnp.moveaxis(v, ax, -1)
+        if largest:
+            vals, idx = jax.lax.top_k(vm, k)
+        else:
+            vals, idx = jax.lax.top_k(-vm, k)
+            vals = -vals
+        return jnp.moveaxis(vals, -1, ax), jnp.moveaxis(idx.astype(jnp.int64), -1, ax)
+
+    return tuple(run_op("topk", f, _ensure(x)))
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    def f(v):
+        ax = _axis(axis) % v.ndim
+        vals = jnp.sort(v, axis=ax)
+        idxs = jnp.argsort(v, axis=ax)
+        tk = jnp.take(vals, k - 1, axis=ax)
+        ti = jnp.take(idxs, k - 1, axis=ax).astype(jnp.int64)
+        if keepdim:
+            tk, ti = jnp.expand_dims(tk, ax), jnp.expand_dims(ti, ax)
+        return tk, ti
+
+    return tuple(run_op("kthvalue", f, _ensure(x)))
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    def f(v):
+        ax = _axis(axis) % v.ndim
+        vm = jnp.moveaxis(v, ax, -1)
+        n = vm.shape[-1]
+        # O(n^2) pairwise count — fine for the last-dim sizes mode() sees.
+        counts = jnp.sum(vm[..., :, None] == vm[..., None, :], axis=-1)
+        best = jnp.argmax(counts, axis=-1)
+        val = jnp.take_along_axis(vm, best[..., None], axis=-1)[..., 0]
+        match = vm == val[..., None]
+        idx = jnp.max(jnp.where(match, jnp.arange(n), -1), axis=-1).astype(jnp.int64)
+        if keepdim:
+            val = jnp.moveaxis(val[..., None], -1, ax)
+            idx = jnp.moveaxis(idx[..., None], -1, ax)
+        return val, idx
+
+    return tuple(run_op("mode", f, _ensure(x)))
+
+
+def nonzero(x, as_tuple=False):
+    xv = np.asarray(_ensure(x)._value)
+    nz = np.nonzero(xv)
+    if as_tuple:
+        return tuple(to_tensor(n.astype(np.int64)) for n in nz)
+    return to_tensor(np.stack(nz, axis=1).astype(np.int64))
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    return run_op(
+        "where", lambda c, a, b: jnp.where(c, a, b), _ensure(condition), _ensure(x), _ensure(y)
+    )
+
+
+def where_(condition, x=None, y=None, name=None):
+    out = where(condition, x, y)
+    return x._rebind(out)
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    def f(seq, v):
+        side = "right" if right else "left"
+        if seq.ndim == 1:
+            out = jnp.searchsorted(seq, v, side=side)
+        else:
+            out = jax.vmap(lambda s, vv: jnp.searchsorted(s, vv, side=side))(
+                seq.reshape(-1, seq.shape[-1]), v.reshape(-1, v.shape[-1])
+            ).reshape(v.shape)
+        return out.astype(jnp.int32 if out_int32 else jnp.int64)
+
+    return run_op("searchsorted", f, _ensure(sorted_sequence), _ensure(values))
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32, right)
+
+
+def index_fill(x, index, axis, value, name=None):
+    def f(v, idx):
+        vm = jnp.moveaxis(v, axis, 0)
+        vm = vm.at[idx.astype(jnp.int32)].set(value)
+        return jnp.moveaxis(vm, 0, axis)
+
+    return run_op("index_fill", f, _ensure(x), _ensure(index))
+
+
+# --- stat -----------------------------------------------------------------
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return run_op(
+        "std",
+        lambda v: jnp.std(v, axis=ax, ddof=1 if unbiased else 0, keepdims=keepdim),
+        _ensure(x),
+    )
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return run_op(
+        "var",
+        lambda v: jnp.var(v, axis=ax, ddof=1 if unbiased else 0, keepdims=keepdim),
+        _ensure(x),
+    )
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    def f(v):
+        if mode == "avg":
+            return jnp.median(v, axis=_axis(axis), keepdims=keepdim)
+        # min mode: lower median value (paddle also returns index)
+        ax = _axis(axis)
+        if ax is None:
+            flat = jnp.sort(v.reshape(-1))
+            k = (flat.shape[0] - 1) // 2
+            return flat[k]
+        vs = jnp.sort(v, axis=ax)
+        k = (v.shape[ax] - 1) // 2
+        out = jnp.take(vs, k, axis=ax)
+        return jnp.expand_dims(out, ax) if keepdim else out
+
+    return run_op("median", f, _ensure(x))
+
+
+def nanmedian(x, axis=None, keepdim=False, mode="avg", name=None):
+    return run_op(
+        "nanmedian", lambda v: jnp.nanmedian(v, axis=_axis(axis), keepdims=keepdim), _ensure(x)
+    )
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    qv = q._value if isinstance(q, Tensor) else jnp.asarray(q)
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return run_op(
+        "quantile",
+        lambda v: jnp.quantile(v, qv, axis=ax, keepdims=keepdim, method=interpolation),
+        _ensure(x),
+    )
+
+
+def nanquantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    qv = q._value if isinstance(q, Tensor) else jnp.asarray(q)
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return run_op(
+        "nanquantile",
+        lambda v: jnp.nanquantile(v, qv, axis=ax, keepdims=keepdim, method=interpolation),
+        _ensure(x),
+    )
+
+
+def histogram(input, bins=100, min=0, max=0, weight=None, density=False, name=None):
+    xv = np.asarray(_ensure(input)._value)
+    lo, hi = (min, max) if (min != 0 or max != 0) else (xv.min(), xv.max())
+    wv = np.asarray(weight._value) if isinstance(weight, Tensor) else weight
+    h, _ = np.histogram(xv.reshape(-1), bins=bins, range=(lo, hi), weights=wv, density=density)
+    return to_tensor(h if density or weight is not None else h.astype(np.int64))
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None, name=None):
+    xv = np.asarray(_ensure(x)._value)
+    wv = np.asarray(weights._value) if isinstance(weights, Tensor) else weights
+    h, edges = np.histogramdd(xv, bins=bins, range=ranges, density=density, weights=wv)
+    return to_tensor(h), [to_tensor(e) for e in edges]
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    xv = np.asarray(_ensure(x)._value)
+    wv = np.asarray(weights._value) if isinstance(weights, Tensor) else weights
+    return to_tensor(np.bincount(xv, weights=wv, minlength=minlength))

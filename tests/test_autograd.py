@@ -1,0 +1,193 @@
+"""Autograd engine tests: analytic grads vs numeric (check_grad capability,
+test/legacy_test/op_test.py:2973) + hooks, paddle.grad, PyLayer."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def numeric_grad(f, x, eps=1e-3):
+    """Central-difference gradient of scalar f wrt numpy x."""
+    g = np.zeros_like(x)
+    flat = x.reshape(-1)
+    gf = g.reshape(-1)
+    for i in range(flat.size):
+        old = flat[i]
+        flat[i] = old + eps
+        f1 = f(x)
+        flat[i] = old - eps
+        f0 = f(x)
+        flat[i] = old
+        gf[i] = (f1 - f0) / (2 * eps)
+    return g
+
+
+class TestBackward:
+    def test_simple_chain(self):
+        x = paddle.to_tensor([2.0, 3.0], stop_gradient=False)
+        y = (x * x + x).sum()
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [5.0, 7.0])
+
+    def test_matmul_grad_numeric(self):
+        a = np.random.RandomState(0).randn(3, 4).astype(np.float64)
+        x = paddle.to_tensor(a, dtype="float64", stop_gradient=False)
+        w = paddle.to_tensor(np.random.RandomState(1).randn(4, 2), dtype="float64",
+                             stop_gradient=False)
+        loss = paddle.matmul(x, w).tanh().sum()
+        loss.backward()
+
+        def f(av):
+            return float(np.tanh(av @ w.numpy()).sum())
+
+        np.testing.assert_allclose(x.grad.numpy(), numeric_grad(f, a.copy()), rtol=1e-4, atol=1e-5)
+
+    def test_branching_accumulation(self):
+        x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+        a = x * 2
+        b = x * 3
+        (a + b).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [5.0, 5.0])
+
+    def test_grad_accumulates_across_backwards(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        (x * 2).sum().backward()
+        (x * 3).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [5.0])
+
+    def test_retain_graph(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        y = (x * 2).sum()
+        y.backward(retain_graph=True)
+        y.backward(retain_graph=True)
+        np.testing.assert_allclose(x.grad.numpy(), [4.0])
+        y2 = (x * 2).sum()
+        y2.backward()
+        with pytest.raises(RuntimeError):
+            y2.backward()
+
+    def test_stop_gradient(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        y = paddle.to_tensor([2.0], stop_gradient=True)
+        (x * y).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2.0])
+        assert y.grad is None
+
+    def test_detach(self):
+        x = paddle.to_tensor([3.0], stop_gradient=False)
+        y = x * 2
+        z = y.detach() * x
+        z.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [6.0])
+
+    def test_no_grad(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        with paddle.no_grad():
+            y = x * 2
+        assert y.stop_gradient
+        assert y._grad_node is None
+
+    def test_multi_output_op(self):
+        x = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3), stop_gradient=False)
+        parts = paddle.split(x, 3, axis=1)
+        parts[0].sum().backward()
+        expected = np.zeros((2, 3), np.float32)
+        expected[:, 0] = 1
+        np.testing.assert_allclose(x.grad.numpy(), expected)
+
+    def test_hook(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        seen = []
+        h = x.register_hook(lambda g: seen.append(g.numpy()) or g * 10)
+        (x * 2).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [20.0])
+        assert len(seen) == 1
+        h.remove()
+
+
+class TestGradAPI:
+    def test_paddle_grad(self):
+        x = paddle.to_tensor([2.0], stop_gradient=False)
+        y = x * x
+        (gx,) = paddle.grad(y, x)
+        np.testing.assert_allclose(gx.numpy(), [4.0])
+        assert x.grad is None  # grad() must not accumulate into .grad
+
+    def test_grad_intermediate(self):
+        x = paddle.to_tensor([2.0], stop_gradient=False)
+        y = x * 3
+        z = y * y
+        (gy,) = paddle.grad(z, y)
+        np.testing.assert_allclose(gy.numpy(), [12.0])
+
+    def test_allow_unused(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        u = paddle.to_tensor([1.0], stop_gradient=False)
+        y = x * 2
+        with pytest.raises(RuntimeError):
+            paddle.grad(y, [u])
+        gx, gu = paddle.grad((x * 2), [x, u], allow_unused=True)
+        assert gu is None
+
+    def test_jacobian_hessian(self):
+        from paddle_tpu.autograd import hessian, jacobian
+
+        x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+        J = jacobian(lambda v: (v * v).sum(), x)
+        np.testing.assert_allclose(J.numpy(), [2.0, 4.0])
+        H = hessian(lambda v: (v * v).sum(), x)
+        np.testing.assert_allclose(H.numpy(), 2 * np.eye(2))
+
+
+class TestPyLayer:
+    def test_custom_vjp(self):
+        from paddle_tpu.autograd import PyLayer
+
+        class Double(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)
+                return x * 2
+
+            @staticmethod
+            def backward(ctx, g):
+                return g * 2
+
+        x = paddle.to_tensor([3.0], stop_gradient=False)
+        y = Double.apply(x)
+        y.sum().backward()
+        np.testing.assert_allclose(y.numpy(), [6.0])
+        np.testing.assert_allclose(x.grad.numpy(), [2.0])
+
+    def test_custom_vjp_nonstandard(self):
+        from paddle_tpu.autograd import PyLayer
+
+        class FakeGrad(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                return x * 5
+
+            @staticmethod
+            def backward(ctx, g):
+                return g * 100  # deliberately not the true grad
+
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        FakeGrad.apply(x).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [100.0])
+
+
+class TestVjpJvp:
+    def test_vjp(self):
+        from paddle_tpu.autograd import vjp
+
+        x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+        out, g = vjp(lambda v: (v * v).sum(), x)
+        np.testing.assert_allclose(g.numpy(), [2.0, 4.0])
+
+    def test_jvp(self):
+        from paddle_tpu.autograd import jvp
+
+        x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+        out, jv = jvp(lambda v: (v * v).sum(), x)
+        np.testing.assert_allclose(jv.numpy(), 6.0)

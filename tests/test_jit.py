@@ -1,0 +1,169 @@
+"""to_static tests: correctness vs eager, state threading, caching, RNG."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def np_t(shape, seed=0):
+    return np.random.RandomState(seed).randn(*shape).astype(np.float32)
+
+
+class TestBasics:
+    def test_matches_eager(self):
+        model = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        x = paddle.to_tensor(np_t([3, 4]))
+        eager = model(x).numpy()
+        fn = paddle.jit.to_static(model.forward)
+        static = fn(x).numpy()
+        np.testing.assert_allclose(static, eager, rtol=1e-5)
+
+    def test_cache_by_shape(self):
+        model = nn.Linear(4, 2)
+        fn = paddle.jit.to_static(model.forward)
+        fn(paddle.to_tensor(np_t([3, 4])))
+        fn(paddle.to_tensor(np_t([5, 4])))
+        assert len(fn._cache) == 2
+        fn(paddle.to_tensor(np_t([3, 4], seed=9)))
+        assert len(fn._cache) == 2
+
+    def test_param_update_visible(self):
+        """Compiled fn must read the LIVE param value, not a baked constant."""
+        model = nn.Linear(2, 2, bias_attr=False)
+        fn = paddle.jit.to_static(model.forward)
+        x = paddle.to_tensor(np.eye(2, dtype=np.float32))
+        out1 = fn(x).numpy()
+        model.weight.set_value(model.weight.numpy() * 2)
+        out2 = fn(x).numpy()
+        np.testing.assert_allclose(out2, out1 * 2, rtol=1e-5)
+
+
+class TestTrainStep:
+    def test_full_train_step_matches_eager(self):
+        paddle.seed(0)
+        m1 = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 1))
+        paddle.seed(0)
+        m2 = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 1))
+        for p1, p2 in zip(m1.parameters(), m2.parameters()):
+            np.testing.assert_allclose(p1.numpy(), p2.numpy())
+        o1 = paddle.optimizer.SGD(learning_rate=0.1, parameters=m1.parameters())
+        o2 = paddle.optimizer.SGD(learning_rate=0.1, parameters=m2.parameters())
+
+        x = paddle.to_tensor(np_t([8, 4]))
+        y = paddle.to_tensor(np_t([8, 1], seed=2))
+
+        def step(model, opt, xv, yv):
+            loss = F.mse_loss(model(xv), yv)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        static_step = paddle.jit.to_static(lambda xv, yv: step(m2, o2, xv, yv))
+        for i in range(4):
+            l1 = step(m1, o1, x, y)
+            l2 = static_step(x, y)
+            np.testing.assert_allclose(float(l1), float(l2), rtol=1e-4)
+        for p1, p2 in zip(m1.parameters(), m2.parameters()):
+            np.testing.assert_allclose(p1.numpy(), p2.numpy(), rtol=1e-4, atol=1e-5)
+
+    def test_bn_stats_threaded(self):
+        """Buffer mutations (BN running stats) must update across jit calls."""
+        bn = nn.BatchNorm2D(3)
+        fn = paddle.jit.to_static(bn.forward)
+        x = paddle.to_tensor(np_t([4, 3, 5, 5]))
+        m0 = bn._mean.numpy().copy()
+        fn(x)
+        m1 = bn._mean.numpy().copy()
+        fn(x)
+        m2 = bn._mean.numpy().copy()
+        assert not np.allclose(m0, m1)
+        assert not np.allclose(m1, m2)
+
+    def test_rng_threaded(self):
+        """Dropout inside jit must give different masks per call."""
+        drop = nn.Dropout(0.5)
+        drop.train()
+        fn = paddle.jit.to_static(drop.forward)
+        x = paddle.to_tensor(np.ones((64,), np.float32))
+        a = fn(x).numpy()
+        b = fn(x).numpy()
+        assert not np.allclose(a, b)
+
+    def test_optimizer_state_threaded(self):
+        """Adam moments/step must evolve across compiled calls identically to
+        eager (regression: slots must be traced state, not baked constants)."""
+        paddle.seed(3)
+        m1 = nn.Linear(4, 4)
+        paddle.seed(3)
+        m2 = nn.Linear(4, 4)
+        o1 = paddle.optimizer.Adam(learning_rate=0.01, parameters=m1.parameters())
+        o2 = paddle.optimizer.Adam(learning_rate=0.01, parameters=m2.parameters())
+        xs = [paddle.to_tensor(np_t([4, 4], seed=s)) for s in range(6)]
+
+        def step(model, opt, xv):
+            loss = model(xv).square().mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        static_step = paddle.jit.to_static(lambda xv: step(m2, o2, xv))
+        for x in xs:
+            l1 = step(m1, o1, x)
+            l2 = static_step(x)
+            np.testing.assert_allclose(float(l1), float(l2), rtol=1e-4)
+        np.testing.assert_allclose(m1.weight.numpy(), m2.weight.numpy(), rtol=1e-4, atol=1e-6)
+        # slot evolution check: step counter must be 6 on the live state
+        t = o2._state[id(m2.weight)]["t"]
+        assert int(np.asarray(t._value)) == 6
+
+    def test_rng_seed_reproducible(self):
+        drop = nn.Dropout(0.5)
+        drop.train()
+        fn = paddle.jit.to_static(drop.forward)
+        x = paddle.to_tensor(np.ones((64,), np.float32))
+        paddle.seed(5)
+        a = fn(x).numpy()
+        paddle.seed(5)
+        b = fn(x).numpy()
+        np.testing.assert_allclose(a, b)
+
+
+class TestDecorator:
+    def test_decorator_form(self):
+        @paddle.jit.to_static
+        def f(a, b):
+            return a * 2 + b
+
+        out = f(paddle.to_tensor([1.0]), paddle.to_tensor([3.0]))
+        np.testing.assert_allclose(out.numpy(), [5.0])
+
+    def test_nested_static(self):
+        @paddle.jit.to_static
+        def inner(a):
+            return a * 2
+
+        @paddle.jit.to_static
+        def outer(a):
+            return inner(a) + 1
+
+        np.testing.assert_allclose(outer(paddle.to_tensor([2.0])).numpy(), [5.0])
+
+
+class TestSaveLoad:
+    def test_export_roundtrip(self, tmp_path):
+        from paddle_tpu.static import InputSpec
+
+        model = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        model.eval()
+        x = paddle.to_tensor(np_t([2, 4]))
+        expected = model(x).numpy()
+        path = str(tmp_path / "model")
+        paddle.jit.save(model, path, input_spec=[InputSpec([2, 4], "float32")])
+        loaded = paddle.jit.load(path)
+        out = loaded(x).numpy()
+        np.testing.assert_allclose(out, expected, rtol=1e-5)

@@ -1,0 +1,275 @@
+"""nn layer tests: shapes, numpy-reference outputs, state_dict, hooks."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def np_t(shape, seed=0):
+    return np.random.RandomState(seed).randn(*shape).astype(np.float32)
+
+
+class TestLinear:
+    def test_forward_matches_numpy(self):
+        layer = nn.Linear(4, 3)
+        x = np_t([2, 4])
+        out = layer(paddle.to_tensor(x))
+        expected = x @ layer.weight.numpy() + layer.bias.numpy()
+        np.testing.assert_allclose(out.numpy(), expected, rtol=1e-5)
+
+    def test_no_bias(self):
+        layer = nn.Linear(4, 3, bias_attr=False)
+        assert layer.bias is None
+        assert len(layer.parameters()) == 1
+
+
+class TestConv:
+    def test_conv2d_shape_and_value(self):
+        layer = nn.Conv2D(3, 8, 3, padding=1)
+        x = paddle.to_tensor(np_t([2, 3, 16, 16]))
+        out = layer(x)
+        assert out.shape == [2, 8, 16, 16]
+
+    def test_conv2d_vs_manual(self):
+        # 1x1 conv == matmul over channels
+        layer = nn.Conv2D(4, 2, 1, bias_attr=False)
+        x = np_t([1, 4, 5, 5])
+        out = layer(paddle.to_tensor(x)).numpy()
+        w = layer.weight.numpy()  # [2,4,1,1]
+        expected = np.einsum("nchw,oc->nohw", x, w[:, :, 0, 0])
+        np.testing.assert_allclose(out, expected, rtol=1e-4)
+
+    def test_conv_stride_groups(self):
+        layer = nn.Conv2D(4, 4, 3, stride=2, padding=1, groups=2)
+        out = layer(paddle.to_tensor(np_t([2, 4, 8, 8])))
+        assert out.shape == [2, 4, 4, 4]
+
+    def test_conv2d_transpose(self):
+        layer = nn.Conv2DTranspose(4, 2, 2, stride=2)
+        out = layer(paddle.to_tensor(np_t([1, 4, 5, 5])))
+        assert out.shape == [1, 2, 10, 10]
+
+    def test_conv1d_3d(self):
+        assert nn.Conv1D(2, 4, 3, padding=1)(paddle.to_tensor(np_t([2, 2, 9]))).shape == [2, 4, 9]
+        assert nn.Conv3D(2, 4, 3, padding=1)(
+            paddle.to_tensor(np_t([1, 2, 4, 4, 4]))).shape == [1, 4, 4, 4, 4]
+
+
+class TestNorm:
+    def test_layer_norm(self):
+        ln = nn.LayerNorm(8)
+        x = np_t([4, 8])
+        out = ln(paddle.to_tensor(x)).numpy()
+        m = x.mean(-1, keepdims=True)
+        v = x.var(-1, keepdims=True)
+        np.testing.assert_allclose(out, (x - m) / np.sqrt(v + 1e-5), rtol=1e-4, atol=1e-5)
+
+    def test_batch_norm_train_eval(self):
+        bn = nn.BatchNorm2D(3)
+        x = paddle.to_tensor(np_t([4, 3, 5, 5]))
+        bn.train()
+        out = bn(x)
+        assert out.shape == [4, 3, 5, 5]
+        # running stats updated
+        assert not np.allclose(bn._mean.numpy(), 0.0)
+        bn.eval()
+        out_eval = bn(x)
+        assert out_eval.shape == [4, 3, 5, 5]
+
+    def test_group_norm(self):
+        gn = nn.GroupNorm(2, 4)
+        out = gn(paddle.to_tensor(np_t([2, 4, 5, 5])))
+        assert out.shape == [2, 4, 5, 5]
+
+    def test_rms_norm(self):
+        rn = nn.RMSNorm(8)
+        x = np_t([2, 8])
+        out = rn(paddle.to_tensor(x)).numpy()
+        expected = x / np.sqrt((x**2).mean(-1, keepdims=True) + 1e-6)
+        np.testing.assert_allclose(out, expected, rtol=1e-4)
+
+
+class TestPooling:
+    def test_max_avg_pool(self):
+        x = np_t([1, 2, 4, 4])
+        mp = F.max_pool2d(paddle.to_tensor(x), 2).numpy()
+        ap = F.avg_pool2d(paddle.to_tensor(x), 2).numpy()
+        expected_mp = x.reshape(1, 2, 2, 2, 2, 2).max((3, 5))
+        expected_ap = x.reshape(1, 2, 2, 2, 2, 2).mean((3, 5))
+        np.testing.assert_allclose(mp, expected_mp, rtol=1e-6)
+        np.testing.assert_allclose(ap, expected_ap, rtol=1e-6)
+
+    def test_adaptive_pool(self):
+        out = F.adaptive_avg_pool2d(paddle.to_tensor(np_t([2, 3, 8, 8])), 1)
+        assert out.shape == [2, 3, 1, 1]
+
+
+class TestActivations:
+    def test_values(self):
+        x = np_t([3, 4])
+        t = paddle.to_tensor(x)
+        np.testing.assert_allclose(F.relu(t).numpy(), np.maximum(x, 0))
+        np.testing.assert_allclose(F.sigmoid(t).numpy(), 1 / (1 + np.exp(-x)), rtol=1e-5)
+        sm = F.softmax(t, axis=-1).numpy()
+        np.testing.assert_allclose(sm.sum(-1), np.ones(3), rtol=1e-5)
+        np.testing.assert_allclose(F.gelu(t).numpy(),
+                                   x * 0.5 * (1 + np.vectorize(np_erf)(x / np.sqrt(2))),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def np_erf(v):
+    import math
+
+    return math.erf(v)
+
+
+class TestLosses:
+    def test_cross_entropy(self):
+        logits = np_t([4, 10])
+        labels = np.array([1, 3, 5, 7])
+        loss = F.cross_entropy(paddle.to_tensor(logits), paddle.to_tensor(labels))
+        p = np.exp(logits - logits.max(-1, keepdims=True))
+        p = p / p.sum(-1, keepdims=True)
+        expected = -np.log(p[np.arange(4), labels]).mean()
+        np.testing.assert_allclose(float(loss), expected, rtol=1e-5)
+
+    def test_cross_entropy_ignore_index(self):
+        logits = np_t([4, 10])
+        labels = np.array([1, -100, 5, -100])
+        loss = F.cross_entropy(paddle.to_tensor(logits), paddle.to_tensor(labels),
+                               ignore_index=-100)
+        p = np.exp(logits - logits.max(-1, keepdims=True))
+        p = p / p.sum(-1, keepdims=True)
+        expected = -np.log(p[[0, 2], [1, 5]]).mean()
+        np.testing.assert_allclose(float(loss), expected, rtol=1e-5)
+
+    def test_mse_l1(self):
+        a, b = np_t([5]), np_t([5], seed=3)
+        np.testing.assert_allclose(
+            float(F.mse_loss(paddle.to_tensor(a), paddle.to_tensor(b))),
+            ((a - b) ** 2).mean(), rtol=1e-5)
+        np.testing.assert_allclose(
+            float(F.l1_loss(paddle.to_tensor(a), paddle.to_tensor(b))),
+            np.abs(a - b).mean(), rtol=1e-5)
+
+    def test_bce_with_logits(self):
+        z, y = np_t([6]), (np.random.RandomState(4).rand(6) > 0.5).astype(np.float32)
+        loss = F.binary_cross_entropy_with_logits(paddle.to_tensor(z), paddle.to_tensor(y))
+        p = 1 / (1 + np.exp(-z))
+        expected = -(y * np.log(p) + (1 - y) * np.log(1 - p)).mean()
+        np.testing.assert_allclose(float(loss), expected, rtol=1e-4)
+
+
+class TestDropoutEmbedding:
+    def test_dropout_train_eval(self):
+        d = nn.Dropout(0.5)
+        x = paddle.to_tensor(np.ones((100, 100), np.float32))
+        d.train()
+        out = d(x).numpy()
+        frac = (out == 0).mean()
+        assert 0.4 < frac < 0.6
+        d.eval()
+        np.testing.assert_allclose(d(x).numpy(), 1.0)
+
+    def test_embedding(self):
+        emb = nn.Embedding(10, 4)
+        idx = paddle.to_tensor([[1, 2], [3, 4]])
+        out = emb(idx)
+        assert out.shape == [2, 2, 4]
+        np.testing.assert_allclose(out.numpy()[0, 0], emb.weight.numpy()[1])
+
+
+class TestContainers:
+    def test_sequential_layerlist(self):
+        seq = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        out = seq(paddle.to_tensor(np_t([3, 4])))
+        assert out.shape == [3, 2]
+        assert len(seq.parameters()) == 4
+        ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+        assert len(ll) == 3 and len(ll.parameters()) == 6
+
+    def test_state_dict_roundtrip(self):
+        m1 = nn.Sequential(nn.Linear(4, 8), nn.LayerNorm(8))
+        m2 = nn.Sequential(nn.Linear(4, 8), nn.LayerNorm(8))
+        m2.set_state_dict(m1.state_dict())
+        x = paddle.to_tensor(np_t([2, 4]))
+        np.testing.assert_allclose(m1(x).numpy(), m2(x).numpy(), rtol=1e-6)
+
+    def test_named_parameters(self):
+        model = nn.Sequential(nn.Linear(2, 3), nn.Linear(3, 4))
+        names = dict(model.named_parameters())
+        assert "0.weight" in names and "1.bias" in names
+
+
+class TestHooks:
+    def test_forward_hooks(self):
+        layer = nn.Linear(2, 2)
+        calls = []
+        h1 = layer.register_forward_pre_hook(lambda l, inp: calls.append("pre"))
+        h2 = layer.register_forward_post_hook(lambda l, inp, out: calls.append("post"))
+        layer(paddle.to_tensor(np_t([1, 2])))
+        assert calls == ["pre", "post"]
+        h1.remove()
+        h2.remove()
+        layer(paddle.to_tensor(np_t([1, 2])))
+        assert calls == ["pre", "post"]
+
+
+class TestRNN:
+    def test_lstm_shapes(self):
+        lstm = nn.LSTM(4, 8, num_layers=2)
+        out, (h, c) = lstm(paddle.to_tensor(np_t([2, 5, 4])))
+        assert out.shape == [2, 5, 8]
+        assert h.shape == [2, 2, 8] and c.shape == [2, 2, 8]
+
+    def test_gru_bidirectional(self):
+        gru = nn.GRU(4, 8, direction="bidirect")
+        out, h = gru(paddle.to_tensor(np_t([2, 5, 4])))
+        assert out.shape == [2, 5, 16]
+
+    def test_lstm_backward(self):
+        lstm = nn.LSTM(4, 8)
+        x = paddle.to_tensor(np_t([2, 5, 4]), stop_gradient=False)
+        out, _ = lstm(x)
+        out.sum().backward()
+        assert x.grad is not None
+        assert lstm.weight_ih_l0.grad is not None
+
+
+class TestTransformer:
+    def test_mha(self):
+        mha = nn.MultiHeadAttention(16, 4)
+        x = paddle.to_tensor(np_t([2, 6, 16]))
+        out = mha(x)
+        assert out.shape == [2, 6, 16]
+
+    def test_encoder(self):
+        enc_layer = nn.TransformerEncoderLayer(16, 4, 32, dropout=0.0)
+        enc = nn.TransformerEncoder(enc_layer, 2)
+        out = enc(paddle.to_tensor(np_t([2, 6, 16])))
+        assert out.shape == [2, 6, 16]
+        # layers must be independent copies
+        p0 = enc.layers[0].linear1.weight
+        p1 = enc.layers[1].linear1.weight
+        assert p0 is not p1
+
+    def test_transformer_full(self):
+        model = nn.Transformer(d_model=16, nhead=4, num_encoder_layers=1,
+                               num_decoder_layers=1, dim_feedforward=32, dropout=0.0)
+        src = paddle.to_tensor(np_t([2, 5, 16]))
+        tgt = paddle.to_tensor(np_t([2, 3, 16], seed=2))
+        out = model(src, tgt)
+        assert out.shape == [2, 3, 16]
+
+
+class TestGradClip:
+    def test_global_norm(self):
+        p = paddle.Parameter(np.ones(4, np.float32) * 10)
+        p.grad = paddle.to_tensor(np.ones(4, np.float32) * 10)
+        clip = nn.ClipGradByGlobalNorm(1.0)
+        out = clip([(p, p.grad)])
+        total = np.linalg.norm(out[0][1].numpy())
+        np.testing.assert_allclose(total, 1.0, rtol=1e-5)

@@ -1,0 +1,178 @@
+"""Optimizer + LR scheduler tests (vs closed-form update math)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.optimizer import SGD, Adam, AdamW, Lamb, Momentum, RMSProp, lr
+
+
+def make_param(value):
+    return paddle.Parameter(np.asarray(value, np.float32))
+
+
+def set_grad(p, g):
+    p.grad = paddle.to_tensor(np.asarray(g, np.float32))
+
+
+class TestSGD:
+    def test_update(self):
+        p = make_param([1.0, 2.0])
+        opt = SGD(learning_rate=0.1, parameters=[p])
+        set_grad(p, [1.0, 1.0])
+        opt.step()
+        np.testing.assert_allclose(p.numpy(), [0.9, 1.9], rtol=1e-6)
+
+    def test_weight_decay(self):
+        p = make_param([1.0])
+        opt = SGD(learning_rate=0.1, parameters=[p], weight_decay=0.1)
+        set_grad(p, [0.0])
+        opt.step()
+        np.testing.assert_allclose(p.numpy(), [1.0 - 0.1 * 0.1], rtol=1e-6)
+
+
+class TestMomentum:
+    def test_two_steps(self):
+        p = make_param([0.0])
+        opt = Momentum(learning_rate=1.0, momentum=0.9, parameters=[p])
+        set_grad(p, [1.0])
+        opt.step()
+        np.testing.assert_allclose(p.numpy(), [-1.0], rtol=1e-6)
+        set_grad(p, [1.0])
+        opt.step()
+        np.testing.assert_allclose(p.numpy(), [-1.0 - 1.9], rtol=1e-6)
+
+
+class TestAdam:
+    def test_first_step_magnitude(self):
+        p = make_param([1.0])
+        opt = Adam(learning_rate=0.001, parameters=[p])
+        set_grad(p, [0.5])
+        opt.step()
+        # first adam step ≈ lr * sign(g)
+        np.testing.assert_allclose(p.numpy(), [1.0 - 0.001], rtol=1e-3)
+
+    def test_adamw_decoupled_decay(self):
+        p = make_param([1.0])
+        opt = AdamW(learning_rate=0.01, weight_decay=0.1, parameters=[p])
+        set_grad(p, [0.0])
+        opt.step()
+        # pure decay: w *= (1 - lr*wd); adam term 0 since grad 0
+        np.testing.assert_allclose(p.numpy(), [1.0 * (1 - 0.01 * 0.1)], rtol=1e-5)
+
+
+class TestTrainingConvergence:
+    def test_linear_regression(self):
+        rng = np.random.RandomState(0)
+        X = rng.randn(64, 3).astype(np.float32)
+        true_w = np.array([[1.0], [-2.0], [0.5]], np.float32)
+        Y = X @ true_w
+        model = nn.Linear(3, 1)
+        opt = Adam(learning_rate=0.1, parameters=model.parameters())
+        xt, yt = paddle.to_tensor(X), paddle.to_tensor(Y)
+        for _ in range(200):
+            loss = ((model(xt) - yt) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        np.testing.assert_allclose(model.weight.numpy(), true_w, atol=0.05)
+
+    @pytest.mark.parametrize("opt_cls", [SGD, Momentum, Adam, AdamW, RMSProp, Lamb])
+    def test_all_optimizers_reduce_loss(self, opt_cls):
+        rng = np.random.RandomState(1)
+        X = rng.randn(32, 4).astype(np.float32)
+        Y = (X.sum(1, keepdims=True) > 0).astype(np.float32)
+        model = nn.Linear(4, 1)
+        opt = opt_cls(learning_rate=0.05, parameters=model.parameters())
+        xt, yt = paddle.to_tensor(X), paddle.to_tensor(Y)
+
+        def loss_fn():
+            import paddle_tpu.nn.functional as F
+
+            return F.binary_cross_entropy_with_logits(model(xt), yt)
+
+        l0 = float(loss_fn())
+        for _ in range(30):
+            loss = loss_fn()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        assert float(loss_fn()) < l0
+
+
+class TestMasterWeights:
+    def test_bf16_master(self):
+        p = paddle.Parameter(np.ones(4, np.float32))
+        p._value = p._value.astype("bfloat16")
+        opt = Adam(learning_rate=1e-4, parameters=[p], multi_precision=True)
+        set_grad(p, [1e-3] * 4)
+        opt.step()
+        assert str(p.dtype) == "bfloat16"
+        assert "master" in opt._state[id(p)]  # fp32 master kept
+        assert str(opt._state[id(p)]["master"].dtype) == "float32"
+
+
+class TestStateDict:
+    def test_roundtrip(self):
+        p = make_param([1.0, 2.0])
+        opt = Adam(learning_rate=0.01, parameters=[p])
+        set_grad(p, [0.1, 0.2])
+        opt.step()
+        state = opt.state_dict()
+        p2 = make_param(p.numpy())
+        opt2 = Adam(learning_rate=0.01, parameters=[p2])
+        opt2.set_state_dict(state)
+        set_grad(p, [0.1, 0.2])
+        set_grad(p2, [0.1, 0.2])
+        opt.step()
+        opt2.step()
+        np.testing.assert_allclose(p.numpy(), p2.numpy(), rtol=1e-6)
+
+
+class TestLRSchedulers:
+    def test_step_decay(self):
+        s = lr.StepDecay(learning_rate=1.0, step_size=2, gamma=0.5)
+        vals = []
+        for _ in range(5):
+            vals.append(s())
+            s.step()
+        np.testing.assert_allclose(vals, [1.0, 1.0, 0.5, 0.5, 0.25])
+
+    def test_cosine(self):
+        s = lr.CosineAnnealingDecay(learning_rate=1.0, T_max=10)
+        assert abs(s() - 1.0) < 1e-6
+        for _ in range(10):
+            s.step()
+        assert s() < 1e-6
+
+    def test_linear_warmup(self):
+        s = lr.LinearWarmup(learning_rate=1.0, warmup_steps=10, start_lr=0.0, end_lr=1.0)
+        s.step(5)
+        np.testing.assert_allclose(s(), 0.5, rtol=1e-6)
+
+    def test_scheduler_in_optimizer(self):
+        p = make_param([1.0])
+        sched = lr.StepDecay(learning_rate=0.1, step_size=1, gamma=0.1)
+        opt = SGD(learning_rate=sched, parameters=[p])
+        assert abs(opt.get_lr() - 0.1) < 1e-9
+        sched.step()
+        assert abs(opt.get_lr() - 0.01) < 1e-9
+
+    def test_reduce_on_plateau(self):
+        s = lr.ReduceOnPlateau(learning_rate=1.0, patience=1, factor=0.5)
+        for loss in [1.0, 1.0, 1.0, 1.0]:
+            s.step(loss)
+        assert s() < 1.0
+
+
+class TestGradClipIntegration:
+    def test_clip_in_step(self):
+        p = make_param(np.ones(4))
+        opt = SGD(learning_rate=1.0, parameters=[p],
+                  grad_clip=paddle.nn.ClipGradByGlobalNorm(0.1))
+        set_grad(p, np.ones(4) * 100)
+        opt.step()
+        # update magnitude ≈ clip_norm
+        delta = np.abs(p.numpy() - 1.0)
+        np.testing.assert_allclose(np.linalg.norm(delta), 0.1, rtol=1e-4)

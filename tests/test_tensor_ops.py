@@ -1,0 +1,233 @@
+"""NumPy-reference op tests (OpTest capability, test/legacy_test/op_test.py:420):
+outputs checked against numpy, gradients checked analytically via the tape."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def np_t(shape, dtype=np.float32, seed=0):
+    rng = np.random.RandomState(seed)
+    return rng.randn(*shape).astype(dtype)
+
+
+class TestCreation:
+    def test_zeros_ones_full(self):
+        assert paddle.zeros([2, 3]).numpy().sum() == 0
+        assert paddle.ones([2, 3]).numpy().sum() == 6
+        np.testing.assert_allclose(paddle.full([2, 2], 3.5).numpy(), np.full((2, 2), 3.5))
+
+    def test_arange_linspace(self):
+        np.testing.assert_array_equal(paddle.arange(5).numpy(), np.arange(5))
+        np.testing.assert_array_equal(paddle.arange(1, 10, 2).numpy(), np.arange(1, 10, 2))
+        np.testing.assert_allclose(paddle.linspace(0, 1, 5).numpy(), np.linspace(0, 1, 5))
+
+    def test_eye_diag(self):
+        np.testing.assert_array_equal(paddle.eye(3).numpy(), np.eye(3, dtype=np.float32))
+        v = paddle.to_tensor([1.0, 2.0, 3.0])
+        np.testing.assert_array_equal(paddle.diag(v).numpy(), np.diag([1, 2, 3]).astype(np.float32))
+
+    def test_like_variants(self):
+        x = paddle.to_tensor(np_t([3, 4]))
+        assert paddle.zeros_like(x).shape == [3, 4]
+        assert paddle.ones_like(x).numpy().sum() == 12
+        np.testing.assert_allclose(paddle.full_like(x, 2.0).numpy(), np.full((3, 4), 2.0))
+
+    def test_tril_triu(self):
+        a = np_t([4, 4])
+        x = paddle.to_tensor(a)
+        np.testing.assert_allclose(paddle.tril(x).numpy(), np.tril(a))
+        np.testing.assert_allclose(paddle.triu(x, 1).numpy(), np.triu(a, 1))
+
+    def test_dtype_conversion(self):
+        x = paddle.to_tensor([1, 2, 3])
+        assert str(x.dtype) == "int64"
+        y = x.astype("float32")
+        assert y.dtype == paddle.float32
+
+
+class TestMath:
+    def test_binary_ops(self):
+        a, b = np_t([3, 4], seed=1), np_t([3, 4], seed=2)
+        x, y = paddle.to_tensor(a), paddle.to_tensor(b)
+        np.testing.assert_allclose((x + y).numpy(), a + b, rtol=1e-6)
+        np.testing.assert_allclose((x - y).numpy(), a - b, rtol=1e-6)
+        np.testing.assert_allclose((x * y).numpy(), a * b, rtol=1e-6)
+        np.testing.assert_allclose((x / y).numpy(), a / b, rtol=1e-5)
+        np.testing.assert_allclose(paddle.maximum(x, y).numpy(), np.maximum(a, b))
+        np.testing.assert_allclose((x**2).numpy(), a**2, rtol=1e-6)
+
+    def test_unary_ops(self):
+        a = np.abs(np_t([3, 4])) + 0.1
+        x = paddle.to_tensor(a)
+        np.testing.assert_allclose(paddle.sqrt(x).numpy(), np.sqrt(a), rtol=1e-6)
+        np.testing.assert_allclose(paddle.log(x).numpy(), np.log(a), rtol=1e-5)
+        np.testing.assert_allclose(paddle.exp(x).numpy(), np.exp(a), rtol=1e-6)
+        np.testing.assert_allclose(paddle.tanh(x).numpy(), np.tanh(a), rtol=1e-6)
+        np.testing.assert_allclose(paddle.abs(paddle.to_tensor(-a)).numpy(), a)
+
+    def test_reductions(self):
+        a = np_t([3, 4, 5])
+        x = paddle.to_tensor(a)
+        np.testing.assert_allclose(paddle.sum(x).numpy(), a.sum(), rtol=1e-5)
+        np.testing.assert_allclose(paddle.sum(x, axis=1).numpy(), a.sum(1), rtol=1e-5)
+        np.testing.assert_allclose(paddle.mean(x, axis=[0, 2]).numpy(), a.mean((0, 2)), rtol=1e-5)
+        np.testing.assert_allclose(paddle.max(x, axis=1, keepdim=True).numpy(),
+                                   a.max(1, keepdims=True))
+        np.testing.assert_allclose(paddle.prod(x, axis=0).numpy(), a.prod(0), rtol=1e-5)
+
+    def test_matmul(self):
+        a, b = np_t([2, 3, 4]), np_t([2, 4, 5])
+        np.testing.assert_allclose(
+            paddle.matmul(paddle.to_tensor(a), paddle.to_tensor(b)).numpy(),
+            a @ b, rtol=1e-5)
+        np.testing.assert_allclose(
+            paddle.matmul(paddle.to_tensor(a), paddle.to_tensor(b.swapaxes(1, 2)),
+                          transpose_y=True).numpy(),
+            a @ b, rtol=1e-5)
+
+    def test_cumsum_clip(self):
+        a = np_t([3, 4])
+        x = paddle.to_tensor(a)
+        np.testing.assert_allclose(paddle.cumsum(x, axis=1).numpy(), a.cumsum(1), rtol=1e-5)
+        np.testing.assert_allclose(paddle.clip(x, -0.5, 0.5).numpy(), a.clip(-0.5, 0.5))
+
+    def test_scale_addn(self):
+        a = np_t([3])
+        x = paddle.to_tensor(a)
+        np.testing.assert_allclose(paddle.scale(x, 2.0, 1.0).numpy(), a * 2 + 1, rtol=1e-6)
+        np.testing.assert_allclose(paddle.add_n([x, x, x]).numpy(), a * 3, rtol=1e-6)
+
+    def test_einsum(self):
+        a, b = np_t([3, 4]), np_t([4, 5])
+        np.testing.assert_allclose(
+            paddle.einsum("ij,jk->ik", paddle.to_tensor(a), paddle.to_tensor(b)).numpy(),
+            np.einsum("ij,jk->ik", a, b), rtol=1e-5)
+
+
+class TestManipulation:
+    def test_reshape_transpose(self):
+        a = np_t([2, 3, 4])
+        x = paddle.to_tensor(a)
+        assert x.reshape([6, 4]).shape == [6, 4]
+        np.testing.assert_allclose(x.transpose([2, 0, 1]).numpy(), a.transpose(2, 0, 1))
+        assert x.flatten().shape == [24]
+
+    def test_concat_split_stack(self):
+        a, b = np_t([2, 3]), np_t([2, 3], seed=5)
+        x, y = paddle.to_tensor(a), paddle.to_tensor(b)
+        np.testing.assert_allclose(paddle.concat([x, y], axis=0).numpy(),
+                                   np.concatenate([a, b], 0))
+        np.testing.assert_allclose(paddle.stack([x, y], axis=1).numpy(), np.stack([a, b], 1))
+        parts = paddle.split(paddle.to_tensor(np_t([6, 4])), 3, axis=0)
+        assert len(parts) == 3 and parts[0].shape == [2, 4]
+        parts = paddle.split(paddle.to_tensor(np_t([6, 4])), [1, 2, -1], axis=0)
+        assert parts[2].shape == [3, 4]
+
+    def test_squeeze_expand(self):
+        x = paddle.to_tensor(np_t([1, 3, 1, 4]))
+        assert x.squeeze().shape == [3, 4]
+        assert x.squeeze(0).shape == [3, 1, 4]
+        assert paddle.unsqueeze(paddle.to_tensor(np_t([3])), 0).shape == [1, 3]
+        assert paddle.expand(paddle.to_tensor(np_t([1, 3])), [5, 3]).shape == [5, 3]
+
+    def test_gather_scatter(self):
+        a = np_t([5, 3])
+        x = paddle.to_tensor(a)
+        idx = paddle.to_tensor([0, 2, 4])
+        np.testing.assert_allclose(paddle.gather(x, idx).numpy(), a[[0, 2, 4]])
+        upd = paddle.to_tensor(np.ones((2, 3), np.float32))
+        out = paddle.scatter(x, paddle.to_tensor([1, 3]), upd)
+        assert np.allclose(out.numpy()[1], 1.0) and np.allclose(out.numpy()[3], 1.0)
+
+    def test_indexing(self):
+        a = np_t([4, 5])
+        x = paddle.to_tensor(a)
+        np.testing.assert_allclose(x[1].numpy(), a[1])
+        np.testing.assert_allclose(x[1:3, 2:].numpy(), a[1:3, 2:])
+        x[0] = 0.0
+        assert np.allclose(x.numpy()[0], 0.0)
+
+    def test_topk_sort_argmax(self):
+        a = np_t([3, 10])
+        x = paddle.to_tensor(a)
+        vals, idx = paddle.topk(x, 3)
+        np.testing.assert_allclose(vals.numpy(), np.sort(a, 1)[:, ::-1][:, :3], rtol=1e-6)
+        np.testing.assert_array_equal(paddle.argmax(x, axis=1).numpy(), a.argmax(1))
+        np.testing.assert_allclose(paddle.sort(x, axis=1).numpy(), np.sort(a, 1))
+
+    def test_where_masked(self):
+        a = np_t([3, 4])
+        x = paddle.to_tensor(a)
+        out = paddle.where(x > 0, x, paddle.zeros_like(x))
+        np.testing.assert_allclose(out.numpy(), np.where(a > 0, a, 0))
+
+
+class TestLinalg:
+    def test_solve_inv_det(self):
+        a = np_t([3, 3]) + 3 * np.eye(3, dtype=np.float32)
+        b = np_t([3, 2], seed=7)
+        x = paddle.to_tensor(a)
+        np.testing.assert_allclose(paddle.inverse(x).numpy(), np.linalg.inv(a), rtol=1e-4)
+        np.testing.assert_allclose(
+            paddle.tensor.linalg.solve(x, paddle.to_tensor(b)).numpy(),
+            np.linalg.solve(a, b), rtol=1e-4)
+        np.testing.assert_allclose(paddle.tensor.linalg.det(x).numpy(),
+                                   np.linalg.det(a), rtol=1e-4)
+
+    def test_svd_qr_cholesky(self):
+        a = np_t([4, 3])
+        u, s, vh = np.linalg.svd(a, full_matrices=False)
+        _, ps, _ = paddle.tensor.linalg.svd(paddle.to_tensor(a))
+        np.testing.assert_allclose(ps.numpy(), s, rtol=1e-4)
+        spd = a.T @ a + np.eye(3, dtype=np.float32)
+        L = paddle.tensor.linalg.cholesky(paddle.to_tensor(spd))
+        np.testing.assert_allclose(L.numpy() @ L.numpy().T, spd, rtol=1e-4)
+
+    def test_norm(self):
+        a = np_t([3, 4])
+        x = paddle.to_tensor(a)
+        np.testing.assert_allclose(paddle.tensor.linalg.norm(x).numpy(),
+                                   np.linalg.norm(a), rtol=1e-5)
+        np.testing.assert_allclose(paddle.tensor.linalg.norm(x, p=1, axis=1).numpy(),
+                                   np.abs(a).sum(1), rtol=1e-5)
+
+
+class TestLogic:
+    def test_comparisons(self):
+        a, b = np_t([3]), np_t([3], seed=9)
+        x, y = paddle.to_tensor(a), paddle.to_tensor(b)
+        np.testing.assert_array_equal((x > y).numpy(), a > b)
+        np.testing.assert_array_equal((x == x).numpy(), np.ones(3, bool))
+        assert bool(paddle.allclose(x, x))
+        assert not bool(paddle.equal_all(x, y))
+
+    def test_logical(self):
+        t = paddle.to_tensor([True, False, True])
+        f = paddle.to_tensor([False, False, True])
+        np.testing.assert_array_equal(paddle.logical_and(t, f).numpy(), [False, False, True])
+        np.testing.assert_array_equal(paddle.logical_not(t).numpy(), [False, True, False])
+
+
+class TestRandom:
+    def test_shapes_and_determinism(self):
+        paddle.seed(7)
+        a = paddle.randn([3, 4])
+        paddle.seed(7)
+        b = paddle.randn([3, 4])
+        np.testing.assert_allclose(a.numpy(), b.numpy())
+        assert paddle.rand([2, 2]).shape == [2, 2]
+        r = paddle.randint(0, 10, [100])
+        assert r.numpy().min() >= 0 and r.numpy().max() < 10
+        perm = paddle.randperm(10).numpy()
+        np.testing.assert_array_equal(np.sort(perm), np.arange(10))
+
+
+class TestStat:
+    def test_std_var_median(self):
+        a = np_t([20])
+        x = paddle.to_tensor(a)
+        np.testing.assert_allclose(paddle.std(x).numpy(), a.std(ddof=1), rtol=1e-5)
+        np.testing.assert_allclose(paddle.var(x, unbiased=False).numpy(), a.var(), rtol=1e-5)
+        np.testing.assert_allclose(paddle.median(x).numpy(), np.median(a), rtol=1e-6)

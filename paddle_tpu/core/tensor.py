@@ -41,6 +41,7 @@ class Tensor:
         "_hook_counter",
         "trainable",
         "dist_attr",
+        "dist_spec",
         "__weakref__",
     )
 
@@ -290,7 +291,8 @@ class Parameter(Tensor):
     Analog of ``paddle.base.framework.EagerParamBase``.
     """
 
-    __slots__ = ("optimize_attr", "regularizer", "need_clip", "is_distributed")
+    __slots__ = ("optimize_attr", "regularizer", "need_clip", "is_distributed",
+                 "sequence_parallel")
 
     def __init__(self, value, trainable: bool = True, name: Optional[str] = None):
         super().__init__(value, stop_gradient=not trainable, name=name)

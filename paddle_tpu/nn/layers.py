@@ -143,6 +143,12 @@ class Layer:
     def parameters(self, include_sublayers: bool = True) -> List[Parameter]:
         return [p for _, p in self.named_parameters(include_sublayers=include_sublayers)]
 
+    def clear_gradients(self, set_to_zero: bool = True):
+        """``Layer.clear_gradients`` (layers.py:334 surface) — drop grads."""
+        for p in self.parameters():
+            if p is not None:
+                p.grad = None
+
     def named_buffers(self, prefix: str = "", include_sublayers: bool = True):
         seen = set()
         for name, layer, lp in self._walk(prefix):

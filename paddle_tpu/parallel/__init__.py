@@ -1,0 +1,41 @@
+"""Hybrid-parallel strategy layer (SURVEY.md §2.3 / §7 step 6).
+
+The reference implements every strategy as NCCL-subgroup wrappers under
+``python/paddle/distributed/fleet/meta_parallel/``; here each strategy is a
+way of steering GSPMD/shard_map over the global 5-axis mesh
+([dp, pp, sharding, sep, mp], ``paddle_tpu.distributed.topology``):
+
+* TP — :mod:`mp_layers` (param PartitionSpecs + activation constraints)
+* SP — :mod:`sequence_parallel` (seq-dim sharding outside TP blocks)
+* PP — :mod:`pipeline` (shard_map + collective-permute microbatch ring)
+* ZeRO — :mod:`sharding` (declarative param/slot placement)
+* EP/MoE — :mod:`moe` (gshard gating + expert-sharded einsum dispatch)
+* CP — :mod:`ring_attention` (ring K/V rotation for long context)
+* recompute — :mod:`recompute` (jax.checkpoint remat)
+"""
+
+from . import moe, mp_layers, pipeline, random, recompute, ring_attention, sequence_parallel, sharding, utils  # noqa: F401
+from .moe import FusedMoEMLP, GShardGate, MoELayer, NaiveGate, SwitchGate, global_gather, global_scatter  # noqa: F401
+from .mp_layers import ColumnParallelLinear, ParallelCrossEntropy, RowParallelLinear, VocabParallelEmbedding  # noqa: F401
+from .pipeline import LayerDesc, PipelineLayer, SharedLayerDesc, pipeline_forward, pipeline_spmd  # noqa: F401
+from .random import RNGStatesTracker, get_rng_state_tracker, model_parallel_random_seed  # noqa: F401
+from .recompute import recompute, recompute_sequential  # noqa: F401
+from .ring_attention import ring_attention_local, ring_flash_attention  # noqa: F401
+from .sequence_parallel import (  # noqa: F401
+    AllGatherOp,
+    ColumnSequenceParallelLinear,
+    GatherOp,
+    ReduceScatterOp,
+    RowSequenceParallelLinear,
+    ScatterOp,
+    mark_as_sequence_parallel_parameter,
+)
+from .sharding import (  # noqa: F401
+    GroupShardedOptimizerStage2,
+    GroupShardedStage2,
+    GroupShardedStage3,
+    group_sharded_parallel,
+    save_group_sharded_model,
+    shard_parameters,
+)
+from .utils import annotate_param, apply_param_shardings, param_spec, sharding_constraint  # noqa: F401

@@ -1,0 +1,263 @@
+"""Mixture-of-Experts with expert parallelism (EP).
+
+Capability analog of the reference MoE stack:
+``python/paddle/incubate/distributed/models/moe/moe_layer.py:263``
+(``MoELayer``), gates under ``moe/gate/`` (naive/gshard/switch), capacity
+pruning (``distributed/models/moe/utils.py:20-178``), and the
+``global_scatter``/``global_gather`` all-to-all pair
+(``python/paddle/distributed/utils/moe_utils.py:20,153``).
+
+TPU-first: the GShard formulation — gating produces dense dispatch/combine
+tensors and the token shuffle is two einsums over an expert-sharded buffer;
+annotating the ``[E, C, H]`` buffer's E dim over the ``ep`` mesh axis makes
+GSPMD emit the all-to-all over ICI (the reference's global_scatter/gather
+NCCL calls).  Expert FFNs are *stacked* weights ``[E, H, FF]`` so every
+expert's matmul is one big batched MXU contraction.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import run_op
+from ..core.tensor import Tensor
+from ..nn import functional as F
+from ..nn.initializer import Constant, XavierNormal
+from ..nn.layers import Layer
+from .utils import annotate_param, axis_size, sharding_constraint
+
+EP_AXIS = "sep"  # expert parallelism rides the sep axis of the 5-axis mesh
+
+
+def _one_hot(idx, n):
+    return jax.nn.one_hot(idx, n, dtype=jnp.float32)
+
+
+def _top2_gating(logits, capacity, second_policy_random=False):
+    """GShard top-2 gating with capacity pruning and load-balance aux loss
+    (moe/gate/gshard_gate.py analog). logits: [T, E] float32."""
+    T, E = logits.shape
+    probs = jax.nn.softmax(logits, axis=-1)
+
+    g1_idx = jnp.argmax(probs, axis=-1)
+    m1 = _one_hot(g1_idx, E)
+    g1 = jnp.sum(probs * m1, axis=-1)
+
+    probs2 = probs * (1.0 - m1)
+    g2_idx = jnp.argmax(probs2, axis=-1)
+    m2 = _one_hot(g2_idx, E)
+    g2 = jnp.sum(probs2 * m2, axis=-1)
+
+    # aux loss: mean(prob per expert) * mean(tokens-routed per expert) * E
+    density = jnp.mean(m1, axis=0)
+    density_proxy = jnp.mean(probs, axis=0)
+    aux = jnp.sum(density * density_proxy) * E
+
+    # capacity positions by cumulative count (tokens beyond capacity dropped)
+    pos1 = jnp.cumsum(m1, axis=0) * m1 - 1.0
+    m1 = m1 * (pos1 < capacity)
+    pos2 = (jnp.cumsum(m2, axis=0) + jnp.sum(m1, axis=0, keepdims=True)) * m2 - 1.0
+    m2 = m2 * (pos2 < capacity)
+
+    # renormalize the two gates over surviving assignments
+    g1 = g1 * jnp.sum(m1, axis=-1)
+    g2 = g2 * jnp.sum(m2, axis=-1)
+    denom = g1 + g2
+    denom = jnp.where(denom > 0, denom, 1.0)
+    g1, g2 = g1 / denom, g2 / denom
+
+    p1 = jnp.sum(pos1 * m1, axis=-1).astype(jnp.int32)
+    p2 = jnp.sum(pos2 * m2, axis=-1).astype(jnp.int32)
+    # combine[t, e, c]
+    combine = (
+        g1[:, None, None] * m1[:, :, None] * _one_hot(p1, capacity)[:, None, :]
+        + g2[:, None, None] * m2[:, :, None] * _one_hot(p2, capacity)[:, None, :]
+    )
+    dispatch = combine > 0.0
+    return combine, dispatch, aux
+
+
+def _top1_gating(logits, capacity):
+    """Switch-transformer top-1 gating (moe/gate/switch_gate.py analog)."""
+    T, E = logits.shape
+    probs = jax.nn.softmax(logits, axis=-1)
+    idx = jnp.argmax(probs, axis=-1)
+    m1 = _one_hot(idx, E)
+    g1 = jnp.sum(probs * m1, axis=-1)
+
+    density = jnp.mean(m1, axis=0)
+    density_proxy = jnp.mean(probs, axis=0)
+    aux = jnp.sum(density * density_proxy) * E
+
+    pos1 = jnp.cumsum(m1, axis=0) * m1 - 1.0
+    m1 = m1 * (pos1 < capacity)
+    p1 = jnp.sum(pos1 * m1, axis=-1).astype(jnp.int32)
+    combine = g1[:, None, None] * m1[:, :, None] * _one_hot(p1, capacity)[:, None, :]
+    dispatch = combine > 0.0
+    return combine, dispatch, aux
+
+
+class BaseGate(Layer):
+    def __init__(self, d_model: int, num_experts: int):
+        super().__init__()
+        self.d_model = d_model
+        self.num_experts = num_experts
+        self.weight = self.create_parameter(
+            [d_model, num_experts], default_initializer=XavierNormal())
+        self.loss = None
+
+    def logits(self, x):
+        # gate math in f32 for routing stability (reference casts likewise)
+        return run_op(
+            "gate_logits",
+            lambda v, w: jnp.matmul(v.astype(jnp.float32), w.astype(jnp.float32)),
+            x, self.weight)
+
+
+class GShardGate(BaseGate):
+    top_k = 2
+
+    def gating(self, logits_val, capacity):
+        return _top2_gating(logits_val, capacity)
+
+
+class SwitchGate(BaseGate):
+    top_k = 1
+
+    def gating(self, logits_val, capacity):
+        return _top1_gating(logits_val, capacity)
+
+
+class NaiveGate(GShardGate):
+    """top-2 without aux loss weighting (moe/gate/naive_gate.py)."""
+
+
+class FusedMoEMLP(Layer):
+    """Stacked-expert SwiGLU/GELU FFN: weights [E, H, FF] / [E, FF, H],
+    E-dim sharded over the ``ep`` axis.  One einsum per projection keeps
+    every expert on the MXU (the reference loops per-expert Linears)."""
+
+    def __init__(self, num_experts: int, d_model: int, d_hidden: int,
+                 activation: str = "gelu"):
+        super().__init__()
+        self.num_experts = num_experts
+        self.activation = activation
+        k = 1.0 / math.sqrt(d_model)
+        self.w_in = self.create_parameter(
+            [num_experts, d_model, d_hidden], default_initializer=XavierNormal())
+        self.w_gate = (self.create_parameter(
+            [num_experts, d_model, d_hidden], default_initializer=XavierNormal())
+            if activation == "swiglu" else None)
+        self.w_out = self.create_parameter(
+            [num_experts, d_hidden, d_model], default_initializer=XavierNormal())
+        annotate_param(self.w_in, EP_AXIS, None, None)
+        if self.w_gate is not None:
+            annotate_param(self.w_gate, EP_AXIS, None, None)
+        annotate_param(self.w_out, EP_AXIS, None, None)
+
+    def forward(self, dispatched):  # [E, C, H]
+        def f(x, w_in, w_out, *rest):
+            h = jnp.einsum("ech,ehf->ecf", x, w_in.astype(x.dtype))
+            if self.w_gate is not None:
+                g = jnp.einsum("ech,ehf->ecf", x, rest[0].astype(x.dtype))
+                h = jax.nn.silu(g) * h
+            elif self.activation == "gelu":
+                h = jax.nn.gelu(h)
+            else:
+                h = jax.nn.relu(h)
+            return jnp.einsum("ecf,efh->ech", h, w_out.astype(x.dtype))
+
+        args = [dispatched, self.w_in, self.w_out]
+        if self.w_gate is not None:
+            args.append(self.w_gate)
+        return run_op("moe_experts", f, *args)
+
+
+class MoELayer(Layer):
+    """(``moe_layer.py:263`` analog) gate → dispatch einsum → expert-sharded
+    FFN → combine einsum.  ``experts`` may be a :class:`FusedMoEMLP` (fast
+    path) or a list of Layers (generic fallback, python loop over experts)."""
+
+    def __init__(self, d_model: int, experts, gate: Optional[Layer] = None,
+                 num_experts: Optional[int] = None, capacity_factor: float = 1.25,
+                 moe_group=None, recompute_interval: int = 0):
+        super().__init__()
+        self.d_model = d_model
+        if isinstance(experts, FusedMoEMLP):
+            self.experts = experts
+            self.num_experts = experts.num_experts
+            self._fused = True
+        else:
+            from ..nn.container import LayerList
+
+            self.experts = experts if isinstance(experts, LayerList) else LayerList(list(experts))
+            self.num_experts = len(self.experts)
+            self._fused = False
+        self.gate = gate if gate is not None else GShardGate(d_model, self.num_experts)
+        self.capacity_factor = capacity_factor
+        self.aux_loss = None
+
+    def forward(self, x):  # [B, S, H] or [T, H]
+        orig_shape = x.shape
+        hidden = orig_shape[-1]
+        from .. import tensor as ops
+
+        flat = ops.reshape(x, [-1, hidden])
+        T = flat.shape[0]
+        E = self.num_experts
+        capacity = max(1, int(self.capacity_factor * self.gate.top_k * T / E))
+
+        logits = self.gate.logits(flat)
+
+        def gating(lv):
+            combine, dispatch, aux = self.gate.gating(lv, capacity)
+            return combine, aux
+
+        combine, aux = run_op("moe_gating", gating, logits)
+        self.aux_loss = aux
+        self.gate.loss = aux
+
+        def dispatch_fn(xv, cv):
+            return jnp.einsum("tec,th->ech", (cv > 0).astype(xv.dtype), xv)
+
+        dispatched = run_op("moe_dispatch", dispatch_fn, flat, combine)
+        # E over ep → GSPMD all-to-all (global_scatter analog)
+        dispatched = sharding_constraint(dispatched, EP_AXIS, None, None)
+
+        if self._fused:
+            expert_out = self.experts(dispatched)
+        else:
+            outs = []
+            for e, expert in enumerate(self.experts):
+                outs.append(expert(dispatched[e]))
+            expert_out = ops.stack(outs, axis=0)
+        expert_out = sharding_constraint(expert_out, EP_AXIS, None, None)
+
+        def combine_fn(ov, cv):
+            return jnp.einsum("ech,tec->th", ov, cv.astype(ov.dtype))
+
+        out = run_op("moe_combine", combine_fn, expert_out, combine)
+        return ops.reshape(out, orig_shape)
+
+
+def global_scatter(x: Tensor, local_count, global_count, group=None) -> Tensor:
+    """``distributed/utils/moe_utils.py:20`` analog — explicit all-to-all for
+    shard_map code paths (GSPMD handles the jit path automatically)."""
+    return run_op(
+        "global_scatter",
+        lambda v: jax.lax.all_to_all(v, EP_AXIS, split_axis=0, concat_axis=0),
+        x,
+    )
+
+
+def global_gather(x: Tensor, local_count, global_count, group=None) -> Tensor:
+    """``moe_utils.py:153`` analog (inverse all-to-all)."""
+    return run_op(
+        "global_gather",
+        lambda v: jax.lax.all_to_all(v, EP_AXIS, split_axis=0, concat_axis=0),
+        x,
+    )

@@ -1,0 +1,143 @@
+"""Tensor-parallel (Megatron-style) layers.
+
+Capability analog of ``python/paddle/distributed/fleet/layers/mpu/mp_layers.py``:
+``VocabParallelEmbedding`` (:46), ``ColumnParallelLinear`` (:335),
+``RowParallelLinear`` (:542), and the identity/concat/split comm ops in
+``mp_ops.py``.
+
+TPU-first design: parameters carry a ``PartitionSpec`` over the ``mp`` mesh
+axis and forward pins activation layouts with ``with_sharding_constraint``;
+GSPMD then inserts exactly the collectives the reference issues by hand —
+column-parallel needs none (output stays sharded), row-parallel gets the
+all-reduce (psum over ``mp``) when the output is constrained replicated, and
+vocab-parallel embedding's masked-lookup + all-reduce collapses into a
+sharded gather.  Everything rides ICI because ``mp`` is the innermost mesh
+axis (``distributed/topology.py``).
+"""
+
+from __future__ import annotations
+
+import math
+
+from ..core.tensor import Tensor
+from ..nn import functional as F
+from ..nn.initializer import Constant, Normal, XavierNormal
+from ..nn.layers import Layer
+from .utils import annotate_param, axis_size, sharding_constraint
+
+
+class VocabParallelEmbedding(Layer):
+    """Embedding with the vocab dim sharded over ``mp``
+    (``mp_layers.py:46`` analog — its mask-and-allreduce lookup is GSPMD's
+    sharded gather here)."""
+
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+        self.weight = self.create_parameter(
+            [num_embeddings, embedding_dim], attr=weight_attr,
+            default_initializer=Normal(0.0, 0.02),
+        )
+        annotate_param(self.weight, "mp", None)
+
+    def forward(self, x):
+        out = F.embedding(x, self.weight)
+        return sharding_constraint(out, "dp", None, None)
+
+
+class ColumnParallelLinear(Layer):
+    """Linear with W [in, out] column-sharded over ``mp``
+    (``mp_layers.py:335`` analog).
+
+    ``gather_output=False`` leaves the activation sharded on its last dim —
+    the zero-collective fast path feeding a RowParallelLinear, exactly the
+    column→row pairing Megatron uses.
+    """
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, gather_output=True, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.gather_output = gather_output
+        mp = axis_size("mp")
+        if out_features % mp != 0:
+            raise ValueError(
+                f"out_features {out_features} not divisible by mp degree {mp}")
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=XavierNormal(),
+        )
+        annotate_param(self.weight, None, "mp")
+        if has_bias:
+            self.bias = self.create_parameter(
+                [out_features], attr=None, is_bias=True,
+                default_initializer=Constant(0.0))
+            annotate_param(self.bias, "mp")
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        out = F.linear(x, self.weight, self.bias)
+        if self.gather_output:
+            return sharding_constraint(out, "dp")
+        return sharding_constraint(out, "dp", None, "mp")
+
+
+class RowParallelLinear(Layer):
+    """Linear with W [in, out] row-sharded over ``mp``
+    (``mp_layers.py:542`` analog).
+
+    With ``input_is_parallel=True`` the incoming activation is already
+    sharded on its last dim (from a ColumnParallelLinear); the partial
+    matmul products are summed by the psum GSPMD inserts to satisfy the
+    replicated output constraint — the reference's explicit
+    ``mp_allreduce_sum``.
+    """
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=False, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.input_is_parallel = input_is_parallel
+        mp = axis_size("mp")
+        if in_features % mp != 0:
+            raise ValueError(
+                f"in_features {in_features} not divisible by mp degree {mp}")
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=XavierNormal(),
+        )
+        annotate_param(self.weight, "mp", None)
+        if has_bias:
+            # bias is added after the implicit all-reduce → replicated
+            self.bias = self.create_parameter(
+                [out_features], attr=None, is_bias=True,
+                default_initializer=Constant(0.0))
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        if self.input_is_parallel:
+            x = sharding_constraint(x, "dp", None, "mp")
+        out = F.linear(x, self.weight, self.bias)
+        return sharding_constraint(out, "dp")
+
+
+class ParallelCrossEntropy(Layer):
+    """Vocab-parallel softmax cross-entropy (``mp_layers.py`` parallel loss
+    analog).  Logits may arrive vocab-sharded; the constraint makes GSPMD
+    compute the global softmax (all-reduce of max/sum over ``mp``)."""
+
+    def __init__(self, mp_group=None, name=None, ignore_index=-100):
+        super().__init__()
+        self.ignore_index = ignore_index
+
+    def forward(self, logits, labels):
+        return F.cross_entropy(
+            logits, labels, reduction="mean", ignore_index=self.ignore_index)

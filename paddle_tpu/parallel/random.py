@@ -1,0 +1,82 @@
+"""Model-parallel RNG state tracker.
+
+Capability analog of ``python/paddle/distributed/fleet/layers/mpu/random.py``
+(``RNGStatesTracker``): dropout inside TP-sharded blocks must draw different
+randomness per mp rank (activations are sharded) while dropout outside must
+be identical across mp ranks (activations replicated).
+
+TPU-first note: under single-controller GSPMD there is one logical program,
+so "same randomness everywhere" is the default; per-rank divergent streams
+are provided for shard_map-based code paths and API parity.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Dict
+
+from ..core import random as rng
+
+MODEL_PARALLEL_RNG = "model_parallel_rng"
+
+
+class RNGStatesTracker:
+    def __init__(self):
+        self.states_: Dict[str, object] = {}
+        self.seeds_ = set()
+
+    def reset(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def add(self, name: str, seed: int):
+        if seed in self.seeds_:
+            raise ValueError(f"seed {seed} already exists")
+        self.seeds_.add(seed)
+        if name in self.states_:
+            raise ValueError(f"state {name} already exists")
+        outer = rng.get_rng_state()
+        rng.seed(seed)
+        self.states_[name] = rng.get_rng_state()
+        rng.set_rng_state(outer)
+
+    def get_states_tracker(self):
+        return dict(self.states_)
+
+    def set_states_tracker(self, states):
+        self.states_ = dict(states)
+
+    @contextlib.contextmanager
+    def rng_state(self, name: str = MODEL_PARALLEL_RNG):
+        if name not in self.states_:
+            raise ValueError(f"state {name} not added via add()")
+        outer = rng.get_rng_state()
+        rng.set_rng_state(self.states_[name])
+        try:
+            yield
+        finally:
+            self.states_[name] = rng.get_rng_state()
+            rng.set_rng_state(outer)
+
+
+_tracker = RNGStatesTracker()
+
+
+def get_rng_state_tracker() -> RNGStatesTracker:
+    return _tracker
+
+
+def model_parallel_random_seed(seed: int = 2048):
+    """(random.py seed-setup analog) global stream shared, mp stream offset
+    by a per-rank constant (axis position is folded in under shard_map)."""
+    import paddle_tpu as paddle
+
+    _tracker.reset()
+    paddle.seed(seed)
+    _tracker.add(MODEL_PARALLEL_RNG, seed + 1024)
+
+
+@contextlib.contextmanager
+def dropout_state(name: str = MODEL_PARALLEL_RNG):
+    with _tracker.rng_state(name):
+        yield

@@ -1,0 +1,107 @@
+"""Activation recomputation (gradient checkpointing).
+
+Capability analog of ``python/paddle/distributed/fleet/recompute/recompute.py``
+(PyLayer that stows inputs + RNG state and replays forward in backward).
+
+TPU-first: ``jax.checkpoint`` (remat) does the replay *inside* the XLA
+program — under ``to_static`` the recompute block's activations are dropped
+from the live set and the compiler schedules the replay right before the
+consuming backward ops, trading HBM for MXU FLOPs with zero host round
+trips.  RNG state preservation is structural: dispatch traces the forward
+once (dropout keys become trace constants), so the remat replay reuses
+identical masks — no state stow/restore needed.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List
+
+import jax
+
+from ..core.dispatch import run_op
+from ..core.tensor import Parameter, Tensor
+from ..nn.layers import Layer
+
+
+def _find_params(function: Callable) -> List[Parameter]:
+    owner = function if isinstance(function, Layer) else getattr(function, "__self__", None)
+    if isinstance(owner, Layer):
+        return [p for p in owner.parameters() if p is not None and not p.stop_gradient]
+    # closure-captured parameters (functools.partial or nested fns)
+    seen = []
+    for cell in getattr(function, "__closure__", None) or ():
+        v = cell.cell_contents
+        if isinstance(v, Layer):
+            seen.extend(p for p in v.parameters() if p is not None and not p.stop_gradient)
+        elif isinstance(v, Parameter) and not v.stop_gradient:
+            seen.append(v)
+    return seen
+
+
+def recompute(function: Callable, *args, **kwargs) -> Any:
+    """Run ``function(*args, **kwargs)``, rematerializing its activations in
+    backward (``fleet.recompute.recompute`` analog).
+
+    Differentiable state = positional Tensor args + the parameters of the
+    Layer being called (the reference gets param grads because its backward
+    replay runs on the live tape; here they must be explicit vjp inputs).
+    """
+    kwargs.pop("use_reentrant", None)
+    kwargs.pop("preserve_rng_state", None)
+
+    arg_tensors = [a for a in args if isinstance(a, Tensor) and not a.stop_gradient]
+    params = _find_params(function)
+    tensors = arg_tensors + params
+    if not tensors:
+        return function(*args, **kwargs)
+
+    def pure(*vals):
+        saved = [t._value for t in tensors]
+        for t, v in zip(tensors, vals):
+            t._value = v
+        try:
+            out = function(*args, **kwargs)
+            if isinstance(out, (list, tuple)):
+                return type(out)(o._value if isinstance(o, Tensor) else o for o in out)
+            return out._value if isinstance(out, Tensor) else out
+        finally:
+            for t, v in zip(tensors, saved):
+                t._value = v
+
+    return run_op("recompute", jax.checkpoint(pure), *tensors)
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    """``fleet.recompute.recompute_sequential`` analog: checkpoint a
+    Sequential in ``segments`` chunks."""
+    segments = (ctx or {}).get("segments", 1)
+    layers = list(functions)
+    seg = max(1, len(layers) // max(1, segments))
+    out = args
+    i = 0
+    while i < len(layers):
+        chunk = layers[i : i + seg]
+
+        def block(*xs, _chunk=tuple(chunk)):
+            cur = xs
+            for l in _chunk:
+                cur = l(*cur) if isinstance(cur, tuple) else l(cur)
+                if not isinstance(cur, tuple):
+                    cur = (cur,)
+            return cur[0] if len(cur) == 1 else cur
+
+        # explicit param plumbing: collect over the whole chunk
+        class _ChunkOwner(Layer):
+            def __init__(self, mods):
+                super().__init__()
+                for j, m in enumerate(mods):
+                    setattr(self, f"m{j}", m)
+
+            def forward(self, *xs):
+                return block(*xs)
+
+        owner = _ChunkOwner(chunk)
+        res = recompute(owner, *(out if isinstance(out, tuple) else (out,)), **kwargs)
+        out = res
+        i += seg
+    return out

@@ -1,0 +1,122 @@
+"""Megatron-style sequence parallelism (SP).
+
+Capability analog of
+``python/paddle/distributed/fleet/utils/sequence_parallel_utils.py``:
+Scatter/Gather/AllGather/ReduceScatter PyLayers (:84-126),
+``ColumnSequenceParallelLinear`` (:229), ``RowSequenceParallelLinear`` (:339).
+
+TPU-first: SP means activations outside the TP block are sharded on the
+*sequence* dim over the ``mp`` axis.  Layout is ``[B, S, H]`` (batch-first,
+unlike the reference's ``[S, B, H]``).  The PyLayer comm ops become sharding
+constraints — GSPMD emits the all-gather entering a column-parallel matmul
+and the reduce-scatter leaving a row-parallel one, fusing them with the
+matmuls where profitable (the reference overlaps these by hand).
+"""
+
+from __future__ import annotations
+
+from ..core.tensor import Tensor
+from ..nn import functional as F
+from ..nn.initializer import Constant, XavierNormal
+from ..nn.layers import Layer
+from .utils import annotate_param, axis_size, sharding_constraint
+
+# activation layouts as PartitionSpecs over the 5-axis mesh
+_SEQ_SHARDED = ("dp", "mp", None)     # [B, S/mp, H]
+_REPLICATED = ("dp", None, None)      # [B, S, H]
+_HIDDEN_SHARDED = ("dp", None, "mp")  # [B, S, H/mp]
+
+
+def scatter(x: Tensor) -> Tensor:
+    """Split the sequence dim across ``mp`` (ScatterOp, :84)."""
+    return sharding_constraint(x, *_SEQ_SHARDED)
+
+
+def gather(x: Tensor) -> Tensor:
+    """Re-replicate the sequence dim (GatherOp, :97)."""
+    return sharding_constraint(x, *_REPLICATED)
+
+
+def all_gather(x: Tensor) -> Tensor:
+    """AllGatherOp (:110) — identical to gather under GSPMD."""
+    return sharding_constraint(x, *_REPLICATED)
+
+
+def reduce_scatter(x: Tensor) -> Tensor:
+    """ReduceScatterOp (:126): partial-sum input → seq-sharded reduced
+    output.  The psum half comes from GSPMD resolving the preceding
+    row-parallel matmul directly into this layout."""
+    return sharding_constraint(x, *_SEQ_SHARDED)
+
+
+def mark_as_sequence_parallel_parameter(p):
+    """Parameters living outside the TP block (norms, biases) are replicated;
+    the reference registers an allreduce-on-grad hook (:191) — here DP/SP
+    grad reduction falls out of GSPMD's partial-sum handling."""
+    annotate_param(p)
+    p.is_distributed = False
+    p.sequence_parallel = True
+    return p
+
+
+class ColumnSequenceParallelLinear(Layer):
+    """(:229 analog) input [B, S/mp, H] → implicit seq all-gather → column
+    matmul → [B, S, out/mp]."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, gather_output=False, mp_group=None, name=None):
+        super().__init__()
+        mp = axis_size("mp")
+        if out_features % mp != 0:
+            raise ValueError(f"out_features {out_features} % mp {mp} != 0")
+        self.gather_output = gather_output
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=XavierNormal())
+        annotate_param(self.weight, None, "mp")
+        self.bias = (self.create_parameter([out_features], is_bias=True,
+                                           default_initializer=Constant(0.0))
+                     if has_bias else None)
+        if self.bias is not None:
+            annotate_param(self.bias, "mp")
+
+    def forward(self, x):
+        out = F.linear(x, self.weight, self.bias)
+        if self.gather_output:
+            return sharding_constraint(out, *_REPLICATED)
+        return sharding_constraint(out, *_HIDDEN_SHARDED)
+
+
+class RowSequenceParallelLinear(Layer):
+    """(:339 analog) input [B, S, in/mp] → row matmul (+psum) →
+    reduce-scatter to [B, S/mp, out]."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=True, mp_group=None, name=None):
+        super().__init__()
+        mp = axis_size("mp")
+        if in_features % mp != 0:
+            raise ValueError(f"in_features {in_features} % mp {mp} != 0")
+        self.input_is_parallel = input_is_parallel
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=XavierNormal())
+        annotate_param(self.weight, "mp", None)
+        self.bias = (self.create_parameter([out_features], is_bias=True,
+                                           default_initializer=Constant(0.0))
+                     if has_bias else None)
+        if self.bias is not None:
+            mark_as_sequence_parallel_parameter(self.bias)
+
+    def forward(self, x):
+        if self.input_is_parallel:
+            x = sharding_constraint(x, *_HIDDEN_SHARDED)
+        out = F.linear(x, self.weight, self.bias)
+        return sharding_constraint(out, *_SEQ_SHARDED)
+
+
+# reference-name aliases (PyLayer classes exposed as callables)
+ScatterOp = scatter
+GatherOp = gather
+AllGatherOp = all_gather
+ReduceScatterOp = reduce_scatter

@@ -1,0 +1,100 @@
+"""Shared helpers for the hybrid-parallel strategy layer.
+
+The reference wires parallelism through NCCL subgroups created per topology
+axis (``python/paddle/distributed/fleet/base/topology.py:174``).  TPU-first,
+the single source of truth is the global 5-axis ``jax.sharding.Mesh``
+([dp, pp, sharding, sep, mp], ``paddle_tpu.distributed.topology``); strategy
+layers steer GSPMD with ``with_sharding_constraint`` and parameter
+``PartitionSpec`` annotations instead of issuing collectives by hand.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Optional, Sequence
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ..core.dispatch import run_op
+from ..core.tensor import Parameter, Tensor
+from ..distributed import topology
+
+# Set while tracing under shard_map (pipeline / ring-attention bodies):
+# GSPMD sharding constraints are meaningless on per-shard views, so the
+# constraint helpers become no-ops there.
+_manual_mode_depth = 0
+
+
+@contextlib.contextmanager
+def manual_sharding_mode():
+    global _manual_mode_depth
+    _manual_mode_depth += 1
+    try:
+        yield
+    finally:
+        _manual_mode_depth -= 1
+
+
+def in_manual_mode() -> bool:
+    return _manual_mode_depth > 0
+
+
+def axis_size(axis: str) -> int:
+    """Size of a named mesh axis (1 if no mesh / axis absent)."""
+    mesh = topology.get_mesh()
+    if mesh is None or axis not in mesh.axis_names:
+        return 1
+    return mesh.shape[axis]
+
+
+def named_sharding(*spec) -> Optional[NamedSharding]:
+    mesh = topology.get_mesh()
+    if mesh is None:
+        return None
+    return NamedSharding(mesh, PartitionSpec(*spec))
+
+
+def sharding_constraint(x: Tensor, *spec) -> Tensor:
+    """Steer GSPMD: constrain ``x``'s sharding to ``PartitionSpec(*spec)``.
+
+    This is the TPU analog of the reference's explicit c_identity/c_concat/
+    c_split comm ops (``fleet/layers/mpu/mp_ops.py``): instead of issuing the
+    collective, we pin the layout and XLA inserts the (fused, ICI-scheduled)
+    collective where needed.  No-op without a mesh or under shard_map.
+    """
+    mesh = topology.get_mesh()
+    if mesh is None or in_manual_mode():
+        return x if isinstance(x, Tensor) else Tensor(x)
+    sh = NamedSharding(mesh, PartitionSpec(*spec))
+    return run_op(
+        "sharding_constraint", lambda v: jax.lax.with_sharding_constraint(v, sh), x
+    )
+
+
+def annotate_param(p: Parameter, *spec) -> Parameter:
+    """Attach a PartitionSpec annotation; applied lazily by
+    :func:`apply_param_shardings` / the jit in_shardings builder."""
+    p.dist_spec = PartitionSpec(*spec)
+    return p
+
+
+def param_spec(p: Tensor) -> PartitionSpec:
+    spec = getattr(p, "dist_spec", None)
+    return spec if spec is not None else PartitionSpec()
+
+
+def apply_param_shardings(layer, mesh: Optional[Mesh] = None):
+    """device_put every annotated parameter/buffer onto the mesh — the analog
+    of fleet's broadcast-on-init (``fleet/model.py:32``), except placement is
+    declarative and XLA moves only the local shard."""
+    mesh = mesh or topology.get_mesh()
+    if mesh is None:
+        return layer
+    for _, p in layer.named_parameters():
+        spec = param_spec(p)
+        p._value = jax.device_put(p._value, NamedSharding(mesh, spec))
+    for _, b in layer.named_buffers():
+        spec = param_spec(b)
+        b._value = jax.device_put(b._value, NamedSharding(mesh, spec))
+    return layer

@@ -17,7 +17,6 @@ expert's matmul is one big batched MXU contraction.
 
 from __future__ import annotations
 
-import math
 from typing import List, Optional
 
 import jax
@@ -37,7 +36,7 @@ def _one_hot(idx, n):
     return jax.nn.one_hot(idx, n, dtype=jnp.float32)
 
 
-def _top2_gating(logits, capacity, second_policy_random=False):
+def _top2_gating(logits, capacity):
     """GShard top-2 gating with capacity pruning and load-balance aux loss
     (moe/gate/gshard_gate.py analog). logits: [T, E] float32."""
     T, E = logits.shape
@@ -146,7 +145,6 @@ class FusedMoEMLP(Layer):
         super().__init__()
         self.num_experts = num_experts
         self.activation = activation
-        k = 1.0 / math.sqrt(d_model)
         self.w_in = self.create_parameter(
             [num_experts, d_model, d_hidden], default_initializer=XavierNormal())
         self.w_gate = (self.create_parameter(

@@ -21,7 +21,6 @@ memory the way 1F1B's eager-release does.
 
 from __future__ import annotations
 
-import functools
 from typing import Any, Callable, List, Optional, Sequence
 
 import jax
@@ -113,7 +112,6 @@ class PipelineLayer(Layer):
         return self.run_order[lo:hi]
 
     def forward(self, x):
-        shared_items = {k: v[0] for k, v in self._shared.items()}
         for item, desc in zip(self.run_order, self._descs):
             if isinstance(desc, SharedLayerDesc) and desc.forward_func is not None:
                 x = desc.forward_func(item, x)
@@ -226,7 +224,6 @@ def pipeline_forward(layer: PipelineLayer, x: Tensor, n_microbatch: int,
         cur = act
         for li, l in enumerate(templates):
             saved = [p._value for _, p in l.named_parameters()]
-            names = [n_ for n_, _ in l.named_parameters()]
             for (pn, p), v in zip(l.named_parameters(), params[li]):
                 p._value = v
             try:

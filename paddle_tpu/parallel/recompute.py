@@ -50,8 +50,10 @@ def recompute(function: Callable, *args, **kwargs) -> Any:
     kwargs.pop("preserve_rng_state", None)
 
     arg_tensors = [a for a in args if isinstance(a, Tensor) and not a.stop_gradient]
+    kw_tensors = [v for v in kwargs.values()
+                  if isinstance(v, Tensor) and not v.stop_gradient]
     params = _find_params(function)
-    tensors = arg_tensors + params
+    tensors = arg_tensors + kw_tensors + params
     if not tensors:
         return function(*args, **kwargs)
 

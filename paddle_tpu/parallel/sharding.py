@@ -37,7 +37,8 @@ def shard_spec_for(shape, axis: str = SHARDING_AXIS, extra_spec=None) -> Partiti
     """Pick the first dim divisible by the axis degree (the reference slices
     the flattened buffer; we shard a real dim so XLA keeps layouts tiled)."""
     n = axis_size(axis)
-    base = list(extra_spec) if extra_spec is not None else [None] * len(shape)
+    base = list(extra_spec) if extra_spec is not None else []
+    base += [None] * (len(shape) - len(base))
     if n <= 1:
         return PartitionSpec(*base)
     for i, s in enumerate(shape):

@@ -55,18 +55,47 @@ def named_sharding(*spec) -> Optional[NamedSharding]:
     return NamedSharding(mesh, PartitionSpec(*spec))
 
 
+def _fit_spec(spec, shape, mesh) -> PartitionSpec:
+    """Adapt a spec to an actual array: pad/truncate to rank, and drop axis
+    entries whose degree doesn't divide the dim (XLA requires even tiling;
+    the reference imposes no such global-batch constraint on layer forward)."""
+    ndim = len(shape)
+    entries = list(spec)
+    if len(entries) > ndim:
+        # keep dim0 (batch) + right-align the feature entries
+        head, tail = entries[0], [e for e in entries[1:] if e is not None]
+        entries = [head] + [None] * max(0, ndim - 1 - len(tail)) + tail
+        entries = entries[:ndim]
+    entries += [None] * (ndim - len(entries))
+    fitted = []
+    for dim, e in zip(shape, entries):
+        if e is None:
+            fitted.append(None)
+            continue
+        axes = e if isinstance(e, tuple) else (e,)
+        degree = 1
+        for a in axes:
+            degree *= mesh.shape.get(a, 1)
+        fitted.append(e if degree > 0 and dim % degree == 0 else None)
+    return PartitionSpec(*fitted)
+
+
 def sharding_constraint(x: Tensor, *spec) -> Tensor:
     """Steer GSPMD: constrain ``x``'s sharding to ``PartitionSpec(*spec)``.
 
     This is the TPU analog of the reference's explicit c_identity/c_concat/
     c_split comm ops (``fleet/layers/mpu/mp_ops.py``): instead of issuing the
     collective, we pin the layout and XLA inserts the (fused, ICI-scheduled)
-    collective where needed.  No-op without a mesh or under shard_map.
+    collective where needed.  No-op without a mesh or under shard_map.  The
+    spec is rank-adapted: shorter specs pad with None, longer specs keep
+    batch + right-aligned feature entries, and entries that don't evenly
+    divide the dim are dropped.
     """
     mesh = topology.get_mesh()
     if mesh is None or in_manual_mode():
         return x if isinstance(x, Tensor) else Tensor(x)
-    sh = NamedSharding(mesh, PartitionSpec(*spec))
+    shape = tuple(x.shape) if isinstance(x, Tensor) else jax.numpy.shape(x)
+    sh = NamedSharding(mesh, _fit_spec(spec, shape, mesh))
     return run_op(
         "sharding_constraint", lambda v: jax.lax.with_sharding_constraint(v, sh), x
     )

@@ -83,6 +83,41 @@ class TestTensorParallel:
         assert shard_shape == (8, 4)  # 16 cols / mp4
 
 
+class TestSpecFitting:
+    def test_2d_input_tp_layers(self, mesh_dp2_mp4):
+        # rank-2 [tokens, hidden] inputs must work (reference supports them)
+        col = pl.ColumnParallelLinear(16, 8, gather_output=False)
+        row = pl.RowParallelLinear(8, 16, input_is_parallel=True)
+        x = paddle.to_tensor(np.random.randn(8, 16).astype("float32"))
+        out = row(col(x))
+        ref = (x.numpy() @ col.weight.numpy()) @ row.weight.numpy() + row.bias.numpy()
+        np.testing.assert_allclose(out.numpy(), ref, rtol=2e-5, atol=2e-5)
+
+    def test_indivisible_batch_ok(self, mesh_dp2_mp4):
+        # batch 3 not divisible by dp=2: constraint must drop, not crash
+        col = pl.ColumnParallelLinear(16, 8)
+        x = paddle.to_tensor(np.random.randn(3, 16).astype("float32"))
+        out = col(x)
+        assert out.shape == [3, 8]
+
+    def test_sp_bias_then_stage3(self, mesh_dp2_mp4):
+        # SP-marked bias has PartitionSpec(); stage-3 sharding must pad it
+        row = pl.RowSequenceParallelLinear(16, 8)
+        pl.shard_parameters(row)
+
+    def test_recompute_kwarg_tensor_grads(self):
+        lin = nn.Linear(8, 8)
+        a = paddle.to_tensor(np.random.randn(4, 8).astype("float32"),
+                             stop_gradient=False)
+
+        def fn(x, scale=None):
+            return lin(x) * scale
+
+        s = paddle.to_tensor(np.float32(2.0), stop_gradient=False)
+        pl.recompute(fn, a, scale=s).sum().backward()
+        assert s.grad is not None
+
+
 class TestSequenceParallel:
     def test_column_row_seq_pair(self, mesh_dp2_mp4):
         B, S, H, FF = 2, 8, 16, 32

@@ -1,0 +1,114 @@
+"""Flagship benchmark: Llama train-step throughput on one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The whole train step (forward + backward + AdamW) is one `to_static`-compiled
+XLA program in bf16.  vs_baseline = measured MFU / 0.40, the north-star MFU
+target from BASELINE.md (the reference publishes no numbers of its own).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+
+# bf16 peak FLOP/s per chip by device kind (public TPU specs)
+_PEAK = [
+    ("v6", 918e12),
+    ("trillium", 918e12),
+    ("v5p", 459e12),
+    ("v5e", 197e12),
+    ("v5 lite", 197e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+]
+
+
+def _peak_flops(kind: str) -> float:
+    kind = kind.lower()
+    for key, val in _PEAK:
+        if key in kind:
+            return val
+    return 0.0
+
+
+def main():
+    import os
+
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS"):
+        # the TPU plugin pins the platform at interpreter startup; an env
+        # override must go through jax.config (see tests/conftest.py)
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models import (
+        LlamaConfig,
+        LlamaForCausalLM,
+        LlamaPretrainingCriterion,
+    )
+    from paddle_tpu.jit import to_static
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        cfg = LlamaConfig(
+            vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+            num_hidden_layers=12, num_attention_heads=16,
+            num_key_value_heads=8, max_position_embeddings=2048,
+            rope_theta=10000.0, dtype="bfloat16")
+        batch, seq, iters = 8, 2048, 10
+        paddle.set_default_dtype("bfloat16")
+    else:  # CPU smoke mode so the script always runs
+        cfg = LlamaConfig.tiny()
+        batch, seq, iters = 4, 64, 3
+
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    criterion = LlamaPretrainingCriterion(cfg)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters())
+
+    @to_static
+    def train_step(ids):
+        logits = model(ids)
+        loss = criterion(logits, ids)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    ids = paddle.to_tensor(
+        np.random.default_rng(0).integers(0, cfg.vocab_size, (batch, seq)),
+        dtype="int32")
+
+    for _ in range(2):  # compile + settle
+        float(train_step(ids))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = train_step(ids)
+    loss_val = float(loss)  # blocks on the final step
+    dt = (time.perf_counter() - t0) / iters
+
+    tokens = batch * seq
+    tok_per_s = tokens / dt
+
+    n_params = sum(p.size for p in model.parameters())
+    # PaLM-style train FLOPs/token: 6N + 12·L·S·hidden (attention term)
+    flops_per_tok = 6 * n_params + 12 * cfg.num_hidden_layers * seq * cfg.hidden_size
+    peak = _peak_flops(jax.devices()[0].device_kind) if on_tpu else 0.0
+    mfu = (flops_per_tok * tok_per_s / peak) if peak else 0.0
+
+    print(json.dumps({
+        "metric": "llama_train_tokens_per_sec_per_chip",
+        "value": round(tok_per_s, 2),
+        "unit": "tokens/s",
+        "vs_baseline": round(mfu / 0.40, 4),
+    }))
+    assert np.isfinite(loss_val), f"non-finite loss {loss_val}"
+
+
+if __name__ == "__main__":
+    main()

@@ -45,6 +45,26 @@ def _set_capture_recorder(rec):
     _capture_recorder = rec
 
 
+def mark_derived(tensors):
+    """Tell an active to_static discovery recorder that ``tensors`` are
+    derived intermediates, not pre-existing state (used by strategy code that
+    builds fresh Tensors outside run_op, e.g. the pipeline's stacked param
+    leaves — capturing those as jit state would thread a full second copy of
+    every stage parameter through the compiled program)."""
+    if _capture_recorder is not None:
+        _capture_recorder.on_outputs(list(tensors))
+
+
+def mark_inputs(tensors):
+    """Explicitly register ``tensors`` as captured state with an active
+    to_static discovery recorder.  Needed by code that reads ``_value``
+    directly instead of going through run_op (e.g. the pipeline's
+    stack_states) — without this, params touched only inside an inner trace
+    would compile in as constants and go stale after set_state_dict."""
+    if _capture_recorder is not None:
+        _capture_recorder.on_inputs(list(tensors))
+
+
 def _tree_leaves_with_path(out):
     if isinstance(out, (list, tuple)):
         return list(out), type(out)

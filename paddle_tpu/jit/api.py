@@ -65,6 +65,13 @@ class _Recorder:
         for t in tensors:
             tid = id(t)
             if tid not in self.derived and tid not in self.captured:
+                if _is_tracer(t._value):
+                    # A pre-existing tensor temporarily holding a tracer is a
+                    # substituted view inside an inner trace (e.g. pipeline
+                    # stage params under shard_map) — snapshotting it would
+                    # capture a dead tracer as state.  Its real value is
+                    # recorded when touched eagerly (e.g. by opt.step).
+                    continue
                 self.captured[tid] = (t, t._value, t.grad, t._grad_node, t._out_index)
 
     def on_outputs(self, tensors):

@@ -1,0 +1,14 @@
+"""Flagship model families (the capability ladder of BASELINE.md).
+
+Analog of the PaddleNLP/PaddleClas model zoos the reference's configs target
+(`llm/` Llama pretrain, BERT finetune, ResNet-50) — built here as first-class
+framework models so the capability rungs are runnable in-repo.
+"""
+
+from . import llama  # noqa: F401
+from .llama import (  # noqa: F401
+    LlamaConfig,
+    LlamaForCausalLM,
+    LlamaModel,
+    LlamaPretrainingCriterion,
+)

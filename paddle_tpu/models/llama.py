@@ -1,0 +1,294 @@
+"""Llama model family — the flagship (BASELINE.md config #4: Llama-3-8B
+pretrain with TP+PP+sharding).
+
+Capability analog of PaddleNLP's ``llm/`` Llama stack that the reference's
+north-star config targets, built TPU-first on the hybrid-parallel strategy
+layer (:mod:`paddle_tpu.parallel`):
+
+* **TP** — q/k/v/gate/up projections are :class:`ColumnParallelLinear`
+  (``gather_output=False``), o/down are :class:`RowParallelLinear`
+  (``input_is_parallel=True``): the Megatron column→row pairing with zero
+  collectives inside the block and one GSPMD-inserted psum at the exit.
+* **SP** — with ``config.sequence_parallel``, hidden states between blocks
+  are constrained to ``P('dp', 'mp', None)`` (seq dim sharded over ``mp``);
+  GSPMD turns the block-entry/exit layout changes into the all-gather /
+  reduce-scatter pair of Megatron SP
+  (``fleet/utils/sequence_parallel_utils.py`` analog).
+* **CP** — attention routes through :func:`ring_flash_attention` whenever the
+  ``sep`` axis is >1 (K/V ppermute ring over ICI), the long-context answer to
+  the reference's SEP axis.
+* **PP** — the decoder stack is homogeneous single-input layers, so it drops
+  straight into :class:`PipelineLayer` + :func:`pipeline_forward` (shard_map
+  collective-permute microbatch schedule); embedding/head stay outside.
+* **recompute** — per-decoder-layer ``jax.checkpoint`` via
+  :func:`paddle_tpu.parallel.recompute`.
+
+Architecture follows Llama-3: RMSNorm pre-norm, rotary embeddings, grouped
+query attention, SwiGLU MLP, untied LM head (tying supported).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..nn import functional as F
+from ..nn.container import LayerList
+from ..nn.initializer import Constant, Normal
+from ..nn.layers import Layer
+from ..nn.norm import RMSNorm
+from ..parallel.mp_layers import (
+    ColumnParallelLinear,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+)
+from ..parallel.pipeline import PipelineLayer, pipeline_forward
+from ..parallel.recompute import recompute as _recompute
+from ..parallel.ring_attention import ring_flash_attention
+from ..parallel.utils import axis_size, sharding_constraint
+from ..core.dispatch import run_op
+
+
+@dataclass
+class LlamaConfig:
+    """Llama-3 family hyperparameters (defaults = Llama-3-8B)."""
+
+    vocab_size: int = 128256
+    hidden_size: int = 4096
+    intermediate_size: int = 14336
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 8
+    max_position_embeddings: int = 8192
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 500000.0
+    initializer_range: float = 0.02
+    tie_word_embeddings: bool = False
+    # parallel/perf knobs
+    sequence_parallel: bool = False
+    recompute: bool = False
+    use_flash_attention: bool = True
+    dtype: str = "float32"
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    @classmethod
+    def llama3_8b(cls, **kw):
+        return cls(**kw)
+
+    @classmethod
+    def tiny(cls, **kw):
+        """Test/dry-run config."""
+        defaults = dict(
+            vocab_size=256, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=4, num_attention_heads=4, num_key_value_heads=2,
+            max_position_embeddings=256, rope_theta=10000.0)
+        defaults.update(kw)
+        return cls(**defaults)
+
+
+def _rope_tables(head_dim: int, max_pos: int, theta: float):
+    # Host-side numpy: sliced at trace time and embedded as jit constants.
+    # Deliberately NOT device buffers — a committed array carries a mesh
+    # sharding that conflicts inside shard_map (Manual) pipeline bodies.
+    import numpy as np
+
+    inv = 1.0 / (theta ** (np.arange(0, head_dim, 2, dtype=np.float32) / head_dim))
+    t = np.arange(max_pos, dtype=np.float32)
+    freqs = np.outer(t, inv)                       # [S, D/2]
+    return np.cos(freqs), np.sin(freqs)
+
+
+def _apply_rope(x, cos, sin):
+    """x: [B, S, H, D]; cos/sin: [S, D/2] (rotate-half convention)."""
+    d2 = x.shape[-1] // 2
+    x1, x2 = x[..., :d2], x[..., d2:]
+    cos = cos[None, :, None, :].astype(x.dtype)
+    sin = sin[None, :, None, :].astype(x.dtype)
+    return jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+
+
+class LlamaAttention(Layer):
+    """Grouped-query attention with rotary embeddings.
+
+    TP: head dim sharded over ``mp`` via column/row parallel projections;
+    after reshape the head axis carries the ``mp`` sharding (constraint
+    re-pinned below so GSPMD keeps attention fully local per mp shard).
+    """
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        h, hd = config.hidden_size, config.head_dim
+        self.num_heads = config.num_attention_heads
+        self.num_kv_heads = config.num_key_value_heads
+        init = Normal(0.0, config.initializer_range)
+        self.q_proj = ColumnParallelLinear(h, self.num_heads * hd,
+                                           has_bias=False, gather_output=False,
+                                           weight_attr=init)
+        self.k_proj = ColumnParallelLinear(h, self.num_kv_heads * hd,
+                                           has_bias=False, gather_output=False,
+                                           weight_attr=init)
+        self.v_proj = ColumnParallelLinear(h, self.num_kv_heads * hd,
+                                           has_bias=False, gather_output=False,
+                                           weight_attr=init)
+        self.o_proj = RowParallelLinear(self.num_heads * hd, h, has_bias=False,
+                                        input_is_parallel=True, weight_attr=init)
+        self._rope_cos, self._rope_sin = _rope_tables(
+            hd, config.max_position_embeddings, config.rope_theta)
+
+    def forward(self, x):
+        B, S = x.shape[0], x.shape[1]
+        hd = self.config.head_dim
+        q = self.q_proj(x)
+        k = self.k_proj(x)
+        v = self.v_proj(x)
+
+        def shape_heads(t, n):
+            out = run_op("reshape_heads",
+                         lambda a: a.reshape(B, S, n, hd), t)
+            return sharding_constraint(out, "dp", "sep", "mp", None)
+
+        q = shape_heads(q, self.num_heads)
+        k = shape_heads(k, self.num_kv_heads)
+        v = shape_heads(v, self.num_kv_heads)
+
+        cos, sin = self._rope_cos[:S], self._rope_sin[:S]
+        q = run_op("rope", lambda a: _apply_rope(a, cos, sin), q)
+        k = run_op("rope", lambda a: _apply_rope(a, cos, sin), k)
+
+        rep = self.num_heads // self.num_kv_heads
+        if rep > 1:
+            k = run_op("repeat_kv", lambda a: jnp.repeat(a, rep, axis=2), k)
+            v = run_op("repeat_kv", lambda a: jnp.repeat(a, rep, axis=2), v)
+
+        # ring attention when sequence is sep-sharded; per-device flash/XLA
+        # attention otherwise (ring_flash_attention falls through itself)
+        out = ring_flash_attention(q, k, v, causal=True)
+        out = run_op("merge_heads",
+                     lambda a: a.reshape(B, S, self.num_heads * hd), out)
+        out = sharding_constraint(out, "dp", "sep", "mp")
+        return self.o_proj(out)
+
+
+class LlamaMLP(Layer):
+    """SwiGLU feed-forward, column→row TP pairing."""
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        h, ff = config.hidden_size, config.intermediate_size
+        init = Normal(0.0, config.initializer_range)
+        self.gate_proj = ColumnParallelLinear(h, ff, has_bias=False,
+                                              gather_output=False, weight_attr=init)
+        self.up_proj = ColumnParallelLinear(h, ff, has_bias=False,
+                                            gather_output=False, weight_attr=init)
+        self.down_proj = RowParallelLinear(ff, h, has_bias=False,
+                                           input_is_parallel=True, weight_attr=init)
+
+    def forward(self, x):
+        return self.down_proj(F.silu(self.gate_proj(x)) * self.up_proj(x))
+
+
+class LlamaDecoderLayer(Layer):
+    """Pre-norm decoder block; single-input forward so the stack is
+    pipeline-homogeneous (drops into PipelineLayer unchanged)."""
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.input_layernorm = RMSNorm(config.hidden_size, config.rms_norm_eps)
+        self.self_attn = LlamaAttention(config)
+        self.post_attention_layernorm = RMSNorm(config.hidden_size,
+                                                config.rms_norm_eps)
+        self.mlp = LlamaMLP(config)
+
+    def _sp(self, x):
+        # Megatron-SP layout between blocks: seq sharded over mp (+sep for CP)
+        if self.config.sequence_parallel:
+            return sharding_constraint(x, "dp", ("sep", "mp"), None)
+        return sharding_constraint(x, "dp", "sep", None)
+
+    def forward(self, x):
+        x = self._sp(x)
+        h = x + self.self_attn(self.input_layernorm(x))
+        out = h + self.mlp(self.post_attention_layernorm(h))
+        return self._sp(out)
+
+
+class LlamaModel(Layer):
+    """Embedding + decoder stack + final norm (PaddleNLP ``LlamaModel``
+    analog).  ``pp_microbatches`` routes the stack through the SPMD pipeline
+    schedule when the mesh has a ``pp`` axis."""
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.embed_tokens = VocabParallelEmbedding(
+            config.vocab_size, config.hidden_size,
+            weight_attr=Normal(0.0, config.initializer_range))
+        self.layers = LayerList(
+            [LlamaDecoderLayer(config) for _ in range(config.num_hidden_layers)])
+        self.norm = RMSNorm(config.hidden_size, config.rms_norm_eps)
+        self._pipe: Optional[PipelineLayer] = None
+
+    def _pipeline(self) -> PipelineLayer:
+        if self._pipe is None:
+            self._pipe = PipelineLayer(list(self.layers),
+                                       num_stages=axis_size("pp"))
+        return self._pipe
+
+    def forward(self, input_ids, pp_microbatches: Optional[int] = None):
+        h = self.embed_tokens(input_ids)
+        if pp_microbatches and axis_size("pp") > 1:
+            h = pipeline_forward(self._pipeline(), h, pp_microbatches)
+        else:
+            for layer in self.layers:
+                if self.config.recompute and self.training:
+                    h = _recompute(layer, h)
+                else:
+                    h = layer(h)
+        return self.norm(h)
+
+
+class LlamaForCausalLM(Layer):
+    """Llama with LM head (PaddleNLP ``LlamaForCausalLM`` analog)."""
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.llama = LlamaModel(config)
+        if config.tie_word_embeddings:
+            self.lm_head = None
+        else:
+            self.lm_head = ColumnParallelLinear(
+                config.hidden_size, config.vocab_size, has_bias=False,
+                gather_output=True,
+                weight_attr=Normal(0.0, config.initializer_range))
+
+    def forward(self, input_ids, pp_microbatches: Optional[int] = None):
+        h = self.llama(input_ids, pp_microbatches=pp_microbatches)
+        if self.lm_head is None:
+            w = self.llama.embed_tokens.weight
+            return run_op("tied_head", lambda a, wv: a @ wv.T, h, w)
+        return self.lm_head(h)
+
+
+class LlamaPretrainingCriterion(Layer):
+    """Shifted next-token cross-entropy (PaddleNLP
+    ``LlamaPretrainingCriterion`` analog); ignore_index=-100 masks padding."""
+
+    def __init__(self, config: Optional[LlamaConfig] = None, ignore_index=-100):
+        super().__init__()
+        self.ignore_index = ignore_index
+
+    def forward(self, logits, labels):
+        shifted = logits[:, :-1, :]
+        target = labels[:, 1:]
+        return F.cross_entropy(shifted, target, reduction="mean",
+                               ignore_index=self.ignore_index)

@@ -28,7 +28,7 @@ import jax.numpy as jnp
 from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
-from ..core.dispatch import run_op
+from ..core.dispatch import mark_derived, mark_inputs, run_op
 from ..core.tensor import Tensor
 from ..distributed import topology
 from ..nn.layers import Layer
@@ -203,9 +203,12 @@ def pipeline_forward(layer: PipelineLayer, x: Tensor, n_microbatch: int,
     if n == 1:
         return layer(x)
 
-    import numpy as np
-
     stage_layers = [layer.get_stage_layers(s) for s in range(layer.num_stages)]
+    # stack_states reads param values directly (no run_op), and inside the
+    # shard_map body params hold manual tracers the recorder must ignore —
+    # register them as to_static state here, while values are concrete.
+    mark_inputs([p for ls in stage_layers for l in ls
+                 for _, p in l.named_parameters()])
 
     def stack_states():
         states = []
@@ -264,4 +267,5 @@ def pipeline_forward(layer: PipelineLayer, x: Tensor, n_microbatch: int,
             t.register_hook(scatter_grad)
         leaf_tensors.append(t)
 
+    mark_derived(leaf_tensors)
     return run_op("pipeline_forward", f, x, *leaf_tensors)

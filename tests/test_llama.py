@@ -1,0 +1,229 @@
+"""Flagship Llama model tests: architecture correctness, grads, and the
+hybrid-parallel (TP+PP+DP+SP) training step on the 8-device CPU mesh —
+the loss-alignment pattern of SURVEY.md §4."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import topology
+from paddle_tpu.jit import to_static
+from paddle_tpu.models import (
+    LlamaConfig,
+    LlamaForCausalLM,
+    LlamaModel,
+    LlamaPretrainingCriterion,
+)
+from paddle_tpu.models.llama import _apply_rope, _rope_tables
+from paddle_tpu.parallel.utils import apply_param_shardings
+
+
+@pytest.fixture
+def hybrid_mesh():
+    m = topology.init_mesh(dp=2, pp=2, mp=2)
+    yield m
+    topology._global_mesh = None
+    topology._global_hcg = None
+
+
+@pytest.fixture
+def mp_mesh():
+    m = topology.init_mesh(dp=2, mp=4)
+    yield m
+    topology._global_mesh = None
+    topology._global_hcg = None
+
+
+def _data(cfg, batch=2, seq=16, seed=0):
+    rng = np.random.default_rng(seed)
+    return paddle.to_tensor(
+        rng.integers(0, cfg.vocab_size, (batch, seq)), dtype="int32")
+
+
+class TestLlamaArchitecture:
+    def test_forward_shape(self):
+        cfg = LlamaConfig.tiny()
+        m = LlamaForCausalLM(cfg)
+        ids = _data(cfg)
+        logits = m(ids)
+        assert logits.shape == [2, 16, cfg.vocab_size]
+
+    def test_rope_rotation_norm_preserving(self):
+        cos, sin = _rope_tables(8, 32, 10000.0)
+        x = np.random.randn(1, 32, 2, 8).astype("float32")
+        out = np.asarray(_apply_rope(x, cos, sin))
+        np.testing.assert_allclose(
+            np.linalg.norm(out, axis=-1), np.linalg.norm(x, axis=-1),
+            rtol=1e-5)
+        # position 0 is the identity rotation
+        np.testing.assert_allclose(out[:, 0], x[:, 0], rtol=1e-6)
+
+    def test_rope_relative_position(self):
+        # <q(m), k(n)> must depend only on m - n for rotated vectors
+        cos, sin = _rope_tables(8, 16, 10000.0)
+        v = np.random.randn(8).astype("float32")
+        x = np.broadcast_to(v, (1, 16, 1, 8)).copy()
+        r = np.asarray(_apply_rope(x, cos, sin))[0, :, 0]
+        d1 = float(r[3] @ r[5])
+        d2 = float(r[8] @ r[10])
+        assert abs(d1 - d2) < 1e-4
+
+    def test_causality(self):
+        cfg = LlamaConfig.tiny()
+        m = LlamaForCausalLM(cfg)
+        m.eval()
+        ids = _data(cfg, batch=1, seq=12)
+        base = m(ids).numpy()
+        # perturbing a late token must not change earlier logits
+        ids2 = ids.numpy().copy()
+        ids2[0, 8] = (ids2[0, 8] + 1) % cfg.vocab_size
+        pert = m(paddle.to_tensor(ids2)).numpy()
+        np.testing.assert_allclose(pert[0, :8], base[0, :8], atol=1e-5)
+        assert np.abs(pert[0, 8:] - base[0, 8:]).max() > 1e-6
+
+    def test_gqa_head_counts(self):
+        cfg = LlamaConfig.tiny(num_attention_heads=4, num_key_value_heads=2)
+        m = LlamaForCausalLM(cfg)
+        attn = m.llama.layers[0].self_attn
+        assert attn.q_proj.weight.shape == [cfg.hidden_size, 4 * cfg.head_dim]
+        assert attn.k_proj.weight.shape == [cfg.hidden_size, 2 * cfg.head_dim]
+
+    def test_all_params_get_grads(self):
+        cfg = LlamaConfig.tiny(num_hidden_layers=2)
+        m = LlamaForCausalLM(cfg)
+        crit = LlamaPretrainingCriterion(cfg)
+        ids = _data(cfg)
+        crit(m(ids), ids).backward()
+        missing = [n for n, p in m.named_parameters() if p.grad is None]
+        assert missing == []
+
+    def test_tied_embeddings(self):
+        cfg = LlamaConfig.tiny(tie_word_embeddings=True)
+        m = LlamaForCausalLM(cfg)
+        assert m.lm_head is None
+        logits = m(_data(cfg))
+        assert logits.shape[-1] == cfg.vocab_size
+        crit = LlamaPretrainingCriterion(cfg)
+        crit(logits, _data(cfg)).backward()
+        assert m.llama.embed_tokens.weight.grad is not None
+
+    def test_criterion_ignore_index(self):
+        cfg = LlamaConfig.tiny()
+        crit = LlamaPretrainingCriterion(cfg)
+        logits = paddle.ones([1, 8, cfg.vocab_size])
+        labels = np.zeros((1, 8), "int64")
+        labels[0, 4:] = -100
+        l1 = crit(logits, paddle.to_tensor(labels))
+        l2 = crit(logits, paddle.to_tensor(np.zeros((1, 8), "int64")))
+        np.testing.assert_allclose(float(l1), float(l2), rtol=1e-6)
+
+    def test_recompute_matches_plain(self):
+        cfg = LlamaConfig.tiny(num_hidden_layers=2)
+        paddle.seed(7)
+        m1 = LlamaForCausalLM(cfg)
+        ids = _data(cfg)
+        ref = m1(ids).numpy()
+        m1.config.recompute = True
+        m1.llama.config.recompute = True
+        out = m1(ids).numpy()
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+
+class TestLlamaParallel:
+    def test_tp_matches_single_device(self, mp_mesh):
+        """mp=4 sharded forward must equal the dense math (same weights)."""
+        cfg = LlamaConfig.tiny(num_hidden_layers=1)
+        paddle.seed(3)
+        m = LlamaForCausalLM(cfg)
+        apply_param_shardings(m)
+        ids = _data(cfg)
+        logits = m(ids)
+        # dense reference: same weights without any mesh registered
+        topology._global_mesh, saved = None, topology._global_mesh
+        try:
+            ref = m(ids)
+        finally:
+            topology._global_mesh = saved
+        np.testing.assert_allclose(logits.numpy(), ref.numpy(),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_hybrid_train_step_loss_decreases(self, hybrid_mesh):
+        cfg = LlamaConfig.tiny(sequence_parallel=True)
+        paddle.seed(0)
+        m = LlamaForCausalLM(cfg)
+        apply_param_shardings(m)
+        crit = LlamaPretrainingCriterion(cfg)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=m.parameters())
+
+        @to_static
+        def step(ids):
+            loss = crit(m(ids, pp_microbatches=2), ids)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        ids = _data(cfg, batch=4)
+        losses = [float(step(ids)) for _ in range(4)]
+        assert losses[-1] < losses[0]
+        assert all(np.isfinite(l) for l in losses)
+
+    def test_forward_only_jit_sees_weight_updates(self, hybrid_mesh):
+        """Params touched only inside the shard_map pipeline must still be
+        threaded as jit state — not baked in as constants (regression:
+        set_state_dict after compile must change the output)."""
+        cfg = LlamaConfig.tiny()
+        m = LlamaForCausalLM(cfg)
+        m.eval()
+
+        @to_static
+        def fwd(ids):
+            with paddle.no_grad():
+                return m(ids, pp_microbatches=2)
+
+        ids = paddle.to_tensor(np.zeros((4, 16), "int32"))
+        before = fwd(ids).numpy()
+        w = m.llama.layers[0].mlp.gate_proj.weight
+        w.set_value(np.asarray(w.numpy()) * 0.0)
+        after = fwd(ids).numpy()
+        assert np.abs(before - after).max() > 1e-6
+
+    def test_pipeline_matches_sequential(self, hybrid_mesh):
+        """pp=2 pipeline forward == plain layer loop on the same weights."""
+        cfg = LlamaConfig.tiny()
+        paddle.seed(5)
+        m = LlamaForCausalLM(cfg)
+        apply_param_shardings(m)
+        ids = _data(cfg, batch=4)
+        m.eval()
+        piped = m(ids, pp_microbatches=2).numpy()
+        plain = m(ids).numpy()
+        np.testing.assert_allclose(piped, plain, rtol=2e-4, atol=2e-4)
+
+
+class TestGraftEntry:
+    def test_entry_compiles(self):
+        import importlib.util
+        import jax
+
+        spec = importlib.util.spec_from_file_location(
+            "graft_entry", "/root/repo/__graft_entry__.py")
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        fn, args = mod.entry()
+        out = jax.jit(fn)(*args)
+        assert out.shape == (2, 32, 256)
+
+    def test_dryrun_multichip(self):
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "graft_entry", "/root/repo/__graft_entry__.py")
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        try:
+            mod.dryrun_multichip(8)
+        finally:
+            topology._global_mesh = None
+            topology._global_hcg = None

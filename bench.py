@@ -56,7 +56,7 @@ def main():
     if on_tpu:
         cfg = LlamaConfig(
             vocab_size=32000, hidden_size=1024, intermediate_size=2816,
-            num_hidden_layers=12, num_attention_heads=16,
+            num_hidden_layers=12, num_attention_heads=8,  # head_dim 128 → pallas flash
             num_key_value_heads=8, max_position_embeddings=2048,
             rope_theta=10000.0, dtype="bfloat16")
         batch, seq, iters = 8, 2048, 10

@@ -25,7 +25,7 @@ def use_flash(q_shape, attn_mask) -> bool:
     if len(q_shape) != 4:
         return False
     seq, head_dim = q_shape[1], q_shape[3]
-    if seq < _FLASH_MIN_SEQ or seq % 512 != 0:
+    if seq < _FLASH_MIN_SEQ or seq % 128 != 0:
         return False
     if head_dim % 128 != 0:
         return False
@@ -54,7 +54,8 @@ def flash_attention_fwd(q, k, v, causal: bool = False):
         try:
             from .pallas_flash import flash_attention as pallas_flash
 
-            return pallas_flash(q, k, v, causal=causal)
+            # positional: custom_vjp with nondiff_argnums rejects kwargs
+            return pallas_flash(q, k, v, causal)
         except Exception:
             pass
     return _reference_attention(q, k, v, causal)

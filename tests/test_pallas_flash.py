@@ -1,0 +1,72 @@
+"""Pallas flash attention numerics vs the dense reference (interpret mode on
+CPU — the kernel itself, not the XLA fallback; mirrors the reference's
+flash-attn tolerance tests, SURVEY.md §7 hard part (d))."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.ops.flash_attention import _reference_attention
+from paddle_tpu.ops.pallas_flash import flash_attention
+
+
+def _qkv(B=1, S=256, H=2, D=128, dtype=jnp.float32, seed=0):
+    rng = np.random.default_rng(seed)
+    mk = lambda: jnp.asarray(rng.standard_normal((B, S, H, D)), dtype)
+    return mk(), mk(), mk()
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_forward_matches_reference(causal):
+    q, k, v = _qkv()
+    out = flash_attention(q, k, v, causal)
+    ref = _reference_attention(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_grads_match_reference(causal):
+    q, k, v = _qkv(S=128)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(_reference_attention(q, k, v, causal) ** 2)
+
+    gf = jax.grad(loss_flash, (0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, (0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        scale = np.abs(np.asarray(b)).max() + 1e-9
+        np.testing.assert_allclose(np.asarray(a) / scale,
+                                   np.asarray(b) / scale,
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_multi_block_sequence():
+    # several q and kv blocks (S > block size) exercises the online-softmax
+    # accumulation across grid steps
+    q, k, v = _qkv(S=512, H=1)
+    out = flash_attention(q, k, v, True)
+    ref = _reference_attention(q, k, v, True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_bf16_inputs():
+    q, k, v = _qkv(S=128, dtype=jnp.bfloat16)
+    out = flash_attention(q, k, v, True)
+    ref = _reference_attention(q, k, v, True)
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32),
+        rtol=2e-2, atol=2e-2)
+
+
+def test_under_jit():
+    q, k, v = _qkv(S=128)
+    jitted = jax.jit(lambda q, k, v: flash_attention(q, k, v, True))
+    np.testing.assert_allclose(
+        np.asarray(jitted(q, k, v)),
+        np.asarray(flash_attention(q, k, v, True)), rtol=1e-6)

@@ -16,25 +16,35 @@ import jax.numpy as jnp
 
 
 class Generator:
-    """Splittable PRNG stream (one per device class in the reference)."""
+    """Splittable PRNG stream (one per device class in the reference).
+
+    Key creation is lazy — materialising a PRNGKey initialises the JAX
+    backend, which must not happen at ``import paddle_tpu`` time (the
+    launcher master process and CLI tools never touch a device)."""
 
     def __init__(self, seed: int = 0):
-        self._key = jax.random.PRNGKey(seed)
+        self._key = None
         self._seed = seed
 
     def manual_seed(self, seed: int):
-        self._key = jax.random.PRNGKey(seed)
+        self._key = None
         self._seed = seed
         return self
 
     def seed(self):
         return self._seed
 
+    def _ensure(self):
+        if self._key is None:
+            self._key = jax.random.PRNGKey(self._seed)
+
     def next_key(self):
+        self._ensure()
         self._key, sub = jax.random.split(self._key)
         return sub
 
     def get_state(self):
+        self._ensure()
         return self._key
 
     def set_state(self, state):

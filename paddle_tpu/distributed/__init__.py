@@ -5,7 +5,9 @@ sep, mp] replaces NCCL process groups; XLA collectives over named axes
 replace collective kernels; GSPMD shardings replace the reshard lattice.
 """
 
-from . import collective, env, topology  # noqa: F401
+from . import checkpoint, collective, env, launch, topology  # noqa: F401
+from .checkpoint import load_state_dict, save_state_dict  # noqa: F401
+from .spawn import spawn  # noqa: F401
 from .auto_parallel import (  # noqa: F401
     DistAttr,
     Placement,

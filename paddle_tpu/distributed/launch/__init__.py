@@ -1,0 +1,16 @@
+"""``python -m paddle_tpu.distributed.launch`` — multi-process launcher.
+
+Capability analog of ``python/paddle/distributed/launch/main.py:20`` +
+``controllers/collective.py``: spawn worker processes with rendezvous env
+injected (``PADDLE_TRAINER_ID``, ``PADDLE_TRAINERS_NUM``, ``MASTER_ADDR``...),
+aggregate logs, watch for failures, elastic restart.
+
+TPU-first: on a TPU pod each *host* runs exactly one controller process
+(JAX single-controller-per-host), so ``--nproc_per_node`` defaults to 1
+there and the launcher's real jobs are (a) env/rendezvous wiring for
+``jax.distributed.initialize`` and (b) the CPU-simulation mode
+(``--devices`` on cpu backend) that forks N single-device processes on one
+machine — the reference's multi-node-on-one-host test trick (SURVEY.md §4).
+"""
+
+from .main import launch, main  # noqa: F401

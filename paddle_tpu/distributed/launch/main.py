@@ -1,0 +1,152 @@
+"""Launcher implementation (launch/main.py + controllers/collective.py analog)."""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional
+
+ELASTIC_EXIT_CODE = 101  # fleet/elastic/manager.py:32 analog
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def _build_env(rank: int, nprocs: int, master: str, base: Dict[str, str],
+               cpu_sim: bool, log_dir: Optional[str]) -> Dict[str, str]:
+    env = dict(base)
+    env.update({
+        # paddle-compat names (launch/controllers/collective.py env set)
+        "PADDLE_TRAINER_ID": str(rank),
+        "PADDLE_TRAINERS_NUM": str(nprocs),
+        "PADDLE_MASTER": master,
+        "MASTER_ADDR": master.split(":")[0],
+        "MASTER_PORT": master.split(":")[1],
+        "PADDLE_RANK_IN_NODE": str(rank),
+        # jax.distributed picks these up via init_parallel_env
+        "PADDLE_TPU_LAUNCHED": "1",
+    })
+    if cpu_sim:
+        # each simulated worker is an independent 1-device CPU "host"
+        env["PADDLE_TPU_CPU_SIM"] = "1"
+        env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+class Pod:
+    """A set of local worker processes (launch/job/pod.py analog)."""
+
+    def __init__(self):
+        self.procs: List[subprocess.Popen] = []
+        self.logs: List[Optional[object]] = []
+
+    def spawn(self, cmd: List[str], envs: List[Dict[str, str]],
+              log_dir: Optional[str]):
+        if log_dir:
+            os.makedirs(log_dir, exist_ok=True)
+        for rank, env in enumerate(envs):
+            out = None
+            if log_dir:
+                out = open(os.path.join(log_dir, f"workerlog.{rank}"), "w")
+            self.logs.append(out)
+            self.procs.append(subprocess.Popen(
+                cmd, env=env, stdout=out or None, stderr=out or None))
+
+    def watch(self) -> int:
+        """Block until all exit (0) or any fails (its code); kill the rest."""
+        try:
+            while True:
+                codes = [p.poll() for p in self.procs]
+                if all(c == 0 for c in codes):
+                    return 0
+                bad = [c for c in codes if c not in (None, 0)]
+                if bad:
+                    self.terminate()
+                    return bad[0]
+                time.sleep(0.2)
+        finally:
+            for f in self.logs:
+                if f:
+                    f.close()
+
+    def terminate(self):
+        for p in self.procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        deadline = time.time() + 5
+        for p in self.procs:
+            try:
+                p.wait(max(0.1, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+def launch(script: str, script_args: List[str] = (), nproc_per_node: int = 1,
+           master: Optional[str] = None, log_dir: Optional[str] = None,
+           cpu_sim: bool = False, max_restarts: int = 0) -> int:
+    """Programmatic launch (spawn.py:450-style entry); returns exit code.
+
+    ``max_restarts`` > 0 enables elastic behavior: workers exiting with
+    ``ELASTIC_EXIT_CODE`` (or crashing) are relaunched with a fresh
+    rendezvous, up to the limit (fleet/elastic/manager.py:126 analog).
+    """
+    master = master or f"127.0.0.1:{_free_port()}"
+    cmd = [sys.executable, "-u", script, *script_args]
+
+    restarts = 0
+    while True:
+        envs = [
+            _build_env(r, nproc_per_node, master, dict(os.environ),
+                       cpu_sim, log_dir)
+            for r in range(nproc_per_node)
+        ]
+        pod = Pod()
+        pod.spawn(cmd, envs, log_dir)
+        code = pod.watch()
+        if code == 0:
+            return 0
+        if restarts >= max_restarts:
+            return code
+        restarts += 1
+        master = f"127.0.0.1:{_free_port()}"  # rendezvous regen
+        print(f"[launch] worker failed (exit {code}); elastic restart "
+              f"{restarts}/{max_restarts}", file=sys.stderr)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="paddle_tpu.distributed.launch",
+        description="Launch distributed training "
+                    "(paddle.distributed.launch analog)")
+    p.add_argument("--nnodes", type=int, default=1,
+                   help="number of hosts (informational on TPU pods)")
+    p.add_argument("--nproc_per_node", "--devices", dest="nproc_per_node",
+                   type=lambda v: len(v.split(",")) if "," in str(v) else int(v),
+                   default=1, help="worker processes on this host "
+                   "(CPU-sim) — on TPU keep 1 per host")
+    p.add_argument("--master", default=None, help="rendezvous addr host:port")
+    p.add_argument("--log_dir", default=None)
+    p.add_argument("--backend", default=None,
+                   help="'cpu' forces CPU-simulation workers")
+    p.add_argument("--max_restarts", type=int, default=0)
+    p.add_argument("script")
+    p.add_argument("script_args", nargs=argparse.REMAINDER)
+    args = p.parse_args(argv)
+
+    return launch(
+        args.script, args.script_args,
+        nproc_per_node=args.nproc_per_node, master=args.master,
+        log_dir=args.log_dir, cpu_sim=(args.backend == "cpu"),
+        max_restarts=args.max_restarts)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

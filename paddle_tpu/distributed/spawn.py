@@ -1,0 +1,63 @@
+"""``paddle.distributed.spawn`` analog (``spawn.py:450``): fork N worker
+processes running ``func`` with rendezvous env injected.
+
+TPU-first note: on a real pod you launch one controller per host (use
+``paddle_tpu.distributed.launch``); ``spawn`` exists for the CPU-simulation
+path and API parity — each child is an independent single-device CPU
+process, exactly the reference's per-GPU fork semantics."""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+import socket
+from typing import Optional, Tuple
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def _worker(func, rank: int, nprocs: int, master: str, args: Tuple):
+    os.environ.update({
+        "PADDLE_TRAINER_ID": str(rank),
+        "PADDLE_TRAINERS_NUM": str(nprocs),
+        "PADDLE_MASTER": master,
+        "MASTER_ADDR": master.split(":")[0],
+        "MASTER_PORT": master.split(":")[1],
+        "JAX_PLATFORMS": os.environ.get("JAX_PLATFORMS", "cpu"),
+        "PADDLE_TPU_CPU_SIM": "1",
+    })
+    func(*args)
+
+
+def spawn(func, args=(), nprocs: int = 1, join: bool = True,
+          daemon: bool = False, **options):
+    """Run ``func(*args)`` in ``nprocs`` processes; returns the context."""
+    master = options.get("master") or f"127.0.0.1:{_free_port()}"
+    ctx = mp.get_context("spawn")
+    procs = []
+    for rank in range(nprocs):
+        p = ctx.Process(target=_worker,
+                        args=(func, rank, nprocs, master, tuple(args)),
+                        daemon=daemon)
+        p.start()
+        procs.append(p)
+
+    class Context:
+        def __init__(self, procs):
+            self.processes = procs
+
+        def join(self, timeout: Optional[float] = None):
+            for p in self.processes:
+                p.join(timeout)
+            bad = [p.exitcode for p in self.processes if p.exitcode]
+            if bad:
+                raise RuntimeError(f"spawned worker failed: exit {bad[0]}")
+
+    c = Context(procs)
+    if join:
+        c.join()
+    return c

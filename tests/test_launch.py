@@ -1,0 +1,69 @@
+"""Launcher tests: multi-process CPU-sim pod, env injection, elastic restart
+(the reference's CommunicationTestDistBase / elastic pattern, SURVEY.md §4)."""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+from paddle_tpu.distributed.launch.main import ELASTIC_EXIT_CODE, launch
+
+
+def _write(tmp_path, name, body):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(body))
+    return str(p)
+
+
+def test_launch_two_workers_env(tmp_path):
+    script = _write(tmp_path, "worker.py", f"""
+        import os
+        rank = os.environ["PADDLE_TRAINER_ID"]
+        assert os.environ["PADDLE_TRAINERS_NUM"] == "2"
+        assert os.environ["MASTER_ADDR"] == "127.0.0.1"
+        open(r"{tmp_path}/rank_" + rank, "w").write("ok")
+    """)
+    code = launch(script, nproc_per_node=2, cpu_sim=True,
+                  log_dir=str(tmp_path / "logs"))
+    assert code == 0
+    assert (tmp_path / "rank_0").exists()
+    assert (tmp_path / "rank_1").exists()
+    assert (tmp_path / "logs" / "workerlog.0").exists()
+
+
+def test_launch_failure_propagates(tmp_path):
+    script = _write(tmp_path, "bad.py", """
+        import sys
+        sys.exit(3)
+    """)
+    assert launch(script, nproc_per_node=2, cpu_sim=True) == 3
+
+
+def test_elastic_restart(tmp_path):
+    marker = tmp_path / "attempted"
+    script = _write(tmp_path, "flaky.py", f"""
+        import os, sys
+        m = r"{marker}"
+        if not os.path.exists(m):
+            open(m, "w").write("1")
+            sys.exit({ELASTIC_EXIT_CODE})   # simulated preemption
+        # second attempt succeeds
+    """)
+    code = launch(script, nproc_per_node=1, cpu_sim=True, max_restarts=2)
+    assert code == 0
+    assert marker.exists()
+
+
+def test_cli_entry(tmp_path):
+    script = _write(tmp_path, "hello.py", """
+        import os
+        print("rank", os.environ["PADDLE_TRAINER_ID"])
+    """)
+    out = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", "--backend", "cpu", script],
+        capture_output=True, text=True, cwd="/root/repo",
+        env={**os.environ,
+             "JAX_PLATFORMS": "cpu",  # don't touch the TPU tunnel from tests
+             "PYTHONPATH": "/root/repo:" + os.environ.get("PYTHONPATH", "")})
+    assert out.returncode == 0, out.stderr

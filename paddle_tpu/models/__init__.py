@@ -5,10 +5,17 @@ Analog of the PaddleNLP/PaddleClas model zoos the reference's configs target
 framework models so the capability rungs are runnable in-repo.
 """
 
-from . import llama  # noqa: F401
+from . import bert, llama  # noqa: F401
+from .bert import (  # noqa: F401
+    BertConfig,
+    BertForQuestionAnswering,
+    BertForSequenceClassification,
+    BertModel,
+)
 from .llama import (  # noqa: F401
     LlamaConfig,
     LlamaForCausalLM,
     LlamaModel,
+    LlamaMoEBlock,
     LlamaPretrainingCriterion,
 )

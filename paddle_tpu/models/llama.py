@@ -73,6 +73,12 @@ class LlamaConfig:
     recompute: bool = False
     use_flash_attention: bool = True
     dtype: str = "float32"
+    # MoE knobs (0 experts = dense; DeepSeek/Qwen2-MoE style otherwise)
+    num_experts: int = 0
+    num_experts_per_tok: int = 2
+    moe_intermediate_size: int = 0      # per-expert FFN width
+    num_shared_experts: int = 0         # always-on experts (DeepSeek-MoE)
+    aux_loss_weight: float = 0.01
 
     @property
     def head_dim(self) -> int:
@@ -91,6 +97,14 @@ class LlamaConfig:
             max_position_embeddings=256, rope_theta=10000.0)
         defaults.update(kw)
         return cls(**defaults)
+
+    @classmethod
+    def tiny_moe(cls, **kw):
+        """Tiny MoE config (DeepSeek-MoE shape: shared + routed experts)."""
+        defaults = dict(num_experts=4, num_experts_per_tok=2,
+                        moe_intermediate_size=64, num_shared_experts=1)
+        defaults.update(kw)
+        return cls.tiny(**defaults)
 
 
 def _rope_tables(head_dim: int, max_pos: int, theta: float):
@@ -195,6 +209,41 @@ class LlamaMLP(Layer):
         return self.down_proj(F.silu(self.gate_proj(x)) * self.up_proj(x))
 
 
+class LlamaMoEBlock(Layer):
+    """DeepSeek/Qwen2-MoE FFN: optional always-on shared experts + top-k
+    routed experts with expert parallelism (BASELINE.md config #5; built on
+    :class:`paddle_tpu.parallel.MoELayer`'s GShard dispatch — the E-sharded
+    buffer's all-to-all rides ICI over the ``sep``/ep axis)."""
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        from ..parallel.moe import FusedMoEMLP, GShardGate, MoELayer, SwitchGate
+
+        ff = config.moe_intermediate_size or config.intermediate_size
+        gate_cls = SwitchGate if config.num_experts_per_tok == 1 else GShardGate
+        self.moe = MoELayer(
+            config.hidden_size,
+            FusedMoEMLP(config.num_experts, config.hidden_size, ff,
+                        activation="swiglu"),
+            gate=gate_cls(config.hidden_size, config.num_experts))
+        if config.num_shared_experts > 0:
+            shared_cfg = LlamaConfig(**{**config.__dict__})
+            shared_cfg.intermediate_size = ff * config.num_shared_experts
+            self.shared_experts = LlamaMLP(shared_cfg)
+        else:
+            self.shared_experts = None
+
+    @property
+    def aux_loss(self):
+        return self.moe.aux_loss
+
+    def forward(self, x):
+        out = self.moe(x)
+        if self.shared_experts is not None:
+            out = out + self.shared_experts(x)
+        return out
+
+
 class LlamaDecoderLayer(Layer):
     """Pre-norm decoder block; single-input forward so the stack is
     pipeline-homogeneous (drops into PipelineLayer unchanged)."""
@@ -206,7 +255,10 @@ class LlamaDecoderLayer(Layer):
         self.self_attn = LlamaAttention(config)
         self.post_attention_layernorm = RMSNorm(config.hidden_size,
                                                 config.rms_norm_eps)
-        self.mlp = LlamaMLP(config)
+        if config.num_experts > 0:
+            self.mlp = LlamaMoEBlock(config)
+        else:
+            self.mlp = LlamaMLP(config)
 
     def _sp(self, x):
         # Megatron-SP layout between blocks: seq sharded over mp (+sep for CP)
@@ -277,6 +329,17 @@ class LlamaForCausalLM(Layer):
             w = self.llama.embed_tokens.weight
             return run_op("tied_head", lambda a, wv: a @ wv.T, h, w)
         return self.lm_head(h)
+
+    @property
+    def aux_loss(self):
+        """Sum of MoE load-balance losses from the last forward (add
+        ``config.aux_loss_weight * model.aux_loss`` to the training loss)."""
+        total = None
+        for layer in self.llama.layers:
+            al = getattr(layer.mlp, "aux_loss", None)
+            if al is not None:
+                total = al if total is None else total + al
+        return total
 
 
 class LlamaPretrainingCriterion(Layer):

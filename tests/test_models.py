@@ -1,0 +1,149 @@
+"""BERT + MoE-Llama model family tests (BASELINE.md capability rungs #3/#5)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.distributed import topology
+from paddle_tpu.jit import to_static
+from paddle_tpu.models import (
+    BertConfig,
+    BertForQuestionAnswering,
+    BertForSequenceClassification,
+    BertModel,
+    LlamaConfig,
+    LlamaForCausalLM,
+    LlamaPretrainingCriterion,
+)
+from paddle_tpu.parallel.utils import apply_param_shardings, param_spec
+
+
+@pytest.fixture
+def ep_mesh():
+    m = topology.init_mesh(dp=2, sep=4)
+    yield m
+    topology._global_mesh = None
+    topology._global_hcg = None
+
+
+def _ids(cfg, batch=2, seq=16, seed=0, low=1):
+    rng = np.random.default_rng(seed)
+    return paddle.to_tensor(
+        rng.integers(low, cfg.vocab_size, (batch, seq)).astype("int64"))
+
+
+class TestBert:
+    def test_shapes(self):
+        cfg = BertConfig.tiny()
+        m = BertModel(cfg)
+        seq, pooled = m(_ids(cfg))
+        assert seq.shape == [2, 16, cfg.hidden_size]
+        assert pooled.shape == [2, cfg.hidden_size]
+
+    def test_padding_mask_isolates_pad_tokens(self):
+        cfg = BertConfig.tiny()
+        m = BertModel(cfg)
+        m.eval()
+        ids = _ids(cfg, batch=1)
+        base, _ = m(ids)
+        # changing content of a PADDED position must not affect real tokens
+        padded = ids.numpy().copy()
+        padded[0, -4:] = cfg.pad_token_id
+        out1, _ = m(paddle.to_tensor(padded))
+        changed = padded.copy()
+        changed[0, -1] = 7  # still masked out in out1's mask? no — mask is
+        # computed from ids, so instead compare two pad-content variants with
+        # an explicit mask
+        mask = np.ones((1, 16), "float32")
+        mask[0, -4:] = 0.0
+        o1, _ = m(paddle.to_tensor(padded), attention_mask=paddle.to_tensor(mask))
+        changed[0, -2] = 9
+        o2, _ = m(paddle.to_tensor(changed), attention_mask=paddle.to_tensor(mask))
+        np.testing.assert_allclose(o1.numpy()[0, :12], o2.numpy()[0, :12],
+                                   atol=1e-5)
+
+    def test_qa_head(self):
+        cfg = BertConfig.tiny()
+        m = BertForQuestionAnswering(cfg)
+        s, e = m(_ids(cfg))
+        assert s.shape == [2, 16] and e.shape == [2, 16]
+
+    def test_finetune_step_learns(self):
+        cfg = BertConfig.tiny(hidden_dropout_prob=0.0,
+                              attention_probs_dropout_prob=0.0)
+        paddle.seed(0)
+        m = BertForSequenceClassification(cfg, num_classes=2)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=m.parameters())
+        loss_fn = nn.CrossEntropyLoss()
+        # learnable rule: label = (first token > vocab/2)
+        rng = np.random.default_rng(0)
+        ids = rng.integers(1, cfg.vocab_size, (16, 12)).astype("int64")
+        labels = (ids[:, 0] > cfg.vocab_size // 2).astype("int64")
+
+        @to_static
+        def step(x, y):
+            loss = loss_fn(m(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        losses = [float(step(paddle.to_tensor(ids), paddle.to_tensor(labels)))
+                  for _ in range(25)]
+        assert losses[-1] < losses[0] * 0.5, losses
+
+
+class TestMoELlama:
+    def test_moe_block_wired(self):
+        cfg = LlamaConfig.tiny_moe()
+        m = LlamaForCausalLM(cfg)
+        from paddle_tpu.models import LlamaMoEBlock
+
+        assert isinstance(m.llama.layers[0].mlp, LlamaMoEBlock)
+        # expert-stacked weights are EP-annotated on dim 0
+        w = m.llama.layers[0].mlp.moe.experts.w_in
+        assert param_spec(w)[0] == "sep"
+
+    def test_aux_loss_present_and_grads(self):
+        cfg = LlamaConfig.tiny_moe()
+        m = LlamaForCausalLM(cfg)
+        crit = LlamaPretrainingCriterion(cfg)
+        ids = _ids(cfg, low=0)
+        loss = crit(m(ids), ids) + cfg.aux_loss_weight * m.aux_loss
+        loss.backward()
+        missing = [n for n, p in m.named_parameters() if p.grad is None]
+        assert missing == []
+
+    def test_ep_train_step_loss_decreases(self, ep_mesh):
+        cfg = LlamaConfig.tiny_moe()
+        paddle.seed(0)
+        m = LlamaForCausalLM(cfg)
+        apply_param_shardings(m)
+        crit = LlamaPretrainingCriterion(cfg)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=m.parameters())
+
+        @to_static
+        def step(ids):
+            loss = crit(m(ids), ids) + cfg.aux_loss_weight * m.aux_loss
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        ids = paddle.to_tensor(
+            np.random.default_rng(0).integers(0, cfg.vocab_size, (4, 16))
+            .astype("int32"))
+        losses = [float(step(ids)) for _ in range(4)]
+        assert losses[-1] < losses[0]
+
+    def test_switch_top1_variant(self):
+        cfg = LlamaConfig.tiny_moe(num_experts_per_tok=1)
+        m = LlamaForCausalLM(cfg)
+        from paddle_tpu.parallel.moe import SwitchGate
+
+        assert isinstance(m.llama.layers[0].mlp.moe.gate, SwitchGate)
+        ids = _ids(cfg, low=0)
+        assert m(ids).shape == [2, 16, cfg.vocab_size]

@@ -33,6 +33,7 @@ import math
 from dataclasses import dataclass, field
 from typing import Optional
 
+import jax
 import jax.numpy as jnp
 
 from ..core.tensor import Tensor
@@ -157,7 +158,7 @@ class LlamaAttention(Layer):
         self._rope_cos, self._rope_sin = _rope_tables(
             hd, config.max_position_embeddings, config.rope_theta)
 
-    def forward(self, x):
+    def forward(self, x, cache=None, pos=None):
         B, S = x.shape[0], x.shape[1]
         hd = self.config.head_dim
         q = self.q_proj(x)
@@ -173,9 +174,24 @@ class LlamaAttention(Layer):
         k = shape_heads(k, self.num_kv_heads)
         v = shape_heads(v, self.num_kv_heads)
 
-        cos, sin = self._rope_cos[:S], self._rope_sin[:S]
-        q = run_op("rope", lambda a: _apply_rope(a, cos, sin), q)
-        k = run_op("rope", lambda a: _apply_rope(a, cos, sin), k)
+        if pos is None:
+            cos, sin = self._rope_cos[:S], self._rope_sin[:S]
+            q = run_op("rope", lambda a: _apply_rope(a, cos, sin), q)
+            k = run_op("rope", lambda a: _apply_rope(a, cos, sin), k)
+        else:
+            # decode: gather tables at traced positions [pos, pos+S)
+            cos_t, sin_t = self._rope_cos, self._rope_sin
+
+            def rope_at(a, p):
+                idx = p + jnp.arange(S)
+                return _apply_rope(a, jnp.asarray(cos_t)[idx],
+                                   jnp.asarray(sin_t)[idx])
+
+            q = run_op("rope_at", rope_at, q, pos)
+            k = run_op("rope_at", rope_at, k, pos)
+
+        if cache is not None:
+            return self._cached_attention(q, k, v, cache, pos, B, S, hd)
 
         rep = self.num_heads // self.num_kv_heads
         if rep > 1:
@@ -188,6 +204,42 @@ class LlamaAttention(Layer):
         out = run_op("merge_heads",
                      lambda a: a.reshape(B, S, self.num_heads * hd), out)
         out = sharding_constraint(out, "dp", "sep", "mp")
+        return self.o_proj(out)
+
+    def _cached_attention(self, q, k, v, cache, pos, B, S, hd):
+        """KV-cached attention for generation: append k/v into the static
+        [B, M, Hkv, D] buffers at ``pos`` and attend over the valid prefix
+        (fixed shapes + length mask — one compiled decode step serves every
+        position; the serving analog of the reference's fused decode path)."""
+        k_buf, v_buf = cache
+
+        def upd(buf, new, p):
+            zero = jnp.zeros((), p.dtype) if hasattr(p, "dtype") else 0
+            return jax.lax.dynamic_update_slice(
+                buf, new.astype(buf.dtype), (zero, p, zero, zero))
+
+        k_buf._rebind(run_op("kv_write", upd, k_buf, k, pos))
+        v_buf._rebind(run_op("kv_write", upd, v_buf, v, pos))
+
+        rep = self.num_heads // self.num_kv_heads
+        scale = 1.0 / math.sqrt(hd)
+
+        def attend(qv, kb, vb, p):
+            if rep > 1:
+                kb = jnp.repeat(kb, rep, axis=2)
+                vb = jnp.repeat(vb, rep, axis=2)
+            M = kb.shape[1]
+            logits = jnp.einsum("bqhd,bkhd->bhqk", qv, kb,
+                                preferred_element_type=jnp.float32) * scale
+            col = jnp.arange(M)[None, :]
+            row = jnp.arange(S)[:, None]
+            mask = col <= (p + row)               # causal over written prefix
+            logits = jnp.where(mask[None, None], logits, -1e30)
+            probs = jax.nn.softmax(logits, axis=-1)
+            out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(vb.dtype), vb)
+            return out.reshape(B, S, self.num_heads * hd)
+
+        out = run_op("cached_attention", attend, q, k_buf, v_buf, pos)
         return self.o_proj(out)
 
 
@@ -266,9 +318,9 @@ class LlamaDecoderLayer(Layer):
             return sharding_constraint(x, "dp", ("sep", "mp"), None)
         return sharding_constraint(x, "dp", "sep", None)
 
-    def forward(self, x):
+    def forward(self, x, cache=None, pos=None):
         x = self._sp(x)
-        h = x + self.self_attn(self.input_layernorm(x))
+        h = x + self.self_attn(self.input_layernorm(x), cache=cache, pos=pos)
         out = h + self.mlp(self.post_attention_layernorm(h))
         return self._sp(out)
 
@@ -295,9 +347,13 @@ class LlamaModel(Layer):
                                        num_stages=axis_size("pp"))
         return self._pipe
 
-    def forward(self, input_ids, pp_microbatches: Optional[int] = None):
+    def forward(self, input_ids, pp_microbatches: Optional[int] = None,
+                caches=None, pos=None):
         h = self.embed_tokens(input_ids)
-        if pp_microbatches and axis_size("pp") > 1:
+        if caches is not None:
+            for layer, cache in zip(self.layers, caches):
+                h = layer(h, cache=cache, pos=pos)
+        elif pp_microbatches and axis_size("pp") > 1:
             h = pipeline_forward(self._pipeline(), h, pp_microbatches)
         else:
             for layer in self.layers:
@@ -323,12 +379,95 @@ class LlamaForCausalLM(Layer):
                 gather_output=True,
                 weight_attr=Normal(0.0, config.initializer_range))
 
-    def forward(self, input_ids, pp_microbatches: Optional[int] = None):
-        h = self.llama(input_ids, pp_microbatches=pp_microbatches)
+    def forward(self, input_ids, pp_microbatches: Optional[int] = None,
+                caches=None, pos=None):
+        h = self.llama(input_ids, pp_microbatches=pp_microbatches,
+                       caches=caches, pos=pos)
         if self.lm_head is None:
             w = self.llama.embed_tokens.weight
             return run_op("tied_head", lambda a, wv: a @ wv.T, h, w)
         return self.lm_head(h)
+
+    def generate(self, input_ids, max_new_tokens: int = 32,
+                 temperature: float = 1.0, top_k: int = 0, top_p: float = 1.0,
+                 eos_token_id: Optional[int] = None, seed: int = 0):
+        """Autoregressive generation with a static KV cache: prefill compiles
+        once, then every decode step reuses ONE compiled program (position is
+        a traced input, cache buffers are threaded jit state — the serving
+        analog of the reference's fused decode kernels).  Greedy when
+        ``temperature == 0``."""
+        import numpy as np
+
+        from .. import no_grad
+        from ..core.tensor import to_tensor
+        from ..jit import to_static
+
+        cfg = self.config
+        B, T0 = input_ids.shape[0], input_ids.shape[1]
+        M = T0 + max_new_tokens
+        caches = [
+            (Tensor(jnp.zeros((B, M, cfg.num_key_value_heads, cfg.head_dim),
+                              self.llama.embed_tokens.weight.dtype)),
+             Tensor(jnp.zeros((B, M, cfg.num_key_value_heads, cfg.head_dim),
+                              self.llama.embed_tokens.weight.dtype)))
+            for _ in cfg.num_hidden_layers * [0]
+        ]
+
+        was_training = self.training
+        self.eval()
+
+        @to_static
+        def prefill(ids, pos):
+            with no_grad():
+                logits = self(ids, caches=caches, pos=pos)
+            return logits[:, -1]
+
+        @to_static
+        def decode(tok, pos):
+            with no_grad():
+                logits = self(tok, caches=caches, pos=pos)
+            return logits[:, -1]
+
+        rng = np.random.default_rng(seed)
+
+        def sample(logits_np):
+            if temperature == 0.0:
+                return logits_np.argmax(-1)
+            logits_np = logits_np / max(temperature, 1e-6)
+            if top_k > 0:
+                kth = np.sort(logits_np, -1)[:, -top_k][:, None]
+                logits_np = np.where(logits_np < kth, -1e30, logits_np)
+            probs = np.exp(logits_np - logits_np.max(-1, keepdims=True))
+            probs /= probs.sum(-1, keepdims=True)
+            if top_p < 1.0:
+                order = np.argsort(-probs, -1)
+                sorted_p = np.take_along_axis(probs, order, -1)
+                keep = np.cumsum(sorted_p, -1) - sorted_p < top_p
+                mask = np.zeros_like(probs, bool)
+                np.put_along_axis(mask, order, keep, -1)
+                probs = np.where(mask, probs, 0.0)
+                probs /= probs.sum(-1, keepdims=True)
+            return np.array([rng.choice(probs.shape[-1], p=p) for p in probs])
+
+        out = [np.asarray(input_ids.numpy(), dtype=np.int64)]
+        logits = prefill(input_ids, to_tensor(0, dtype="int32"))
+        tok = sample(np.asarray(logits.numpy(), np.float32))
+        finished = np.zeros((B,), bool)
+        for step in range(max_new_tokens):
+            if eos_token_id is not None:
+                finished |= tok == eos_token_id
+            out.append(tok[:, None])
+            if eos_token_id is not None and finished.all():
+                break
+            if step == max_new_tokens - 1:
+                break
+            logits = decode(to_tensor(tok[:, None].astype("int64")),
+                            to_tensor(T0 + step, dtype="int32"))
+            tok = sample(np.asarray(logits.numpy(), np.float32))
+
+        if was_training:
+            self.train()
+        return to_tensor(np.concatenate(out, axis=1))
 
     @property
     def aux_loss(self):

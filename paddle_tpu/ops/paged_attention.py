@@ -1,0 +1,105 @@
+"""Paged (block) KV-cache attention for serving.
+
+Capability analog of the reference's
+``phi/kernels/fusion/gpu/block_multi_head_attention_kernel.cu`` (paged
+KV-cache attention à la vLLM): the KV cache lives in fixed-size blocks
+indexed per-sequence through a block table, so sequences share a global
+block pool with no per-request contiguous allocation.
+
+TPU-first: the cache pool is a dense ``[num_blocks, block_size, H, D]``
+array updated with scatter writes (XLA keeps it resident in HBM and donates
+the buffer between decode steps under jit); the gather of a sequence's
+blocks is one ``take`` along the block dim — compiler-friendly static
+shapes with a length mask instead of dynamic slicing.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class BlockKVCache:
+    """Host-side block-pool manager (BlockTable bookkeeping is python; the
+    cache tensors live on device)."""
+
+    def __init__(self, num_blocks: int, block_size: int, num_heads: int,
+                 head_dim: int, dtype=jnp.bfloat16):
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        self.k_cache = jnp.zeros((num_blocks, block_size, num_heads, head_dim), dtype)
+        self.v_cache = jnp.zeros((num_blocks, block_size, num_heads, head_dim), dtype)
+        self._free = list(range(num_blocks - 1, 0, -1))  # block 0 = null page
+        self.block_tables = {}  # seq_id -> list[int]
+        self.seq_lens = {}      # seq_id -> int
+
+    def allocate(self, seq_id: int, num_tokens: int):
+        """Reserve enough blocks for ``num_tokens`` more tokens."""
+        table = self.block_tables.setdefault(seq_id, [])
+        cur = self.seq_lens.get(seq_id, 0)
+        need = -(-(cur + num_tokens) // self.block_size) - len(table)
+        for _ in range(need):
+            if not self._free:
+                raise RuntimeError("KV cache pool exhausted")
+            table.append(self._free.pop())
+        return table
+
+    def free(self, seq_id: int):
+        for b in self.block_tables.pop(seq_id, []):
+            self._free.append(b)
+        self.seq_lens.pop(seq_id, None)
+
+    def write(self, seq_id: int, k: jax.Array, v: jax.Array):
+        """Append [T, H, D] keys/values for one sequence."""
+        T = k.shape[0]
+        start = self.seq_lens.get(seq_id, 0)
+        table = self.allocate(seq_id, T)
+        pos = np.arange(start, start + T)
+        blocks = np.asarray([table[p // self.block_size] for p in pos])
+        offs = pos % self.block_size
+        self.k_cache = self.k_cache.at[blocks, offs].set(k.astype(self.k_cache.dtype))
+        self.v_cache = self.v_cache.at[blocks, offs].set(v.astype(self.v_cache.dtype))
+        self.seq_lens[seq_id] = start + T
+
+    def gather_view(self, seq_ids, max_blocks: Optional[int] = None):
+        """Dense [B, max_blocks] block table + [B] lengths for the kernel."""
+        if max_blocks is None:
+            max_blocks = max(len(self.block_tables[s]) for s in seq_ids)
+        bt = np.zeros((len(seq_ids), max_blocks), np.int32)
+        lens = np.zeros((len(seq_ids),), np.int32)
+        for i, s in enumerate(seq_ids):
+            t = self.block_tables[s]
+            bt[i, :len(t)] = t
+            lens[i] = self.seq_lens[s]
+        return jnp.asarray(bt), jnp.asarray(lens)
+
+
+def paged_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
+                    block_tables: jax.Array, seq_lens: jax.Array) -> jax.Array:
+    """Decode-step attention over a paged KV cache.
+
+    q: [B, H, D] (one new token per sequence); k/v_cache:
+    [num_blocks, block_size, H, D]; block_tables: [B, max_blocks] int32;
+    seq_lens: [B] int32.  Returns [B, H, D].
+    """
+    B, H, D = q.shape
+    max_blocks = block_tables.shape[1]
+    bs = k_cache.shape[1]
+    scale = 1.0 / math.sqrt(D)
+
+    # gather each sequence's pages: [B, max_blocks, bs, H, D] → [B, S, H, D]
+    k = k_cache[block_tables].reshape(B, max_blocks * bs, H, D)
+    v = v_cache[block_tables].reshape(B, max_blocks * bs, H, D)
+
+    logits = jnp.einsum("bhd,bshd->bhs", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    pos = jnp.arange(max_blocks * bs)[None, None, :]
+    mask = pos < seq_lens[:, None, None]
+    logits = jnp.where(mask, logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhs,bshd->bhd", probs, v.astype(jnp.float32))
+    return out.astype(q.dtype)

@@ -1,0 +1,202 @@
+// Shared-memory slot ring for the DataLoader hot path.
+//
+// Capability analog of the reference's multiprocess DataLoader data channel
+// (python/paddle/io/dataloader/worker.py + fluid shared-memory LoDTensor
+// transfer): worker processes push serialized batches through a POSIX
+// shared-memory ring instead of pickling through a multiprocessing pipe —
+// one memcpy in, one zero-copy numpy view out on the consumer side.
+// Keeping a TPU fed is a host-CPU problem (SURVEY.md §7 hard part (e));
+// this removes the pipe/pickle bottleneck from the feed path.
+//
+// Design: fixed number of fixed-size slots; counting semaphores (pshared)
+// for free/used slots; a pshared mutex serializes head/tail updates so any
+// number of producers/consumers is safe. Messages must fit in one slot.
+//
+// C ABI for ctypes. No exceptions across the boundary; every function
+// returns 0 on success / -errno on failure.
+
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+
+#include <fcntl.h>
+#include <pthread.h>
+#include <semaphore.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+struct RingHeader {
+  uint64_t magic;
+  uint32_t n_slots;
+  uint64_t slot_size;
+  uint32_t head;  // next slot to read
+  uint32_t tail;  // next slot to write
+  pthread_mutex_t mutex;
+  sem_t free_slots;
+  sem_t used_slots;
+  // slot lengths follow, then slot data
+};
+
+constexpr uint64_t kMagic = 0x70616464726e67ULL;  // "paddrng"
+
+inline uint64_t* slot_lens(RingHeader* h) {
+  return reinterpret_cast<uint64_t*>(h + 1);
+}
+
+inline char* slot_data(RingHeader* h, uint32_t idx) {
+  char* base = reinterpret_cast<char*>(slot_lens(h) + h->n_slots);
+  return base + static_cast<uint64_t>(idx) * h->slot_size;
+}
+
+inline uint64_t total_size(uint32_t n_slots, uint64_t slot_size) {
+  return sizeof(RingHeader) + n_slots * sizeof(uint64_t) +
+         static_cast<uint64_t>(n_slots) * slot_size;
+}
+
+int sem_wait_ms(sem_t* sem, long timeout_ms) {
+  if (timeout_ms < 0) {
+    while (sem_wait(sem) != 0) {
+      if (errno != EINTR) return -errno;
+    }
+    return 0;
+  }
+  timespec ts;
+  clock_gettime(CLOCK_REALTIME, &ts);
+  ts.tv_sec += timeout_ms / 1000;
+  ts.tv_nsec += (timeout_ms % 1000) * 1000000L;
+  if (ts.tv_nsec >= 1000000000L) {
+    ts.tv_sec += 1;
+    ts.tv_nsec -= 1000000000L;
+  }
+  while (sem_timedwait(sem, &ts) != 0) {
+    if (errno == EINTR) continue;
+    return -errno;  // -ETIMEDOUT on timeout
+  }
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Create + initialize a ring; returns mapped pointer or nullptr.
+void* ring_create(const char* name, uint32_t n_slots, uint64_t slot_size) {
+  shm_unlink(name);  // stale ring from a crashed run
+  int fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  uint64_t sz = total_size(n_slots, slot_size);
+  if (ftruncate(fd, static_cast<off_t>(sz)) != 0) {
+    close(fd);
+    shm_unlink(name);
+    return nullptr;
+  }
+  void* mem = mmap(nullptr, sz, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);
+  if (mem == MAP_FAILED) {
+    shm_unlink(name);
+    return nullptr;
+  }
+  auto* h = static_cast<RingHeader*>(mem);
+  h->n_slots = n_slots;
+  h->slot_size = slot_size;
+  h->head = 0;
+  h->tail = 0;
+  pthread_mutexattr_t mattr;
+  pthread_mutexattr_init(&mattr);
+  pthread_mutexattr_setpshared(&mattr, PTHREAD_PROCESS_SHARED);
+  // robust: a worker dying with the lock held must not wedge the loader
+  pthread_mutexattr_setrobust(&mattr, PTHREAD_MUTEX_ROBUST);
+  pthread_mutex_init(&h->mutex, &mattr);
+  sem_init(&h->free_slots, 1, n_slots);
+  sem_init(&h->used_slots, 1, 0);
+  h->magic = kMagic;  // last: attachers spin on this
+  return mem;
+}
+
+void* ring_attach(const char* name) {
+  int fd = shm_open(name, O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0) {
+    close(fd);
+    return nullptr;
+  }
+  void* mem =
+      mmap(nullptr, st.st_size, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);
+  if (mem == MAP_FAILED) return nullptr;
+  auto* h = static_cast<RingHeader*>(mem);
+  if (h->magic != kMagic) {
+    munmap(mem, st.st_size);
+    return nullptr;
+  }
+  return mem;
+}
+
+uint64_t ring_slot_size(void* ring) {
+  return static_cast<RingHeader*>(ring)->slot_size;
+}
+
+static int lock_robust(RingHeader* h) {
+  int rc = pthread_mutex_lock(&h->mutex);
+  if (rc == EOWNERDEAD) {
+    pthread_mutex_consistent(&h->mutex);
+    rc = 0;
+  }
+  return rc == 0 ? 0 : -rc;
+}
+
+// Push one message; blocks while full. timeout_ms<0 = forever.
+int ring_push(void* ring, const void* data, uint64_t len, long timeout_ms) {
+  auto* h = static_cast<RingHeader*>(ring);
+  if (len > h->slot_size) return -EMSGSIZE;
+  int rc = sem_wait_ms(&h->free_slots, timeout_ms);
+  if (rc != 0) return rc;
+  if ((rc = lock_robust(h)) != 0) return rc;
+  uint32_t idx = h->tail;
+  h->tail = (h->tail + 1) % h->n_slots;
+  pthread_mutex_unlock(&h->mutex);
+  memcpy(slot_data(h, idx), data, len);
+  slot_lens(h)[idx] = len;
+  sem_post(&h->used_slots);
+  return 0;
+}
+
+// Pop one message into buf (cap bytes); returns message length, or <0.
+int64_t ring_pop(void* ring, void* buf, uint64_t cap, long timeout_ms) {
+  auto* h = static_cast<RingHeader*>(ring);
+  int rc = sem_wait_ms(&h->used_slots, timeout_ms);
+  if (rc != 0) return rc;
+  if ((rc = lock_robust(h)) != 0) return rc;
+  uint32_t idx = h->head;
+  h->head = (h->head + 1) % h->n_slots;
+  pthread_mutex_unlock(&h->mutex);
+  uint64_t len = slot_lens(h)[idx];
+  if (len > cap) {
+    // caller's buffer too small: put the slot back as free and report
+    sem_post(&h->free_slots);
+    return -EMSGSIZE;
+  }
+  memcpy(buf, slot_data(h, idx), len);
+  sem_post(&h->free_slots);
+  return static_cast<int64_t>(len);
+}
+
+int ring_size(void* ring) {
+  auto* h = static_cast<RingHeader*>(ring);
+  int v = 0;
+  sem_getvalue(&h->used_slots, &v);
+  return v;
+}
+
+void ring_close(void* ring) {
+  auto* h = static_cast<RingHeader*>(ring);
+  munmap(ring, total_size(h->n_slots, h->slot_size));
+}
+
+void ring_destroy(const char* name) { shm_unlink(name); }
+
+}  // extern "C"

@@ -1,0 +1,288 @@
+// TCP rendezvous key-value store.
+//
+// Capability analog of the reference's TCPStore
+// (paddle/phi/core/distributed/store/tcp_store.h:121 + store/socket.cpp):
+// a master process serves set/get/add/wait over TCP; workers block on keys
+// for rendezvous and barrier semantics. Used by the launcher for multi-host
+// bring-up (the coordination path BEFORE jax.distributed's own service is
+// up) and by elastic restart to re-rendezvous.
+//
+// Single-threaded poll() server — rendezvous traffic is tiny; simplicity
+// and robustness beat throughput here.
+//
+// Wire format (little-endian):
+//   request:  u8 op | u32 klen | key bytes | u64 arg | u32 vlen | value
+//   response: i64 status/num  | u32 vlen | value
+// ops: 1=SET 2=GET 3=ADD 4=WAIT 5=CHECK(num keys set)
+
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <string>
+#include <vector>
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <pthread.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+namespace {
+
+enum Op : uint8_t { SET = 1, GET = 2, ADD = 3, WAIT = 4, CHECK = 5 };
+
+struct PendingWait {
+  int fd;
+  std::string key;
+};
+
+struct Server {
+  int listen_fd = -1;
+  pthread_t thread{};
+  bool running = false;
+  std::map<std::string, std::vector<char>> data;
+  std::vector<PendingWait> waiters;
+  std::vector<int> clients;
+};
+
+bool read_n(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = read(fd, p, n);
+    if (r <= 0) {
+      if (r < 0 && errno == EINTR) continue;
+      return false;
+    }
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_n(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t r = write(fd, p, n);
+    if (r <= 0) {
+      if (r < 0 && errno == EINTR) continue;
+      return false;
+    }
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool send_resp(int fd, int64_t status, const std::vector<char>& value) {
+  uint32_t vlen = static_cast<uint32_t>(value.size());
+  if (!write_n(fd, &status, 8)) return false;
+  if (!write_n(fd, &vlen, 4)) return false;
+  if (vlen && !write_n(fd, value.data(), vlen)) return false;
+  return true;
+}
+
+void notify_waiters(Server* s, const std::string& key) {
+  auto it = s->waiters.begin();
+  while (it != s->waiters.end()) {
+    if (it->key == key) {
+      send_resp(it->fd, 0, s->data[key]);
+      it = s->waiters.erase(it);
+    } else {
+      ++it;
+    }
+  }
+}
+
+// Handle one request from fd; false = connection closed / error.
+bool handle(Server* s, int fd) {
+  uint8_t op;
+  uint32_t klen;
+  if (!read_n(fd, &op, 1) || !read_n(fd, &klen, 4)) return false;
+  std::string key(klen, '\0');
+  if (klen && !read_n(fd, key.data(), klen)) return false;
+  uint64_t arg = 0;
+  uint32_t vlen = 0;
+  if (!read_n(fd, &arg, 8) || !read_n(fd, &vlen, 4)) return false;
+  std::vector<char> value(vlen);
+  if (vlen && !read_n(fd, value.data(), vlen)) return false;
+
+  switch (op) {
+    case SET: {
+      s->data[key] = std::move(value);
+      notify_waiters(s, key);
+      return send_resp(fd, 0, {});
+    }
+    case GET: {
+      auto it = s->data.find(key);
+      if (it == s->data.end()) return send_resp(fd, -ENOENT, {});
+      return send_resp(fd, 0, it->second);
+    }
+    case ADD: {
+      int64_t cur = 0;
+      auto it = s->data.find(key);
+      if (it != s->data.end() && it->second.size() == 8)
+        memcpy(&cur, it->second.data(), 8);
+      cur += static_cast<int64_t>(arg);
+      std::vector<char> v(8);
+      memcpy(v.data(), &cur, 8);
+      s->data[key] = v;
+      notify_waiters(s, key);
+      return send_resp(fd, cur, {});
+    }
+    case WAIT: {
+      auto it = s->data.find(key);
+      if (it != s->data.end()) return send_resp(fd, 0, it->second);
+      s->waiters.push_back({fd, key});
+      return true;  // response deferred until SET/ADD
+    }
+    case CHECK:
+      return send_resp(fd, static_cast<int64_t>(s->data.size()), {});
+  }
+  return false;
+}
+
+void* serve(void* arg) {
+  auto* s = static_cast<Server*>(arg);
+  while (s->running) {
+    std::vector<pollfd> fds;
+    fds.push_back({s->listen_fd, POLLIN, 0});
+    for (int c : s->clients) fds.push_back({c, POLLIN, 0});
+    int rc = poll(fds.data(), fds.size(), 200);
+    if (rc <= 0) continue;
+    if (fds[0].revents & POLLIN) {
+      int c = accept(s->listen_fd, nullptr, nullptr);
+      if (c >= 0) {
+        int one = 1;
+        setsockopt(c, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+        s->clients.push_back(c);
+      }
+    }
+    for (size_t i = 1; i < fds.size(); ++i) {
+      if (!(fds[i].revents & (POLLIN | POLLHUP | POLLERR))) continue;
+      int fd = fds[i].fd;
+      if (!handle(s, fd)) {
+        close(fd);
+        for (auto it = s->clients.begin(); it != s->clients.end(); ++it) {
+          if (*it == fd) {
+            s->clients.erase(it);
+            break;
+          }
+        }
+        auto w = s->waiters.begin();
+        while (w != s->waiters.end())
+          w = (w->fd == fd) ? s->waiters.erase(w) : w + 1;
+      }
+    }
+  }
+  return nullptr;
+}
+
+}  // namespace
+
+extern "C" {
+
+// ---- server ----
+void* store_server_start(uint16_t port) {
+  auto* s = new Server();
+  s->listen_fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (s->listen_fd < 0) {
+    delete s;
+    return nullptr;
+  }
+  int one = 1;
+  setsockopt(s->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(port);
+  if (bind(s->listen_fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) !=
+          0 ||
+      listen(s->listen_fd, 64) != 0) {
+    close(s->listen_fd);
+    delete s;
+    return nullptr;
+  }
+  s->running = true;
+  pthread_create(&s->thread, nullptr, serve, s);
+  return s;
+}
+
+void store_server_stop(void* handle) {
+  auto* s = static_cast<Server*>(handle);
+  s->running = false;
+  pthread_join(s->thread, nullptr);
+  close(s->listen_fd);
+  for (int c : s->clients) close(c);
+  delete s;
+}
+
+// ---- client ----
+int store_connect(const char* host, uint16_t port, int timeout_ms) {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -errno;
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(port);
+  if (inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+    close(fd);
+    return -EINVAL;
+  }
+  // retry loop: workers race the master's bind during bring-up
+  int waited = 0;
+  while (connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+    if (waited >= timeout_ms) {
+      close(fd);
+      return -ETIMEDOUT;
+    }
+    usleep(50 * 1000);
+    waited += 50;
+  }
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  return fd;
+}
+
+static int64_t request(int fd, uint8_t op, const char* key, uint64_t arg,
+                       const void* value, uint32_t vlen, void* out,
+                       uint32_t out_cap, uint32_t* out_len) {
+  uint32_t klen = static_cast<uint32_t>(strlen(key));
+  if (!write_n(fd, &op, 1) || !write_n(fd, &klen, 4) ||
+      !write_n(fd, key, klen) || !write_n(fd, &arg, 8) ||
+      !write_n(fd, &vlen, 4) || (vlen && !write_n(fd, value, vlen)))
+    return -EPIPE;
+  int64_t status;
+  uint32_t rlen;
+  if (!read_n(fd, &status, 8) || !read_n(fd, &rlen, 4)) return -EPIPE;
+  std::vector<char> tmp(rlen);
+  if (rlen && !read_n(fd, tmp.data(), rlen)) return -EPIPE;
+  if (out_len) *out_len = rlen;
+  if (out && rlen) memcpy(out, tmp.data(), rlen < out_cap ? rlen : out_cap);
+  return status;
+}
+
+int64_t store_set(int fd, const char* key, const void* value, uint32_t vlen) {
+  return request(fd, SET, key, 0, value, vlen, nullptr, 0, nullptr);
+}
+
+int64_t store_get(int fd, const char* key, void* out, uint32_t cap,
+                  uint32_t* out_len) {
+  return request(fd, GET, key, 0, nullptr, 0, out, cap, out_len);
+}
+
+int64_t store_add(int fd, const char* key, int64_t amount) {
+  return request(fd, ADD, key, static_cast<uint64_t>(amount), nullptr, 0,
+                 nullptr, 0, nullptr);
+}
+
+// blocks (server defers response) until key exists
+int64_t store_wait(int fd, const char* key, void* out, uint32_t cap,
+                   uint32_t* out_len) {
+  return request(fd, WAIT, key, 0, nullptr, 0, out, cap, out_len);
+}
+
+void store_close(int fd) { close(fd); }
+
+}  // extern "C"

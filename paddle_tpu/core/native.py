@@ -1,0 +1,63 @@
+"""Native (C++) component loader.
+
+The reference's runtime layer is C++ (SURVEY.md §2.1); here the native
+pieces live in ``csrc/`` and are compiled on demand with g++ into cached
+shared objects, bound via ctypes (no pybind dependency in this image).
+Compilation is hash-cached: a source change triggers exactly one rebuild.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+
+_CSRC = os.path.join(os.path.dirname(__file__), "..", "..", "csrc")
+_CACHE = os.path.join(os.path.dirname(__file__), "..", "_native")
+_lock = threading.Lock()
+_loaded = {}
+
+
+class NativeBuildError(RuntimeError):
+    pass
+
+
+def load(name: str) -> ctypes.CDLL:
+    """Compile (if needed) and dlopen ``csrc/<name>.cpp``."""
+    with _lock:
+        if name in _loaded:
+            return _loaded[name]
+        src = os.path.abspath(os.path.join(_CSRC, f"{name}.cpp"))
+        with open(src, "rb") as f:
+            digest = hashlib.sha256(f.read()).hexdigest()[:16]
+        os.makedirs(_CACHE, exist_ok=True)
+        so = os.path.join(_CACHE, f"lib{name}-{digest}.so")
+        if not os.path.exists(so):
+            tmp = so + ".tmp"
+            cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+                   src, "-o", tmp, "-lpthread", "-lrt"]
+            proc = subprocess.run(cmd, capture_output=True, text=True)
+            if proc.returncode != 0:
+                raise NativeBuildError(
+                    f"g++ failed for {name}:\n{proc.stderr}")
+            os.replace(tmp, so)
+            # drop stale builds of the same component
+            for f in os.listdir(_CACHE):
+                if f.startswith(f"lib{name}-") and f != os.path.basename(so):
+                    try:
+                        os.unlink(os.path.join(_CACHE, f))
+                    except OSError:
+                        pass
+        lib = ctypes.CDLL(so)
+        _loaded[name] = lib
+        return lib
+
+
+def available(name: str) -> bool:
+    try:
+        load(name)
+        return True
+    except Exception:
+        return False

@@ -1,0 +1,112 @@
+"""TCPStore binding (csrc/tcp_store.cpp) — C++ rendezvous KV store.
+
+API analog of the reference's ``paddle/phi/core/distributed/store/
+tcp_store.h:121`` as exposed to Python: ``TCPStore(host, port, is_master)``
+with ``set/get/add/wait``.  The launcher and elastic manager use it for
+cross-host rendezvous before ``jax.distributed``'s coordination service is
+up (and as the barrier primitive in CPU-sim multi-process tests).
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Optional
+
+from ..core import native
+
+
+def _lib():
+    lib = native.load("tcp_store")
+    lib.store_server_start.restype = ctypes.c_void_p
+    lib.store_server_start.argtypes = [ctypes.c_uint16]
+    lib.store_server_stop.argtypes = [ctypes.c_void_p]
+    lib.store_connect.restype = ctypes.c_int
+    lib.store_connect.argtypes = [ctypes.c_char_p, ctypes.c_uint16, ctypes.c_int]
+    lib.store_set.restype = ctypes.c_int64
+    lib.store_set.argtypes = [ctypes.c_int, ctypes.c_char_p, ctypes.c_char_p,
+                              ctypes.c_uint32]
+    lib.store_get.restype = ctypes.c_int64
+    lib.store_get.argtypes = [ctypes.c_int, ctypes.c_char_p, ctypes.c_char_p,
+                              ctypes.c_uint32, ctypes.POINTER(ctypes.c_uint32)]
+    lib.store_add.restype = ctypes.c_int64
+    lib.store_add.argtypes = [ctypes.c_int, ctypes.c_char_p, ctypes.c_int64]
+    lib.store_wait.restype = ctypes.c_int64
+    lib.store_wait.argtypes = [ctypes.c_int, ctypes.c_char_p, ctypes.c_char_p,
+                               ctypes.c_uint32, ctypes.POINTER(ctypes.c_uint32)]
+    lib.store_close.argtypes = [ctypes.c_int]
+    return lib
+
+
+class TCPStore:
+    """``paddle.distributed.TCPStore``-compatible rendezvous store."""
+
+    _MAX_VALUE = 1 << 20
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 6170,
+                 is_master: bool = False, world_size: int = 1,
+                 timeout: float = 30.0):
+        self._lib = _lib()
+        self._server = None
+        if is_master:
+            self._server = self._lib.store_server_start(port)
+            if not self._server:
+                raise OSError(f"TCPStore: cannot bind port {port}")
+        self._fd = self._lib.store_connect(
+            host.encode(), port, int(timeout * 1000))
+        if self._fd < 0:
+            raise OSError(f"TCPStore: connect failed ({self._fd})")
+        self._buf = ctypes.create_string_buffer(self._MAX_VALUE)
+
+    def set(self, key: str, value) -> None:
+        if isinstance(value, str):
+            value = value.encode()
+        rc = self._lib.store_set(self._fd, key.encode(), value, len(value))
+        if rc != 0:
+            raise OSError(f"TCPStore.set failed ({rc})")
+
+    def get(self, key: str) -> Optional[bytes]:
+        n = ctypes.c_uint32(0)
+        rc = self._lib.store_get(self._fd, key.encode(), self._buf,
+                                 self._MAX_VALUE, ctypes.byref(n))
+        if rc == -2:  # -ENOENT
+            return None
+        if rc != 0:
+            raise OSError(f"TCPStore.get failed ({rc})")
+        return self._buf.raw[:n.value]
+
+    def add(self, key: str, amount: int = 1) -> int:
+        rc = self._lib.store_add(self._fd, key.encode(), amount)
+        if rc < 0:
+            raise OSError(f"TCPStore.add failed ({rc})")
+        return int(rc)
+
+    def wait(self, key: str) -> bytes:
+        """Block until ``key`` exists; returns its value."""
+        n = ctypes.c_uint32(0)
+        rc = self._lib.store_wait(self._fd, key.encode(), self._buf,
+                                  self._MAX_VALUE, ctypes.byref(n))
+        if rc != 0:
+            raise OSError(f"TCPStore.wait failed ({rc})")
+        return self._buf.raw[:n.value]
+
+    def barrier(self, name: str, world_size: int):
+        """All-processes barrier built from add + wait."""
+        arrived = self.add(f"__barrier/{name}", 1)
+        if arrived == world_size:
+            self.set(f"__barrier/{name}/go", b"1")
+        else:
+            self.wait(f"__barrier/{name}/go")
+
+    def close(self):
+        if self._fd is not None and self._fd >= 0:
+            self._lib.store_close(self._fd)
+            self._fd = -1
+        if self._server:
+            self._lib.store_server_stop(self._server)
+            self._server = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -75,6 +75,7 @@ class DataLoader:
         self.num_workers = num_workers
         self.prefetch_factor = max(prefetch_factor, 2)
         self.use_buffer_reader = use_buffer_reader
+        self.use_shared_memory = use_shared_memory
         self.worker_init_fn = worker_init_fn
         self._iterable = isinstance(dataset, IterableDataset)
         if self._iterable:
@@ -96,6 +97,13 @@ class DataLoader:
     def _fetch(self, indices):
         return self.collate_fn([self.dataset[i] for i in indices])
 
+    def _use_shm(self) -> bool:
+        if self._iterable or not self.use_shared_memory:
+            return False
+        from .shm_ring import native_available
+
+        return native_available()
+
     def _batches_iterable(self):
         it = iter(self.dataset)
         while True:
@@ -110,10 +118,22 @@ class DataLoader:
         if self._iterable:
             yield from self._batches_iterable()
             return
-        if self.num_workers > 0:
-            # keep a persistent thread pool: dataset access + collate run
-            # concurrently with device compute (shared-memory queue analog)
-            if self._pool is None:
+        if self.num_workers > 0 and self._use_shm():
+            # true multiprocess workers over the C++ shared-memory ring
+            # (io/dataloader/worker.py analog; GIL-free fetch+collate)
+            from .worker_pool import ShmWorkerPool
+
+            if self._pool is None or not isinstance(self._pool, ShmWorkerPool):
+                self._pool = ShmWorkerPool(
+                    self.dataset, self.collate_fn, self.num_workers,
+                    worker_init_fn=self.worker_init_fn)
+            batches = list(self.batch_sampler)
+            for seq, indices in enumerate(batches):
+                self._pool.submit(seq, indices)
+            yield from self._pool.results(len(batches))
+        elif self.num_workers > 0:
+            # thread-pool fallback (no native build / user opt-out)
+            if not isinstance(self._pool, ThreadPoolExecutor):
                 self._pool = ThreadPoolExecutor(max_workers=self.num_workers)
             futures = []
             sampler_it = iter(self.batch_sampler)

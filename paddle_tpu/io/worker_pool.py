@@ -1,0 +1,160 @@
+"""Multiprocess DataLoader worker pool over the C++ shared-memory ring.
+
+Capability analog of ``python/paddle/io/dataloader/worker.py`` (worker loop)
++ the reference's shared-memory tensor channel: forked worker processes
+fetch+collate batches and push them through :class:`ShmRing`; the consumer
+reorders by sequence id so iteration order matches the sampler regardless
+of worker scheduling.  Tiny control messages (tasks, errors, oversize
+batches) ride a normal mp.Queue — only the bulk array bytes take the ring.
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import pickle
+import struct
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .shm_ring import ShmRing, _pack, _unpack
+
+_ARRAY = "__nd__"
+
+
+def _tree_flatten(obj, arrays: List[np.ndarray]):
+    if isinstance(obj, np.ndarray):
+        arrays.append(obj)
+        return (_ARRAY, len(arrays) - 1)
+    if isinstance(obj, (list, tuple)):
+        return (type(obj).__name__, [_tree_flatten(o, arrays) for o in obj])
+    if isinstance(obj, dict):
+        return ("dict", {k: _tree_flatten(v, arrays) for k, v in obj.items()})
+    return ("leaf", obj)
+
+
+def _tree_unflatten(desc, arrays: List[np.ndarray]):
+    tag, val = desc
+    if tag == _ARRAY:
+        return arrays[val]
+    if tag in ("list", "tuple"):
+        seq = [_tree_unflatten(d, arrays) for d in val]
+        return seq if tag == "list" else tuple(seq)
+    if tag == "dict":
+        return {k: _tree_unflatten(d, arrays) for k, d in val.items()}
+    return val
+
+
+def _frame(seq: int, batch) -> bytes:
+    arrays: List[np.ndarray] = []
+    desc = _tree_flatten(batch, arrays)
+    payload = pickle.dumps((seq, desc))
+    body = _pack(arrays)
+    return struct.pack("<I", len(payload)) + payload + body
+
+
+def _unframe(buf: bytes) -> Tuple[int, Any]:
+    (plen,) = struct.unpack_from("<I", buf, 0)
+    seq, desc = pickle.loads(buf[4:4 + plen])
+    arrays = _unpack(memoryview(buf)[4 + plen:])
+    return seq, _tree_unflatten(desc, arrays)
+
+
+def _worker_loop(dataset, collate_fn, task_q, ctrl_q, ring_name,
+                 worker_id, num_workers, worker_init_fn):
+    from . import dataloader as dl_mod
+
+    dl_mod._worker_info = dl_mod.WorkerInfo(worker_id, num_workers, dataset)
+    if worker_init_fn is not None:
+        worker_init_fn(worker_id)
+    ring = ShmRing(ring_name, create=False)
+    while True:
+        task = task_q.get()
+        if task is None:
+            break
+        seq, indices = task
+        try:
+            batch = collate_fn([dataset[i] for i in indices])
+            data = _frame(seq, batch)
+            try:
+                ring.push_bytes(data)
+            except OSError:
+                # oversize for the ring slot — fall back to the control queue
+                ctrl_q.put(("big", seq, data))
+                continue
+            ctrl_q.put(("ring", seq, None))
+        except Exception as e:  # propagate to the consumer
+            ctrl_q.put(("err", seq, pickle.dumps(e)))
+
+
+class ShmWorkerPool:
+    """Ordered multi-process fetch pool (consumer side)."""
+
+    _counter = 0
+
+    def __init__(self, dataset, collate_fn, num_workers: int,
+                 n_slots: int = 8, slot_size: int = 64 * 1024 * 1024,
+                 worker_init_fn: Optional[Callable] = None):
+        ShmWorkerPool._counter += 1
+        name = f"pt_dl_{mp.current_process().pid}_{ShmWorkerPool._counter}"
+        self.ring = ShmRing(name, n_slots=n_slots, slot_size=slot_size)
+        ctx = mp.get_context("fork")
+        self.task_q = ctx.Queue()
+        self.ctrl_q = ctx.Queue()
+        self.workers = [
+            ctx.Process(target=_worker_loop,
+                        args=(dataset, collate_fn, self.task_q, self.ctrl_q,
+                              name, w, num_workers, worker_init_fn),
+                        daemon=True)
+            for w in range(num_workers)
+        ]
+        for w in self.workers:
+            w.start()
+        self._num_workers = num_workers
+        self._closed = False
+
+    def submit(self, seq: int, indices):
+        self.task_q.put((seq, indices))
+
+    def results(self, total: int):
+        """Yield batches for seq 0..total-1 in order."""
+        pending: Dict[int, Any] = {}
+        ready: Dict[int, Any] = {}
+        next_seq = 0
+        received = 0
+        while next_seq < total:
+            while next_seq in ready:
+                yield ready.pop(next_seq)
+                next_seq += 1
+            if received >= total and next_seq >= total:
+                break
+            if next_seq >= total:
+                break
+            kind, seq, payload = self.ctrl_q.get()
+            received += 1
+            if kind == "err":
+                self.shutdown()
+                raise pickle.loads(payload)
+            if kind == "big":
+                got_seq, batch = _unframe(payload)
+            else:
+                got_seq, batch = _unframe(self.ring.pop_bytes())
+            ready[got_seq] = batch
+
+    def shutdown(self):
+        if self._closed:
+            return
+        self._closed = True
+        for _ in self.workers:
+            self.task_q.put(None)
+        for w in self.workers:
+            w.join(timeout=2)
+            if w.is_alive():
+                w.terminate()
+        self.ring.close()
+
+    def __del__(self):
+        try:
+            self.shutdown()
+        except Exception:
+            pass

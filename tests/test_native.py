@@ -1,0 +1,160 @@
+"""Native C++ components: shm ring, TCPStore, and the multiprocess
+DataLoader path built on them (the reference's native runtime analogs)."""
+
+import multiprocessing as mp
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.core import native
+from paddle_tpu.distributed.store import TCPStore
+from paddle_tpu.io.shm_ring import ShmRing, native_available
+
+
+pytestmark = pytest.mark.skipif(not native_available(),
+                                reason="g++ native build unavailable")
+
+
+def _push_batches(name):
+    ring = ShmRing(name, create=False)
+    for i in range(5):
+        ring.push_arrays([np.full((4, 4), i, "float32")])
+
+
+class TestShmRing:
+    def test_roundtrip_mixed_dtypes(self):
+        r = ShmRing("t_ring_a", n_slots=4, slot_size=1 << 20)
+        try:
+            a = np.random.randn(8, 32).astype("float32")
+            b = np.arange(10, dtype="int64")
+            c = np.asarray(3.5, dtype="float64")  # 0-d
+            r.push_arrays([a, b, c])
+            out = r.pop_arrays()
+            np.testing.assert_array_equal(out[0], a)
+            np.testing.assert_array_equal(out[1], b)
+            assert out[2] == c
+        finally:
+            r.close()
+
+    def test_cross_process(self):
+        r = ShmRing("t_ring_b", n_slots=4, slot_size=1 << 20)
+        try:
+            p = mp.get_context("fork").Process(target=_push_batches,
+                                               args=("t_ring_b",))
+            p.start()
+            vals = [int(r.pop_arrays(timeout_ms=10000)[0][0, 0])
+                    for _ in range(5)]
+            p.join()
+            assert vals == [0, 1, 2, 3, 4]
+        finally:
+            r.close()
+
+    def test_backpressure_blocks_then_drains(self):
+        r = ShmRing("t_ring_c", n_slots=2, slot_size=1 << 16)
+        try:
+            r.push_arrays([np.ones(4)])
+            r.push_arrays([np.ones(4)])
+            t0 = time.time()
+            with pytest.raises(OSError):  # -ETIMEDOUT surfaces as OSError
+                r.push_bytes(b"x" * 16, timeout_ms=200)
+            assert time.time() - t0 >= 0.15
+            r.pop_arrays()
+            r.push_arrays([np.ones(4)])  # space again
+            assert r.qsize() == 2
+        finally:
+            r.close()
+
+    def test_oversize_message_rejected(self):
+        r = ShmRing("t_ring_d", n_slots=2, slot_size=1024)
+        try:
+            with pytest.raises(OSError):
+                r.push_bytes(b"x" * 4096)
+        finally:
+            r.close()
+
+
+class TestTCPStore:
+    def test_set_get_add(self):
+        m = TCPStore("127.0.0.1", 29871, is_master=True)
+        c = TCPStore("127.0.0.1", 29871)
+        try:
+            m.set("k", b"v1")
+            assert c.get("k") == b"v1"
+            assert c.get("absent") is None
+            assert c.add("n", 2) == 2
+            assert m.add("n", 40) == 42
+        finally:
+            c.close()
+            m.close()
+
+    def test_wait_blocks_until_set(self):
+        m = TCPStore("127.0.0.1", 29872, is_master=True)
+        c = TCPStore("127.0.0.1", 29872)
+        got = []
+        try:
+            t = threading.Thread(target=lambda: got.append(c.wait("late")))
+            t.start()
+            time.sleep(0.2)
+            assert got == []
+            m.set("late", b"now")
+            t.join(5)
+            assert got == [b"now"]
+        finally:
+            c.close()
+            m.close()
+
+    def test_barrier(self):
+        m = TCPStore("127.0.0.1", 29873, is_master=True)
+        cs = [TCPStore("127.0.0.1", 29873) for _ in range(2)]
+        done = []
+        try:
+            ts = [threading.Thread(target=lambda s=s, i=i: (
+                s.barrier("b", 3), done.append(i)))
+                for i, s in enumerate([m] + cs)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(5)
+            assert sorted(done) == [0, 1, 2]
+        finally:
+            for s in cs:
+                s.close()
+            m.close()
+
+
+class TestShmDataLoader:
+    def test_multiprocess_loader_order_and_content(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.io import DataLoader, Dataset
+
+        class DS(Dataset):
+            def __len__(self):
+                return 32
+
+            def __getitem__(self, i):
+                return np.full((3,), i, "float32"), np.int64(i)
+
+        dl = DataLoader(DS(), batch_size=4, num_workers=2, shuffle=False)
+        seen = []
+        for x, y in dl:
+            assert x.shape == [4, 3]
+            seen.extend(y.numpy().tolist())
+        assert seen == list(range(32))  # sampler order preserved
+
+    def test_worker_exception_propagates(self):
+        from paddle_tpu.io import DataLoader, Dataset
+
+        class Bad(Dataset):
+            def __len__(self):
+                return 8
+
+            def __getitem__(self, i):
+                if i == 5:
+                    raise ValueError("boom at 5")
+                return np.zeros(2, "float32")
+
+        dl = DataLoader(Bad(), batch_size=2, num_workers=2)
+        with pytest.raises(ValueError, match="boom"):
+            list(dl)

@@ -57,9 +57,13 @@ from . import autograd  # noqa: F401
 from . import device  # noqa: F401
 from . import distributed  # noqa: F401
 from . import distribution  # noqa: F401
+from . import fft  # noqa: F401
+from . import incubate  # noqa: F401
 from . import io  # noqa: F401
 from . import jit  # noqa: F401
 from . import metric  # noqa: F401
+from . import models  # noqa: F401
+from . import quantization  # noqa: F401
 from . import nn  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import profiler  # noqa: F401

@@ -1,0 +1,68 @@
+"""``paddle.fft`` — FFT family over XLA's FFT (pocketfft analog in the
+reference, ``python/paddle/fft.py``).  All ops route through run_op so
+gradients record on the tape."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .core.dispatch import run_op
+from .core.tensor import Tensor, to_tensor
+
+
+def _ensure(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def _op(name, fn):
+    def op(x, n=None, axis=-1, norm="backward", name_=None):
+        return run_op(name, lambda v: fn(v, n=n, axis=axis, norm=norm),
+                      _ensure(x))
+
+    op.__name__ = name
+    return op
+
+
+fft = _op("fft", jnp.fft.fft)
+ifft = _op("ifft", jnp.fft.ifft)
+rfft = _op("rfft", jnp.fft.rfft)
+irfft = _op("irfft", jnp.fft.irfft)
+hfft = _op("hfft", jnp.fft.hfft)
+ihfft = _op("ihfft", jnp.fft.ihfft)
+
+
+def _opn(name, fn):
+    def op(x, s=None, axes=None, norm="backward", name_=None):
+        if axes is None:
+            axes = (-2, -1) if name.endswith("2") else None
+        return run_op(name, lambda v: fn(v, s=s, axes=axes, norm=norm),
+                      _ensure(x))
+
+    op.__name__ = name
+    return op
+
+
+fft2 = _opn("fft2", jnp.fft.fft2)
+ifft2 = _opn("ifft2", jnp.fft.ifft2)
+rfft2 = _opn("rfft2", jnp.fft.rfft2)
+irfft2 = _opn("irfft2", jnp.fft.irfft2)
+fftn = _opn("fftn", jnp.fft.fftn)
+ifftn = _opn("ifftn", jnp.fft.ifftn)
+rfftn = _opn("rfftn", jnp.fft.rfftn)
+irfftn = _opn("irfftn", jnp.fft.irfftn)
+
+
+def fftshift(x, axes=None, name=None):
+    return run_op("fftshift", lambda v: jnp.fft.fftshift(v, axes=axes), _ensure(x))
+
+
+def ifftshift(x, axes=None, name=None):
+    return run_op("ifftshift", lambda v: jnp.fft.ifftshift(v, axes=axes), _ensure(x))
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    return Tensor(jnp.fft.fftfreq(n, d))
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    return Tensor(jnp.fft.rfftfreq(n, d))

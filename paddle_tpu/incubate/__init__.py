@@ -1,0 +1,7 @@
+"""``paddle.incubate`` namespace — fused-op layer APIs.
+
+The reference's incubate tree holds the fused transformer building blocks
+(``python/paddle/incubate/nn``); here each maps to the Pallas/XLA fused path.
+"""
+
+from . import nn  # noqa: F401

@@ -1,0 +1,128 @@
+"""Fused layers (``python/paddle/incubate/nn`` analog).
+
+Each wraps the TPU fused path: flash attention (Pallas), fused rope,
+fused rms-norm — the APIs the reference backs with hand-written CUDA
+(``fluid/operators/fused/``, ``phi/kernels/fusion/gpu/``); XLA fusion plus
+the Pallas kernels supply the performance here.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ...core.dispatch import run_op
+from ...core.tensor import Tensor
+from ...nn import functional as F
+from ...nn.layers import Layer
+from .functional import (  # noqa: F401
+    fused_dropout_add,
+    fused_linear,
+    fused_rms_norm,
+    fused_rotary_position_embedding,
+    memory_efficient_attention,
+)
+
+
+class FusedMultiHeadAttention(Layer):
+    """(incubate/nn/layer/fused_transformer.py FusedMultiHeadAttention
+    analog) pre/post-LN attention block with the fused attention path."""
+
+    def __init__(self, embed_dim, num_heads, dropout_rate=0.0,
+                 attn_dropout_rate=0.0, normalize_before=False,
+                 need_weights=False, weight_attr=None, bias_attr=None,
+                 epsilon=1e-5):
+        super().__init__()
+        from ...nn.common import Dropout, Linear
+        from ...nn.norm import LayerNorm
+
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.normalize_before = normalize_before
+        self.qkv_proj = Linear(embed_dim, 3 * embed_dim, weight_attr,
+                               bias_attr=bias_attr)
+        self.out_proj = Linear(embed_dim, embed_dim, weight_attr,
+                               bias_attr=bias_attr)
+        self.norm = LayerNorm(embed_dim, epsilon=epsilon)
+        self.dropout = Dropout(dropout_rate)
+
+    def forward(self, x, attn_mask=None):
+        residual = x
+        if self.normalize_before:
+            x = self.norm(x)
+        qkv = self.qkv_proj(x)
+        B, S = x.shape[0], x.shape[1]
+        n, d = self.num_heads, self.head_dim
+
+        def attn(qkv_v, *mask):
+            q, k, v = jnp.split(qkv_v.reshape(B, S, 3, n, d), 3, axis=2)
+            q, k, v = q[:, :, 0], k[:, :, 0], v[:, :, 0]
+            from ...ops.flash_attention import flash_attention_fwd
+
+            if mask:
+                logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(
+                    jnp.asarray(d, q.dtype))
+                logits = logits + mask[0]
+                p = jnp.exp(logits - logits.max(-1, keepdims=True))
+                p = p / p.sum(-1, keepdims=True)
+                out = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+            else:
+                out = flash_attention_fwd(q, k, v, causal=False)
+            return out.reshape(B, S, n * d)
+
+        args = [qkv]
+        if attn_mask is not None:
+            args.append(attn_mask)
+        ctx = run_op("fused_mha", attn, *args)
+        out = residual + self.dropout(self.out_proj(ctx))
+        if not self.normalize_before:
+            out = self.norm(out)
+        return out
+
+
+class FusedFeedForward(Layer):
+    """(FusedFeedForward analog) LN + linear-act-linear + residual."""
+
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.0,
+                 activation="relu", normalize_before=False, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        from ...nn.common import Dropout, Linear
+        from ...nn.norm import LayerNorm
+
+        self.normalize_before = normalize_before
+        self.linear1 = Linear(d_model, dim_feedforward, weight_attr, bias_attr)
+        self.linear2 = Linear(dim_feedforward, d_model, weight_attr, bias_attr)
+        self.norm = LayerNorm(d_model, epsilon=epsilon)
+        self.dropout = Dropout(dropout_rate)
+        self.activation = activation
+
+    def forward(self, x):
+        residual = x
+        if self.normalize_before:
+            x = self.norm(x)
+        act = getattr(F, self.activation)
+        out = residual + self.dropout(self.linear2(act(self.linear1(x))))
+        if not self.normalize_before:
+            out = self.norm(out)
+        return out
+
+
+class FusedTransformerEncoderLayer(Layer):
+    """(FusedTransformerEncoderLayer analog)."""
+
+    def __init__(self, d_model, nhead, dim_feedforward, dropout_rate=0.1,
+                 activation="relu", attn_dropout_rate=None,
+                 act_dropout_rate=None, normalize_before=False):
+        super().__init__()
+        self.fused_attn = FusedMultiHeadAttention(
+            d_model, nhead, dropout_rate,
+            attn_dropout_rate or dropout_rate,
+            normalize_before=normalize_before)
+        self.ffn = FusedFeedForward(
+            d_model, dim_feedforward, dropout_rate, activation,
+            normalize_before=normalize_before)
+
+    def forward(self, src, src_mask=None):
+        return self.ffn(self.fused_attn(src, src_mask))

@@ -1,0 +1,129 @@
+"""Fused functional ops (``incubate/nn/functional`` analog).
+
+Cites: fused_rms_norm → ``phi/kernels/fusion/gpu`` rms_norm kernel;
+fused_rotary_position_embedding → ``fused_rope``; memory_efficient_attention
+→ ``phi/kernels/fusion/cutlass/memory_efficient_attention``.  On TPU these
+are jnp compositions XLA fuses into single kernels (plus the Pallas flash
+path for attention) — the API surface is what we owe the reference.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import run_op
+from ...core.tensor import Tensor, to_tensor
+
+
+def _ensure(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6,
+                   begin_norm_axis=-1, residual=None):
+    """RMS norm (+ optional residual add) as one fused op."""
+    args = [_ensure(x), _ensure(norm_weight)]
+    has_bias = norm_bias is not None
+    has_res = residual is not None
+    if has_bias:
+        args.append(_ensure(norm_bias))
+    if has_res:
+        args.append(_ensure(residual))
+
+    def f(xv, wv, *rest):
+        i = 0
+        bias = rest[i] if has_bias else None
+        i += int(has_bias)
+        res = rest[i] if has_res else None
+        if res is not None:
+            xv = xv + res
+        var = jnp.mean(jnp.square(xv.astype(jnp.float32)), axis=-1,
+                       keepdims=True)
+        out = (xv * jax.lax.rsqrt(var + epsilon).astype(xv.dtype)) * wv
+        if bias is not None:
+            out = out + bias
+        return out
+
+    return run_op("fused_rms_norm", f, *args)
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None, use_neox_rotary_style=True):
+    """Fused RoPE over [B, S, H, D] (fused_rope kernel analog)."""
+    outs = []
+    for t in (q, k, v):
+        if t is None:
+            outs.append(None)
+            continue
+        tt = _ensure(t)
+        S, D = tt.shape[1], tt.shape[3]
+        if cos is None:
+            inv = 1.0 / (10000.0 ** (jnp.arange(0, D, 2) / D))
+            ang = jnp.outer(jnp.arange(S), inv)
+            c, s = jnp.cos(ang), jnp.sin(ang)
+        else:
+            c = jnp.asarray(cos._value if isinstance(cos, Tensor) else cos)
+            s = jnp.asarray(sin._value if isinstance(sin, Tensor) else sin)
+            c = c.reshape(S, -1)[:, : D // 2]
+            s = s.reshape(S, -1)[:, : D // 2]
+
+        def rope(x, c=c, s=s):
+            d2 = x.shape[-1] // 2
+            if use_neox_rotary_style:
+                x1, x2 = x[..., :d2], x[..., d2:]
+            else:
+                x1, x2 = x[..., 0::2], x[..., 1::2]
+            cc = c[None, :, None, :].astype(x.dtype)
+            ss = s[None, :, None, :].astype(x.dtype)
+            o1 = x1 * cc - x2 * ss
+            o2 = x2 * cc + x1 * ss
+            if use_neox_rotary_style:
+                return jnp.concatenate([o1, o2], axis=-1)
+            out = jnp.stack([o1, o2], axis=-1)
+            return out.reshape(x.shape)
+
+        outs.append(run_op("fused_rope", rope, tt))
+    return tuple(outs)
+
+
+def memory_efficient_attention(query, key, value, attn_bias=None, p=0.0,
+                               scale=None, training=True):
+    """Memory-efficient attention (cutlass kernel analog → Pallas/XLA)."""
+    from ...ops.flash_attention import flash_attention_fwd
+
+    q, k, v = _ensure(query), _ensure(key), _ensure(value)
+    if attn_bias is None:
+        return run_op("mem_eff_attention",
+                      lambda a, b, c: flash_attention_fwd(a, b, c, causal=False),
+                      q, k, v)
+
+    def f(qv, kv, vv, bias):
+        import math
+
+        d = qv.shape[-1]
+        sc = scale or 1.0 / math.sqrt(d)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", qv, kv) * sc + bias
+        p_ = jax.nn.softmax(logits, axis=-1)
+        return jnp.einsum("bhqk,bkhd->bqhd", p_.astype(vv.dtype), vv)
+
+    return run_op("mem_eff_attention", f, q, k, v, _ensure(attn_bias))
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False):
+    """GEMM-epilogue fusion analog (cublasLt fused_gemm_epilogue)."""
+    from ...nn import functional as F
+
+    w = _ensure(weight)
+    if transpose_weight:
+        w = run_op("transpose", lambda v: v.T, w)
+    return F.linear(_ensure(x), w, bias)
+
+
+def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train"):
+    """dropout(x) + y as one op (fused_dropout_add kernel analog)."""
+    from ...nn import functional as F
+
+    return F.dropout(_ensure(x), p=p, training=training, mode=mode) + _ensure(y)

@@ -1,0 +1,193 @@
+"""Quantization: config-driven QAT (fake-quant + STE) and PTQ (observers).
+
+Capability analog of ``python/paddle/quantization`` (``qat.py`` QAT wrapper
+insertion, ``ptq.py`` observer collection, imperative quant-aware layers).
+
+TPU-first notes: int8 storage with bf16/f32 compute is the useful TPU mode
+(HBM-bandwidth relief — weights dequantize on the fly in VMEM); fake-quant
+uses a straight-through estimator via ``jax.custom_vjp`` so QAT training
+stays one fused XLA program.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional, Type
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import run_op
+from ..core.tensor import Tensor
+from ..nn.layers import Layer
+
+
+# ---------------------------------------------------------------------------
+# fake quant primitive (STE)
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def fake_quant(x, scale, bits=8):
+    """Simulated symmetric quantization: round(x/Δ)·Δ with Δ=scale/qmax."""
+    qmax = 2.0 ** (bits - 1) - 1
+    delta = jnp.maximum(scale / qmax, 1e-9)
+    return jnp.clip(jnp.round(x / delta), -qmax - 1, qmax) * delta
+
+
+def _fq_fwd(x, scale, bits):
+    return fake_quant(x, scale, bits), (x, scale)
+
+
+def _fq_bwd(bits, res, g):
+    x, scale = res
+    # STE inside the representable range, zero outside
+    qmax = 2.0 ** (bits - 1) - 1
+    delta = jnp.maximum(scale / qmax, 1e-9)
+    inside = (jnp.abs(x) <= scale).astype(g.dtype)
+    return g * inside, jnp.zeros_like(scale)
+
+
+fake_quant.defvjp(_fq_fwd, _fq_bwd)
+
+
+def quantize_to_int8(w) -> tuple:
+    """Real int8 conversion for deployment: returns (int8 values, scale)."""
+    scale = jnp.max(jnp.abs(w))
+    qmax = 127.0
+    delta = jnp.maximum(scale / qmax, 1e-9)
+    q = jnp.clip(jnp.round(w / delta), -128, 127).astype(jnp.int8)
+    return q, delta
+
+
+def dequantize(q, delta, dtype=jnp.float32):
+    return q.astype(dtype) * delta
+
+
+# ---------------------------------------------------------------------------
+# observers
+# ---------------------------------------------------------------------------
+
+class AbsmaxObserver:
+    """Running abs-max activation observer (PTQ calibration)."""
+
+    def __init__(self):
+        self.scale = 0.0
+
+    def observe(self, x: Tensor):
+        import numpy as np
+
+        v = float(np.max(np.abs(np.asarray(x._value))))
+        self.scale = max(self.scale, v)
+
+
+class EMAObserver(AbsmaxObserver):
+    """Exponential-moving-average abs-max (QAT activation ranges)."""
+
+    def __init__(self, momentum: float = 0.9):
+        super().__init__()
+        self.momentum = momentum
+
+    def observe(self, x: Tensor):
+        import numpy as np
+
+        v = float(np.max(np.abs(np.asarray(x._value))))
+        self.scale = v if self.scale == 0.0 else (
+            self.momentum * self.scale + (1 - self.momentum) * v)
+
+
+# ---------------------------------------------------------------------------
+# quantized layer wrappers
+# ---------------------------------------------------------------------------
+
+class QuantedLinear(Layer):
+    """Linear with fake-quantized weight + activation (qat wrapper analog)."""
+
+    def __init__(self, inner, bits: int = 8, quant_input: bool = True):
+        super().__init__()
+        self.inner = inner
+        self.bits = bits
+        self.quant_input = quant_input
+        self.act_observer = EMAObserver()
+
+    def forward(self, x):
+        from ..nn import functional as F
+
+        w = self.inner.weight
+        wq = run_op("fake_quant_w",
+                    lambda wv: fake_quant(wv, jnp.max(jnp.abs(wv)), self.bits),
+                    w)
+        if self.quant_input:
+            if not isinstance(x._value, jax.core.Tracer):
+                self.act_observer.observe(x)
+            s = self.act_observer.scale
+            if s > 0:
+                x = run_op("fake_quant_a",
+                           lambda xv: fake_quant(xv, jnp.asarray(s, xv.dtype),
+                                                 self.bits), x)
+        return F.linear(x, wq, self.inner.bias)
+
+
+class QuantConfig:
+    """(``quantization/config.py`` analog) which layer types to quantize."""
+
+    def __init__(self, activation=None, weight=None, bits: int = 8):
+        self.bits = bits
+        self._types: List[Type[Layer]] = []
+
+    def add_type_config(self, layer_type, activation=None, weight=None):
+        self._types.append(layer_type)
+        return self
+
+    def types(self):
+        from ..nn.common import Linear
+
+        return self._types or [Linear]
+
+
+class QAT:
+    """Quantization-aware training driver (``qat.py`` analog):
+    ``quantize`` swaps target layers for fake-quant wrappers in-place;
+    ``convert`` bakes real int8 weights for deployment."""
+
+    def __init__(self, config: Optional[QuantConfig] = None):
+        self.config = config or QuantConfig()
+
+    def quantize(self, model: Layer, inplace: bool = True) -> Layer:
+        targets = tuple(self.config.types())
+        for name, sub in list(model._sub_layers.items()):
+            if isinstance(sub, targets):
+                model._sub_layers[name] = QuantedLinear(sub, self.config.bits)
+            else:
+                self.quantize(sub, inplace=True)
+        return model
+
+    def convert(self, model: Layer, inplace: bool = True) -> Layer:
+        """Replace fake-quant wrappers with int8-weight layers."""
+        for name, sub in list(model._sub_layers.items()):
+            if isinstance(sub, QuantedLinear):
+                inner = sub.inner
+                q, delta = quantize_to_int8(inner.weight._value)
+                inner.weight._value = dequantize(q, delta,
+                                                 inner.weight._value.dtype)
+                inner._int8_weight = q
+                inner._weight_scale = delta
+                model._sub_layers[name] = inner
+            else:
+                self.convert(sub, inplace=True)
+        return model
+
+
+class PTQ:
+    """Post-training quantization: observe activations on calibration data,
+    then convert (``ptq.py`` analog)."""
+
+    def __init__(self, config: Optional[QuantConfig] = None):
+        self.config = config or QuantConfig()
+        self._observers: Dict[int, AbsmaxObserver] = {}
+
+    def quantize(self, model: Layer, inplace: bool = True) -> Layer:
+        qat = QAT(self.config)
+        return qat.quantize(model)
+
+    def convert(self, model: Layer, inplace: bool = True) -> Layer:
+        return QAT(self.config).convert(model)

@@ -1,0 +1,146 @@
+"""fft, quantization, incubate fused layers (SURVEY.md §2.2 coverage)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import fft, nn
+from paddle_tpu.incubate.nn import (
+    FusedMultiHeadAttention,
+    FusedTransformerEncoderLayer,
+)
+from paddle_tpu.incubate.nn.functional import (
+    fused_rms_norm,
+    fused_rotary_position_embedding,
+    memory_efficient_attention,
+)
+from paddle_tpu.quantization import QAT, PTQ, QuantConfig, fake_quant
+
+
+class TestFFT:
+    def test_fft_matches_numpy(self):
+        x = np.random.randn(8, 16).astype("float32")
+        out = fft.fft(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(out, np.fft.fft(x), rtol=1e-4, atol=1e-4)
+
+    def test_rfft_irfft_roundtrip(self):
+        x = np.random.randn(4, 32).astype("float32")
+        y = fft.irfft(fft.rfft(paddle.to_tensor(x)), n=32).numpy()
+        np.testing.assert_allclose(y, x, rtol=1e-4, atol=1e-5)
+
+    def test_fft2_and_shift(self):
+        x = np.random.randn(8, 8).astype("float32")
+        out = fft.fftshift(fft.fft2(paddle.to_tensor(x))).numpy()
+        np.testing.assert_allclose(out, np.fft.fftshift(np.fft.fft2(x)),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_fft_grad_flows(self):
+        x = paddle.to_tensor(np.random.randn(16).astype("float32"),
+                             stop_gradient=False)
+        y = fft.fft(x)
+        paddle.tensor.real(y).sum().backward()
+        assert x.grad is not None
+
+
+class TestQuantization:
+    def test_fake_quant_grid(self):
+        import jax.numpy as jnp
+
+        x = jnp.linspace(-1.0, 1.0, 11)
+        q = fake_quant(x, jnp.asarray(1.0), 8)
+        # values land on the int8 grid
+        grid = np.round(np.asarray(q) / (1.0 / 127)) * (1.0 / 127)
+        np.testing.assert_allclose(np.asarray(q), grid, atol=1e-7)
+
+    def test_fake_quant_ste_gradient(self):
+        import jax
+        import jax.numpy as jnp
+
+        g = jax.grad(lambda x: fake_quant(x, jnp.asarray(1.0), 8).sum())(
+            jnp.asarray([0.3, 2.0]))  # 2.0 is outside scale → grad 0
+        np.testing.assert_allclose(np.asarray(g), [1.0, 0.0])
+
+    def test_qat_insert_train_convert(self):
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+        q = QAT(QuantConfig())
+        q.quantize(net)
+        from paddle_tpu.quantization import QuantedLinear
+
+        assert isinstance(net[0], QuantedLinear)
+        x = paddle.to_tensor(np.random.randn(4, 8).astype("float32"))
+        out = net(x)
+        out.sum().backward()
+        assert net[0].inner.weight.grad is not None
+        # convert: wrappers removed, int8 payload attached
+        q.convert(net)
+        assert not isinstance(net[0], QuantedLinear)
+        assert net[0]._int8_weight.dtype == np.int8
+        # dequantized forward close to quantized-aware forward
+        out2 = net(x)
+        assert out2.shape == [4, 4]
+
+    def test_ptq_quantizes_from_calibration(self):
+        net = nn.Sequential(nn.Linear(8, 8))
+        ptq = PTQ()
+        ptq.quantize(net)
+        for _ in range(3):
+            net(paddle.to_tensor(np.random.randn(2, 8).astype("float32")))
+        assert net[0].act_observer.scale > 0
+        ptq.convert(net)
+        assert hasattr(net[0], "_int8_weight")
+
+
+class TestIncubateFused:
+    def test_fused_rms_norm_matches_layer(self):
+        from paddle_tpu.nn.norm import RMSNorm
+
+        x = paddle.to_tensor(np.random.randn(2, 8, 16).astype("float32"))
+        layer = RMSNorm(16)
+        ref = layer(x).numpy()
+        out = fused_rms_norm(x, layer.weight).numpy()
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+    def test_fused_rms_norm_residual(self):
+        x = paddle.to_tensor(np.random.randn(2, 4, 8).astype("float32"))
+        r = paddle.to_tensor(np.random.randn(2, 4, 8).astype("float32"))
+        w = paddle.ones([8])
+        out = fused_rms_norm(x, w, residual=r).numpy()
+        ref = fused_rms_norm(x + r, w).numpy()
+        np.testing.assert_allclose(out, ref, rtol=1e-6)
+
+    def test_fused_rope_matches_llama(self):
+        from paddle_tpu.models.llama import _apply_rope, _rope_tables
+
+        q = np.random.randn(1, 16, 2, 8).astype("float32")
+        (out,) = fused_rotary_position_embedding(
+            paddle.to_tensor(q), use_neox_rotary_style=True)[:1]
+        cos, sin = _rope_tables(8, 16, 10000.0)
+        ref = np.asarray(_apply_rope(q, cos, sin))
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5, atol=1e-5)
+
+    def test_memory_efficient_attention(self):
+        from paddle_tpu.ops.flash_attention import _reference_attention
+
+        q = np.random.randn(1, 16, 2, 8).astype("float32")
+        k = np.random.randn(1, 16, 2, 8).astype("float32")
+        v = np.random.randn(1, 16, 2, 8).astype("float32")
+        out = memory_efficient_attention(paddle.to_tensor(q),
+                                         paddle.to_tensor(k),
+                                         paddle.to_tensor(v)).numpy()
+        import jax.numpy as jnp
+
+        ref = np.asarray(_reference_attention(jnp.asarray(q), jnp.asarray(k),
+                                              jnp.asarray(v), False))
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+    def test_fused_encoder_layer_runs_and_grads(self):
+        layer = FusedTransformerEncoderLayer(d_model=16, nhead=2,
+                                             dim_feedforward=32,
+                                             dropout_rate=0.0)
+        x = paddle.to_tensor(np.random.randn(2, 8, 16).astype("float32"))
+        out = layer(x)
+        assert out.shape == [2, 8, 16]
+        out.sum().backward()
+        missing = [n for n, p in layer.named_parameters() if p.grad is None]
+        assert missing == []

@@ -1,0 +1,98 @@
+"""Vision Transformer (the model-zoo ViT the reference's vision ladder
+carries; built on the same fused attention path as the NLP stack)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ... import nn
+from ...core.dispatch import run_op
+from ...core.tensor import Tensor
+from ...nn.initializer import Constant, Normal, TruncatedNormal
+
+
+class PatchEmbed(nn.Layer):
+    """Image → patch tokens via strided conv (one MXU matmul per image)."""
+
+    def __init__(self, img_size=224, patch_size=16, in_chans=3, embed_dim=768):
+        super().__init__()
+        self.num_patches = (img_size // patch_size) ** 2
+        self.proj = nn.Conv2D(in_chans, embed_dim, kernel_size=patch_size,
+                              stride=patch_size)
+
+    def forward(self, x):
+        from ... import tensor as ops
+
+        x = self.proj(x)                       # [B, E, H/P, W/P]
+        B, E = x.shape[0], x.shape[1]
+        x = ops.reshape(x, [B, E, -1])
+        return ops.transpose(x, [0, 2, 1])     # [B, N, E]
+
+
+class ViTBlock(nn.Layer):
+    def __init__(self, dim, num_heads, mlp_ratio=4.0, qkv_bias=True,
+                 dropout=0.0, epsilon=1e-6):
+        super().__init__()
+        self.norm1 = nn.LayerNorm(dim, epsilon=epsilon)
+        self.attn = nn.MultiHeadAttention(dim, num_heads, dropout=dropout,
+                                          need_weights=False)
+        self.norm2 = nn.LayerNorm(dim, epsilon=epsilon)
+        hidden = int(dim * mlp_ratio)
+        self.mlp = nn.Sequential(nn.Linear(dim, hidden), nn.GELU(),
+                                 nn.Dropout(dropout), nn.Linear(hidden, dim),
+                                 nn.Dropout(dropout))
+
+    def forward(self, x):
+        h = self.norm1(x)
+        x = x + self.attn(h, h, h)
+        return x + self.mlp(self.norm2(x))
+
+
+class VisionTransformer(nn.Layer):
+    """ViT-B/16 defaults (class_num head, learned pos-emb + CLS token)."""
+
+    def __init__(self, img_size=224, patch_size=16, in_chans=3, class_num=1000,
+                 embed_dim=768, depth=12, num_heads=12, mlp_ratio=4.0,
+                 qkv_bias=True, drop_rate=0.0, epsilon=1e-6):
+        super().__init__()
+        self.class_num = class_num
+        self.patch_embed = PatchEmbed(img_size, patch_size, in_chans, embed_dim)
+        n = self.patch_embed.num_patches
+        self.cls_token = self.create_parameter(
+            [1, 1, embed_dim], default_initializer=Constant(0.0))
+        self.pos_embed = self.create_parameter(
+            [1, n + 1, embed_dim], default_initializer=TruncatedNormal(std=0.02))
+        self.pos_drop = nn.Dropout(drop_rate)
+        self.blocks = nn.LayerList([
+            ViTBlock(embed_dim, num_heads, mlp_ratio, qkv_bias, drop_rate,
+                     epsilon) for _ in range(depth)])
+        self.norm = nn.LayerNorm(embed_dim, epsilon=epsilon)
+        self.head = (nn.Linear(embed_dim, class_num)
+                     if class_num > 0 else None)
+
+    def forward(self, x):
+        import jax.numpy as jnp
+
+        x = self.patch_embed(x)
+        B = x.shape[0]
+
+        def cat_cls(tokens, cls, pos):
+            c = jnp.broadcast_to(cls, (B,) + tuple(cls.shape[1:]))
+            return jnp.concatenate([c, tokens], axis=1) + pos
+
+        x = run_op("vit_embed", cat_cls, x, self.cls_token, self.pos_embed)
+        x = self.pos_drop(x)
+        for blk in self.blocks:
+            x = blk(x)
+        x = self.norm(x)
+        if self.head is None:
+            return x
+        return self.head(x[:, 0])
+
+
+def vit_base_patch16_224(**kwargs):
+    return VisionTransformer(embed_dim=768, depth=12, num_heads=12, **kwargs)
+
+
+def vit_large_patch16_224(**kwargs):
+    return VisionTransformer(embed_dim=1024, depth=24, num_heads=16, **kwargs)

@@ -147,3 +147,38 @@ class TestMoELlama:
         assert isinstance(m.llama.layers[0].mlp.moe.gate, SwitchGate)
         ids = _ids(cfg, low=0)
         assert m(ids).shape == [2, 16, cfg.vocab_size]
+
+
+class TestVisionModels:
+    def test_mobilenet_v2_forward_backward(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.vision.models import mobilenet_v2
+
+        paddle.seed(0)
+        m = mobilenet_v2(num_classes=10)
+        x = paddle.to_tensor(np.random.randn(2, 3, 32, 32).astype("float32"))
+        out = m(x)
+        assert out.shape == [2, 10]
+        out.sum().backward()
+        convs = [p for n, p in m.named_parameters() if "conv" in n.lower() or "weight" in n]
+        assert any(p.grad is not None for p in convs)
+
+    def test_vit_forward_backward(self):
+        from paddle_tpu.vision.models import VisionTransformer
+
+        paddle.seed(0)
+        m = VisionTransformer(img_size=32, patch_size=8, embed_dim=32,
+                              depth=2, num_heads=2, class_num=5)
+        x = paddle.to_tensor(np.random.randn(2, 3, 32, 32).astype("float32"))
+        out = m(x)
+        assert out.shape == [2, 5]
+        out.sum().backward()
+        assert m.pos_embed.grad is not None
+        assert m.cls_token.grad is not None
+
+    def test_vgg_forward(self):
+        from paddle_tpu.vision.models import vgg11
+
+        m = vgg11(num_classes=7)
+        x = paddle.to_tensor(np.random.randn(1, 3, 224, 224).astype("float32"))
+        assert m(x).shape == [1, 7]

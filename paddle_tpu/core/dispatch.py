@@ -65,6 +65,24 @@ def mark_inputs(tensors):
         _capture_recorder.on_inputs(list(tensors))
 
 
+# Static-graph op observer (paddle.static Program recording): sees every
+# dispatched op as (fn, args, kwargs, result).  Installed by
+# paddle_tpu.static.program_guard.
+_op_observer = None
+
+
+def _set_op_observer(obs):
+    global _op_observer
+    _op_observer = obs
+
+
+def notify_rebind(wrapper, source):
+    """Tensor._rebind hook: tells an active static recorder that ``wrapper``
+    now carries ``source``'s value (in-place ops / optimizer updates)."""
+    if _op_observer is not None:
+        _op_observer.on_rebind(wrapper, source)
+
+
 def _tree_leaves_with_path(out):
     if isinstance(out, (list, tuple)):
         return list(out), type(out)
@@ -108,6 +126,8 @@ def run_op(name: str, fn: Callable, *args, **kwargs):
         if _capture_recorder is not None:
             outs = result if isinstance(result, (list, tuple)) else [result]
             _capture_recorder.on_outputs([o for o in outs if isinstance(o, Tensor)])
+        if _op_observer is not None:
+            _op_observer.on_op(name, fn, args, kwraw, result)
         return result
 
     diff_idx = [i for i in tensor_idx if not args[i].stop_gradient]
@@ -139,6 +159,8 @@ def run_op(name: str, fn: Callable, *args, **kwargs):
     if _capture_recorder is not None:
         outs = result if isinstance(result, (list, tuple)) else [result]
         _capture_recorder.on_outputs([o for o in outs if isinstance(o, Tensor)])
+    if _op_observer is not None:
+        _op_observer.on_op(name, fn, args, kwraw, result)
     return result
 
 

@@ -203,6 +203,9 @@ class Tensor:
         self._grad_node = other._grad_node
         self._out_index = other._out_index
         self.stop_gradient = other.stop_gradient
+        from .dispatch import notify_rebind
+
+        notify_rebind(self, other)
         return self
 
     def set_value(self, value):

@@ -5,9 +5,12 @@ sep, mp] replaces NCCL process groups; XLA collectives over named axes
 replace collective kernels; GSPMD shardings replace the reshard lattice.
 """
 
-from . import checkpoint, collective, env, launch, topology  # noqa: F401
+from . import auto_tuner, checkpoint, collective, env, launch, topology, watchdog  # noqa: F401
+from .auto_tuner import AutoTuner, ModelSpec, TuneConfig  # noqa: F401
 from .checkpoint import load_state_dict, save_state_dict  # noqa: F401
 from .spawn import spawn  # noqa: F401
+from .store import TCPStore  # noqa: F401
+from .watchdog import StepWatchdog  # noqa: F401
 from .auto_parallel import (  # noqa: F401
     DistAttr,
     Placement,

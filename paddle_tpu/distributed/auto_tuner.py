@@ -1,0 +1,134 @@
+"""Parallel-config auto-tuner.
+
+Capability analog of ``python/paddle/distributed/auto_tuner/tuner.py``:
+enumerate {dp, mp, pp, sharding, micro-batch} candidates over the device
+count, prune with divisibility + a memory model, run measured trials, pick
+the fastest.
+
+TPU-first pruning: ``mp`` stays small and innermost (ICI-neighbor
+collectives), ``pp`` must divide the layer count, ZeRO ``sharding`` divides
+optimizer state; the memory model charges params/grads/optimizer-state and
+activation bytes per device the way the reference's tuner does.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+
+@dataclass
+class TuneConfig:
+    dp: int = 1
+    mp: int = 1
+    pp: int = 1
+    sharding: int = 1
+    micro_batch: int = 1
+
+    @property
+    def world(self) -> int:
+        return self.dp * self.mp * self.pp * self.sharding
+
+    def as_dict(self) -> Dict[str, int]:
+        return {"dp": self.dp, "mp": self.mp, "pp": self.pp,
+                "sharding": self.sharding, "micro_batch": self.micro_batch}
+
+
+@dataclass
+class ModelSpec:
+    """Inputs to the memory model."""
+
+    num_params: float = 0.0
+    num_layers: int = 1
+    num_heads: int = 1
+    hidden: int = 1
+    seq_len: int = 1
+    global_batch: int = 1
+    bytes_per_param: int = 2           # bf16
+    optimizer_state_factor: int = 6    # AdamW master+m+v in f32 over bf16
+
+
+def _divisors(n: int) -> List[int]:
+    return [d for d in range(1, n + 1) if n % d == 0]
+
+
+class AutoTuner:
+    """(tuner.py analog) grid + prune + measured trials."""
+
+    def __init__(self, n_devices: int, model: Optional[ModelSpec] = None,
+                 hbm_bytes: float = 95e9, max_mp: int = 8):
+        self.n = n_devices
+        self.model = model or ModelSpec()
+        self.hbm = hbm_bytes
+        self.max_mp = max_mp
+        self.history: List[Dict] = []
+
+    # --- search space -----------------------------------------------------
+    def candidates(self) -> List[TuneConfig]:
+        m = self.model
+        out = []
+        for mp, pp, sharding in itertools.product(
+                _divisors(self.n), _divisors(self.n), _divisors(self.n)):
+            rest = self.n // (mp * pp * sharding) if \
+                self.n % (mp * pp * sharding) == 0 else 0
+            if rest < 1:
+                continue
+            dp = rest
+            if mp > self.max_mp:
+                continue
+            if m.num_heads % mp != 0:
+                continue
+            if m.num_layers % pp != 0:
+                continue
+            if m.global_batch % (dp * sharding) != 0:
+                continue
+            per_rank_batch = m.global_batch // max(dp * sharding, 1)
+            for mb in _divisors(per_rank_batch):
+                cfg = TuneConfig(dp, mp, pp, sharding, mb)
+                if self.estimate_memory(cfg) <= self.hbm:
+                    out.append(cfg)
+        # de-dup + stable order: prefer less pp, then less mp (less bubble /
+        # fewer collectives), then more sharding
+        seen = set()
+        uniq = []
+        for c in sorted(out, key=lambda c: (c.pp, c.mp, -c.sharding,
+                                            c.micro_batch)):
+            k = tuple(c.as_dict().values())
+            if k not in seen:
+                seen.add(k)
+                uniq.append(c)
+        return uniq
+
+    # --- memory model (tuner memory cost analog) --------------------------
+    def estimate_memory(self, cfg: TuneConfig) -> float:
+        m = self.model
+        if m.num_params == 0:
+            return 0.0
+        shard_denom = cfg.mp * cfg.pp
+        p_bytes = m.num_params * m.bytes_per_param / shard_denom
+        g_bytes = p_bytes
+        o_bytes = (m.num_params * m.bytes_per_param *
+                   m.optimizer_state_factor / (shard_denom * cfg.sharding))
+        # activations: micro_batch × seq × hidden × layers-per-stage × ~34
+        # bytes/element (Megatron activation-memory rule of thumb), mp-sharded
+        act = (cfg.micro_batch * m.seq_len * m.hidden *
+               (m.num_layers / cfg.pp) * 34 / cfg.mp)
+        return p_bytes + g_bytes + o_bytes + act
+
+    # --- trials -----------------------------------------------------------
+    def tune(self, trial_fn: Callable[[TuneConfig], float],
+             max_trials: int = 8) -> Optional[TuneConfig]:
+        """Run measured trials (trial_fn returns step seconds; raise to mark
+        a config infeasible) and return the fastest."""
+        best, best_t = None, float("inf")
+        for cfg in self.candidates()[:max_trials]:
+            try:
+                t = trial_fn(cfg)
+            except Exception as e:
+                self.history.append({**cfg.as_dict(), "error": str(e)})
+                continue
+            self.history.append({**cfg.as_dict(), "time": t})
+            if t < best_t:
+                best, best_t = cfg, t
+        return best

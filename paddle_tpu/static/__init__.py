@@ -1,13 +1,30 @@
-"""Minimal ``paddle.static`` surface.
+"""``paddle.static`` — graph-mode facade.
 
-The TPU runtime is dynamic-first (SURVEY.md §7); static-graph capture is
-``paddle_tpu.jit.to_static`` over the same eager code.  This module keeps the
-pieces other APIs depend on (InputSpec, name guards).
+Capability analog of the reference's static Program/Executor
+(``python/paddle/static``, ``base/framework.py`` Program +
+``base/executor.py``).  TPU-first design: a ``Program`` is a recorded op
+list — every framework op already dispatches through ``run_op``, so under
+``program_guard`` the dispatch layer appends (fn, inputs, outputs) nodes;
+``Executor.run`` rebinds placeholder values from ``feed`` and replays the
+list (optionally as one jitted XLA program).  In-place rebinds are recorded
+as alias events so SSA resolution stays correct.
+
+Scope: forward/inference graphs.  Static *training* in this framework is
+``paddle.jit.to_static`` over the whole train step (SURVEY.md §7 layer 3)
+— the Program facade intentionally does not re-implement append_backward.
 """
 
 from __future__ import annotations
 
+import contextlib
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from ..core import dispatch as _dispatch
 from ..core import dtype as dtype_mod
+from ..core.tensor import Tensor
 
 
 class InputSpec:
@@ -25,3 +42,198 @@ class InputSpec:
 
     def __repr__(self):
         return f"InputSpec(shape={self.shape}, dtype={self.dtype}, name={self.name})"
+
+
+_static_mode = False
+
+
+def in_static_mode() -> bool:
+    return _static_mode
+
+
+class _Node:
+    __slots__ = ("kind", "name", "fn", "arg_ids", "arg_snaps", "kwargs",
+                 "out_ids", "src_id")
+
+    def __init__(self, kind, **kw):
+        self.kind = kind
+        for k, v in kw.items():
+            setattr(self, k, v)
+
+
+class Program:
+    """A recorded op list with named placeholders (framework.py Program)."""
+
+    def __init__(self):
+        self.nodes: List[_Node] = []
+        self.placeholders: Dict[str, int] = {}  # name -> tensor id
+        self._keepalive: List[Tensor] = []      # keep ids unique/alive
+
+    # --- observer callbacks (dispatch hook) -------------------------------
+    def on_op(self, name, fn, args, kwraw, result):
+        arg_ids, arg_snaps = [], []
+        for a in args:
+            if isinstance(a, Tensor):
+                arg_ids.append(id(a))
+                arg_snaps.append(a._value)
+                self._keepalive.append(a)
+            else:
+                arg_ids.append(None)
+                arg_snaps.append(a)
+        outs = result if isinstance(result, (list, tuple)) else [result]
+        out_ids = []
+        for o in outs:
+            if isinstance(o, Tensor):
+                out_ids.append(id(o))
+                self._keepalive.append(o)
+            else:
+                out_ids.append(None)
+        self.nodes.append(_Node("op", name=name, fn=fn, arg_ids=arg_ids,
+                                arg_snaps=arg_snaps, kwargs=kwraw,
+                                out_ids=out_ids))
+
+    def on_rebind(self, wrapper, source):
+        self._keepalive.extend([wrapper, source])
+        self.nodes.append(_Node("alias", out_ids=[id(wrapper)],
+                                src_id=id(source), name="alias", fn=None,
+                                arg_ids=[], arg_snaps=[], kwargs={}))
+
+    # --- replay -----------------------------------------------------------
+    def replay(self, env: Dict[int, Any]):
+        for node in self.nodes:
+            if node.kind == "alias":
+                if node.src_id in env:
+                    env[node.out_ids[0]] = env[node.src_id]
+                continue
+            args = []
+            for aid, snap in zip(node.arg_ids, node.arg_snaps):
+                if aid is not None and aid in env:
+                    args.append(env[aid])
+                else:
+                    args.append(snap)
+            out = node.fn(*args, **node.kwargs)
+            outs = out if isinstance(out, (list, tuple)) else [out]
+            for oid, o in zip(node.out_ids, outs):
+                if oid is not None:
+                    env[oid] = o
+        return env
+
+    def global_block(self):
+        return self
+
+    def __repr__(self):
+        return f"Program(nodes={len(self.nodes)}, feeds={list(self.placeholders)})"
+
+
+_default_main_program = Program()
+_default_startup_program = Program()
+
+
+def default_main_program() -> Program:
+    return _default_main_program
+
+
+def default_startup_program() -> Program:
+    return _default_startup_program
+
+
+@contextlib.contextmanager
+def program_guard(main_program: Program, startup_program: Optional[Program] = None):
+    """Record ops built inside the context into ``main_program``."""
+    global _default_main_program
+    prev_main = _default_main_program
+    _default_main_program = main_program
+    _dispatch._set_op_observer(main_program)
+    try:
+        yield
+    finally:
+        _dispatch._set_op_observer(None)
+        _default_main_program = prev_main
+
+
+def enable_static():
+    global _static_mode
+    _static_mode = True
+    _dispatch._set_op_observer(_default_main_program)
+
+
+def disable_static():
+    global _static_mode
+    _static_mode = False
+    _dispatch._set_op_observer(None)
+
+
+def data(name: str, shape, dtype="float32", lod_level=0) -> Tensor:
+    """Declare a named placeholder (``static.data`` analog).  The returned
+    Tensor carries zeros of the given shape during build; ``Executor.run``
+    substitutes the fed value on replay."""
+    import jax.numpy as jnp
+
+    d = dtype_mod.convert_dtype(dtype) or dtype_mod.get_default_dtype()
+    shape = [1 if (s is None or (isinstance(s, int) and s < 0)) else s
+             for s in shape]
+    t = Tensor(jnp.zeros(shape, d), name=name)
+    prog = _default_main_program
+    prog.placeholders[name] = id(t)
+    prog._keepalive.append(t)
+    return t
+
+
+class Executor:
+    """Replays a recorded Program with fed placeholder values
+    (``base/executor.py`` analog).  ``use_jit=True`` compiles the whole
+    replay into one XLA program (the PirInterpreter/CINN role — here XLA
+    does scheduling, fusion and memory planning, SURVEY.md N26/N27)."""
+
+    def __init__(self, place=None):
+        self.place = place
+        self._jit_cache: Dict[int, Any] = {}
+
+    def run(self, program: Optional[Program] = None, feed=None,
+            fetch_list: Optional[Sequence] = None, use_jit: bool = False,
+            return_numpy: bool = True):
+        program = program or _default_main_program
+        feed = feed or {}
+        env: Dict[int, Any] = {}
+        for name, value in feed.items():
+            if name not in program.placeholders:
+                raise KeyError(f"feed target '{name}' not declared via static.data")
+            if isinstance(value, Tensor):
+                value = value._value
+            env[program.placeholders[name]] = jax.numpy.asarray(value)
+
+        if use_jit:
+            fn = self._jit_cache.get(id(program))
+            if fn is None:
+                names = tuple(sorted(program.placeholders))
+
+                def replay_pure(feed_vals, _names=names, _prog=program):
+                    e = dict(zip((_prog.placeholders[n] for n in _names),
+                                 feed_vals))
+                    return _prog.replay(e)
+
+                fn = jax.jit(replay_pure)
+                self._jit_cache[id(program)] = fn
+            env = fn([env[program.placeholders[n]]
+                      for n in sorted(program.placeholders)])
+        else:
+            program.replay(env)
+
+        results = []
+        for f in fetch_list or []:
+            fid = id(f) if isinstance(f, Tensor) else program.placeholders[f]
+            val = env.get(fid, f._value if isinstance(f, Tensor) else None)
+            results.append(np.asarray(val) if return_numpy else Tensor(val))
+        return results
+
+
+def name_scope(prefix):
+    return contextlib.nullcontext()
+
+
+class Scope:
+    pass
+
+
+def global_scope():
+    return Scope()

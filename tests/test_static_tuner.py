@@ -1,0 +1,119 @@
+"""static Program/Executor, auto-tuner, watchdog (SURVEY.md §2.2/§2.3/§5)."""
+
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, static
+from paddle_tpu.distributed.auto_tuner import AutoTuner, ModelSpec, TuneConfig
+from paddle_tpu.distributed.watchdog import StepWatchdog
+
+
+class TestStaticProgram:
+    def test_build_and_replay(self):
+        paddle.seed(0)
+        prog = static.Program()
+        net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        with static.program_guard(prog):
+            x = static.data("x", [3, 4], "float32")
+            y = net(x)
+        exe = static.Executor()
+        feed = np.random.randn(3, 4).astype("float32")
+        (out,) = exe.run(prog, feed={"x": feed}, fetch_list=[y])
+        ref = net(paddle.to_tensor(feed)).numpy()
+        np.testing.assert_allclose(out, ref, rtol=1e-6)
+
+    def test_jit_replay_matches(self):
+        paddle.seed(1)
+        prog = static.Program()
+        net = nn.Linear(4, 4)
+        with static.program_guard(prog):
+            x = static.data("x", [2, 4], "float32")
+            y = net(x) * 2.0
+        exe = static.Executor()
+        feed = np.random.randn(2, 4).astype("float32")
+        (a,) = exe.run(prog, feed={"x": feed}, fetch_list=[y])
+        (b,) = exe.run(prog, feed={"x": feed}, fetch_list=[y], use_jit=True)
+        np.testing.assert_allclose(a, b, rtol=1e-6)
+
+    def test_inplace_alias_replay(self):
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [4], "float32")
+            x[0] = 5.0            # in-place: rebind recorded as alias
+            y = x * 2.0
+        exe = static.Executor()
+        (out,) = exe.run(prog, feed={"x": np.arange(4, dtype="float32")},
+                         fetch_list=[y])
+        np.testing.assert_allclose(out, [10.0, 2.0, 4.0, 6.0])
+
+    def test_unfed_placeholder_raises(self):
+        prog = static.Program()
+        with static.program_guard(prog):
+            static.data("x", [2], "float32")
+        with pytest.raises(KeyError):
+            static.Executor().run(prog, feed={"wrong": np.zeros(2)},
+                                  fetch_list=[])
+
+
+class TestAutoTuner:
+    def _model(self):
+        return ModelSpec(num_params=8e9, num_layers=32, num_heads=32,
+                         hidden=4096, seq_len=4096, global_batch=64)
+
+    def test_candidates_respect_divisibility(self):
+        t = AutoTuner(64, self._model())
+        for c in t.candidates():
+            assert c.world == 64
+            assert 32 % c.mp == 0 and 32 % c.pp == 0
+            assert 64 % (c.dp * c.sharding) == 0
+
+    def test_memory_prunes_infeasible(self):
+        # 8B params cannot fit a single 16GB chip un-sharded
+        t = AutoTuner(1, self._model(), hbm_bytes=16e9)
+        assert t.candidates() == []
+        t64 = AutoTuner(64, self._model(), hbm_bytes=16e9)
+        assert len(t64.candidates()) > 0
+
+    def test_tune_picks_fastest(self):
+        t = AutoTuner(8, ModelSpec(num_params=1e6, num_layers=8, num_heads=8,
+                                   hidden=64, seq_len=64, global_batch=8))
+
+        def trial(cfg: TuneConfig) -> float:
+            if cfg.sharding > 1:
+                raise RuntimeError("oom")        # simulated failure
+            return 1.0 / cfg.dp                  # more dp = faster
+
+        best = t.tune(trial, max_trials=12)
+        assert best is not None and best.sharding == 1
+        assert any("error" in h for h in t.history)
+        assert best.dp == max(h.get("dp", 0) for h in t.history if "time" in h)
+
+
+class TestWatchdog:
+    def test_fast_section_does_not_fire(self):
+        wd = StepWatchdog(timeout=5.0)
+        with wd.watch("quick"):
+            time.sleep(0.05)
+        time.sleep(0.2)
+        assert wd.fired == []
+        wd.shutdown()
+
+    def test_hang_detected_and_callback(self, capsys):
+        hits = []
+        wd = StepWatchdog(timeout=0.3,
+                          on_timeout=lambda label, t: hits.append(label))
+        with wd.watch("stuck_collective"):
+            time.sleep(1.0)
+        wd.shutdown()
+        assert hits == ["stuck_collective"]
+        err = capsys.readouterr().err
+        assert "stuck_collective" in err and "Thread stacks" in err
+
+    def test_wrap(self):
+        wd = StepWatchdog(timeout=5.0)
+        f = wd.wrap(lambda x: x + 1, "inc")
+        assert f(2) == 3
+        wd.shutdown()

@@ -56,8 +56,11 @@ from . import amp  # noqa: F401
 from . import autograd  # noqa: F401
 from . import device  # noqa: F401
 from . import distributed  # noqa: F401
+from . import audio  # noqa: F401
 from . import distribution  # noqa: F401
 from . import fft  # noqa: F401
+from . import signal  # noqa: F401
+from . import text  # noqa: F401
 from . import incubate  # noqa: F401
 from . import io  # noqa: F401
 from . import jit  # noqa: F401

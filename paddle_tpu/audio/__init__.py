@@ -1,0 +1,7 @@
+"""``paddle.audio`` — audio features + windows (``python/paddle/audio``
+analog): spectrogram/MFCC pipelines over paddle_tpu.signal's XLA STFT."""
+
+from __future__ import annotations
+
+from . import functional  # noqa: F401
+from .features import LogMelSpectrogram, MFCC, MelSpectrogram, Spectrogram  # noqa: F401

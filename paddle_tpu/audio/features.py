@@ -1,0 +1,89 @@
+"""Audio feature layers (``python/paddle/audio/features/layers.py`` analog)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ..core.dispatch import run_op
+from ..nn.layers import Layer
+from .. import signal as sig
+from .functional import compute_fbank_matrix, create_dct, get_window, power_to_db
+
+
+class Spectrogram(Layer):
+    def __init__(self, n_fft: int = 512, hop_length: Optional[int] = None,
+                 win_length: Optional[int] = None, window: str = "hann",
+                 power: float = 2.0, center: bool = True,
+                 pad_mode: str = "reflect", dtype: str = "float32"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        self.window = get_window(window, self.win_length, dtype=dtype)
+
+    def forward(self, x):
+        spec = sig.stft(x, self.n_fft, self.hop_length, self.win_length,
+                        self.window, self.center, self.pad_mode)
+        return run_op("spec_power",
+                      lambda s: jnp.abs(s) ** self.power, spec)
+
+
+class MelSpectrogram(Layer):
+    def __init__(self, sr: int = 22050, n_fft: int = 512,
+                 hop_length: Optional[int] = None, win_length=None,
+                 window: str = "hann", power: float = 2.0, center=True,
+                 pad_mode="reflect", n_mels: int = 64, f_min: float = 50.0,
+                 f_max=None, htk=False, norm="slaney", dtype="float32"):
+        super().__init__()
+        self.spectrogram = Spectrogram(n_fft, hop_length, win_length, window,
+                                       power, center, pad_mode, dtype)
+        self.fbank = compute_fbank_matrix(sr, n_fft, n_mels, f_min, f_max,
+                                          htk, norm, dtype)
+
+    def forward(self, x):
+        spec = self.spectrogram(x)
+        return run_op("mel_project",
+                      lambda s, fb: jnp.einsum("mf,...ft->...mt", fb, s),
+                      spec, self.fbank)
+
+
+class LogMelSpectrogram(Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 n_mels=64, f_min=50.0, f_max=None, htk=False, norm="slaney",
+                 ref_value=1.0, amin=1e-10, top_db=None, dtype="float32"):
+        super().__init__()
+        self.mel = MelSpectrogram(sr, n_fft, hop_length, win_length, window,
+                                  power, center, pad_mode, n_mels, f_min,
+                                  f_max, htk, norm, dtype)
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x):
+        return power_to_db(self.mel(x), self.ref_value, self.amin, self.top_db)
+
+
+class MFCC(Layer):
+    def __init__(self, sr=22050, n_mfcc=40, n_fft=512, hop_length=None,
+                 win_length=None, window="hann", power=2.0, center=True,
+                 pad_mode="reflect", n_mels=64, f_min=50.0, f_max=None,
+                 htk=False, norm="slaney", ref_value=1.0, amin=1e-10,
+                 top_db=None, dtype="float32"):
+        super().__init__()
+        self.logmel = LogMelSpectrogram(sr, n_fft, hop_length, win_length,
+                                        window, power, center, pad_mode,
+                                        n_mels, f_min, f_max, htk, norm,
+                                        ref_value, amin, top_db, dtype)
+        self.dct = create_dct(n_mfcc, n_mels, dtype=dtype)
+
+    def forward(self, x):
+        lm = self.logmel(x)
+        return run_op("mfcc_dct",
+                      lambda s, d: jnp.einsum("mk,...mt->...kt", d, s),
+                      lm, self.dct)

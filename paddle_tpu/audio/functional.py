@@ -1,0 +1,111 @@
+"""Audio functional ops (``python/paddle/audio/functional`` analog)."""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import run_op
+from ..core.tensor import Tensor, to_tensor
+
+
+def get_window(window: str, win_length: int, fftbins: bool = True,
+               dtype: str = "float32") -> Tensor:
+    """hann/hamming/blackman/... (functional/window.py analog)."""
+    n = win_length
+    # periodic (fftbins) windows divide by N, symmetric by N-1
+    denom = n if fftbins else n - 1
+    k = np.arange(n)
+    if window in ("hann", "hanning"):
+        w = 0.5 - 0.5 * np.cos(2 * np.pi * k / denom)
+    elif window == "hamming":
+        w = 0.54 - 0.46 * np.cos(2 * np.pi * k / denom)
+    elif window == "blackman":
+        w = (0.42 - 0.5 * np.cos(2 * np.pi * k / denom)
+             + 0.08 * np.cos(4 * np.pi * k / denom))
+    elif window in ("rect", "boxcar", "ones"):
+        w = np.ones(n)
+    elif window == "bartlett":
+        w = 1.0 - np.abs(2.0 * k / denom - 1.0)
+    else:
+        raise ValueError(f"unknown window '{window}'")
+    return to_tensor(w.astype(dtype))
+
+
+def hz_to_mel(freq, htk: bool = False):
+    if htk:
+        return 2595.0 * np.log10(1.0 + np.asarray(freq) / 700.0)
+    f = np.asarray(freq, dtype=np.float64)
+    f_min, f_sp = 0.0, 200.0 / 3
+    mels = (f - f_min) / f_sp
+    min_log_hz = 1000.0
+    min_log_mel = (min_log_hz - f_min) / f_sp
+    logstep = math.log(6.4) / 27.0
+    return np.where(f >= min_log_hz,
+                    min_log_mel + np.log(np.maximum(f, 1e-10) / min_log_hz) / logstep,
+                    mels)
+
+
+def mel_to_hz(mel, htk: bool = False):
+    if htk:
+        return 700.0 * (10.0 ** (np.asarray(mel) / 2595.0) - 1.0)
+    m = np.asarray(mel, dtype=np.float64)
+    f_min, f_sp = 0.0, 200.0 / 3
+    freqs = f_min + f_sp * m
+    min_log_hz = 1000.0
+    min_log_mel = (min_log_hz - f_min) / f_sp
+    logstep = math.log(6.4) / 27.0
+    return np.where(m >= min_log_mel,
+                    min_log_hz * np.exp(logstep * (m - min_log_mel)), freqs)
+
+
+def compute_fbank_matrix(sr: int, n_fft: int, n_mels: int = 64,
+                         f_min: float = 0.0, f_max: Optional[float] = None,
+                         htk: bool = False, norm: str = "slaney",
+                         dtype: str = "float32") -> Tensor:
+    """Triangular mel filterbank [n_mels, n_fft//2+1]."""
+    f_max = f_max or sr / 2.0
+    n_freqs = n_fft // 2 + 1
+    freqs = np.linspace(0, sr / 2, n_freqs)
+    mel_pts = np.linspace(hz_to_mel(f_min, htk), hz_to_mel(f_max, htk),
+                          n_mels + 2)
+    hz_pts = mel_to_hz(mel_pts, htk)
+    fb = np.zeros((n_mels, n_freqs))
+    for i in range(n_mels):
+        lo, ctr, hi = hz_pts[i], hz_pts[i + 1], hz_pts[i + 2]
+        up = (freqs - lo) / max(ctr - lo, 1e-10)
+        down = (hi - freqs) / max(hi - ctr, 1e-10)
+        fb[i] = np.maximum(0.0, np.minimum(up, down))
+    if norm == "slaney":
+        enorm = 2.0 / (hz_pts[2:] - hz_pts[:-2])
+        fb *= enorm[:, None]
+    return to_tensor(fb.astype(dtype))
+
+
+def power_to_db(magnitude, ref_value: float = 1.0, amin: float = 1e-10,
+                top_db: Optional[float] = 80.0):
+    x = magnitude if isinstance(magnitude, Tensor) else to_tensor(magnitude)
+
+    def f(v):
+        db = 10.0 * jnp.log10(jnp.maximum(v, amin))
+        db = db - 10.0 * jnp.log10(jnp.maximum(jnp.asarray(ref_value), amin))
+        if top_db is not None:
+            db = jnp.maximum(db, db.max() - top_db)
+        return db
+
+    return run_op("power_to_db", f, x)
+
+
+def create_dct(n_mfcc: int, n_mels: int, norm: Optional[str] = "ortho",
+               dtype: str = "float32") -> Tensor:
+    """DCT-II matrix [n_mels, n_mfcc]."""
+    k = np.arange(n_mfcc)[None, :]
+    n = np.arange(n_mels)[:, None]
+    dct = np.cos(np.pi / n_mels * (n + 0.5) * k) * 2.0
+    if norm == "ortho":
+        dct[:, 0] *= 1.0 / math.sqrt(2)
+        dct *= math.sqrt(1.0 / (2.0 * n_mels))
+    return to_tensor(dct.astype(dtype))

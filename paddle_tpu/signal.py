@@ -1,0 +1,112 @@
+"""``paddle.signal`` — STFT/ISTFT (``python/paddle/signal.py`` analog),
+built on the fft module (XLA FFT)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from .core.dispatch import run_op
+from .core.tensor import Tensor, to_tensor
+
+
+def _ensure(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def frame(x, frame_length: int, hop_length: int, axis: int = -1, name=None):
+    """Slice overlapping frames along ``axis`` (signal.frame analog)."""
+
+    def f(v):
+        n = v.shape[axis]
+        num = 1 + (n - frame_length) // hop_length
+        starts = jnp.arange(num) * hop_length
+        idx = starts[:, None] + jnp.arange(frame_length)[None, :]
+        moved = jnp.moveaxis(v, axis, -1)
+        framed = moved[..., idx]                      # [..., num, frame]
+        return jnp.moveaxis(framed, (-2, -1), (axis - 1 if axis < 0 else axis,
+                                               axis if axis < 0 else axis + 1))
+
+    return run_op("frame", f, _ensure(x))
+
+
+def stft(x, n_fft: int, hop_length: Optional[int] = None,
+         win_length: Optional[int] = None, window=None, center: bool = True,
+         pad_mode: str = "reflect", normalized: bool = False,
+         onesided: bool = True, name=None):
+    """Short-time Fourier transform over the last axis.
+
+    Returns [..., n_freq, n_frames] complex (paddle layout).
+    """
+    hop = hop_length or n_fft // 4
+    wl = win_length or n_fft
+    xt = _ensure(x)
+    win = None if window is None else _ensure(window)
+
+    def f(v, *w):
+        if center:
+            pad = n_fft // 2
+            cfg = [(0, 0)] * (v.ndim - 1) + [(pad, pad)]
+            v = jnp.pad(v, cfg, mode=pad_mode)
+        n = v.shape[-1]
+        num = 1 + (n - n_fft) // hop
+        starts = jnp.arange(num) * hop
+        idx = starts[:, None] + jnp.arange(n_fft)[None, :]
+        frames = v[..., idx]                          # [..., num, n_fft]
+        if w:
+            wv = w[0]
+            if wl < n_fft:  # centre-pad the window
+                lp = (n_fft - wl) // 2
+                wv = jnp.pad(wv, (lp, n_fft - wl - lp))
+            frames = frames * wv
+        spec = jnp.fft.rfft(frames) if onesided else jnp.fft.fft(frames)
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        return jnp.swapaxes(spec, -1, -2)             # [..., freq, frames]
+
+    args = [xt] + ([win] if win is not None else [])
+    return run_op("stft", f, *args)
+
+
+def istft(x, n_fft: int, hop_length: Optional[int] = None,
+          win_length: Optional[int] = None, window=None, center: bool = True,
+          normalized: bool = False, onesided: bool = True, length=None,
+          return_complex: bool = False, name=None):
+    """Inverse STFT (overlap-add with window-square normalization)."""
+    hop = hop_length or n_fft // 4
+    wl = win_length or n_fft
+    xt = _ensure(x)
+    win = None if window is None else _ensure(window)
+
+    def f(spec, *w):
+        spec = jnp.swapaxes(spec, -1, -2)             # [..., frames, freq]
+        if normalized:
+            spec = spec * jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        frames = (jnp.fft.irfft(spec, n=n_fft) if onesided
+                  else jnp.fft.ifft(spec, n=n_fft).real)
+        if w:
+            wv = w[0]
+            if wl < n_fft:
+                lp = (n_fft - wl) // 2
+                wv = jnp.pad(wv, (lp, n_fft - wl - lp))
+        else:
+            wv = jnp.ones((n_fft,), frames.dtype)
+        num = frames.shape[-2]
+        total = n_fft + hop * (num - 1)
+        out = jnp.zeros(frames.shape[:-2] + (total,), frames.dtype)
+        norm = jnp.zeros((total,), frames.dtype)
+        for i in range(num):  # static unroll: num is trace-time constant
+            seg = frames[..., i, :] * wv
+            out = out.at[..., i * hop:i * hop + n_fft].add(seg)
+            norm = norm.at[i * hop:i * hop + n_fft].add(wv * wv)
+        out = out / jnp.maximum(norm, 1e-10)
+        if center:
+            pad = n_fft // 2
+            out = out[..., pad:total - pad]
+        if length is not None:
+            out = out[..., :length]
+        return out
+
+    args = [xt] + ([win] if win is not None else [])
+    return run_op("istft", f, *args)

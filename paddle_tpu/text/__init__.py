@@ -1,0 +1,133 @@
+"""``paddle.text`` — text datasets + viterbi decode
+(``python/paddle/text`` analog).  Air-gapped: datasets fall back to
+deterministic synthetic corpora with real shapes (same policy as
+paddle_tpu.vision.datasets)."""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import numpy as np
+
+from ..core.dispatch import run_op
+from ..core.tensor import Tensor, to_tensor
+from ..io.dataset import Dataset
+
+
+class Imdb(Dataset):
+    """IMDB sentiment (text/datasets/imdb.py analog)."""
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train",
+                 cutoff: int = 150, seed: int = 0):
+        rng = np.random.default_rng(seed + (0 if mode == "train" else 1))
+        n = 256 if mode == "train" else 64
+        self.vocab_size = 5000
+        lengths = rng.integers(16, 128, n)
+        self.docs = [rng.integers(2, self.vocab_size, l).astype("int64")
+                     for l in lengths]
+        self.labels = rng.integers(0, 2, n).astype("int64")
+
+    def word_idx(self):
+        return {f"w{i}": i for i in range(self.vocab_size)}
+
+    def __len__(self):
+        return len(self.docs)
+
+    def __getitem__(self, i):
+        return self.docs[i], self.labels[i]
+
+
+class Conll05st(Dataset):
+    """SRL dataset (text/datasets/conll05.py analog, synthetic fallback)."""
+
+    def __init__(self, mode: str = "train", seed: int = 0):
+        rng = np.random.default_rng(seed)
+        n = 128
+        self.n_labels = 19
+        lengths = rng.integers(8, 40, n)
+        self.sents = [rng.integers(0, 5000, l).astype("int64") for l in lengths]
+        self.labels = [rng.integers(0, self.n_labels, l).astype("int64")
+                       for l in lengths]
+
+    def __len__(self):
+        return len(self.sents)
+
+    def __getitem__(self, i):
+        return self.sents[i], self.labels[i]
+
+
+class UCIHousing(Dataset):
+    """(text/datasets/uci_housing.py analog) 13-feature regression."""
+
+    def __init__(self, data_file=None, mode="train", seed=0):
+        rng = np.random.default_rng(seed + (0 if mode == "train" else 1))
+        n = 404 if mode == "train" else 102
+        self.x = rng.standard_normal((n, 13)).astype("float32")
+        w = rng.standard_normal(13).astype("float32")
+        self.y = (self.x @ w + 0.1 * rng.standard_normal(n)).astype("float32")
+
+    def __len__(self):
+        return len(self.x)
+
+    def __getitem__(self, i):
+        return self.x[i], np.asarray([self.y[i]], "float32")
+
+
+class ViterbiDecoder:
+    """CRF viterbi decode (``paddle.text.ViterbiDecoder`` analog)."""
+
+    def __init__(self, transitions, include_bos_eos_tag: bool = True,
+                 name=None):
+        self.transitions = (transitions if isinstance(transitions, Tensor)
+                            else to_tensor(transitions))
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def __call__(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag: bool = True, name=None):
+    """Batched viterbi: potentials [B, T, N], transitions [N, N],
+    lengths [B] → (scores [B], paths [B, T])."""
+    import jax
+    import jax.numpy as jnp
+
+    pot = potentials if isinstance(potentials, Tensor) else to_tensor(potentials)
+    trans = (transition_params if isinstance(transition_params, Tensor)
+             else to_tensor(transition_params))
+    lens = lengths if isinstance(lengths, Tensor) else to_tensor(lengths)
+
+    def f(p, tr, ln):
+        B, T, N = p.shape
+
+        def step(carry, emit_t):
+            alpha, t = carry
+            scores = alpha[:, :, None] + tr[None] + emit_t[:, None, :]
+            best = jnp.max(scores, axis=1)
+            back = jnp.argmax(scores, axis=1)
+            keep = (t < ln)[:, None]
+            alpha = jnp.where(keep, best, alpha)
+            return (alpha, t + 1), jnp.where(keep, back,
+                                             jnp.arange(N)[None, :])
+
+        alpha0 = p[:, 0]
+        (alpha, _), backs = jax.lax.scan(step, (alpha0, jnp.ones((), jnp.int32)),
+                                         jnp.moveaxis(p[:, 1:], 1, 0))
+        score = jnp.max(alpha, axis=-1)
+        last = jnp.argmax(alpha, axis=-1).astype(jnp.int32)
+
+        # positions >= length carry identity backpointers (see step), so
+        # walking from T-1 through them preserves the tag chosen at len-1
+        def walk(tag, back_t):
+            prev = jnp.take_along_axis(back_t, tag[:, None], 1)[:, 0]
+            return prev.astype(jnp.int32), prev.astype(jnp.int32)
+
+        _, prevs = jax.lax.scan(walk, last, backs[::-1])  # [T-1, B]
+        path = jnp.concatenate(
+            [prevs[::-1].swapaxes(0, 1), last[:, None]], axis=1)
+        return score, path
+
+    return run_op("viterbi_decode", f, pot, trans, lens)

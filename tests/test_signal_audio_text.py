@@ -1,0 +1,89 @@
+"""signal (STFT/ISTFT), audio features, text (datasets + viterbi)."""
+
+import itertools
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import audio, signal, text
+
+
+class TestSignal:
+    def test_stft_shape_and_dtype(self):
+        x = np.random.randn(2, 512).astype("float32")
+        spec = signal.stft(paddle.to_tensor(x), n_fft=128, hop_length=32)
+        # centered: padded to 640 → 1 + (640-128)//32 = 17 frames
+        assert spec.shape == [2, 65, 17]
+        assert "complex" in str(spec.dtype)
+
+    def test_istft_roundtrip(self):
+        x = np.random.randn(2, 1024).astype("float32")
+        win = audio.functional.get_window("hann", 256)
+        spec = signal.stft(paddle.to_tensor(x), n_fft=256, hop_length=64,
+                           window=win)
+        rec = signal.istft(spec, n_fft=256, hop_length=64, window=win,
+                           length=1024)
+        np.testing.assert_allclose(rec.numpy(), x, atol=1e-4)
+
+    def test_stft_parseval(self):
+        # un-centered, rect-window, hop=n_fft → frames partition the signal
+        x = np.random.randn(1, 512).astype("float32")
+        spec = signal.stft(paddle.to_tensor(x), n_fft=128, hop_length=128,
+                           center=False, onesided=False)
+        energy_t = np.sum(x[:, :512] ** 2)
+        energy_f = np.sum(np.abs(spec.numpy()) ** 2) / 128
+        np.testing.assert_allclose(energy_f, energy_t, rtol=1e-4)
+
+
+class TestAudio:
+    def test_windows(self):
+        for w in ("hann", "hamming", "blackman", "bartlett"):
+            win = audio.functional.get_window(w, 64).numpy()
+            assert win.shape == (64,) and win.max() <= 1.0 + 1e-6
+
+    def test_mel_fbank_rows_nonneg(self):
+        fb = audio.functional.compute_fbank_matrix(16000, 256, 40).numpy()
+        assert fb.shape == (40, 129)
+        assert (fb >= 0).all()
+        assert (fb.sum(axis=1) > 0).all()  # every filter hits some bins
+
+    def test_mfcc_pipeline(self):
+        x = np.random.randn(2, 1024).astype("float32")
+        m = audio.MFCC(sr=16000, n_mfcc=13, n_fft=256, n_mels=40)
+        out = m(paddle.to_tensor(x))
+        assert out.shape[0] == 2 and out.shape[1] == 13
+
+    def test_power_to_db_topdb(self):
+        x = paddle.to_tensor(np.array([1.0, 1e-12], "float32"))
+        db = audio.functional.power_to_db(x, top_db=30.0).numpy()
+        assert db[0] - db[1] <= 30.0 + 1e-5
+
+
+class TestText:
+    def test_datasets(self):
+        ds = text.Imdb(mode="train")
+        doc, label = ds[0]
+        assert doc.dtype == np.int64 and label in (0, 1)
+        h = text.UCIHousing(mode="test")
+        x, y = h[0]
+        assert x.shape == (13,) and y.shape == (1,)
+
+    def test_viterbi_matches_bruteforce(self):
+        rng = np.random.default_rng(0)
+        B, T, N = 2, 5, 3
+        pot = rng.standard_normal((B, T, N)).astype("float32")
+        trans = rng.standard_normal((N, N)).astype("float32")
+        score, path = text.viterbi_decode(
+            paddle.to_tensor(pot), paddle.to_tensor(trans),
+            paddle.to_tensor(np.array([T, T], "int32")))
+        for b in range(B):
+            best, bestp = -1e9, None
+            for p in itertools.product(range(N), repeat=T):
+                s = pot[b, 0, p[0]] + sum(
+                    trans[p[i - 1], p[i]] + pot[b, i, p[i]]
+                    for i in range(1, T))
+                if s > best:
+                    best, bestp = s, p
+            assert abs(float(score.numpy()[b]) - best) < 1e-4
+            assert tuple(path.numpy()[b].tolist()) == bestp

@@ -20,6 +20,10 @@ _FLASH_MIN_SEQ = 1024  # below this, XLA's fused softmax path is already fast
 
 
 def use_flash(q_shape, attn_mask) -> bool:
+    import os
+
+    if os.environ.get("PADDLE_TPU_DISABLE_PALLAS") == "1":
+        return False  # kill switch: force the XLA composite path
     if attn_mask is not None:
         return False
     if len(q_shape) != 4:

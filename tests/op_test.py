@@ -1,0 +1,77 @@
+"""OpTest harness — the reference's operator test pattern
+(``test/legacy_test/op_test.py:420``): run the framework op, compare
+against a NumPy reference (``check_output``), and verify analytic (tape)
+gradients against central finite differences (``check_grad``)."""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Sequence
+
+import numpy as np
+
+import paddle_tpu as paddle
+
+
+def check_output(op_fn: Callable, np_fn: Callable, inputs: Sequence[np.ndarray],
+                 rtol: float = 1e-5, atol: float = 1e-6, **kwargs):
+    """op_fn(*Tensors) vs np_fn(*ndarrays)."""
+    tensors = [paddle.to_tensor(x) for x in inputs]
+    out = op_fn(*tensors, **kwargs)
+    ref = np_fn(*inputs, **kwargs)
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    refs = ref if isinstance(ref, (list, tuple)) else [ref]
+    for o, r in zip(outs, refs):
+        np.testing.assert_allclose(o.numpy(), r, rtol=rtol, atol=atol)
+
+
+def numeric_grad(f: Callable[[Sequence[np.ndarray]], float],
+                 inputs: Sequence[np.ndarray], idx: int,
+                 eps: float = 1e-3) -> np.ndarray:
+    """Central finite differences of a scalar loss wrt inputs[idx]."""
+    x = inputs[idx].astype(np.float64)
+    grad = np.zeros_like(x)
+    flat = x.reshape(-1)
+    gflat = grad.reshape(-1)
+    for i in range(flat.size):
+        orig = flat[i]
+        flat[i] = orig + eps
+        args = list(inputs)
+        args[idx] = x.reshape(inputs[idx].shape).astype(inputs[idx].dtype)
+        hi = f(args)
+        flat[i] = orig - eps
+        args[idx] = x.reshape(inputs[idx].shape).astype(inputs[idx].dtype)
+        lo = f(args)
+        flat[i] = orig
+        gflat[i] = (hi - lo) / (2 * eps)
+    return grad.astype(inputs[idx].dtype)
+
+
+def check_grad(op_fn: Callable, inputs: Sequence[np.ndarray],
+               grad_inputs: Sequence[int] = None, eps: float = 1e-3,
+               rtol: float = 1e-2, atol: float = 1e-3, **kwargs):
+    """Analytic tape grads vs numeric grads of sum(op(x))."""
+    grad_inputs = list(grad_inputs if grad_inputs is not None
+                       else range(len(inputs)))
+
+    def scalar(arrs) -> float:
+        ts = [paddle.to_tensor(a) for a in arrs]
+        out = op_fn(*ts, **kwargs)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        return float(sum(o.sum() for o in outs).numpy())
+
+    tensors = [paddle.to_tensor(x, stop_gradient=(i not in grad_inputs))
+               for i, x in enumerate(inputs)]
+    out = op_fn(*tensors, **kwargs)
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    total = outs[0].sum()
+    for o in outs[1:]:
+        total = total + o.sum()
+    total.backward()
+
+    for i in grad_inputs:
+        analytic = tensors[i].grad
+        assert analytic is not None, f"no grad for input {i}"
+        numeric = numeric_grad(scalar, list(inputs), i, eps)
+        np.testing.assert_allclose(
+            analytic.numpy(), numeric, rtol=rtol, atol=atol,
+            err_msg=f"gradient mismatch for input {i}")

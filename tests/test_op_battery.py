@@ -1,0 +1,156 @@
+"""Operator battery on the OpTest harness: NumPy-reference outputs +
+numeric-vs-analytic gradient checks across the op surface (the reference's
+legacy_test sweep, SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.nn import functional as F
+
+from op_test import check_grad, check_output
+
+
+def _rand(*shape, seed=0, scale=1.0, shift=0.0):
+    rng = np.random.default_rng(seed)
+    return (rng.standard_normal(shape) * scale + shift).astype("float32")
+
+
+BINARY_OPS = [
+    ("add", lambda a, b: a + b, np.add),
+    ("sub", lambda a, b: a - b, np.subtract),
+    ("mul", lambda a, b: a * b, np.multiply),
+    ("div", lambda a, b: a / b, np.divide),
+    ("maximum", paddle.tensor.maximum, np.maximum),
+    ("minimum", paddle.tensor.minimum, np.minimum),
+    ("pow", lambda a, b: a ** b, np.power),
+]
+
+
+@pytest.mark.parametrize("name,op,ref", BINARY_OPS, ids=[b[0] for b in BINARY_OPS])
+def test_binary_output_and_grad(name, op, ref):
+    a = _rand(3, 4, seed=1, shift=2.0)   # shifted positive for div/pow
+    b = _rand(3, 4, seed=2, shift=2.0)
+    check_output(op, ref, [a, b])
+    check_grad(op, [a, b], rtol=5e-2, atol=5e-3)
+
+
+UNARY_OPS = [
+    ("exp", paddle.tensor.exp, np.exp, 0.0),
+    ("log", paddle.tensor.log, np.log, 3.0),
+    ("sqrt", paddle.tensor.sqrt, np.sqrt, 3.0),
+    ("tanh", paddle.tensor.tanh, np.tanh, 0.0),
+    ("sin", paddle.tensor.sin, np.sin, 0.0),
+    ("cos", paddle.tensor.cos, np.cos, 0.0),
+    ("abs", paddle.tensor.abs, np.abs, 2.0),
+    ("sigmoid", F.sigmoid, lambda x: 1 / (1 + np.exp(-x)), 0.0),
+]
+
+
+@pytest.mark.parametrize("name,op,ref,shift", UNARY_OPS, ids=[u[0] for u in UNARY_OPS])
+def test_unary_output_and_grad(name, op, ref, shift):
+    x = _rand(4, 5, seed=3, shift=shift)
+    check_output(op, ref, [x], rtol=1e-4, atol=1e-5)
+    check_grad(op, [x], rtol=5e-2, atol=5e-3)
+
+
+class TestMatmulFamily:
+    def test_matmul(self):
+        a, b = _rand(3, 4, seed=1), _rand(4, 5, seed=2)
+        check_output(paddle.tensor.matmul, np.matmul, [a, b], rtol=1e-4)
+        check_grad(paddle.tensor.matmul, [a, b], rtol=5e-2, atol=5e-3)
+
+    def test_batched_matmul(self):
+        a, b = _rand(2, 3, 4, seed=1), _rand(2, 4, 5, seed=2)
+        check_output(paddle.tensor.matmul, np.matmul, [a, b], rtol=1e-4)
+
+    def test_einsum_grad(self):
+        a, b = _rand(3, 4, seed=1), _rand(4, 5, seed=2)
+        op = lambda x, y: paddle.tensor.einsum("ij,jk->ik", x, y)  # noqa: E731
+        check_grad(op, [a, b], rtol=5e-2, atol=5e-3)
+
+
+class TestReductions:
+    @pytest.mark.parametrize("axis", [None, 0, 1, -1])
+    def test_sum(self, axis):
+        x = _rand(3, 5, seed=4)
+        check_output(lambda t: paddle.tensor.sum(t, axis=axis),
+                     lambda v: np.sum(v, axis=axis), [x], rtol=1e-4)
+        check_grad(lambda t: paddle.tensor.sum(t, axis=axis), [x])
+
+    def test_mean_grad(self):
+        x = _rand(4, 4, seed=5)
+        check_grad(lambda t: paddle.tensor.mean(t), [x])
+
+    def test_max_grad_subgradient(self):
+        # distinct entries → unique argmax → valid finite-difference check
+        x = np.arange(12, dtype="float32").reshape(3, 4)[::-1].copy()
+        check_grad(lambda t: paddle.tensor.max(t, axis=1), [x])
+
+
+class TestManipulation:
+    def test_concat_split_grads(self):
+        a, b = _rand(2, 3, seed=6), _rand(2, 3, seed=7)
+        check_grad(lambda x, y: paddle.tensor.concat([x, y], axis=1), [a, b])
+        check_grad(lambda x: paddle.tensor.split(x, 3, axis=1), [_rand(2, 6)])
+
+    def test_transpose_reshape(self):
+        x = _rand(2, 3, 4, seed=8)
+        check_output(lambda t: paddle.tensor.transpose(t, [2, 0, 1]),
+                     lambda v: np.transpose(v, [2, 0, 1]), [x])
+        check_grad(lambda t: paddle.tensor.reshape(t, [4, 6]), [x])
+
+    def test_slice_pad_grads(self):
+        x = _rand(4, 6, seed=9)
+        check_grad(lambda t: t[1:3, 2:5], [x])
+        check_grad(lambda t: F.pad(t, [1, 1, 2, 0]), [x])
+
+    def test_where_clip(self):
+        x = _rand(3, 4, seed=10)
+        check_output(lambda t: paddle.tensor.clip(t, -0.5, 0.5),
+                     lambda v: np.clip(v, -0.5, 0.5), [x])
+        # clip grad: only strictly-interior elements have nonzero grad
+        interior = _rand(3, 4, seed=11, scale=0.2)
+        check_grad(lambda t: paddle.tensor.clip(t, -0.5, 0.5), [interior])
+
+
+class TestNNOps:
+    def test_softmax_grad(self):
+        x = _rand(3, 6, seed=12)
+        check_output(F.softmax,
+                     lambda v: np.exp(v - v.max(-1, keepdims=True)) /
+                     np.exp(v - v.max(-1, keepdims=True)).sum(-1, keepdims=True),
+                     [x], rtol=1e-4)
+        check_grad(lambda t: F.softmax(t) ** 2, [x], rtol=5e-2, atol=5e-3)
+
+    def test_layer_norm_grad(self):
+        x = _rand(2, 8, seed=13)
+        w = np.ones(8, "float32")
+        b = np.zeros(8, "float32")
+        check_grad(lambda t, wv, bv: F.layer_norm(t, [8], wv, bv),
+                   [x, w, b], rtol=6e-2, atol=6e-3)
+
+    def test_gelu_relu_silu_grads(self):
+        x = _rand(3, 5, seed=14, shift=0.3)  # keep away from relu kink
+        for act in (F.gelu, F.silu):
+            check_grad(act, [x], rtol=5e-2, atol=5e-3)
+        check_grad(F.relu, [x])
+
+    def test_cross_entropy_grad(self):
+        logits = _rand(4, 6, seed=15)
+        labels = np.array([0, 2, 5, 1], "int64")
+        check_grad(lambda t, l: F.cross_entropy(t, l), [logits, labels],
+                   grad_inputs=[0], rtol=5e-2, atol=5e-3)
+
+    def test_conv2d_grad(self):
+        x = _rand(1, 2, 6, 6, seed=16)
+        w = _rand(3, 2, 3, 3, seed=17, scale=0.5)
+        check_grad(lambda t, wv: F.conv2d(t, wv, padding=1), [x, w],
+                   rtol=6e-2, atol=6e-3)
+
+    def test_embedding_grad(self):
+        ids = np.array([[0, 2], [1, 2]], "int64")
+        w = _rand(4, 3, seed=18)
+        check_grad(lambda i, wv: F.embedding(i, wv), [ids, w],
+                   grad_inputs=[1])

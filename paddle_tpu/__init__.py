@@ -75,6 +75,7 @@ from . import vision  # noqa: F401
 from .device import get_device, set_device  # noqa: F401
 from .framework import CPUPlace, CUDAPlace, TPUPlace, save, load  # noqa: F401
 from .hapi.model import Model  # noqa: F401
+from .hapi.summary import flops, summary  # noqa: F401
 from .jit.api import to_static  # noqa: F401
 from .nn.layers import Layer  # noqa: F401
 

@@ -39,8 +39,6 @@ class Model:
             loss.backward()
             self._optimizer.step()
             self._optimizer.clear_grad()
-            if hasattr(self._optimizer, "_lr") and hasattr(self._optimizer._lr, "step"):
-                self._optimizer._lr.step()
         metric_out = []
         for m in self._metrics:
             res = m.compute(preds_list[0], labels[0])
@@ -70,35 +68,53 @@ class Model:
         from ..io import DataLoader
         from ..io.dataset import Dataset
 
+        from .callbacks import CallbackList, LRScheduler, ProgBarLogger
+
         if isinstance(train_data, Dataset):
             train_loader = DataLoader(train_data, batch_size=batch_size, shuffle=shuffle,
                                       drop_last=drop_last, num_workers=num_workers)
         else:
             train_loader = train_data
+        cbs = list(callbacks or [])
+        if not any(isinstance(c, ProgBarLogger) for c in cbs) and verbose:
+            cbs.append(ProgBarLogger(log_freq, verbose))
+        if not any(isinstance(c, LRScheduler) for c in cbs):
+            cbs.append(LRScheduler(by_step=True))
+        cb = CallbackList(cbs, self, {"epochs": epochs, "verbose": verbose})
+
+        self.stop_training = False
+        cb.call("on_train_begin")
         for epoch in range(epochs):
             self.network.train()
             for m in self._metrics:
                 m.reset()
-            t0 = time.time()
+            cb.call("on_epoch_begin", epoch)
             losses = []
             for step, batch in enumerate(train_loader):
+                cb.call("on_train_batch_begin", step)
                 inputs, labels = batch[:-1], batch[-1:]
                 loss, metrics = self._run_batch(list(inputs), list(labels), train=True)
                 losses.append(float(loss))
-                if verbose and step % log_freq == 0:
-                    mstr = " ".join(
-                        f"{m.name() if isinstance(m.name(), str) else m.name()[0]}:"
-                        f" {m.accumulate() if not isinstance(m.accumulate(), list) else m.accumulate()[0]:.4f}"
-                        for m in self._metrics
-                    )
-                    print(f"Epoch {epoch + 1}/{epochs} step {step} loss: {losses[-1]:.4f} {mstr}")
-            if verbose:
-                print(f"Epoch {epoch + 1}: avg loss {np.mean(losses):.4f} "
-                      f"({time.time() - t0:.1f}s)")
+                logs = {"loss": losses[-1]}
+                for m in self._metrics:
+                    name = m.name() if isinstance(m.name(), str) else m.name()[0]
+                    acc = m.accumulate()
+                    logs[name] = acc[0] if isinstance(acc, (list, tuple)) else acc
+                cb.call("on_train_batch_end", step, logs)
+            epoch_logs = {"loss": float(np.mean(losses))} if losses else {}
             if eval_data is not None and (epoch + 1) % eval_freq == 0:
-                self.evaluate(eval_data, batch_size=batch_size, verbose=verbose)
+                result = self.evaluate(eval_data, batch_size=batch_size,
+                                       verbose=verbose)
+                for k, v in result.items():
+                    val = v[0] if isinstance(v, (list, tuple)) and v else v
+                    if isinstance(val, (int, float)):
+                        epoch_logs[f"eval_{k}"] = val
+            cb.call("on_epoch_end", epoch, epoch_logs)
             if save_dir and (epoch + 1) % save_freq == 0:
                 self.save(f"{save_dir}/epoch{epoch + 1}")
+            if self.stop_training:
+                break
+        cb.call("on_train_end")
         return self
 
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,

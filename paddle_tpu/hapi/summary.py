@@ -1,0 +1,92 @@
+"""``paddle.summary`` / ``paddle.flops`` (hapi/model_summary.py +
+hapi/dynamic_flops.py analogs): layer table from forward hooks + a FLOPs
+estimate for the common layer types."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..nn.layers import Layer
+
+
+def summary(net: Layer, input_size=None, dtypes=None, input=None):
+    """Print a per-layer table of output shapes + param counts."""
+    import jax.numpy as jnp
+
+    rows = []
+    handles = []
+
+    def hook(layer, inputs, outputs):
+        out = outputs[0] if isinstance(outputs, (list, tuple)) else outputs
+        shape = list(out.shape) if isinstance(out, Tensor) else None
+        n_params = sum(p.size for p in layer.parameters(include_sublayers=False))
+        rows.append((type(layer).__name__, shape, n_params))
+
+    for layer in net.sublayers(include_self=False):
+        handles.append(layer.register_forward_post_hook(hook))
+    try:
+        if input is not None:
+            x = input if isinstance(input, (list, tuple)) else [input]
+        else:
+            sizes = input_size if isinstance(input_size, list) else [input_size]
+            x = [Tensor(jnp.zeros(tuple(s), jnp.float32)) for s in sizes]
+        net.eval()
+        net(*x)
+    finally:
+        for h in handles:
+            h.remove()
+
+    total = sum(p.size for p in net.parameters())
+    trainable = sum(p.size for p in net.parameters() if not p.stop_gradient)
+    width = 28
+    lines = [f"{'Layer (type)':<{width}}{'Output Shape':<24}{'Param #':>12}",
+             "-" * (width + 36)]
+    for name, shape, n in rows:
+        lines.append(f"{name:<{width}}{str(shape):<24}{n:>12,}")
+    lines += ["-" * (width + 36),
+              f"Total params: {total:,}",
+              f"Trainable params: {trainable:,}",
+              f"Non-trainable params: {total - trainable:,}"]
+    print("\n".join(lines))
+    return {"total_params": total, "trainable_params": trainable}
+
+
+def flops(net: Layer, input_size, custom_ops=None, print_detail: bool = False):
+    """Estimate forward FLOPs (dynamic_flops.py analog) for conv/linear/
+    norm/attention-bearing models via forward hooks."""
+    import jax.numpy as jnp
+
+    from ..nn.common import Linear
+    from ..nn.conv import Conv2D
+
+    total = [0]
+    handles = []
+
+    def conv_hook(layer, inputs, outputs):
+        out = outputs[0] if isinstance(outputs, (list, tuple)) else outputs
+        k = int(np.prod(layer._kernel_size)) if hasattr(layer, "_kernel_size") else (
+            int(np.prod(layer.weight.shape[2:])))
+        cin = layer.weight.shape[1]
+        total[0] += 2 * int(np.prod(out.shape)) * cin * k
+
+    def linear_hook(layer, inputs, outputs):
+        out = outputs[0] if isinstance(outputs, (list, tuple)) else outputs
+        total[0] += 2 * int(np.prod(out.shape)) * layer.weight.shape[0]
+
+    for layer in net.sublayers(include_self=False):
+        if isinstance(layer, Conv2D):
+            handles.append(layer.register_forward_post_hook(conv_hook))
+        elif isinstance(layer, Linear):
+            handles.append(layer.register_forward_post_hook(linear_hook))
+    try:
+        net.eval()
+        net(Tensor(jnp.zeros(tuple(input_size), jnp.float32)))
+    finally:
+        for h in handles:
+            h.remove()
+    if print_detail:
+        print(f"Total FLOPs: {total[0]:,}")
+    return total[0]

@@ -144,3 +144,54 @@ class TestIncubateFused:
         out.sum().backward()
         missing = [n for n, p in layer.named_parameters() if p.grad is None]
         assert missing == []
+
+
+class TestHapi:
+    def test_summary_and_flops(self, capsys):
+        net = paddle.vision.models.LeNet()
+        info = paddle.summary(net, input_size=(1, 1, 28, 28))
+        assert info["total_params"] == sum(p.size for p in net.parameters())
+        out = capsys.readouterr().out
+        assert "Total params" in out
+        assert paddle.flops(net, (1, 1, 28, 28)) > 0
+
+    def test_fit_with_early_stopping(self):
+        from paddle_tpu.hapi.callbacks import EarlyStopping
+        from paddle_tpu.io.dataset import Dataset
+
+        class DS(Dataset):
+            def __len__(self):
+                return 16
+
+            def __getitem__(self, i):
+                x = np.zeros((1, 28, 28), "float32")
+                x[0, i % 10] = 1.0
+                return x, np.int64(i % 10)
+
+        m = paddle.Model(paddle.vision.models.LeNet())
+        m.prepare(
+            paddle.optimizer.Adam(learning_rate=1e-3,
+                                  parameters=m.parameters()),
+            nn.CrossEntropyLoss(), paddle.metric.Accuracy())
+        es = EarlyStopping(monitor="loss", patience=0, baseline=-1.0)
+        m.fit(DS(), batch_size=8, epochs=4, verbose=0, callbacks=[es])
+        assert m.stop_training and es.stopped_epoch == 0
+
+    def test_lr_scheduler_callback_steps(self):
+        from paddle_tpu.io.dataset import Dataset
+
+        class DS(Dataset):
+            def __len__(self):
+                return 8
+
+            def __getitem__(self, i):
+                return np.zeros((4,), "float32"), np.int64(0)
+
+        sched = paddle.optimizer.lr.StepDecay(learning_rate=0.1, step_size=1,
+                                              gamma=0.5)
+        m = paddle.Model(nn.Linear(4, 2))
+        m.prepare(paddle.optimizer.SGD(learning_rate=sched,
+                                       parameters=m.parameters()),
+                  nn.CrossEntropyLoss())
+        m.fit(DS(), batch_size=4, epochs=1, verbose=0)
+        assert sched.last_lr < 0.1  # stepped by the auto-added LR callback

@@ -73,12 +73,14 @@ class PipelineLayer(Layer):
 
     def __init__(self, layers: Sequence, num_stages: Optional[int] = None,
                  topology=None, loss_fn=None, seg_method="uniform",
-                 recompute_interval: int = 0, **kwargs):
+                 recompute_interval: int = 0,
+                 num_virtual_pipeline_stages: Optional[int] = None, **kwargs):
         super().__init__()
         from ..nn.container import LayerList
 
         self._descs = list(layers)
-        self.num_stages = num_stages or _pp_degree()
+        self.num_virtual_stages = num_virtual_pipeline_stages or 1
+        self.num_stages = (num_stages or _pp_degree()) * self.num_virtual_stages
         self.loss_fn = loss_fn
         self.recompute_interval = recompute_interval
         self._shared: dict = {}
@@ -198,12 +200,32 @@ def pipeline_forward(layer: PipelineLayer, x: Tensor, n_microbatch: int,
                      extra=None) -> Tensor:
     """Tensor-level pipeline forward for homogeneous stages: every stage must
     hold structurally identical layers (the decoder-stack case; put
-    embedding/head outside the pipelined region, see models/llama.py)."""
+    embedding/head outside the pipelined region, see models/llama.py).
+
+    With ``num_virtual_pipeline_stages=v`` > 1 (interleaved VPP,
+    ``PipelineParallelWithInterleave`` analog) the stack is cut into n·v
+    segments, chunk ``r`` of device ``d`` holding segment ``r·n + d``; the
+    microbatch ring runs ``v`` sweeps, one per chunk round.  (The depth-first
+    1F1B interleaving that shrinks the bubble further is a scheduling
+    refinement on top of this placement.)"""
     n = _pp_degree()
     if n == 1:
         return layer(x)
 
+    v = layer.num_virtual_stages
     stage_layers = [layer.get_stage_layers(s) for s in range(layer.num_stages)]
+    if v > 1:
+        # run v chained sweeps: sweep r uses segments [r*n, (r+1)*n)
+        out = x
+        rounds = [stage_layers[r * n:(r + 1) * n] for r in range(v)]
+        for round_layers in rounds:
+            out = _pipeline_forward_ring(round_layers, out, n_microbatch, extra)
+        return out
+    return _pipeline_forward_ring(stage_layers, x, n_microbatch, extra)
+
+
+def _pipeline_forward_ring(stage_layers, x: Tensor, n_microbatch: int,
+                           extra=None) -> Tensor:
     # stack_states reads param values directly (no run_op), and inside the
     # shard_map body params hold manual tracers the recorder must ignore —
     # register them as to_static state here, while values are concrete.
@@ -249,7 +271,7 @@ def pipeline_forward(layer: PipelineLayer, x: Tensor, n_microbatch: int,
         for pi in range(n_params_per_layer[li]):
             param_groups.append(
                 [list(stage_layers[s][li].parameters())[pi]
-                 for s in range(layer.num_stages)])
+                 for s in range(len(stage_layers))])
 
     leaf_tensors = []
     for leaf, group in zip(leaves, param_groups):

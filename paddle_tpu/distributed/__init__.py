@@ -5,7 +5,7 @@ sep, mp] replaces NCCL process groups; XLA collectives over named axes
 replace collective kernels; GSPMD shardings replace the reshard lattice.
 """
 
-from . import auto_tuner, checkpoint, collective, env, launch, topology, watchdog  # noqa: F401
+from . import auto_tuner, checkpoint, collective, env, launch, rpc, topology, watchdog  # noqa: F401
 from .auto_tuner import AutoTuner, ModelSpec, TuneConfig  # noqa: F401
 from .checkpoint import load_state_dict, save_state_dict  # noqa: F401
 from .spawn import spawn  # noqa: F401

@@ -1,0 +1,140 @@
+"""``paddle.distributed.rpc`` — sync/async RPC with master-coordinated
+service discovery (``python/paddle/distributed/rpc/rpc.py`` analog; the
+reference backs this with brpc — here a socket server per worker plus the
+C++ TCPStore for discovery).
+
+API parity: ``init_rpc``, ``rpc_sync``, ``rpc_async``, ``shutdown``,
+``get_worker_info``, ``get_all_worker_infos``.
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+from concurrent.futures import Future, ThreadPoolExecutor
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional
+
+_state: Dict[str, Any] = {}
+
+
+@dataclass(frozen=True)
+class WorkerInfo:
+    name: str
+    rank: int
+    ip: str
+    port: int
+
+
+def _send_msg(sock, payload: bytes):
+    sock.sendall(struct.pack("<Q", len(payload)) + payload)
+
+
+def _recv_msg(sock) -> bytes:
+    hdr = b""
+    while len(hdr) < 8:
+        c = sock.recv(8 - len(hdr))
+        if not c:
+            raise ConnectionError("rpc peer closed")
+        hdr += c
+    (n,) = struct.unpack("<Q", hdr)
+    buf = b""
+    while len(buf) < n:
+        c = sock.recv(min(1 << 20, n - len(buf)))
+        if not c:
+            raise ConnectionError("rpc peer closed")
+        buf += c
+    return buf
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        try:
+            fn, args, kwargs = pickle.loads(_recv_msg(self.request))
+            try:
+                result = (True, fn(*args, **kwargs))
+            except Exception as e:  # ship the exception back
+                result = (False, e)
+            _send_msg(self.request, pickle.dumps(result))
+        except ConnectionError:
+            pass
+
+
+class _Server(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+def init_rpc(name: str, rank: Optional[int] = None,
+             world_size: Optional[int] = None,
+             master_endpoint: Optional[str] = None):
+    """Start this worker's RPC server and register with the master store."""
+    import os
+
+    from .store import TCPStore
+
+    rank = rank if rank is not None else int(os.environ.get("PADDLE_TRAINER_ID", 0))
+    world_size = world_size or int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+    master_endpoint = master_endpoint or os.environ.get(
+        "PADDLE_MASTER_ENDPOINT", "127.0.0.1:8765")
+    host, port = master_endpoint.rsplit(":", 1)
+
+    server = _Server(("127.0.0.1", 0), _Handler)
+    sport = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+
+    store = TCPStore(host, int(port), is_master=(rank == 0),
+                     world_size=world_size)
+    store.set(f"rpc/{rank}", f"{name},{rank},127.0.0.1,{sport}")
+    infos = {}
+    for r in range(world_size):
+        raw = store.wait(f"rpc/{r}").decode()
+        n, rr, ip, p = raw.split(",")
+        infos[n] = WorkerInfo(n, int(rr), ip, int(p))
+    _state.update(server=server, store=store, infos=infos, name=name,
+                  pool=ThreadPoolExecutor(max_workers=8))
+
+
+def get_worker_info(name: Optional[str] = None) -> WorkerInfo:
+    infos = _state["infos"]
+    return infos[name or _state["name"]]
+
+
+def get_all_worker_infos() -> List[WorkerInfo]:
+    return sorted(_state["infos"].values(), key=lambda w: w.rank)
+
+
+def _call(to: str, fn, args, kwargs):
+    info = get_worker_info(to)
+    with socket.create_connection((info.ip, info.port), timeout=60) as s:
+        _send_msg(s, pickle.dumps((fn, args or (), kwargs or {})))
+        ok, payload = pickle.loads(_recv_msg(s))
+    if not ok:
+        raise payload
+    return payload
+
+
+def rpc_sync(to: str, fn, args=None, kwargs=None, timeout: float = -1):
+    """Blocking remote call; returns the result."""
+    return _call(to, fn, args, kwargs)
+
+
+def rpc_async(to: str, fn, args=None, kwargs=None, timeout: float = -1) -> Future:
+    """Non-blocking remote call; returns a Future (``.wait()`` supported)."""
+    fut = _state["pool"].submit(_call, to, fn, args, kwargs)
+    fut.wait = fut.result  # paddle API: fut.wait()
+    return fut
+
+
+def shutdown():
+    if "server" in _state:
+        _state["server"].shutdown()
+        _state["server"].server_close()
+    if "pool" in _state:
+        _state["pool"].shutdown(wait=False)
+    if "store" in _state:
+        _state["store"].close()
+    _state.clear()

@@ -74,6 +74,7 @@ class LlamaConfig:
     recompute: bool = False
     use_flash_attention: bool = True
     dtype: str = "float32"
+    virtual_pp_degree: int = 1          # interleaved VPP chunks per device
     # MoE knobs (0 experts = dense; DeepSeek/Qwen2-MoE style otherwise)
     num_experts: int = 0
     num_experts_per_tok: int = 2
@@ -343,8 +344,9 @@ class LlamaModel(Layer):
 
     def _pipeline(self) -> PipelineLayer:
         if self._pipe is None:
-            self._pipe = PipelineLayer(list(self.layers),
-                                       num_stages=axis_size("pp"))
+            self._pipe = PipelineLayer(
+                list(self.layers), num_stages=axis_size("pp"),
+                num_virtual_pipeline_stages=self.config.virtual_pp_degree)
         return self._pipe
 
     def forward(self, input_ids, pp_microbatches: Optional[int] = None,

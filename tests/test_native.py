@@ -158,3 +158,29 @@ class TestShmDataLoader:
         dl = DataLoader(Bad(), batch_size=2, num_workers=2)
         with pytest.raises(ValueError, match="boom"):
             list(dl)
+
+
+def _square(x):
+    return x * x
+
+
+def _div0():
+    return 1 / 0
+
+
+class TestRPC:
+    def test_sync_async_and_exceptions(self):
+        from paddle_tpu.distributed import rpc
+
+        rpc.init_rpc("w0", rank=0, world_size=1,
+                     master_endpoint="127.0.0.1:29941")
+        try:
+            assert rpc.rpc_sync("w0", _square, args=(7,)) == 49
+            fut = rpc.rpc_async("w0", _square, args=(8,))
+            assert fut.wait() == 64
+            with pytest.raises(ZeroDivisionError):
+                rpc.rpc_sync("w0", _div0)
+            infos = rpc.get_all_worker_infos()
+            assert len(infos) == 1 and infos[0].name == "w0"
+        finally:
+            rpc.shutdown()

@@ -12,9 +12,21 @@
 // for free/used slots; a pshared mutex serializes head/tail updates so any
 // number of producers/consumers is safe. Messages must fit in one slot.
 //
+// Multi-producer commit ordering: a producer claims its slot (and a
+// monotonically increasing ticket) under the mutex but copies the payload
+// after unlocking, so with >=2 producers a later-claimed slot can finish
+// first and post used_slots while the head slot is still being written.
+// Each slot therefore carries a commit sequence number: the producer with
+// ticket T stores T+1 into its slot's commit word (release) only after the
+// payload and length are fully written, and the consumer holding pop ticket
+// T spins (acquire) until the head slot's commit word equals T+1 before
+// reading. Tickets advance by n_slots per lap, so a stale commit from the
+// previous lap can never satisfy the wait.
+//
 // C ABI for ctypes. No exceptions across the boundary; every function
 // returns 0 on success / -errno on failure.
 
+#include <atomic>
 #include <cerrno>
 #include <cstdint>
 #include <cstring>
@@ -32,18 +44,27 @@ struct RingHeader {
   uint64_t magic;
   uint32_t n_slots;
   uint64_t slot_size;
-  uint32_t head;  // next slot to read
-  uint32_t tail;  // next slot to write
+  uint32_t head;          // next slot to read
+  uint32_t tail;          // next slot to write
+  uint64_t push_tickets;  // claim-order counters, protected by mutex
+  uint64_t pop_tickets;
   pthread_mutex_t mutex;
   sem_t free_slots;
   sem_t used_slots;
-  // slot lengths follow, then slot data
+  // per-slot commit words follow, then slot lengths, then slot data
 };
 
-constexpr uint64_t kMagic = 0x70616464726e67ULL;  // "paddrng"
+static_assert(std::atomic<uint64_t>::is_always_lock_free,
+              "shared-memory commit words must be lock-free");
+
+constexpr uint64_t kMagic = 0x70616464726e6732ULL;  // "paddrng2" (v2 layout)
+
+inline std::atomic<uint64_t>* slot_commits(RingHeader* h) {
+  return reinterpret_cast<std::atomic<uint64_t>*>(h + 1);
+}
 
 inline uint64_t* slot_lens(RingHeader* h) {
-  return reinterpret_cast<uint64_t*>(h + 1);
+  return reinterpret_cast<uint64_t*>(slot_commits(h) + h->n_slots);
 }
 
 inline char* slot_data(RingHeader* h, uint32_t idx) {
@@ -52,7 +73,8 @@ inline char* slot_data(RingHeader* h, uint32_t idx) {
 }
 
 inline uint64_t total_size(uint32_t n_slots, uint64_t slot_size) {
-  return sizeof(RingHeader) + n_slots * sizeof(uint64_t) +
+  return sizeof(RingHeader) +
+         n_slots * (sizeof(std::atomic<uint64_t>) + sizeof(uint64_t)) +
          static_cast<uint64_t>(n_slots) * slot_size;
 }
 
@@ -104,6 +126,11 @@ void* ring_create(const char* name, uint32_t n_slots, uint64_t slot_size) {
   h->slot_size = slot_size;
   h->head = 0;
   h->tail = 0;
+  h->push_tickets = 0;
+  h->pop_tickets = 0;
+  for (uint32_t i = 0; i < n_slots; ++i) {
+    slot_commits(h)[i].store(0, std::memory_order_relaxed);
+  }
   pthread_mutexattr_t mattr;
   pthread_mutexattr_init(&mattr);
   pthread_mutexattr_setpshared(&mattr, PTHREAD_PROCESS_SHARED);
@@ -158,9 +185,12 @@ int ring_push(void* ring, const void* data, uint64_t len, long timeout_ms) {
   if ((rc = lock_robust(h)) != 0) return rc;
   uint32_t idx = h->tail;
   h->tail = (h->tail + 1) % h->n_slots;
+  uint64_t ticket = h->push_tickets++;
   pthread_mutex_unlock(&h->mutex);
   memcpy(slot_data(h, idx), data, len);
   slot_lens(h)[idx] = len;
+  // commit AFTER payload+len are fully written; pop waits on this word
+  slot_commits(h)[idx].store(ticket + 1, std::memory_order_release);
   sem_post(&h->used_slots);
   return 0;
 }
@@ -172,7 +202,27 @@ int64_t ring_pop(void* ring, void* buf, uint64_t cap, long timeout_ms) {
   if (rc != 0) return rc;
   if ((rc = lock_robust(h)) != 0) return rc;
   uint32_t idx = h->head;
+  uint64_t ticket = h->pop_tickets;
+  // used_slots only proves SOME producer committed; wait (bounded by the
+  // caller's timeout) until the producer of THIS slot (push ticket == our
+  // pop ticket) has committed it.  head/ticket are advanced only after the
+  // commit is observed, so a timeout leaves the ring state untouched —
+  // a producer dying mid-write costs -ETIMEDOUT, not a wedged consumer.
+  // Spinning with the mutex held is safe: committing producers don't take
+  // the mutex, and blocked peers just see backpressure.
+  timespec nap{0, 50000};  // 50 µs
+  long waited_us = 0;
+  while (slot_commits(h)[idx].load(std::memory_order_acquire) != ticket + 1) {
+    if (timeout_ms >= 0 && waited_us >= timeout_ms * 1000) {
+      pthread_mutex_unlock(&h->mutex);
+      sem_post(&h->used_slots);  // give the message back
+      return -ETIMEDOUT;
+    }
+    nanosleep(&nap, nullptr);
+    waited_us += 50;
+  }
   h->head = (h->head + 1) % h->n_slots;
+  h->pop_tickets++;
   pthread_mutex_unlock(&h->mutex);
   uint64_t len = slot_lens(h)[idx];
   if (len > cap) {

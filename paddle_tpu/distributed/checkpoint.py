@@ -113,17 +113,40 @@ def _shard_index_to_offset(index, shape) -> Tuple[Tuple[int, ...], Tuple[int, ..
     return tuple(offs), tuple(shp)
 
 
+def _choose_uid(path: str, rank: int) -> int:
+    """Smallest unused unique_id for this rank's shard file — re-saving into
+    an existing checkpoint dir must never overwrite files an old manifest
+    still points at (reference save_state_dict unique_id behavior)."""
+    uid = 0
+    while os.path.exists(os.path.join(path, f"{rank}_{uid}.distcp.npz")):
+        uid += 1
+    return uid
+
+
+def _barrier(name: str) -> None:
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(name)
+
+
 def save_state_dict(state_dict: Dict[str, Any], path: str,
                     process_group=None, coordinator_rank: int = 0) -> None:
     """Save a (possibly nested) state dict of sharded tensors
-    (``save_state_dict.py:104`` analog)."""
+    (``save_state_dict.py:104`` analog).
+
+    Multi-host protocol: every process writes its own shard file plus a
+    per-rank metadata file, all processes barrier, then the coordinator
+    merges every rank's chunk lists into the global manifest (the analog of
+    the reference's ``all_gather_objects`` before the coordinator write).
+    """
     os.makedirs(path, exist_ok=True)
     rank = jax.process_index()
     flat = _flatten(state_dict)
 
     arrays: Dict[str, np.ndarray] = {}
     md = Metadata()
-    fname = f"{rank}_0.distcp.npz"
+    fname = f"{rank}_{_choose_uid(path, rank)}.distcp.npz"
     for name, value in flat.items():
         arr = _unwrap(value)
         if arr is None:
@@ -149,18 +172,45 @@ def save_state_dict(state_dict: Dict[str, Any], path: str,
         md.tensors[name] = tm
 
     np.savez(os.path.join(path, fname), **arrays)
+    rank_meta = os.path.join(path, f".rankmeta.{rank}.json")
+    with open(rank_meta + ".tmp", "w") as f:
+        f.write(md.to_json())
+    os.replace(rank_meta + ".tmp", rank_meta)
 
-    # multi-host: every process writes its shard file; the coordinator merges
-    # per-process metadata (single-process: just write it)
+    # all shard + rank-meta files on disk before the coordinator merges
+    _barrier("ckpt_save_shards")
+
     if rank == coordinator_rank:
+        merged = Metadata()
+        for r in range(jax.process_count()):
+            rm = os.path.join(path, f".rankmeta.{r}.json")
+            part = Metadata.from_json(open(rm).read())
+            for name, tm in part.tensors.items():
+                have = merged.tensors.get(name)
+                if have is None:
+                    merged.tensors[name] = tm
+                else:
+                    have.chunks.extend(tm.chunks)
         meta_path = os.path.join(path, _METADATA_FILE)
         if os.path.exists(meta_path):
+            # partial re-save into an existing dir (e.g. model then optimizer):
+            # keep old entries only for tensors NOT in this save — uid probing
+            # guarantees their shard files were not overwritten
             existing = Metadata.from_json(open(meta_path).read())
             for name, tm in existing.tensors.items():
-                if name not in md.tensors:
-                    md.tensors[name] = tm
-        with open(meta_path, "w") as f:
-            f.write(md.to_json())
+                if name not in merged.tensors:
+                    merged.tensors[name] = tm
+        with open(meta_path + ".tmp", "w") as f:
+            f.write(merged.to_json())
+        os.replace(meta_path + ".tmp", meta_path)
+        for r in range(jax.process_count()):
+            try:
+                os.unlink(os.path.join(path, f".rankmeta.{r}.json"))
+            except OSError:
+                pass
+
+    # no process returns before the manifest exists
+    _barrier("ckpt_save_manifest")
 
 
 class _ChunkReader:

@@ -82,13 +82,29 @@ def init_rpc(name: str, rank: Optional[int] = None,
         "PADDLE_MASTER_ENDPOINT", "127.0.0.1:8765")
     host, port = master_endpoint.rsplit(":", 1)
 
-    server = _Server(("127.0.0.1", 0), _Handler)
+    # bind all interfaces; advertise a routable address so cross-host
+    # workers don't connect to their own loopback
+    server = _Server(("0.0.0.0", 0), _Handler)
     sport = server.server_address[1]
     threading.Thread(target=server.serve_forever, daemon=True).start()
 
+    my_ip = os.environ.get("PADDLE_CURRENT_ENDPOINT", "").rsplit(":", 1)[0]
+    if not my_ip:
+        # derive the interface that actually routes to the master (a UDP
+        # connect does no traffic); gethostbyname(hostname) often resolves
+        # to 127.0.1.1 on stock distros, which would silently break
+        # cross-host RPC
+        try:
+            probe = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            probe.connect((host, int(port)))
+            my_ip = probe.getsockname()[0]
+            probe.close()
+        except OSError:
+            my_ip = "127.0.0.1"
+
     store = TCPStore(host, int(port), is_master=(rank == 0),
                      world_size=world_size)
-    store.set(f"rpc/{rank}", f"{name},{rank},127.0.0.1,{sport}")
+    store.set(f"rpc/{rank}", f"{name},{rank},{my_ip},{sport}")
     infos = {}
     for r in range(world_size):
         raw = store.wait(f"rpc/{r}").decode()

@@ -194,11 +194,8 @@ class LlamaAttention(Layer):
         if cache is not None:
             return self._cached_attention(q, k, v, cache, pos, B, S, hd)
 
-        rep = self.num_heads // self.num_kv_heads
-        if rep > 1:
-            k = run_op("repeat_kv", lambda a: jnp.repeat(a, rep, axis=2), k)
-            v = run_op("repeat_kv", lambda a: jnp.repeat(a, rep, axis=2), v)
-
+        # GQA KV heads are consumed natively by every attention path (pallas
+        # index maps / grouped einsums) — never repeated into 4x HBM traffic
         # ring attention when sequence is sep-sharded; per-device flash/XLA
         # attention otherwise (ring_flash_attention falls through itself)
         out = ring_flash_attention(q, k, v, causal=True)
@@ -226,18 +223,18 @@ class LlamaAttention(Layer):
         scale = 1.0 / math.sqrt(hd)
 
         def attend(qv, kb, vb, p):
-            if rep > 1:
-                kb = jnp.repeat(kb, rep, axis=2)
-                vb = jnp.repeat(vb, rep, axis=2)
+            # GQA grouped einsum: q [B,S,Hkv,rep,D] vs KV [B,M,Hkv,D] —
+            # the cache is streamed once, not repeated rep× (hot decode path)
             M = kb.shape[1]
-            logits = jnp.einsum("bqhd,bkhd->bhqk", qv, kb,
+            qg = qv.reshape(B, S, self.num_kv_heads, rep, hd)
+            logits = jnp.einsum("bqhrd,bkhd->bhrqk", qg, kb,
                                 preferred_element_type=jnp.float32) * scale
             col = jnp.arange(M)[None, :]
             row = jnp.arange(S)[:, None]
             mask = col <= (p + row)               # causal over written prefix
-            logits = jnp.where(mask[None, None], logits, -1e30)
+            logits = jnp.where(mask[None, None, None], logits, -1e30)
             probs = jax.nn.softmax(logits, axis=-1)
-            out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(vb.dtype), vb)
+            out = jnp.einsum("bhrqk,bkhd->bqhrd", probs.astype(vb.dtype), vb)
             return out.reshape(B, S, self.num_heads * hd)
 
         out = run_op("cached_attention", attend, q, k_buf, v_buf, pos)

@@ -18,6 +18,11 @@ import jax.numpy as jnp
 
 _FLASH_MIN_SEQ = 1024  # below this, XLA's fused softmax path is already fast
 
+# Which path the most recent dispatch took: "pallas" | "xla".  Benchmarks and
+# tests read this so a kernel regression shows up as a loud signal, not a
+# silent perf cliff (VERDICT r1 weak #5).
+last_path: str | None = None
+
 
 def use_flash(q_shape, attn_mask) -> bool:
     import os
@@ -37,29 +42,43 @@ def use_flash(q_shape, attn_mask) -> bool:
 
 
 def _reference_attention(q, k, v, causal: bool):
+    """XLA composite attention; GQA-native via grouped einsum (query heads
+    reshaped [B,S,Hkv,rep,D] against ungrouped KV — no repeated KV buffer)."""
     B, Sq, H, D = q.shape
+    Sk, Hkv = k.shape[1], k.shape[2]
+    rep = H // Hkv
     scale = 1.0 / math.sqrt(D)
-    qh = jnp.swapaxes(q, 1, 2)
-    kh = jnp.swapaxes(k, 1, 2)
-    vh = jnp.swapaxes(v, 1, 2)
-    logits = jnp.einsum("bhqd,bhkd->bhqk", qh, kh, preferred_element_type=jnp.float32) * scale
+    qg = q.reshape(B, Sq, Hkv, rep, D)
+    logits = jnp.einsum("bqhrd,bkhd->bhrqk", qg, k,
+                        preferred_element_type=jnp.float32) * scale
     if causal:
-        Sk = kh.shape[2]
         mask = jnp.tril(jnp.ones((Sq, Sk), bool), k=Sk - Sq)
         logits = jnp.where(mask, logits, -jnp.inf)
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
-    out = jnp.einsum("bhqk,bhkd->bhqd", probs, vh)
-    return jnp.swapaxes(out, 1, 2)
+    out = jnp.einsum("bhrqk,bkhd->bqhrd", probs, v)
+    return out.reshape(B, Sq, H, D)
 
 
 def flash_attention_fwd(q, k, v, causal: bool = False):
     """Dispatch: Pallas fused kernel on TPU for long sequences, XLA otherwise."""
+    global last_path
     if use_flash(q.shape, None):
         try:
             from .pallas_flash import flash_attention as pallas_flash
 
             # positional: custom_vjp with nondiff_argnums rejects kwargs
-            return pallas_flash(q, k, v, causal)
-        except Exception:
-            pass
+            out = pallas_flash(q, k, v, causal)
+            last_path = "pallas"
+            return out
+        except Exception as e:
+            import os
+            import warnings
+
+            if os.environ.get("PADDLE_TPU_STRICT_PALLAS") == "1":
+                raise
+            warnings.warn(
+                f"pallas flash attention failed, falling back to XLA "
+                f"composite path (set PADDLE_TPU_STRICT_PALLAS=1 to raise): "
+                f"{type(e).__name__}: {e}", RuntimeWarning, stacklevel=2)
+    last_path = "xla"
     return _reference_attention(q, k, v, causal)

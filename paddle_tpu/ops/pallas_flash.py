@@ -95,6 +95,9 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
     Sk = k.shape[1]
     n_q = pl.cdiv(S, block_q)
     n_k = pl.cdiv(Sk, block_k)
+    # GQA: query head h reads KV head h // group straight from the BlockSpec
+    # index map — no jnp.repeat, no extra KV HBM traffic
+    group = H // k.shape[2]
 
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal,
@@ -105,8 +108,10 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
         grid=(B, H, n_q, n_k),
         in_specs=[
             pl.BlockSpec((1, block_q, 1, D), lambda b, h, i, j: (b, i, h, 0)),
-            pl.BlockSpec((1, block_k, 1, D), lambda b, h, i, j: (b, j, h, 0)),
-            pl.BlockSpec((1, block_k, 1, D), lambda b, h, i, j: (b, j, h, 0)),
+            pl.BlockSpec((1, block_k, 1, D),
+                         lambda b, h, i, j: (b, j, h // group, 0)),
+            pl.BlockSpec((1, block_k, 1, D),
+                         lambda b, h, i, j: (b, j, h // group, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, 1, D), lambda b, h, i, j: (b, i, h, 0)),
@@ -174,11 +179,12 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal,
-                    block_q, block_k, n_q):
+                    block_q, block_k, n_q, group):
     ki = pl.program_id(2)
-    qi = pl.program_id(3)
+    gi = pl.program_id(3)
+    qi = pl.program_id(4)
 
-    @pl.when(qi == 0)
+    @pl.when((gi == 0) & (qi == 0))
     def _init():
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
@@ -214,7 +220,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)  # [BK, D]
 
-    @pl.when(qi == n_q - 1)
+    @pl.when((gi == group - 1) & (qi == n_q - 1))
     def _finish():
         dk_ref[0, :, 0, :] = dk_acc[:].astype(dk_ref.dtype)
         dv_ref[0, :, 0, :] = dv_acc[:].astype(dv_ref.dtype)
@@ -224,6 +230,8 @@ def _flash_bwd(res, g, *, scale, causal, block_q, block_k):
     q, k, v, out, lse = res
     B, S, H, D = q.shape
     Sk = k.shape[1]
+    Hkv = k.shape[2]
+    group = H // Hkv
     n_q = pl.cdiv(S, block_q)
     n_k = pl.cdiv(Sk, block_k)
     do = g
@@ -233,7 +241,8 @@ def _flash_bwd(res, g, *, scale, causal, block_q, block_k):
                        out.astype(jnp.float32))
 
     q_spec = pl.BlockSpec((1, block_q, 1, D), lambda b, h, i, j: (b, i, h, 0))
-    k_spec = pl.BlockSpec((1, block_k, 1, D), lambda b, h, i, j: (b, j, h, 0))
+    k_spec = pl.BlockSpec((1, block_k, 1, D),
+                          lambda b, h, i, j: (b, j, h // group, 0))
     r_spec = pl.BlockSpec((1, 1, block_q), lambda b, h, i, j: (b, h, i))
 
     dq = pl.pallas_call(
@@ -247,19 +256,25 @@ def _flash_bwd(res, g, *, scale, causal, block_q, block_k):
         interpret=_interpret(),
     )(q, k, v, do, lse, delta)[0]
 
-    # dk/dv: grid iterates q fastest for fixed kv block
-    q_spec2 = pl.BlockSpec((1, block_q, 1, D), lambda b, h, j, i: (b, i, h, 0))
-    k_spec2 = pl.BlockSpec((1, block_k, 1, D), lambda b, h, j, i: (b, j, h, 0))
-    r_spec2 = pl.BlockSpec((1, 1, block_q), lambda b, h, j, i: (b, h, i))
+    # dk/dv: for each KV block, accumulate across the whole query-head group
+    # then the q blocks — grid (B, Hkv, n_k, group, n_q), KV block resident
+    # in VMEM for the full (group × n_q) sweep
+    q_spec2 = pl.BlockSpec((1, block_q, 1, D),
+                           lambda b, kh, j, g_, i: (b, i, kh * group + g_, 0))
+    k_spec2 = pl.BlockSpec((1, block_k, 1, D),
+                           lambda b, kh, j, g_, i: (b, j, kh, 0))
+    r_spec2 = pl.BlockSpec((1, 1, block_q),
+                           lambda b, kh, j, g_, i: (b, kh * group + g_, i))
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k, n_q=n_q),
-        grid=(B, H, n_k, n_q),
+                          block_q=block_q, block_k=block_k, n_q=n_q,
+                          group=group),
+        grid=(B, Hkv, n_k, group, n_q),
         in_specs=[q_spec2, k_spec2, k_spec2, q_spec2, r_spec2, r_spec2],
         out_specs=[k_spec2, k_spec2],
         out_shape=[
-            jax.ShapeDtypeStruct((B, Sk, H, D), k.dtype),
-            jax.ShapeDtypeStruct((B, Sk, H, D), v.dtype),
+            jax.ShapeDtypeStruct((B, Sk, Hkv, D), k.dtype),
+            jax.ShapeDtypeStruct((B, Sk, Hkv, D), v.dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, D), jnp.float32),
@@ -277,8 +292,13 @@ def _flash_bwd(res, g, *, scale, causal, block_q, block_k):
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
 def flash_attention(q, k, v, causal=False,
                     block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K):
-    """Fused attention over [B, S, H, D] tensors.  Same-head-count Q/KV
-    (repeat GQA KV heads before calling)."""
+    """Fused attention over [B, S, H, D] q and [B, S, Hkv, D] k/v.
+
+    GQA/MQA-native: when Hkv < H (H divisible by Hkv), each query head reads
+    its group's KV head directly via the BlockSpec index map — KV is streamed
+    from HBM once per group, never materialised repeated."""
+    assert q.shape[2] % k.shape[2] == 0, (
+        f"query heads {q.shape[2]} not divisible by kv heads {k.shape[2]}")
     scale = 1.0 / math.sqrt(q.shape[-1])
     out, _ = _flash_fwd(q, k, v, scale, causal, block_q, block_k)
     return out

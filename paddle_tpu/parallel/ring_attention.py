@@ -33,9 +33,15 @@ SEP_AXIS = "sep"
 
 def _block_attn(q, k, v, bias_mask, scale):
     """One blockwise attention step in f32: returns (numerator [B,Sq,H,D],
-    row-sum [B,H,Sq], row-max [B,H,Sq])."""
-    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+    row-sum [B,H,Sq], row-max [B,H,Sq]).  GQA-native: q [B,Sq,H,D] against
+    k/v [B,Sk,Hkv,D] via grouped einsum — KV never repeated."""
+    B, Sq, H, D = q.shape
+    Sk, Hkv = k.shape[1], k.shape[2]
+    rep = H // Hkv
+    qg = q.reshape(B, Sq, Hkv, rep, D)
+    logits = jnp.einsum("bqhrd,bkhd->bhrqk", qg, k,
                         preferred_element_type=jnp.float32) * scale
+    logits = logits.reshape(B, H, Sq, Sk)
     if bias_mask is not None:
         logits = jnp.where(bias_mask, logits, -jnp.inf)
     m = jnp.max(logits, axis=-1)
@@ -44,7 +50,9 @@ def _block_attn(q, k, v, bias_mask, scale):
     p = jnp.exp(logits - m_safe[..., None])
     p = jnp.where(jnp.isfinite(logits), p, 0.0)
     l = jnp.sum(p, axis=-1)
-    num = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    pg = p.reshape(B, Hkv, rep, Sq, Sk)
+    num = jnp.einsum("bhrqk,bkhd->bqhrd", pg, v.astype(jnp.float32))
+    num = num.reshape(B, Sq, H, D)
     return num, l, jnp.where(jnp.isfinite(m), m, -jnp.inf)
 
 

@@ -92,3 +92,32 @@ def test_missing_key_raises(tmp_path):
     ckpt.save_state_dict({"w": paddle.ones([2])}, str(tmp_path))
     with pytest.raises(KeyError):
         ckpt.load_state_dict({"other": paddle.zeros([2])}, str(tmp_path))
+
+
+def test_resave_same_dir_no_stale_manifest(tmp_path):
+    # re-saving into an existing dir must bump unique_id (no overwrite) and
+    # the manifest must point at the NEW data for re-saved tensors
+    sd = {"w": paddle.to_tensor(np.zeros((4, 6), "float32"))}
+    ckpt.save_state_dict(sd, str(tmp_path))
+    first_files = set(p.name for p in tmp_path.glob("*.distcp.npz"))
+
+    sd_new = {"w": paddle.to_tensor(np.full((4, 6), 7.0, "float32"))}
+    ckpt.save_state_dict(sd_new, str(tmp_path))
+    second_files = set(p.name for p in tmp_path.glob("*.distcp.npz"))
+    assert first_files < second_files  # old file untouched, new file added
+
+    out = {"w": paddle.zeros([4, 6])}
+    ckpt.load_state_dict(out, str(tmp_path))
+    np.testing.assert_array_equal(out["w"].numpy(), np.full((4, 6), 7.0))
+
+
+def test_partial_resave_keeps_other_tensors(tmp_path):
+    # model then optimizer into the same dir: both loadable afterwards
+    ckpt.save_state_dict({"model_w": paddle.to_tensor(np.ones(5, "float32"))},
+                         str(tmp_path))
+    ckpt.save_state_dict({"opt_m": paddle.to_tensor(np.full(5, 2.0, "float32"))},
+                         str(tmp_path))
+    out = {"model_w": paddle.zeros([5]), "opt_m": paddle.zeros([5])}
+    ckpt.load_state_dict(out, str(tmp_path))
+    np.testing.assert_array_equal(out["model_w"].numpy(), np.ones(5))
+    np.testing.assert_array_equal(out["opt_m"].numpy(), np.full(5, 2.0))

@@ -66,6 +66,38 @@ class TestShmRing:
         finally:
             r.close()
 
+    def test_multi_producer_no_torn_reads(self):
+        # regression: a later-claimed slot committing before the head slot
+        # must never let the consumer observe an uncommitted/stale payload
+        n_workers, per_worker = 4, 50
+        r = ShmRing("t_ring_mp", n_slots=4, slot_size=1 << 16)
+
+        def _producer(name, wid):
+            ring = ShmRing(name, create=False)
+            for i in range(per_worker):
+                val = wid * 1000 + i
+                ring.push_arrays([np.full((64,), val, "int64")])
+
+        try:
+            ctx = mp.get_context("fork")
+            procs = [ctx.Process(target=_producer, args=("t_ring_mp", w))
+                     for w in range(n_workers)]
+            for p in procs:
+                p.start()
+            seen = []
+            for _ in range(n_workers * per_worker):
+                (a,) = r.pop_arrays(timeout_ms=20000)
+                # torn read ⇒ non-constant array or value out of range
+                assert (a == a[0]).all(), f"torn batch: {a[:8]}"
+                seen.append(int(a[0]))
+            for p in procs:
+                p.join()
+            expect = sorted(w * 1000 + i for w in range(n_workers)
+                            for i in range(per_worker))
+            assert sorted(seen) == expect
+        finally:
+            r.close()
+
     def test_oversize_message_rejected(self):
         r = ShmRing("t_ring_d", n_slots=2, slot_size=1024)
         try:

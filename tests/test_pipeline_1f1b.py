@@ -1,0 +1,177 @@
+"""True 1F1B / interleaved-VPP SPMD pipeline: schedule-table properties,
+numeric alignment of loss+grads vs the unpipelined computation, and the
+bounded-memory claim (VERDICT r1 item 3)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.distributed import topology
+from paddle_tpu.parallel.pipeline_1f1b import (
+    _BWD,
+    _FWD,
+    build_1f1b_schedule,
+    pipeline_train_spmd,
+    stack_device_major,
+)
+
+
+@pytest.fixture
+def mesh_pp4():
+    yield topology.init_mesh(pp=4)
+
+
+@pytest.fixture
+def mesh_pp2():
+    yield topology.init_mesh(pp=2)
+
+
+# --------------------------------------------------------------------------
+# schedule table
+# --------------------------------------------------------------------------
+
+@pytest.mark.parametrize("n,M,v", [(2, 4, 1), (4, 8, 1), (4, 16, 1),
+                                   (2, 4, 2), (4, 8, 2)])
+def test_schedule_valid_and_complete(n, M, v):
+    s = build_1f1b_schedule(n, M, v)
+    nv = n * v
+    fcount = np.zeros((nv, M))
+    bcount = np.zeros((nv, M))
+    for t in range(s.n_slots):
+        for d in range(n):
+            c, m, k = s.opc[t, d], s.mb[t, d], s.ch[t, d]
+            vs = k * n + d
+            if c == _FWD:
+                fcount[vs, m] += 1
+            if c == _BWD:
+                bcount[vs, m] += 1
+    assert (bcount == 1).all()
+    assert (fcount[:nv - 1] == 1).all()
+    assert (fcount[nv - 1] == 0).all()  # last vstage fwd fused into its bwd
+
+
+def test_1f1b_memory_bounded_vs_gpipe():
+    # the 1F1B claim: in-flight activations per stage are O(pp), NOT O(M)
+    n, v = 4, 1
+    for M in (8, 16, 32, 64):
+        s = build_1f1b_schedule(n, M, v)
+        assert max(s.peak_in_flight) <= n, (
+            f"M={M}: peak {s.peak_in_flight} exceeds pp={n}")
+    # GPipe would buffer all M microbatches on stage 0; 64 >> 4
+    assert max(build_1f1b_schedule(n, 64, v).peak_in_flight) == 4
+
+
+def test_vpp_schedule_backward_interleaves_forward():
+    # depth-first VPP: backward ticks must start before the last forward tick
+    s = build_1f1b_schedule(4, 8, 2)
+    first_bwd = min(t for t in range(s.n_slots)
+                    if (s.opc[t] == _BWD).any())
+    last_fwd = max(t for t in range(s.n_slots)
+                   if (s.opc[t] == _FWD).any())
+    assert first_bwd < last_fwd
+
+
+# --------------------------------------------------------------------------
+# executor numerics
+# --------------------------------------------------------------------------
+
+def _toy_setup(n_stages, v, hidden=8, B=8, seed=0):
+    """n_stages*v linear+tanh virtual stages + a quadratic loss head."""
+    rng = np.random.default_rng(seed)
+    nv = n_stages * v
+    Ws = [jnp.asarray(rng.standard_normal((hidden, hidden)) / np.sqrt(hidden),
+                      jnp.float32) for _ in range(nv)]
+    bs = [jnp.asarray(rng.standard_normal(hidden) * 0.1, jnp.float32)
+          for _ in range(nv)]
+    head_w = jnp.asarray(rng.standard_normal((hidden, 4)) / np.sqrt(hidden),
+                         jnp.float32)
+    x = jnp.asarray(rng.standard_normal((B, hidden)), jnp.float32)
+    tgt = jnp.asarray(rng.standard_normal((B, 4)), jnp.float32)
+
+    def stage_fn(params, a, extra):
+        W, b = params
+        return jnp.tanh(a @ W + b)
+
+    def head_fn(hp, a, t):
+        return jnp.mean((a @ hp - t) ** 2)
+
+    def reference(x, Ws, bs, head_w, tgt):
+        a = x
+        for W, b in zip(Ws, bs):
+            a = jnp.tanh(a @ W + b)
+        return jnp.mean((a @ head_w - tgt) ** 2)
+
+    return Ws, bs, head_w, x, tgt, stage_fn, head_fn, reference
+
+
+@pytest.mark.parametrize("v,n_micro", [(1, 4), (1, 8), (2, 4)])
+def test_loss_and_grads_match_sequential(mesh_pp4, v, n_micro):
+    n = 4
+    Ws, bs, head_w, x, tgt, stage_fn, head_fn, reference = _toy_setup(n, v)
+    stacked = stack_device_major([(W, b) for W, b in zip(Ws, bs)], n, v)
+
+    loss, dx, sgrads, hgrads = pipeline_train_spmd(
+        stage_fn, stacked, head_fn, head_w, x, tgt, n_micro, v=v,
+        mesh=mesh_pp4)
+
+    # reference: mean over microbatches of per-microbatch loss == full-batch
+    # loss here because every microbatch has equal size and the loss is a mean
+    ref_loss = reference(x, Ws, bs, head_w, tgt)
+    np.testing.assert_allclose(np.asarray(loss), np.asarray(ref_loss),
+                               rtol=1e-5)
+
+    ref_grads = jax.grad(reference, argnums=(0, 1, 2, 3))(x, Ws, bs, head_w,
+                                                          tgt)
+    dxr, dWs, dbs, dhw = ref_grads
+    np.testing.assert_allclose(np.asarray(dx), np.asarray(dxr),
+                               rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(hgrads), np.asarray(dhw),
+                               rtol=1e-4, atol=1e-6)
+    # sgrads rows are device-major: row d*v + k = vstage k*n + d
+    sW, sb = sgrads
+    for d in range(n):
+        for k in range(v):
+            vs = k * n + d
+            np.testing.assert_allclose(np.asarray(sW[d * v + k]),
+                                       np.asarray(dWs[vs]),
+                                       rtol=1e-4, atol=1e-6)
+            np.testing.assert_allclose(np.asarray(sb[d * v + k]),
+                                       np.asarray(dbs[vs]),
+                                       rtol=1e-4, atol=1e-6)
+
+
+def test_pp2_alignment(mesh_pp2):
+    n, v, n_micro = 2, 1, 4
+    Ws, bs, head_w, x, tgt, stage_fn, head_fn, reference = _toy_setup(n, v)
+    stacked = stack_device_major([(W, b) for W, b in zip(Ws, bs)], n, v)
+    loss, dx, sgrads, hgrads = pipeline_train_spmd(
+        stage_fn, stacked, head_fn, head_w, x, tgt, n_micro, v=v,
+        mesh=mesh_pp2)
+    ref_loss = reference(x, Ws, bs, head_w, tgt)
+    np.testing.assert_allclose(np.asarray(loss), np.asarray(ref_loss),
+                               rtol=1e-5)
+
+
+def test_pp_x_dp_composition():
+    # pp=2 × dp=2: grads must equal the single-device full-batch grads
+    mesh = topology.init_mesh(dp=2, pp=2)
+    n, v, n_micro = 2, 1, 4
+    Ws, bs, head_w, x, tgt, stage_fn, head_fn, reference = _toy_setup(n, v)
+    stacked = stack_device_major([(W, b) for W, b in zip(Ws, bs)], n, v)
+    loss, dx, sgrads, hgrads = pipeline_train_spmd(
+        stage_fn, stacked, head_fn, head_w, x, tgt, n_micro, v=v, mesh=mesh)
+    ref_loss = reference(x, Ws, bs, head_w, tgt)
+    np.testing.assert_allclose(np.asarray(loss), np.asarray(ref_loss),
+                               rtol=1e-5)
+    dxr, dWs, dbs, dhw = jax.grad(reference, argnums=(0, 1, 2, 3))(
+        x, Ws, bs, head_w, tgt)
+    np.testing.assert_allclose(np.asarray(dx), np.asarray(dxr),
+                               rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(hgrads), np.asarray(dhw),
+                               rtol=1e-4, atol=1e-6)
+    sW, _ = sgrads
+    for d in range(n):
+        np.testing.assert_allclose(np.asarray(sW[d]), np.asarray(dWs[d]),
+                                   rtol=1e-4, atol=1e-6)

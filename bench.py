@@ -80,11 +80,15 @@ def _run_inner(platform: str, timeout: int):
         [sys.executable, os.path.abspath(__file__)], cwd=_HERE, env=env,
         capture_output=True, text=True, timeout=timeout)
     sys.stderr.write(proc.stderr[-4000:])
+    if proc.returncode != 0:
+        # the inner bench asserts AFTER printing its JSON line (e.g. a
+        # non-finite loss) — a nonzero exit must not masquerade as success
+        raise RuntimeError(f"inner bench rc={proc.returncode}")
     for line in proc.stdout.splitlines():
         line = line.strip()
         if line.startswith("{"):
             return json.loads(line)
-    raise RuntimeError(f"inner bench rc={proc.returncode}, no JSON line")
+    raise RuntimeError("inner bench produced no JSON line")
 
 
 def main() -> None:

@@ -52,46 +52,60 @@ def main():
 
     import paddle_tpu as paddle
     from paddle_tpu.distributed import checkpoint as dist_ckpt
-    from paddle_tpu.distributed import topology
+    from paddle_tpu.distributed import fleet
     from paddle_tpu.jit import to_static
     from paddle_tpu.models import (
         LlamaConfig,
         LlamaForCausalLM,
         LlamaPretrainingCriterion,
     )
-    from paddle_tpu.parallel.utils import apply_param_shardings
 
     paddle.seed(42)
-    topology.init_mesh(dp=args.dp, mp=args.mp, pp=args.pp,
-                       sharding=args.sharding)
+
+    # fleet API end to end (fleet/fleet.py:167 usage pattern): one strategy
+    # object wires mesh + placements + pipeline schedule + sharded optimizer
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {
+        "dp_degree": args.dp, "mp_degree": args.mp, "pp_degree": args.pp,
+        "sharding_degree": args.sharding,
+        "pp_configs": {"accumulate_steps": args.micro_batches},
+    }
+    strategy.sequence_parallel = args.sequence_parallel
+    if args.recompute:
+        strategy.recompute = True
+    fleet.init(is_collective=True, strategy=strategy)
 
     mk = (LlamaConfig.tiny if args.model == "tiny" else LlamaConfig.llama3_8b)
     cfg = mk(sequence_parallel=args.sequence_parallel,
              recompute=args.recompute)
-    model = LlamaForCausalLM(cfg)
-    apply_param_shardings(model)
+    model = fleet.distributed_model(LlamaForCausalLM(cfg))
     criterion = LlamaPretrainingCriterion(cfg)
     sched = paddle.optimizer.lr.CosineAnnealingDecay(
         learning_rate=args.lr, T_max=args.steps)
-    opt = paddle.optimizer.AdamW(learning_rate=sched,
-                                 parameters=model.parameters(),
-                                 weight_decay=0.01)
+    opt = fleet.distributed_optimizer(
+        paddle.optimizer.AdamW(learning_rate=sched,
+                               parameters=model.parameters(),
+                               weight_decay=0.01))
     if args.resume:
         sd = model.state_dict()
         dist_ckpt.load_state_dict(sd, args.resume)
 
-    n_micro = args.micro_batches if args.pp > 1 else None
-
-    @to_static
-    def train_step(ids):
-        logits = model(ids, pp_microbatches=n_micro)
-        loss = criterion(logits, ids)
-        if model.aux_loss is not None:
-            loss = loss + cfg.aux_loss_weight * model.aux_loss
-        loss.backward()
-        opt.step()
-        opt.clear_grad()
-        return loss
+    if args.pp > 1:
+        @to_static
+        def train_step(ids):
+            return model.train_batch([ids, ids], opt)
+    else:
+        @to_static
+        def train_step(ids):
+            logits = model(ids)
+            loss = criterion(logits, ids)
+            aux = getattr(model, "aux_loss", None)
+            if aux is not None:
+                loss = loss + cfg.aux_loss_weight * aux
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
 
     rng = np.random.default_rng(0)
 

@@ -47,3 +47,4 @@ from .topology import (  # noqa: F401
     init_mesh,
     set_mesh,
 )
+from . import fleet  # noqa: F401  (fleet facade: init/distributed_model/...)

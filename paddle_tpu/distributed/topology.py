@@ -89,11 +89,23 @@ class HybridCommunicateGroup:
     def get_sep_parallel_world_size(self) -> int:
         return self._size("sep")
 
-    # ranks are positions of the current *process's first addressable device*;
-    # under single-controller SPMD per-rank code runs inside shard_map where
-    # jax.lax.axis_index(axis) gives the true in-computation rank.
+    # ranks are positions of the current PROCESS's first addressable device
+    # in the mesh (so multi-host "save only on dp rank 0"-style branches do
+    # the right thing per host); under single-controller SPMD, per-DEVICE
+    # code runs inside shard_map where jax.lax.axis_index(axis) gives the
+    # true in-computation rank.
     def _coord(self, axis: str) -> int:
-        dev = self._mesh.devices.flat[0]
+        my_proc = jax.process_index()
+        dev = None
+        for d in self._mesh.devices.flat:
+            if getattr(d, "process_index", 0) == my_proc:
+                dev = d
+                break
+        if dev is None:
+            raise RuntimeError(
+                f"process {my_proc} owns no device in the mesh; "
+                "get_*_rank() is undefined here — use jax.lax.axis_index "
+                "inside shard_map for per-device ranks")
         idx = np.argwhere(self._mesh.devices == dev)
         if idx.size == 0:
             return 0

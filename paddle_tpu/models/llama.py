@@ -387,6 +387,60 @@ class LlamaForCausalLM(Layer):
             return run_op("tied_head", lambda a, wv: a @ wv.T, h, w)
         return self.lm_head(h)
 
+    def train_batch_1f1b(self, input_ids, labels, n_microbatch: int,
+                         criterion=None):
+        """One true-1F1B pipelined train step (the ``train_batch`` analog of
+        the reference's ``PipelineParallel.forward_backward_pipeline``,
+        ``pipeline_parallel.py:440``): embedding runs on the tape, the
+        decoder stack + final norm + LM head + criterion run inside the 1F1B
+        SPMD schedule with per-microbatch loss on the last stage; MoE aux
+        losses are accumulated and differentiated per stage.  Returns the
+        mean loss; ``loss.backward()`` routes the schedule-computed grads
+        onto every parameter.
+
+        The head reuses the REAL layers (``llama.norm``, ``lm_head``/tied
+        embedding, the criterion) via parameter rebinding, so pipelined and
+        unpipelined runs share one implementation of the loss semantics."""
+        from ..core.tensor import Tensor
+        from ..parallel.pipeline_1f1b import pipeline_train_1f1b
+
+        cfg = self.config
+        if criterion is None:
+            criterion = LlamaPretrainingCriterion(cfg)
+        h = self.llama.embed_tokens(input_ids)
+        pipe = self.llama._pipeline()
+        norm = self.llama.norm
+        lm_head = self.lm_head
+        tied = lm_head is None
+        head_params = [norm.weight,
+                       self.llama.embed_tokens.weight if tied
+                       else lm_head.weight]
+
+        def head_apply(hv, act, tgt):
+            nw, hw = hv
+            saved_n = norm.weight._value
+            norm.weight._value = nw
+            saved_h = None if tied else lm_head.weight._value
+            if not tied:
+                lm_head.weight._value = hw
+            try:
+                hn = norm(Tensor(act, stop_gradient=True))
+                if tied:
+                    logits = hn._value @ hw.T
+                else:
+                    logits = lm_head(hn)._value
+                loss = criterion(Tensor(logits, stop_gradient=True),
+                                 Tensor(tgt, stop_gradient=True))
+                return loss._value if isinstance(loss, Tensor) else loss
+            finally:
+                norm.weight._value = saved_n
+                if not tied:
+                    lm_head.weight._value = saved_h
+
+        aux_w = cfg.aux_loss_weight if cfg.num_experts > 0 else 0.0
+        return pipeline_train_1f1b(pipe, h, labels, head_params, head_apply,
+                                   n_microbatch, aux_weight=aux_w)
+
     def generate(self, input_ids, max_new_tokens: int = 32,
                  temperature: float = 1.0, top_k: int = 0, top_p: float = 1.0,
                  eos_token_id: Optional[int] = None, seed: int = 0):

@@ -56,7 +56,7 @@ class Schedule1F1B:
     """Static schedule tables (all numpy, [T, n]) + occupancy stats."""
 
     def __init__(self, opc, mb, ch, arr_f_mb, arr_f_ch, arr_c_mb, arr_c_ch,
-                 peak_in_flight, n_stages, n_micro, v):
+                 peak_in_flight, n_stages, n_micro, v, buf_depth):
         self.opc = opc
         self.mb = mb
         self.ch = ch
@@ -69,6 +69,10 @@ class Schedule1F1B:
         self.n_micro = n_micro
         self.v = v
         self.n_slots = opc.shape[0]
+        # ring-buffer depth: >= the max per-VIRTUAL-STAGE occupancy of both
+        # the activation and cotangent buffers — slot reuse (m % buf_depth)
+        # is only safe when a vstage never holds more than buf_depth entries
+        self.buf_depth = buf_depth
 
 
 @functools.lru_cache(maxsize=64)
@@ -187,8 +191,30 @@ def build_1f1b_schedule(n_stages: int, n_micro: int, v: int = 1) -> Schedule1F1B
                         held += 1
             peak[d] = max(peak[d], held)
 
+    # buffer depth: max per-vstage occupancy of (a) saved activations
+    # (forward/arrival -> backward) and (b) buffered cotangents
+    # (produced at b(m, vs+1) -> consumed at b(m, vs))
+    depth = 1
+    for vs in range(nv):
+        for ti in range(T):
+            held_a = sum(
+                1 for m in range(n_micro)
+                if f_slot[vs][m] is not None and f_slot[vs][m] <= ti
+                and (b_slot[vs][m] is None or b_slot[vs][m] > ti))
+            held_c = 0
+            if vs < nv - 1:
+                held_c = sum(
+                    1 for m in range(n_micro)
+                    if b_slot[vs + 1][m] is not None
+                    and b_slot[vs + 1][m] <= ti
+                    and (b_slot[vs][m] is None or b_slot[vs][m] > ti))
+            depth = max(depth, held_a, held_c)
+    # +1 guard: an arrival stored at the start of a slot can coexist with
+    # the entry whose backward runs later in that same slot
+    depth = min(depth + 1, n_micro)
+
     return Schedule1F1B(opc, mb, ch, arr_f_mb, arr_f_ch, arr_c_mb, arr_c_ch,
-                        peak, n, n_micro, v)
+                        peak, n, n_micro, v, depth)
 
 
 # --------------------------------------------------------------------------
@@ -199,7 +225,9 @@ def pipeline_train_spmd(stage_fn: Callable, stage_params: Any,
                         head_fn: Callable, head_params: Any,
                         x: jnp.ndarray, targets: Any, n_microbatch: int,
                         v: int = 1, mesh=None, extra: Any = None,
-                        axis: str = PP_AXIS, dp_axis: Optional[str] = "dp"):
+                        axis: str = PP_AXIS, dp_axis: Optional[str] = "dp",
+                        stage_has_aux: bool = False,
+                        aux_weight: float = 0.0):
     """Run the full 1F1B train schedule; returns
     ``(mean_loss, dx, stage_grads, head_grads)``.
 
@@ -213,8 +241,18 @@ def pipeline_train_spmd(stage_fn: Callable, stage_params: Any,
     If the mesh has a ``dp`` axis that divides the microbatch size, each
     microbatch is additionally data-sharded over it (grads pmean'd across
     dp groups — pp×dp composition in one program).
+
+    With ``stage_has_aux=True``, ``stage_fn`` returns ``(act, aux_scalar)``
+    (e.g. MoE load-balance loss); every stage's aux joins the total loss
+    weighted by ``aux_weight`` and is differentiated in that stage's
+    backward tick.
     """
     mesh = mesh or topology.get_mesh()
+    if not stage_has_aux:
+        _inner_stage = stage_fn
+
+        def stage_fn(p, a, e):  # noqa: F811 — uniform (act, aux) contract
+            return _inner_stage(p, a, e), jnp.zeros((), jnp.float32)
     n = mesh.shape[axis]
     sched = build_1f1b_schedule(n, n_microbatch, v)
     B = x.shape[0]
@@ -253,14 +291,15 @@ def pipeline_train_spmd(stage_fn: Callable, stage_params: Any,
                 lambda p: jax.lax.dynamic_index_in_dim(p, k, 0, keepdims=False),
                 params_dev)
 
-        act_sds = jax.eval_shape(
+        act_sds, _ = jax.eval_shape(
             lambda p, a: stage_fn(p, a, extra_local),
             params_at(0), micro_local[0])
         A_shape, A_dtype = act_sds.shape, act_sds.dtype
 
         def _idx2(k, m, ndim):
             z = jnp.zeros((), jnp.int32)
-            return ((jnp.asarray(k, jnp.int32), jnp.asarray(m % n, jnp.int32))
+            return ((jnp.asarray(k, jnp.int32),
+                     jnp.asarray(m % sched.buf_depth, jnp.int32))
                     + (z,) * (ndim - 2))
 
         def buf_set(buf, k, m, val):
@@ -286,7 +325,7 @@ def pipeline_train_spmd(stage_fn: Callable, stage_params: Any,
             inj = jax.lax.dynamic_index_in_dim(micro_local, m, 0,
                                                keepdims=False).astype(A_dtype)
             a_in = jnp.where(is_stage0, inj, buf_get(abuf, k, m))
-            y = stage_fn(params_at(k), a_in, extra_local)
+            y, _ = stage_fn(params_at(k), a_in, extra_local)
             abuf = buf_set(abuf, k, m, a_in)
             return (abuf, cbuf, y, jnp.zeros(A_shape, A_dtype), grads,
                     hgrads, dx, loss)
@@ -300,19 +339,20 @@ def pipeline_train_spmd(stage_fn: Callable, stage_params: Any,
 
             def last_case(_):
                 def full(p, hp, a):
-                    y = stage_fn(p, a, extra_local)
-                    return head_fn(hp, y, tgt_at(m))
+                    y, aux = stage_fn(p, a, extra_local)
+                    return (head_fn(hp, y, tgt_at(m))
+                            + aux_weight * aux.astype(jnp.float32))
                 loss_m, pull = jax.vjp(full, p_k, head_local, a_in)
                 dp, dh, da = pull(jnp.ones((), loss_m.dtype))
                 return dp, dh, da.astype(A_dtype), loss_m
 
             def mid_case(_):
                 g = buf_get(cbuf, k, m).astype(A_dtype)
-                _, pull = jax.vjp(
+                (_, aux), pull = jax.vjp(
                     lambda p, a: stage_fn(p, a, extra_local), p_k, a_in)
-                dp, da = pull(g)
+                dp, da = pull((g, jnp.asarray(aux_weight, aux.dtype)))
                 return (dp, zero_head_grads, da.astype(A_dtype),
-                        jnp.zeros((), jnp.float32))
+                        aux_weight * aux.astype(jnp.float32))
 
             dp, dh, da, loss_m = jax.lax.cond(is_last, last_case, mid_case,
                                               None)
@@ -359,8 +399,8 @@ def pipeline_train_spmd(stage_fn: Callable, stage_params: Any,
             return jax.lax.switch(code, [idle_branch, fwd_branch, bwd_branch],
                                   (carry2, t, m, k))
 
-        abuf0 = jnp.zeros((v, n) + A_shape, A_dtype)
-        cbuf0 = jnp.zeros((v, n) + A_shape, A_dtype)
+        abuf0 = jnp.zeros((v, sched.buf_depth) + A_shape, A_dtype)
+        cbuf0 = jnp.zeros((v, sched.buf_depth) + A_shape, A_dtype)
         z = jnp.zeros(A_shape, A_dtype)
         grads0 = jax.tree.map(jnp.zeros_like, params_dev)
         dx0 = jnp.zeros((n_microbatch,) + micro_local.shape[1:], x.dtype)
@@ -399,6 +439,128 @@ def pipeline_train_spmd(stage_fn: Callable, stage_params: Any,
                                           tgt, extra)
     dx = dx.reshape(x.shape)
     return loss, dx, sgrads, hgrads
+
+
+# --------------------------------------------------------------------------
+# Tensor-level op (tape integration)
+# --------------------------------------------------------------------------
+
+def pipeline_train_1f1b(layer, x: Tensor, targets: Tensor,
+                        head_params: Sequence[Tensor],
+                        head_apply: Callable, n_microbatch: int,
+                        extra: Any = None, axis: str = PP_AXIS,
+                        aux_weight: float = 0.0) -> Tensor:
+    """Tensor-level 1F1B train step over a :class:`PipelineLayer`.
+
+    Returns the mean loss; ``loss.backward()`` routes the schedule-computed
+    gradients onto the stage parameters (via scatter hooks), the head
+    parameters, and ``x`` (so embedding backward runs through the tape) —
+    the pipeline loop itself is never re-differentiated (``jax.custom_vjp``
+    with the grads as residuals).
+
+    ``head_apply(head_values, act, tgt) -> scalar`` is the pure-JAX loss
+    head run per microbatch on the last virtual stage (final norm + LM head
+    + criterion for the Llama case).
+    """
+    mesh = topology.get_mesh()
+    n = mesh.shape[axis]
+    v = layer.num_virtual_stages
+    assert layer.num_stages == n * v, (layer.num_stages, n, v)
+    stage_layers = [layer.get_stage_layers(s) for s in range(layer.num_stages)]
+    order = device_major_order(n, v)
+
+    mark_inputs([p for ls in stage_layers for l in ls
+                 for _, p in l.named_parameters()] + list(head_params))
+
+    def state_of(ls):
+        return [[p._value for _, p in l.named_parameters()] for l in ls]
+
+    states = [state_of(stage_layers[vs]) for vs in order]
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *states)
+    templates = stage_layers[0]
+
+    def _layer_aux(l):
+        """MoE load-balance loss left on the layer by its forward."""
+        for holder in (l, getattr(l, "mlp", None)):
+            al = getattr(holder, "aux_loss", None) if holder is not None else None
+            if al is not None:
+                return al._value if isinstance(al, Tensor) else al
+        return None
+
+    def stage_fn(params, act, _extra):
+        cur = act
+        aux = jnp.zeros((), jnp.float32)
+        for li, l in enumerate(templates):
+            saved = [p._value for _, p in l.named_parameters()]
+            for (pn, p), vv in zip(l.named_parameters(), params[li]):
+                p._value = vv
+            try:
+                out = l(Tensor(cur, stop_gradient=True))
+                cur = out._value if isinstance(out, Tensor) else out
+                al = _layer_aux(l)
+                if al is not None:
+                    aux = aux + al.astype(jnp.float32)
+            finally:
+                for (pn, p), vv in zip(l.named_parameters(), saved):
+                    p._value = vv
+        return cur, aux
+
+    treedef = jax.tree.structure(stacked)
+    n_head = len(head_params)
+
+    def f(xv, *pvals, targets=None):
+        head_vals = tuple(pvals[:n_head])
+        stacked_tree = jax.tree.unflatten(treedef, list(pvals[n_head:]))
+
+        @jax.custom_vjp
+        def op(xv, hv, st):
+            loss, _, _, _ = pipeline_train_spmd(
+                stage_fn, st, head_apply, hv, xv, targets, n_microbatch,
+                v=v, mesh=mesh, extra=extra, axis=axis,
+                stage_has_aux=True, aux_weight=aux_weight)
+            return loss
+
+        def op_fwd(xv, hv, st):
+            loss, dx, sg, hg = pipeline_train_spmd(
+                stage_fn, st, head_apply, hv, xv, targets, n_microbatch,
+                v=v, mesh=mesh, extra=extra, axis=axis,
+                stage_has_aux=True, aux_weight=aux_weight)
+            return loss, (dx, hg, sg)
+
+        def op_bwd(res, g):
+            dx, hg, sg = res
+            return (dx * g, jax.tree.map(lambda a: a * g, hg),
+                    jax.tree.map(lambda a: a * g, sg))
+
+        op.defvjp(op_fwd, op_bwd)
+        return op(xv, head_vals, stacked_tree)
+
+    # stacked leaf -> the real Parameters it came from (device-major rows)
+    leaves = jax.tree.leaves(stacked)
+    param_groups = []
+    for li, l in enumerate(templates):
+        for pi in range(len(l.parameters())):
+            param_groups.append(
+                [list(stage_layers[vs][li].parameters())[pi] for vs in order])
+
+    leaf_tensors = []
+    for leaf, group in zip(leaves, param_groups):
+        t = Tensor(leaf, stop_gradient=all(p.stop_gradient for p in group))
+
+        def scatter_grad(g, _group=group):
+            for r, p in enumerate(_group):
+                gs = g._value[r]
+                p.grad = (Tensor(gs) if p.grad is None
+                          else Tensor(p.grad._value + gs))
+            return g
+
+        if not t.stop_gradient:
+            t.register_hook(scatter_grad)
+        leaf_tensors.append(t)
+
+    mark_derived(leaf_tensors)
+    return run_op("pipeline_1f1b", f, x, *head_params, *leaf_tensors,
+                  targets=targets)
 
 
 def stack_device_major(per_vstage: Sequence, n: int, v: int):

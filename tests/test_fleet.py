@@ -1,0 +1,89 @@
+"""Fleet facade: DistributedStrategy wiring, fleet.init mesh construction,
+distributed_model/distributed_optimizer composition, 1F1B train_batch E2E
+(VERDICT r1 item 5)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import fleet, topology
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+
+def test_strategy_defaults_and_validation():
+    s = fleet.DistributedStrategy()
+    assert s.hybrid_configs["dp_degree"] == 1
+    s.hybrid_configs = {"dp_degree": 2, "pp_degree": 2}
+    assert s.pipeline  # auto-enabled by pp_degree > 1
+    s.pipeline_configs = {"accumulate_steps": 4}
+    assert s.pipeline_configs["schedule_mode"] == "1F1B"
+    with pytest.raises(ValueError):
+        s.amp_configs = {"bogus_knob": 1}
+    with pytest.raises(ValueError):
+        s.hybrid_configs = {"tp_degree": 2}  # reference name is mp_degree
+
+
+def test_fleet_init_builds_mesh():
+    s = fleet.DistributedStrategy()
+    s.hybrid_configs = {"dp_degree": 2, "mp_degree": 2, "pp_degree": 2}
+    fleet.init(is_collective=True, strategy=s)
+    hcg = fleet.get_hybrid_communicate_group()
+    assert hcg.get_data_parallel_world_size() == 2
+    assert hcg.get_model_parallel_world_size() == 2
+    assert hcg.get_pipe_parallel_world_size() == 2
+    # single-process: this process owns device 0 -> rank 0 on every axis
+    assert hcg.get_data_parallel_rank() == 0
+    assert fleet.worker_index() == 0 and fleet.worker_num() == 1
+    assert fleet.is_first_worker()
+
+
+def test_fleet_pipeline_train_batch_llama():
+    s = fleet.DistributedStrategy()
+    s.hybrid_configs = {"pp_degree": 2,
+                        "pp_configs": {"accumulate_steps": 2}}
+    fleet.init(is_collective=True, strategy=s)
+
+    paddle.seed(11)
+    cfg = LlamaConfig.tiny(num_hidden_layers=4)
+    model = fleet.distributed_model(LlamaForCausalLM(cfg))
+    opt = fleet.distributed_optimizer(
+        paddle.optimizer.AdamW(learning_rate=1e-3,
+                               parameters=model.parameters()))
+    ids = paddle.to_tensor(
+        np.random.default_rng(0).integers(0, cfg.vocab_size, (4, 16)),
+        dtype="int32")
+    losses = [float(model.train_batch([ids, ids], opt)) for _ in range(3)]
+    assert losses[-1] < losses[0], losses
+
+
+def test_fleet_dp_model_wrap():
+    s = fleet.DistributedStrategy()
+    s.hybrid_configs = {"dp_degree": 8}
+    fleet.init(is_collective=True, strategy=s)
+    net = paddle.nn.Linear(4, 4)
+    wrapped = fleet.distributed_model(net)
+    from paddle_tpu.distributed.parallel import DataParallel
+
+    assert isinstance(wrapped, DataParallel)
+    out = wrapped(paddle.ones([2, 4]))
+    assert out.shape == [2, 4]
+
+
+def test_fleet_sharded_optimizer():
+    s = fleet.DistributedStrategy()
+    s.hybrid_configs = {"sharding_degree": 4}
+    s.sharding_configs = {"stage": 2, "degree": 4}
+    fleet.init(is_collective=True, strategy=s)
+    net = paddle.nn.Linear(8, 8)
+    net = fleet.distributed_model(net)
+    opt = fleet.distributed_optimizer(
+        paddle.optimizer.AdamW(learning_rate=1e-2,
+                               parameters=net.parameters()))
+    from paddle_tpu.parallel.sharding import GroupShardedOptimizerStage2
+
+    assert isinstance(opt, GroupShardedOptimizerStage2)
+    x = paddle.ones([4, 8])
+    loss = (net(x) ** 2).mean()
+    loss.backward()
+    opt.step()
+    opt.clear_grad()

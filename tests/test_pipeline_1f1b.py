@@ -175,3 +175,137 @@ def test_pp_x_dp_composition():
     for d in range(n):
         np.testing.assert_allclose(np.asarray(sW[d]), np.asarray(dWs[d]),
                                    rtol=1e-4, atol=1e-6)
+
+
+# --------------------------------------------------------------------------
+# Tensor-level op + Llama integration
+# --------------------------------------------------------------------------
+
+def test_llama_1f1b_matches_unpipelined():
+    import paddle_tpu as paddle
+    from paddle_tpu.models import (LlamaConfig, LlamaForCausalLM,
+                                   LlamaPretrainingCriterion)
+
+    topology.init_mesh(pp=4)
+    paddle.seed(7)
+    cfg = LlamaConfig.tiny(num_hidden_layers=4)
+    model = LlamaForCausalLM(cfg)
+    crit = LlamaPretrainingCriterion(cfg)
+    ids = paddle.to_tensor(
+        np.random.default_rng(0).integers(0, cfg.vocab_size, (4, 16)),
+        dtype="int32")
+
+    # reference: plain forward+backward, no pipeline
+    loss_ref = crit(model(ids), ids)
+    loss_ref.backward()
+    ref_grads = {n: np.asarray(p.grad._value)
+                 for n, p in model.named_parameters() if p.grad is not None}
+    for _, p in model.named_parameters():
+        p.clear_grad()
+
+    loss_pp = model.train_batch_1f1b(ids, ids, n_microbatch=2)
+    np.testing.assert_allclose(float(loss_pp), float(loss_ref), rtol=1e-5)
+    loss_pp.backward()
+    pp_grads = {n: np.asarray(p.grad._value)
+                for n, p in model.named_parameters() if p.grad is not None}
+
+    assert set(pp_grads) == set(ref_grads)
+    for n in sorted(ref_grads):
+        scale = np.abs(ref_grads[n]).max() + 1e-9
+        np.testing.assert_allclose(pp_grads[n] / scale, ref_grads[n] / scale,
+                                   rtol=2e-4, atol=2e-5, err_msg=n)
+
+
+def test_llama_1f1b_optimizer_step_decreases_loss():
+    import paddle_tpu as paddle
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    topology.init_mesh(pp=2)
+    paddle.seed(3)
+    cfg = LlamaConfig.tiny(num_hidden_layers=4)
+    model = LlamaForCausalLM(cfg)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=model.parameters())
+    ids = paddle.to_tensor(
+        np.random.default_rng(1).integers(0, cfg.vocab_size, (4, 16)),
+        dtype="int32")
+    losses = []
+    for _ in range(3):
+        loss = model.train_batch_1f1b(ids, ids, n_microbatch=2)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
+
+
+def test_vpp_micro_exceeds_buffer_regression(mesh_pp4):
+    # regression (r2 review): v=2 with n_micro > pp used to overflow the
+    # m % pp ring buffer and silently corrupt gradients
+    n, v, n_micro = 4, 2, 8
+    Ws, bs, head_w, x, tgt, stage_fn, head_fn, reference = _toy_setup(n, v)
+    stacked = stack_device_major([(W, b) for W, b in zip(Ws, bs)], n, v)
+    loss, dx, sgrads, hgrads = pipeline_train_spmd(
+        stage_fn, stacked, head_fn, head_w, x, tgt, n_micro, v=v,
+        mesh=mesh_pp4)
+    ref_loss = reference(x, Ws, bs, head_w, tgt)
+    np.testing.assert_allclose(np.asarray(loss), np.asarray(ref_loss),
+                               rtol=1e-5)
+    dxr, dWs, dbs, dhw = jax.grad(reference, argnums=(0, 1, 2, 3))(
+        x, Ws, bs, head_w, tgt)
+    np.testing.assert_allclose(np.asarray(dx), np.asarray(dxr),
+                               rtol=1e-4, atol=1e-6)
+    sW, sb = sgrads
+    for d in range(n):
+        for k in range(v):
+            vs = k * n + d
+            np.testing.assert_allclose(np.asarray(sW[d * v + k]),
+                                       np.asarray(dWs[vs]),
+                                       rtol=1e-4, atol=1e-6)
+
+
+def test_1f1b_large_micro_count(mesh_pp2):
+    # n_micro >> pp exercises ring-buffer slot reuse in the plain schedule
+    n, v, n_micro = 2, 1, 12
+    Ws, bs, head_w, x, tgt, stage_fn, head_fn, reference = _toy_setup(
+        n, v, B=12)
+    stacked = stack_device_major([(W, b) for W, b in zip(Ws, bs)], n, v)
+    loss, dx, _, _ = pipeline_train_spmd(
+        stage_fn, stacked, head_fn, head_w, x, tgt, n_micro, v=v,
+        mesh=mesh_pp2)
+    ref_loss = reference(x, Ws, bs, head_w, tgt)
+    np.testing.assert_allclose(np.asarray(loss), np.asarray(ref_loss),
+                               rtol=1e-5)
+    dxr = jax.grad(reference, argnums=0)(x, Ws, bs, head_w, tgt)
+    np.testing.assert_allclose(np.asarray(dx), np.asarray(dxr),
+                               rtol=1e-4, atol=1e-6)
+
+
+def test_llama_moe_1f1b_aux_loss_matches():
+    # MoE aux losses must join the pipelined loss exactly like unpipelined
+    import paddle_tpu as paddle
+    from paddle_tpu.models import (LlamaConfig, LlamaForCausalLM,
+                                   LlamaPretrainingCriterion)
+
+    topology.init_mesh(pp=2)
+    paddle.seed(5)
+    cfg = LlamaConfig.tiny(num_hidden_layers=2, num_experts=4)
+    model = LlamaForCausalLM(cfg)
+    crit = LlamaPretrainingCriterion(cfg)
+    ids = paddle.to_tensor(
+        np.random.default_rng(2).integers(0, cfg.vocab_size, (4, 16)),
+        dtype="int32")
+
+    # microbatched reference: MoE capacity depends on tokens-per-forward, so
+    # the unpipelined comparison must run the same microbatches (the
+    # reference's train_batch has identical semantics)
+    totals = []
+    for mb in (ids[:2], ids[2:]):
+        loss_ref = crit(model(mb), mb)
+        aux = model.aux_loss
+        assert aux is not None
+        totals.append(float(loss_ref) + cfg.aux_loss_weight * float(aux))
+    total_ref = sum(totals) / len(totals)
+
+    loss_pp = model.train_batch_1f1b(ids, ids, n_microbatch=2)
+    np.testing.assert_allclose(float(loss_pp), total_ref, rtol=1e-5)

@@ -190,6 +190,24 @@ class StaticFunction:
         rng_mod.set_rng_state(new_keys)
         return _wrap_raw(out_raw)
 
+    def lowered_text(self, *args, **kwargs):
+        """Compiled HLO text of the staged program for these args.
+
+        Lets tests (and users) verify what XLA actually emits — collectives
+        (``reduce-scatter``/``all-gather``), fusions, donation — instead of
+        trusting that GSPMD "will do it".  The entry is cached, so a
+        subsequent ``__call__`` with the same shapes reuses the build.
+        """
+        key = self._cache_key(args, kwargs)
+        entry = self._cache.get(key)
+        if entry is None:
+            entry = self._build(key, args, kwargs)
+        state_tensors, jitted = entry
+        state_vals = [t._value for t in state_tensors]
+        keys = rng_mod.get_rng_state()
+        arg_vals = _tree_map_tensors((args, kwargs), lambda t: t._value)
+        return jitted.lower(state_vals, arg_vals, keys).compile().as_text()
+
     def _build(self, key, args, kwargs):
         # ---- pass 1: discovery --------------------------------------------
         rec = _Recorder()
@@ -243,6 +261,9 @@ class StaticFunction:
         jitted = jax.jit(pure, donate_argnums=donate)
         entry = (state_tensors, jitted)
         self._cache[key] = entry
+        limit = flags.flag("jit_cache_max_entries")
+        while len(self._cache) > limit:  # FIFO eviction (SOT cache-size knob)
+            self._cache.pop(next(iter(self._cache)))
         return entry
 
 

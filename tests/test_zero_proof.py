@@ -1,0 +1,161 @@
+"""ZeRO semantics proven at the HLO / memory level — not declared.
+
+The reference implements stage 2 as explicit grad-shard + reduce-scatter
+hooks (``fleet/meta_parallel/sharding/group_sharded_stage2.py``) and stage 3
+as param shard + on-demand all-gather (``group_sharded_stage3.py:85``).
+TPU-first those collectives are emitted by GSPMD; these tests lower a full
+staged train step and assert the compiled HLO actually contains them, and
+that per-device state bytes shrink by ~1/shard_degree.
+"""
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu import parallel as pl
+from paddle_tpu.distributed import topology
+
+
+@pytest.fixture
+def mesh_sharding4():
+    m = topology.init_mesh(dp=2, sharding=4)
+    yield m
+    topology._global_mesh = None
+    topology._global_hcg = None
+
+
+def _data_sharded_batch(mesh, n=8, d=8):
+    x = paddle.to_tensor(np.random.randn(n, d).astype("float32"))
+    x._value = jax.device_put(
+        x._value, NamedSharding(mesh, P(("dp", "sharding")))
+    )
+    return x
+
+
+def _per_device_bytes(arr: jax.Array) -> int:
+    return arr.addressable_shards[0].data.nbytes
+
+
+def _grad_scatter_proven(hlo: str) -> bool:
+    """True iff the compiled step scatters the weight grad over the sharding
+    axis before (or fused with) its reduction.
+
+    On TPU the SPMD partitioner + reduce-scatter-creator emit a literal
+    ``reduce-scatter``.  XLA:CPU leaves the canonical pre-pass form —
+    all-reduce over the sharding subgroup immediately dynamic-sliced to the
+    shard, with the dp reduction running on the *shard-shaped* ``f32[2,16]``
+    operand — which is the same semantics (scatter before dp-reduce, update
+    math at 1/degree size).  Accept either."""
+    if "reduce-scatter" in hlo:
+        return True
+    import re
+
+    # a cross-device reduction whose operand/result is already shard-shaped
+    # (weight (8,16) sharded 4-way on dim0 -> (2,16); transposed (16,2))
+    shard_reduce = re.search(
+        r"all-reduce[^\n]*f32\[(2,16|16,2)\]|"
+        r"= f32\[(2,16|16,2)\][^\n]*all-reduce",
+        hlo,
+    )
+    return shard_reduce is not None
+
+
+class TestStage2Proof:
+    def test_train_step_hlo_scatters_grads(self, mesh_sharding4):
+        model = nn.Linear(8, 16)
+        opt = paddle.optimizer.Momentum(
+            learning_rate=0.1, parameters=model.parameters()
+        )
+        model, opt, _ = pl.group_sharded_parallel(model, opt, "os_g")
+
+        @paddle.jit.to_static
+        def step(x):
+            loss = model(x).sum()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        x = _data_sharded_batch(mesh_sharding4)
+        hlo = step.lowered_text(x)
+        assert _grad_scatter_proven(hlo), (
+            "stage-2 grad reduction must scatter over the sharding axis "
+            "(reduce-scatter, or all-reduce+slice with shard-shaped dp "
+            "reduction); compiled HLO shows neither"
+        )
+        # the updated (replicated) params are re-materialized by all-gather —
+        # the ZeRO-2 "gather updated shards" step
+        assert "all-gather" in hlo
+        # and the step still trains
+        before = model._layers.weight.numpy().copy()
+        step(x)
+        assert not np.allclose(before, model._layers.weight.numpy())
+        # post-step runtime shardings: grad cleared, slot sharded
+        state = opt._state[id(model._layers.weight)]
+        v = state["velocity"]._value
+        assert v.sharding.shard_shape(v.shape) == (2, 16)
+
+    def test_eager_grad_stored_sharded(self, mesh_sharding4):
+        model = nn.Linear(8, 16)
+        opt = paddle.optimizer.AdamW(
+            learning_rate=0.1, parameters=model.parameters()
+        )
+        model, opt, _ = pl.group_sharded_parallel(model, opt, "os_g")
+        x = _data_sharded_batch(mesh_sharding4)
+        model(x).sum().backward()
+        g = model._layers.weight.grad._value
+        # dim0 (8) sharded over sharding=4 -> per-device shard (2, 16)
+        assert g.sharding.shard_shape(g.shape) == (2, 16)
+        assert _per_device_bytes(g) == g.nbytes // 4
+
+    def test_slot_bytes_shrink_by_degree(self, mesh_sharding4):
+        model = nn.Linear(8, 16)
+        opt = paddle.optimizer.AdamW(
+            learning_rate=0.1, parameters=model.parameters()
+        )
+        model, opt, _ = pl.group_sharded_parallel(model, opt, "os_g")
+        w = model._layers.weight
+        # replicated baseline for comparison: stage 2 starts params whole on
+        # every device (only grads + optimizer states are sharded)
+        assert _per_device_bytes(w._value) == w._value.nbytes
+        x = _data_sharded_batch(mesh_sharding4)
+        model(x).sum().backward()
+        opt.step()
+        state = opt._state[id(w)]
+        m = state["m"]._value
+        assert _per_device_bytes(m) == m.nbytes // 4
+
+
+class TestStage3Proof:
+    def test_param_bytes_shrink_and_hlo_has_all_gather(self, mesh_sharding4):
+        model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 8))
+        opt = paddle.optimizer.AdamW(
+            learning_rate=0.1, parameters=model.parameters()
+        )
+        model, opt, _ = pl.group_sharded_parallel(model, opt, "p_g_os")
+
+        for _, p in model._layers.named_parameters():
+            assert _per_device_bytes(p._value) == p._value.nbytes // 4, (
+                f"stage-3 param {p.shape} not sharded 1/4 per device"
+            )
+
+        @paddle.jit.to_static
+        def step(x):
+            loss = model(x).sum()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        x = _data_sharded_batch(mesh_sharding4)
+        hlo = step.lowered_text(x)
+        assert "all-gather" in hlo, (
+            "stage-3 forward must all-gather sharded params on demand; "
+            "compiled HLO has none"
+        )
+        before = model._layers[0].weight.numpy().copy()
+        step(x)
+        assert not np.allclose(before, model._layers[0].weight.numpy())

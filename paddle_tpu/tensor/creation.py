@@ -187,3 +187,38 @@ def polar(abs_t, angle, name=None):
 
 def clone_detached(x):
     return x.detach().clone()
+
+
+def fill_constant(shape, dtype, value, force_cpu=False, out=None, name=None):
+    """Static-graph style constant fill (``tensor/fill_constant``)."""
+    t = full(shape, value, dtype=dtype)
+    if out is not None:
+        return out._rebind(t)
+    return t
+
+
+def create_tensor(dtype, name=None, persistable=False):
+    return to_tensor(np.array([], dtype=str(dtype_mod.convert_dtype(dtype))))
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    """Standalone Parameter factory (``tensor/creation.py``): bias-like
+    shapes init to zero, weights Xavier-uniform, unless an initializer or a
+    ParamAttr with one is given."""
+    from ..core.tensor import Parameter
+    from ..nn import initializer as init_mod
+
+    init = default_initializer
+    if init is None and attr is not None:
+        init = getattr(attr, "initializer", None)
+    if init is None:
+        init = (init_mod.Constant(0.0) if is_bias
+                else init_mod.XavierUniform())
+    d = dtype_mod.convert_dtype(dtype)
+    return Parameter(init(tuple(shape), d))
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    return full(shape, value, dtype=dtype)

@@ -305,3 +305,35 @@ def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
         lambda v: jnp.cov(v, rowvar=rowvar, ddof=1 if ddof else 0, fweights=fw, aweights=aw),
         _ensure(x),
     )
+
+
+def mv(x, vec, name=None):
+    """Matrix-vector product (``linalg.py:2294``)."""
+    return run_op("mv", lambda m, v: m @ v, _ensure(x), _ensure(vec))
+
+
+def cond(x, p=None, name=None):
+    """Matrix condition number (``linalg.py:1215``): norm(x,p)*norm(inv,p)
+    for p in {fro, nuc, 1, -1, inf, -inf}; sigma_max/sigma_min for p in
+    {None, 2, -2} (via SVD, works for non-square stacks)."""
+
+    def f(m):
+        if p is None or p == 2 or p == -2:
+            s = jnp.linalg.svd(m, compute_uv=False)
+            smax, smin = s[..., 0], s[..., -1]
+            return smax / smin if p != -2 else smin / smax
+        if p == "fro":
+            nrm = lambda a: jnp.sqrt(jnp.sum(jnp.abs(a) ** 2, axis=(-2, -1)))
+        elif p == "nuc":
+            nrm = lambda a: jnp.sum(jnp.linalg.svd(a, compute_uv=False), axis=-1)
+        elif p in (1, -1):
+            red = jnp.max if p == 1 else jnp.min
+            nrm = lambda a: red(jnp.sum(jnp.abs(a), axis=-2), axis=-1)
+        elif p in (np.inf, -np.inf, float("inf"), float("-inf")):
+            red = jnp.max if p > 0 else jnp.min
+            nrm = lambda a: red(jnp.sum(jnp.abs(a), axis=-1), axis=-1)
+        else:
+            raise ValueError(f"unsupported p: {p}")
+        return nrm(m) * nrm(jnp.linalg.inv(m))
+
+    return run_op("cond", f, _ensure(x))

@@ -494,3 +494,209 @@ def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
         return jnp.where(shard == shard_id, v % size, ignore_value)
 
     return run_op("shard_index", f, _ensure(input))
+
+
+def cast(x, dtype):
+    """Module-level dtype cast (``manipulation.py:180``)."""
+    d = dtype_mod.convert_dtype(dtype)
+    return run_op("cast", lambda v: v.astype(d), _ensure(x))
+
+
+def cast_(x, dtype):
+    return x._rebind(cast(x, dtype))
+
+
+def unstack(x, axis=0, num=None):
+    """Split along ``axis`` into that many rank-(n-1) tensors
+    (``manipulation.py:578``)."""
+    t = _ensure(x)
+    n = t._value.shape[axis]
+    if num is not None and num != n:
+        raise ValueError(f"num ({num}) != dim size ({n})")
+    outs = run_op("unstack", lambda v: tuple(jnp.moveaxis(v, axis, 0)), t)
+    return list(outs)
+
+
+def unflatten(x, axis, shape, name=None):
+    """Expand dim ``axis`` into ``shape`` (``manipulation.py:6261``);
+    one entry may be -1."""
+    t = _ensure(x)
+    dims = _ints(shape)
+    ax = axis % t._value.ndim
+    full = list(t._value.shape)
+    if -1 in dims:
+        known = int(np.prod([d for d in dims if d != -1])) or 1
+        dims = tuple(full[ax] // known if d == -1 else d for d in dims)
+    new_shape = tuple(full[:ax]) + dims + tuple(full[ax + 1:])
+    return run_op("unflatten", lambda v: jnp.reshape(v, new_shape), t)
+
+
+def view_as(x, other, name=None):
+    return reshape(x, list(_ensure(other)._value.shape))
+
+
+def as_complex(x, name=None):
+    """Last-dim pairs (re, im) -> complex (``manipulation.py:5392``)."""
+    t = _ensure(x)
+    if t._value.shape[-1] != 2:
+        raise ValueError(
+            f"as_complex requires the last dimension to be 2, got shape "
+            f"{tuple(t._value.shape)}")
+    return run_op(
+        "as_complex", lambda v: jax.lax.complex(v[..., 0], v[..., 1]), t
+    )
+
+
+def as_real(x, name=None):
+    """Complex -> trailing dim [re, im] (``manipulation.py:5438``)."""
+    return run_op(
+        "as_real", lambda v: jnp.stack([jnp.real(v), jnp.imag(v)], axis=-1), _ensure(x)
+    )
+
+
+def tolist(x):
+    return np.asarray(_ensure(x)._value).tolist()
+
+
+def column_stack(x, name=None):
+    ts = [_ensure(t) for t in x]
+    return run_op("column_stack", lambda *vs: jnp.column_stack(vs), *ts)
+
+
+def row_stack(x, name=None):
+    ts = [_ensure(t) for t in x]
+    return run_op("row_stack", lambda *vs: jnp.vstack(vs), *ts)
+
+
+def hsplit(x, num_or_indices, name=None):
+    t = _ensure(x)
+    axis = 0 if t._value.ndim == 1 else 1
+    return split_by_indices(t, num_or_indices, axis)
+
+
+def vsplit(x, num_or_indices, name=None):
+    return split_by_indices(_ensure(x), num_or_indices, 0)
+
+
+def dsplit(x, num_or_indices, name=None):
+    return split_by_indices(_ensure(x), num_or_indices, 2)
+
+
+def split_by_indices(t, num_or_indices, axis):
+    """numpy-style split: int = equal sections, sequence = cut indices."""
+    t = _ensure(t)
+    if isinstance(num_or_indices, int):
+        n = t._value.shape[axis]
+        if n % num_or_indices != 0:
+            raise ValueError(
+                f"dim {axis} size {n} not divisible into {num_or_indices}")
+        cuts = [n // num_or_indices * i for i in range(1, num_or_indices)]
+    else:
+        cuts = list(_ints(num_or_indices))
+    outs = run_op(
+        "split_by_indices", lambda v: tuple(jnp.split(v, cuts, axis=axis)), t
+    )
+    return list(outs)
+
+
+def masked_scatter(x, mask, value, name=None):
+    """Fill ``True`` positions of ``mask`` with consecutive elements of
+    ``value`` (row-major order, ``manipulation.py:4519``)."""
+    t, m, v = _ensure(x), _ensure(mask), _ensure(value)
+    if not isinstance(m._value, jax.core.Tracer):
+        needed = int(np.asarray(
+            jnp.sum(jnp.broadcast_to(m._value.astype(bool),
+                                     t._value.shape))))
+        if v._value.size < needed:
+            raise ValueError(
+                f"masked_scatter: value has {v._value.size} elements but "
+                f"mask selects {needed}")
+
+    def f(xv, vv):
+        mv = jnp.broadcast_to(m._value.astype(bool), xv.shape)
+        flat_m = mv.reshape(-1)
+        # position of each True among Trues -> index into flattened value
+        order = jnp.cumsum(flat_m.astype(jnp.int32)) - 1
+        src = vv.reshape(-1)[jnp.clip(order, 0, vv.size - 1)]
+        return jnp.where(flat_m, src, xv.reshape(-1)).reshape(xv.shape)
+
+    return run_op("masked_scatter", f, t, v)
+
+
+def masked_scatter_(x, mask, value, name=None):
+    return x._rebind(masked_scatter(x, mask, value))
+
+
+def _diag_plane_indices(shape, offset, dim1, dim2):
+    """Index grid of the (offset) diagonal across the dim1/dim2 plane."""
+    n1, n2 = shape[dim1], shape[dim2]
+    if offset >= 0:
+        dlen = max(0, builtins.min(n1, n2 - offset))
+        i1 = np.arange(dlen)
+        i2 = np.arange(dlen) + offset
+    else:
+        dlen = max(0, builtins.min(n1 + offset, n2))
+        i1 = np.arange(dlen) - offset
+        i2 = np.arange(dlen)
+    return i1, i2, dlen
+
+
+def fill_diagonal_tensor(x, y, offset=0, dim1=0, dim2=1, name=None):
+    """Write ``y`` onto the (offset) diagonal of the dim1/dim2 plane
+    (``manipulation.py:1177``): y's last dim runs along the diagonal, its
+    leading dims are the remaining dims of x in order."""
+    t, s = _ensure(x), _ensure(y)
+    nd = t._value.ndim
+    d1, d2 = dim1 % nd, dim2 % nd
+    i1, i2, dlen = _diag_plane_indices(t._value.shape, offset, d1, d2)
+
+    def f(xv, yv):
+        # move the plane dims to the back: (..., d1, d2)
+        rest = [i for i in range(nd) if i not in (d1, d2)]
+        perm = rest + [d1, d2]
+        moved = jnp.transpose(xv, perm)
+        yv = jnp.broadcast_to(yv, tuple(moved.shape[:-2]) + (dlen,))
+        moved = moved.at[..., jnp.asarray(i1), jnp.asarray(i2)].set(yv)
+        inv = np.argsort(perm)
+        return jnp.transpose(moved, inv)
+
+    return run_op("fill_diagonal_tensor", f, t, s)
+
+
+def fill_diagonal_tensor_(x, y, offset=0, dim1=0, dim2=1, name=None):
+    return x._rebind(fill_diagonal_tensor(x, y, offset, dim1, dim2))
+
+
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None):
+    """torch-style alias of :func:`fill_diagonal_tensor`
+    (``manipulation.py:6591``)."""
+    return fill_diagonal_tensor(x, y, offset=offset, dim1=axis1, dim2=axis2)
+
+
+def select_scatter(x, values, axis, index, name=None):
+    """Write ``values`` into slice ``index`` along ``axis``
+    (``manipulation.py:6634``)."""
+    t, s = _ensure(x), _ensure(values)
+
+    def f(xv, vv):
+        idx = [builtins.slice(None)] * xv.ndim
+        idx[axis] = index
+        return xv.at[tuple(idx)].set(vv)
+
+    return run_op("select_scatter", f, t, s)
+
+
+def slice_scatter(x, value, axes, starts, ends, strides, name=None):
+    """Scatter ``value`` into the strided slice region
+    (``manipulation.py:6740``)."""
+    t, s = _ensure(x), _ensure(value)
+    axes_, starts_, ends_, strides_ = (
+        _ints(axes), _ints(starts), _ints(ends), _ints(strides))
+
+    def f(xv, vv):
+        idx = [builtins.slice(None)] * xv.ndim
+        for a, st, en, sr in zip(axes_, starts_, ends_, strides_):
+            idx[a] = builtins.slice(st, en, sr)
+        return xv.at[tuple(idx)].set(vv)
+
+    return run_op("slice_scatter", f, t, s)

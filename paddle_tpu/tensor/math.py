@@ -437,3 +437,109 @@ def vander(x, n=None, increasing=False, name=None):
 def take(x, index, mode="raise", name=None):
     idx = index._value if isinstance(index, Tensor) else jnp.asarray(index)
     return run_op("take", lambda v: jnp.take(v.reshape(-1), idx.reshape(-1).astype(jnp.int32), mode="clip").reshape(idx.shape), _ensure(x))
+
+
+def frexp(x, name=None):
+    """Decompose ``x`` into mantissa in [0.5, 1) and integer exponent so that
+    ``x = mantissa * 2**exponent`` (``python/paddle/tensor/math.py:6525``).
+    Paddle returns the exponent as the same float dtype as ``x``."""
+
+    def f(v):
+        m, e = jnp.frexp(v)
+        return m, e.astype(v.dtype)
+
+    return run_op("frexp", f, _ensure(x))
+
+
+def gammainc(x, y, name=None):
+    """Regularized lower incomplete gamma P(x, y) (math.py:5167)."""
+    return run_op("gammainc", jax.scipy.special.gammainc, _ensure(x), _ensure(y))
+
+
+def gammainc_(x, y, name=None):
+    return x._rebind(gammainc(x, y))
+
+
+def gammaincc(x, y, name=None):
+    """Regularized upper incomplete gamma Q(x, y) (math.py:5212)."""
+    return run_op("gammaincc", jax.scipy.special.gammaincc, _ensure(x), _ensure(y))
+
+
+def gammaincc_(x, y, name=None):
+    return x._rebind(gammaincc(x, y))
+
+
+def multigammaln(x, p, name=None):
+    """Log multivariate gamma ln Γ_p(x) (math.py:5257)."""
+
+    def f(v):
+        j = jnp.arange(p, dtype=v.dtype)
+        terms = jax.scipy.special.gammaln(v[..., None] - j / 2.0)
+        const = p * (p - 1) / 4.0 * jnp.log(jnp.asarray(jnp.pi, dtype=v.dtype))
+        return const + jnp.sum(terms, axis=-1)
+
+    return run_op("multigammaln", f, _ensure(x))
+
+
+def multigammaln_(x, p, name=None):
+    return x._rebind(multigammaln(x, p))
+
+
+def signbit(x, name=None):
+    """True where the sign bit is set, incl. -0.0 and -nan (math.py:7625)."""
+    return run_op("signbit", jnp.signbit, _ensure(x))
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    """Per-slice p-norm clamp along ``axis`` (math.py:2386): slice i is
+    rescaled so its p-norm equals ``max_norm`` when it exceeds it."""
+    nd = _ensure(x)._value.ndim
+    if not -nd <= axis < nd:
+        raise ValueError(f"axis {axis} out of range for rank {nd}")
+    ax = axis % nd
+
+    def f(v):
+        reduce_axes = tuple(i for i in range(v.ndim) if i != ax)
+        norms = jnp.sum(jnp.abs(v) ** p, axis=reduce_axes, keepdims=True) ** (1.0 / p)
+        scale = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+        return v * scale
+
+    return run_op("renorm", f, _ensure(x))
+
+
+def renorm_(x, p, axis, max_norm, name=None):
+    return x._rebind(renorm(x, p, axis, max_norm))
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    """Cumulative trapezoidal integral (math.py:6721)."""
+    xs = x._value if isinstance(x, Tensor) else x
+
+    def f(v):
+        v1 = jax.lax.slice_in_dim(v, 1, v.shape[axis], axis=axis)
+        v0 = jax.lax.slice_in_dim(v, 0, v.shape[axis] - 1, axis=axis)
+        if xs is not None:
+            d = jnp.diff(xs, axis=axis) if xs.ndim == v.ndim else jnp.expand_dims(
+                jnp.diff(xs.reshape(-1)), tuple(range(1, v.ndim - (axis % v.ndim))))
+            if d.ndim < v.ndim:
+                d = jnp.moveaxis(d.reshape(d.shape + (1,) * (v.ndim - d.ndim)), 0, axis)
+        else:
+            d = 1.0 if dx is None else dx
+        return jnp.cumsum((v0 + v1) * d / 2.0, axis=axis)
+
+    return run_op("cumulative_trapezoid", f, _ensure(y))
+
+
+def combinations(x, r=2, with_replacement=False, name=None):
+    """All r-combinations of a 1-D tensor, rows in lexicographic index order
+    (math.py:7559)."""
+    import itertools
+
+    v = _ensure(x)
+    n = v._value.shape[0]
+    gen = (itertools.combinations_with_replacement if with_replacement
+           else itertools.combinations)
+    idx = np.array(list(gen(range(n), r)), dtype=np.int32)
+    if idx.size == 0:
+        idx = idx.reshape(0, r)
+    return run_op("combinations", lambda t: t[jnp.asarray(idx)], v)

@@ -245,3 +245,37 @@ def bincount(x, weights=None, minlength=0, name=None):
     xv = np.asarray(_ensure(x)._value)
     wv = np.asarray(weights._value) if isinstance(weights, Tensor) else weights
     return to_tensor(np.bincount(xv, weights=wv, minlength=minlength))
+
+
+def top_p_sampling(x, ps, threshold=None, seed=None, name=None):
+    """Nucleus sampling (``search.py:1235``): per row of probability scores
+    ``x``, keep the smallest descending-sorted prefix whose mass reaches
+    ``ps`` (always >= 1 token), zero the rest (and anything below
+    ``threshold``), sample one token.  Returns (values, ids[int64]) with a
+    trailing dim of 1."""
+    t, p = to_tensor(x) if not isinstance(x, Tensor) else x, \
+        to_tensor(ps) if not isinstance(ps, Tensor) else ps
+    from ..core import random as rng
+
+    thr = threshold._value if isinstance(threshold, Tensor) else threshold
+    key = (jax.random.PRNGKey(seed) if seed is not None and seed >= 0
+           else rng.next_key())
+
+    def f(probs, pv):
+        order = jnp.argsort(-probs, axis=-1)
+        sorted_p = jnp.take_along_axis(probs, order, axis=-1)
+        cum = jnp.cumsum(sorted_p, axis=-1)
+        # keep tokens while the mass *before* them is < ps (first token always kept)
+        keep = (cum - sorted_p) < pv[..., None]
+        if thr is not None:
+            keep = keep & (sorted_p >= thr)
+            # threshold can empty the nucleus — greedy-keep the top token then
+            keep = keep.at[..., 0].set(keep[..., 0] | ~jnp.any(keep, -1))
+        masked = jnp.where(keep, sorted_p, 0.0)
+        masked = masked / jnp.maximum(jnp.sum(masked, -1, keepdims=True), 1e-9)
+        choice = jax.random.categorical(key, jnp.log(jnp.maximum(masked, 1e-30)))
+        ids = jnp.take_along_axis(order, choice[..., None], axis=-1)
+        vals = jnp.take_along_axis(probs, ids, axis=-1)
+        return vals, ids.astype(jnp.int64)
+
+    return run_op("top_p_sampling", f, t, p)

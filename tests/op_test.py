@@ -75,3 +75,32 @@ def check_grad(op_fn: Callable, inputs: Sequence[np.ndarray],
         np.testing.assert_allclose(
             analytic.numpy(), numeric, rtol=rtol, atol=atol,
             err_msg=f"gradient mismatch for input {i}")
+
+
+def check_output_dtypes(op_fn: Callable, np_fn: Callable,
+                        inputs: Sequence[np.ndarray],
+                        dtypes: Sequence[str] = ("float32", "bfloat16"),
+                        rtol: float = 1e-5, atol: float = 1e-6,
+                        bf16_rtol: float = 2e-2, bf16_atol: float = 2e-2,
+                        **kwargs):
+    """Dtype-swept check_output — the reference's per-op fp16/bf16 sweep
+    (``test/legacy_test/op_test.py:420``).  The low-precision run executes
+    the op in that dtype and compares against the fp32 NumPy reference with
+    widened tolerances; bf16 is the default TPU training dtype so every op
+    in the battery must survive it."""
+    ref = np_fn(*inputs, **kwargs)
+    refs = ref if isinstance(ref, (list, tuple)) else [ref]
+    for dtype in dtypes:
+        low = dtype != "float32"
+        tensors = [paddle.to_tensor(x).astype(dtype)
+                   if np.issubdtype(x.dtype, np.floating) else paddle.to_tensor(x)
+                   for x in inputs]
+        out = op_fn(*tensors, **kwargs)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        for o, r in zip(outs, refs):
+            got = o.astype("float32").numpy() if "float" in str(o.dtype) else o.numpy()
+            np.testing.assert_allclose(
+                got, np.asarray(r, dtype=got.dtype),
+                rtol=bf16_rtol if low else rtol,
+                atol=bf16_atol if low else atol,
+                err_msg=f"dtype sweep failed at {dtype}")

@@ -1,0 +1,26 @@
+"""``paddle.utils`` analog: custom-op extension mechanisms.
+
+The reference exposes runtime-compiled user ops via
+``paddle.utils.cpp_extension`` (``python/paddle/utils/cpp_extension/``,
+``PD_BUILD_OP`` in ``fluid/framework/custom_operator.cc``).  TPU-first the
+two registration paths are:
+
+- :mod:`paddle_tpu.utils.extension` — register a JAX/Pallas kernel as a
+  framework op (tape autograd, AMP, ``to_static`` capture included); this
+  is the path for on-chip custom kernels.
+- :mod:`paddle_tpu.utils.cpp_extension` — runtime-compile C++ sources with
+  g++ and bind exported kernels as host-callback ops (the CPU custom-op
+  capability).
+"""
+
+from . import cpp_extension, extension  # noqa: F401
+from .extension import get_custom_op, register_custom_op  # noqa: F401
+
+
+def try_import(name):
+    import importlib
+
+    try:
+        return importlib.import_module(name)
+    except ImportError:
+        return None

@@ -1,0 +1,187 @@
+"""Runtime-compiled C++ custom ops (``paddle.utils.cpp_extension`` analog).
+
+The reference compiles user C++/CUDA sources at import time and registers
+the kernels as framework ops (``python/paddle/utils/cpp_extension/
+extension_utils.py``, ``PD_BUILD_OP``).  On TPU user C++ cannot run on
+chip, so the TPU-native contract is explicit about placement:
+
+- **Host ops** (this module): C++ compiled with g++ into a shared object,
+  bound via ctypes, executed through ``jax.pure_callback`` — runs on the
+  host CPU, works under jit (XLA inserts the host transfer), differentiable
+  when a ``<name>_grad`` kernel is exported.
+- **Device ops**: write a Pallas kernel and register it with
+  :func:`paddle_tpu.utils.extension.register_custom_op`.
+
+Exported kernel ABI (elementwise, shape-preserving)::
+
+    extern "C" void my_op(const float* x, float* y, int64_t n);
+    extern "C" void my_op_grad(const float* x, const float* gy,
+                               float* gx, int64_t n);   // optional
+
+``load(name=..., sources=[...], functions=[...])`` returns a namespace
+whose attributes are framework ops (Tensor in → Tensor out, tape-
+differentiable when the grad kernel exists).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import types
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import run_op
+from ..core.tensor import Tensor, to_tensor
+
+_DEFAULT_BUILD_DIR = os.path.join(
+    os.path.dirname(__file__), "..", "_native", "extensions")
+
+
+class ExtensionBuildError(RuntimeError):
+    pass
+
+
+def get_build_directory() -> str:
+    return os.environ.get("PADDLE_EXTENSION_DIR", _DEFAULT_BUILD_DIR)
+
+
+def _compile(name: str, sources: Sequence[str], extra_cflags, build_dir,
+             verbose: bool) -> str:
+    h = hashlib.sha256()
+    for s in sources:
+        with open(s, "rb") as f:
+            h.update(f.read())
+    h.update(" ".join(extra_cflags or []).encode())
+    so = os.path.join(build_dir, f"lib{name}-{h.hexdigest()[:16]}.so")
+    if os.path.exists(so):
+        return so
+    os.makedirs(build_dir, exist_ok=True)
+    cmd = (["g++", "-O2", "-std=c++17", "-shared", "-fPIC"]
+           + list(extra_cflags or []) + [os.path.abspath(s) for s in sources]
+           + ["-o", so + ".tmp"])
+    if verbose:
+        print("[cpp_extension]", " ".join(cmd))
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise ExtensionBuildError(f"g++ failed for {name}:\n{proc.stderr}")
+    os.replace(so + ".tmp", so)
+    return so
+
+
+_CFN = ctypes.CFUNCTYPE(None, ctypes.POINTER(ctypes.c_float),
+                        ctypes.POINTER(ctypes.c_float), ctypes.c_int64)
+_CGRADFN = ctypes.CFUNCTYPE(None, ctypes.POINTER(ctypes.c_float),
+                            ctypes.POINTER(ctypes.c_float),
+                            ctypes.POINTER(ctypes.c_float), ctypes.c_int64)
+
+
+def _bind_unary(lib: ctypes.CDLL, sym: str):
+    cfn = _CFN((sym, lib))
+
+    def call(x: np.ndarray) -> np.ndarray:
+        x = np.ascontiguousarray(x, dtype=np.float32)
+        out = np.empty_like(x)
+        cfn(x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), x.size)
+        return out
+
+    return call
+
+
+def _bind_grad(lib: ctypes.CDLL, sym: str):
+    cfn = _CGRADFN((sym, lib))
+
+    def call(x: np.ndarray, gy: np.ndarray) -> np.ndarray:
+        x = np.ascontiguousarray(x, dtype=np.float32)
+        gy = np.ascontiguousarray(gy, dtype=np.float32)
+        gx = np.empty_like(x)
+        cfn(x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            gy.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            gx.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), x.size)
+        return gx
+
+    return call
+
+
+def _make_op(op_name: str, host_fn, host_grad):
+    """Wrap the host kernel as a framework op via pure_callback (+ custom
+    VJP from the exported grad kernel)."""
+
+    def raw(v):
+        shape = jax.ShapeDtypeStruct(v.shape, jnp.float32)
+        return jax.pure_callback(host_fn, shape, v.astype(jnp.float32))
+
+    if host_grad is not None:
+        @jax.custom_vjp
+        def kernel(v):
+            return raw(v)
+
+        def fwd(v):
+            return raw(v), v
+
+        def bwd(v, g):
+            shape = jax.ShapeDtypeStruct(v.shape, jnp.float32)
+            return (jax.pure_callback(
+                host_grad, shape, v.astype(jnp.float32),
+                g.astype(jnp.float32)),)
+
+        kernel.defvjp(fwd, bwd)
+    else:
+        kernel = raw
+
+    def op(x):
+        t = x if isinstance(x, Tensor) else to_tensor(x)
+        return run_op(op_name, kernel, t)
+
+    op.__name__ = op_name
+    return op
+
+
+def load(name: str, sources: Sequence[str],
+         functions: Optional[List[str]] = None,
+         extra_cflags: Optional[Sequence[str]] = None,
+         build_directory: Optional[str] = None,
+         verbose: bool = False) -> types.SimpleNamespace:
+    """Compile ``sources`` and return a namespace of framework ops — the
+    ``cpp_extension.load`` analog (build-and-import in one call).
+
+    ``functions`` lists the exported op symbols (default: ``[name]``); a
+    matching ``<fn>_grad`` export, if present, becomes the op's VJP.
+    """
+    so = _compile(name, sources, extra_cflags,
+                  build_directory or get_build_directory(), verbose)
+    lib = ctypes.CDLL(so)
+    ns = types.SimpleNamespace(__so_path__=so)
+    for fn_name in functions or [name]:
+        host = _bind_unary(lib, fn_name)
+        try:
+            grad = _bind_grad(lib, fn_name + "_grad")
+        except AttributeError:
+            grad = None
+        setattr(ns, fn_name, _make_op(fn_name, host, grad))
+    return ns
+
+
+class CppExtension:
+    """setuptools-style descriptor (API-parity shim; ``load`` is the real
+    entry point in this environment)."""
+
+    def __init__(self, sources, *args, **kwargs):
+        self.sources = list(sources)
+        self.kwargs = kwargs
+
+
+CUDAExtension = CppExtension  # no CUDA on TPU; accepted for portability
+
+
+def setup(**kwargs):
+    raise NotImplementedError(
+        "ahead-of-time extension building is not used here; call "
+        "paddle_tpu.utils.cpp_extension.load(name=..., sources=[...]) "
+        "for build-and-import")

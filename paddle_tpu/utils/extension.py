@@ -1,0 +1,124 @@
+"""Custom-op registration for JAX/Pallas kernels (N37 analog).
+
+The reference lets users add ops at runtime with ``PD_BUILD_OP``
+(``paddle/fluid/framework/custom_operator.cc``) + ``paddle.utils.
+cpp_extension.load``: forward/backward C++ kernels become first-class ops
+with autograd wiring.  TPU-native, a user kernel is a JAX-traceable
+function (a ``jax.numpy`` composition or a Pallas TPU kernel); registering
+it here makes it a *framework* op — dispatched through ``run_op`` so the
+eager tape differentiates it, AMP casts its inputs, ``to_static`` captures
+it into the compiled graph, and the profiler sees its name.
+
+Worked example (Pallas kernel with a custom VJP)::
+
+    import jax, jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from paddle_tpu.utils import register_custom_op
+
+    def _scaled_kernel(x_ref, o_ref, *, alpha):
+        o_ref[...] = x_ref[...] * alpha
+
+    def scaled(x, alpha=2.0):
+        return pl.pallas_call(
+            functools.partial(_scaled_kernel, alpha=alpha),
+            out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype))(x)
+
+    def scaled_fwd(x, alpha=2.0):
+        return scaled(x, alpha), None
+
+    def scaled_bwd(alpha, _, g):
+        return (g * alpha,)
+
+    my_scaled = register_custom_op(
+        scaled, name="my_scaled", vjp=(scaled_fwd, scaled_bwd),
+        nondiff_argnames=("alpha",))
+
+    y = my_scaled(paddle.to_tensor(x), alpha=3.0)   # a framework op now
+    y.sum().backward()                               # uses scaled_bwd
+
+See ``tests/test_custom_op.py`` for the runnable version.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Dict, Optional, Sequence, Tuple
+
+import jax
+
+from ..core.dispatch import run_op
+from ..core.tensor import Tensor, to_tensor
+
+_REGISTRY: Dict[str, Callable] = {}
+
+
+def register_custom_op(fn: Callable = None, *, name: Optional[str] = None,
+                       vjp: Optional[Tuple[Callable, Callable]] = None,
+                       nondiff_argnames: Sequence[str] = ()):
+    """Register ``fn`` (a JAX-traceable kernel over raw arrays) as a
+    framework op.
+
+    Args:
+        fn: callable over ``jax.Array`` positional inputs (+ static kwargs).
+        name: op name (defaults to ``fn.__name__``); appears in profiler
+            traces and ``FLAGS eager_log_ops`` output.
+        vjp: optional ``(fwd, bwd)`` pair wiring ``jax.custom_vjp`` —
+            ``fwd(*args, **kw) -> (out, residuals)``,
+            ``bwd(*nondiff_kwargs, residuals, cotangent) -> input grads``.
+            Without it, the kernel must be differentiable by ``jax.grad``
+            (pure jnp compositions are; Pallas kernels are not).
+        nondiff_argnames: kwarg names treated as static configuration.
+
+    Returns the framework-level op: ``op(Tensor..., **kw) -> Tensor``.
+    Also retrievable via :func:`get_custom_op`.
+    """
+    if fn is None:
+        return functools.partial(register_custom_op, name=name, vjp=vjp,
+                                 nondiff_argnames=nondiff_argnames)
+
+    op_name = name or fn.__name__
+    raw = fn
+    if vjp is not None:
+        fwd, bwd = vjp
+        # custom_vjp over kwargs: close over them per call (static config)
+        raw = fn  # kernel itself; wrapped per-call below
+
+    @functools.wraps(fn)
+    def op(*args, **kwargs):
+        tensors = [a if isinstance(a, Tensor) else to_tensor(a) for a in args]
+        static_kw = {k: v for k, v in kwargs.items()}
+        if vjp is None:
+            kernel = lambda *vals: raw(*vals, **static_kw)
+        else:
+            fwd_fn, bwd_fn = vjp
+
+            @jax.custom_vjp
+            def kernel(*vals):
+                return raw(*vals, **static_kw)
+
+            def _fwd(*vals):
+                return fwd_fn(*vals, **static_kw)
+
+            def _bwd(res, g):
+                cfg = tuple(static_kw[k] for k in nondiff_argnames
+                            if k in static_kw)
+                return tuple(bwd_fn(*cfg, res, g))
+
+            kernel.defvjp(_fwd, _bwd)
+        return run_op(op_name, kernel, *tensors)
+
+    _REGISTRY[op_name] = op
+    return op
+
+
+def get_custom_op(name: str) -> Callable:
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise KeyError(
+            f"no custom op '{name}' registered "
+            f"(have: {sorted(_REGISTRY)})") from None
+
+
+def registered_ops() -> Dict[str, Callable]:
+    return dict(_REGISTRY)

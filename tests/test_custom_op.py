@@ -1,0 +1,167 @@
+"""Custom-op extension mechanism (N37 analog) — the reference's
+``test/custom_op`` build-and-run pattern: register kernels at runtime,
+check outputs and autograd wiring, including under ``to_static``."""
+
+import functools
+import os
+import textwrap
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.utils import cpp_extension, extension
+
+
+class TestRegisterCustomOp:
+    def test_jnp_kernel_autodiff(self):
+        @extension.register_custom_op
+        def my_softsign(x):
+            return x / (1.0 + jnp.abs(x))
+
+        x = paddle.to_tensor(np.array([1.0, -2.0, 0.5], "float32"))
+        x.stop_gradient = False
+        y = my_softsign(x)
+        np.testing.assert_allclose(
+            y.numpy(), x.numpy() / (1 + np.abs(x.numpy())), rtol=1e-6)
+        y.sum().backward()
+        ref = 1.0 / (1.0 + np.abs(x.numpy())) ** 2
+        np.testing.assert_allclose(x.grad.numpy(), ref, rtol=1e-5)
+
+    def test_custom_vjp_used(self):
+        calls = {"bwd": 0}
+
+        def kern(x, alpha=2.0):
+            return x * alpha
+
+        def fwd(x, alpha=2.0):
+            return x * alpha, None
+
+        def bwd(alpha, res, g):
+            calls["bwd"] += 1
+            return (g * alpha,)
+
+        my_scaled = extension.register_custom_op(
+            kern, name="my_scaled", vjp=(fwd, bwd),
+            nondiff_argnames=("alpha",))
+
+        x = paddle.to_tensor(np.ones(4, "float32"))
+        x.stop_gradient = False
+        y = my_scaled(x, alpha=3.0)
+        np.testing.assert_allclose(y.numpy(), 3.0 * np.ones(4), rtol=1e-6)
+        y.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), 3.0 * np.ones(4))
+        assert calls["bwd"] == 1
+        assert extension.get_custom_op("my_scaled") is my_scaled
+
+    def test_pallas_kernel_registration(self):
+        try:
+            from jax.experimental import pallas as pl
+        except ImportError:
+            pytest.skip("pallas unavailable")
+
+        def _kernel(x_ref, o_ref, *, alpha):
+            o_ref[...] = x_ref[...] * alpha
+
+        def scaled(x, alpha=2.0):
+            try:
+                return pl.pallas_call(
+                    functools.partial(_kernel, alpha=alpha),
+                    out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+                    interpret=jax.default_backend() == "cpu")(x)
+            except Exception:
+                pytest.skip("pallas interpret mode unavailable")
+
+        def fwd(x, alpha=2.0):
+            return scaled(x, alpha), None
+
+        def bwd(alpha, res, g):
+            return (g * alpha,)
+
+        op = extension.register_custom_op(
+            scaled, name="pallas_scaled", vjp=(fwd, bwd),
+            nondiff_argnames=("alpha",))
+        x = paddle.to_tensor(np.arange(8.0, dtype="float32"))
+        x.stop_gradient = False
+        y = op(x, alpha=4.0)
+        np.testing.assert_allclose(y.numpy(), 4.0 * x.numpy())
+        y.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), np.full(8, 4.0, "float32"))
+
+    def test_custom_op_under_to_static(self):
+        @extension.register_custom_op(name="squareplus")
+        def squareplus(x):
+            return 0.5 * (x + jnp.sqrt(x * x + 4.0))
+
+        @paddle.jit.to_static
+        def f(x):
+            return squareplus(x) * 2.0
+
+        x = paddle.to_tensor(np.array([0.0, 3.0], "float32"))
+        got = f(x).numpy()
+        ref = (x.numpy() + np.sqrt(x.numpy() ** 2 + 4.0))
+        np.testing.assert_allclose(got, ref, rtol=1e-6)
+
+
+CPP_SOURCE = textwrap.dedent("""
+    #include <cstdint>
+    #include <cmath>
+    extern "C" void my_relu6(const float* x, float* y, int64_t n) {
+        for (int64_t i = 0; i < n; ++i) {
+            float v = x[i] < 0.f ? 0.f : x[i];
+            y[i] = v > 6.f ? 6.f : v;
+        }
+    }
+    extern "C" void my_relu6_grad(const float* x, const float* gy,
+                                  float* gx, int64_t n) {
+        for (int64_t i = 0; i < n; ++i)
+            gx[i] = (x[i] > 0.f && x[i] < 6.f) ? gy[i] : 0.f;
+    }
+    extern "C" void my_square(const float* x, float* y, int64_t n) {
+        for (int64_t i = 0; i < n; ++i) y[i] = x[i] * x[i];
+    }
+""")
+
+
+class TestCppExtension:
+    @pytest.fixture(scope="class")
+    def ext(self, tmp_path_factory):
+        d = tmp_path_factory.mktemp("custom_op")
+        src = d / "my_ops.cc"
+        src.write_text(CPP_SOURCE)
+        return cpp_extension.load(
+            name="my_ops", sources=[str(src)],
+            functions=["my_relu6", "my_square"],
+            build_directory=str(d / "build"))
+
+    def test_output_and_grad(self, ext):
+        x = paddle.to_tensor(
+            np.array([-1.0, 2.0, 7.0, 5.5], "float32"))
+        x.stop_gradient = False
+        y = ext.my_relu6(x)
+        np.testing.assert_allclose(y.numpy(), [0.0, 2.0, 6.0, 5.5])
+        y.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [0.0, 1.0, 0.0, 1.0])
+
+    def test_gradless_op_forward_only(self, ext):
+        x = paddle.to_tensor(np.array([3.0], "float32"))
+        np.testing.assert_allclose(ext.my_square(x).numpy(), [9.0])
+
+    def test_works_under_jit(self, ext):
+        @paddle.jit.to_static
+        def f(x):
+            return ext.my_relu6(x) + 1.0
+
+        x = paddle.to_tensor(np.array([-2.0, 3.0], "float32"))
+        np.testing.assert_allclose(f(x).numpy(), [1.0, 4.0])
+
+    def test_build_cache_reused(self, ext, tmp_path):
+        src = tmp_path / "my_ops.cc"
+        src.write_text(CPP_SOURCE)
+        bdir = os.path.dirname(ext.__so_path__)
+        again = cpp_extension.load(
+            name="my_ops", sources=[str(src)], functions=["my_square"],
+            build_directory=bdir)
+        assert again.__so_path__ == ext.__so_path__

@@ -100,3 +100,214 @@ def relu(x, name=None):
 
 def is_same_shape(x, y):
     return tuple(x.shape) == tuple(y.shape)
+
+
+# ---------------------------------------------------------------------------
+# Unary value ops: applied to stored values, sparsity preserved
+# (``python/paddle/sparse/unary.py`` surface)
+# ---------------------------------------------------------------------------
+
+def _coo_map(x, fn):
+    bcoo = jsparse.BCOO((fn(x.bcoo.data), x.bcoo.indices), shape=x.bcoo.shape)
+    return SparseCooTensor(bcoo, stop_gradient=x.stop_gradient)
+
+
+def _csr_map(x, fn):
+    bcsr = jsparse.BCSR((fn(x.bcsr.data), x.bcsr.indices, x.bcsr.indptr),
+                        shape=x.bcsr.shape)
+    return SparseCsrTensor(bcsr, stop_gradient=x.stop_gradient)
+
+
+def _value_map(x, fn):
+    if isinstance(x, SparseCooTensor):
+        return _coo_map(x, fn)
+    if isinstance(x, SparseCsrTensor):
+        return _csr_map(x, fn)
+    return Tensor(fn(x._value))
+
+
+def sin(x, name=None):
+    return _value_map(x, jnp.sin)
+
+
+def tan(x, name=None):
+    return _value_map(x, jnp.tan)
+
+
+def asin(x, name=None):
+    return _value_map(x, jnp.arcsin)
+
+
+def atan(x, name=None):
+    return _value_map(x, jnp.arctan)
+
+
+def sinh(x, name=None):
+    return _value_map(x, jnp.sinh)
+
+
+def tanh(x, name=None):
+    return _value_map(x, jnp.tanh)
+
+
+def asinh(x, name=None):
+    return _value_map(x, jnp.arcsinh)
+
+
+def atanh(x, name=None):
+    return _value_map(x, jnp.arctanh)
+
+
+def sqrt(x, name=None):
+    return _value_map(x, jnp.sqrt)
+
+
+def square(x, name=None):
+    return _value_map(x, jnp.square)
+
+
+def abs(x, name=None):
+    return _value_map(x, jnp.abs)
+
+
+def log1p(x, name=None):
+    return _value_map(x, jnp.log1p)
+
+
+def expm1(x, name=None):
+    return _value_map(x, jnp.expm1)
+
+
+def neg(x, name=None):
+    return _value_map(x, jnp.negative)
+
+
+def pow(x, factor, name=None):
+    return _value_map(x, lambda v: jnp.power(v, factor))
+
+
+def cast(x, index_dtype=None, value_dtype=None, name=None):
+    from ..core import dtype as dtype_mod
+
+    vd = dtype_mod.convert_dtype(value_dtype) if value_dtype else None
+    return _value_map(x, (lambda v: v.astype(vd)) if vd else (lambda v: v))
+
+
+def deg2rad(x, name=None):
+    return _value_map(x, jnp.deg2rad)
+
+
+def rad2deg(x, name=None):
+    return _value_map(x, jnp.rad2deg)
+
+
+def coalesce(x, name=None):
+    """Sum duplicate COO indices (``sparse/unary.py`` coalesce)."""
+    bcoo = jsparse.bcoo_sum_duplicates(x.bcoo)
+    return SparseCooTensor(bcoo, stop_gradient=x.stop_gradient)
+
+
+def transpose(x, perm, name=None):
+    if isinstance(x, SparseCooTensor):
+        return SparseCooTensor(
+            jsparse.bcoo_transpose(x.bcoo, permutation=tuple(perm)),
+            stop_gradient=x.stop_gradient)
+    return Tensor(jnp.transpose(x._value, tuple(perm)))
+
+
+def reshape(x, shape, name=None):
+    if isinstance(x, SparseCooTensor):
+        return SparseCooTensor(
+            jsparse.bcoo_reshape(x.bcoo, new_sizes=tuple(shape)),
+            stop_gradient=x.stop_gradient)
+    return Tensor(jnp.reshape(x._value, tuple(shape)))
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    if axis is None and isinstance(x, (SparseCooTensor, SparseCsrTensor)):
+        # full reduction touches only the stored values: O(nnz)
+        v = x.bcoo.data if isinstance(x, SparseCooTensor) else x.bcsr.data
+        return Tensor(jnp.sum(v))
+    dense = x.to_dense()._value if isinstance(
+        x, (SparseCooTensor, SparseCsrTensor)) else x._value
+    return Tensor(jnp.sum(dense, axis=axis, keepdims=keepdim))
+
+
+# ---------------------------------------------------------------------------
+# Binary ops over matching layouts (``sparse/binary.py``)
+# ---------------------------------------------------------------------------
+
+def _coo_union_binary(x, y, fn):
+    """Elementwise op over the union of two COO patterns (host-computed
+    index union; value math stays in jax)."""
+    xi = np.asarray(x.bcoo.indices)
+    yi = np.asarray(y.bcoo.indices)
+    keys = {tuple(r) for r in xi.tolist()} | {tuple(r) for r in yi.tolist()}
+    union = np.array(sorted(keys), dtype=np.int32).reshape(len(keys), xi.shape[1])
+
+    def gather_vals(bcoo, idx):
+        dense = bcoo.todense()
+        return dense[tuple(idx[:, d] for d in range(idx.shape[1]))]
+
+    vals = fn(gather_vals(x.bcoo, union), gather_vals(y.bcoo, union))
+    return SparseCooTensor(jsparse.BCOO((vals, jnp.asarray(union)),
+                                        shape=x.bcoo.shape))
+
+
+def subtract(x, y, name=None):
+    if isinstance(x, SparseCooTensor) and isinstance(y, SparseCooTensor):
+        return _coo_union_binary(x, y, jnp.subtract)
+    return Tensor(x._value - y._value)
+
+
+def multiply(x, y, name=None):
+    if isinstance(x, SparseCooTensor) and isinstance(y, SparseCooTensor):
+        return _coo_union_binary(x, y, jnp.multiply)
+    return Tensor(x._value * y._value)
+
+
+def divide(x, y, name=None):
+    if isinstance(x, SparseCooTensor) and isinstance(y, SparseCooTensor):
+        return _coo_union_binary(x, y, jnp.divide)
+    return Tensor(x._value / y._value)
+
+
+def mv(x, vec, name=None):
+    """Sparse matrix × dense vector (``sparse/binary.py`` mv)."""
+    v = vec._value if isinstance(vec, Tensor) else jnp.asarray(vec)
+    if isinstance(x, SparseCooTensor):
+        return Tensor(x.bcoo @ v)
+    if isinstance(x, SparseCsrTensor):
+        return Tensor(x.bcsr @ v)
+    return Tensor(x._value @ v)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    """beta*input + alpha*(x@y) with sparse x (``sparse/binary.py``)."""
+    prod = matmul(x, y)
+    inp = input._value if isinstance(input, Tensor) else jnp.asarray(input)
+    return Tensor(beta * inp + alpha * prod._value)
+
+
+def masked_matmul(x, y, mask, name=None):
+    """SDD: dense @ dense evaluated ONLY at the mask's nonzero positions
+    (``sparse/binary.py`` masked_matmul; the reference lowers to cuSPARSE
+    SDDMM).  Gather the needed rows of ``x`` and cols of ``y`` and contract
+    per-nnz — compute is O(nnz·K), never materializing the dense product."""
+    xv = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    yv = y._value if isinstance(y, Tensor) else jnp.asarray(y)
+    if isinstance(mask, SparseCsrTensor):
+        indptr = np.asarray(mask.bcsr.indptr)
+        cols_ = jnp.asarray(mask.bcsr.indices)
+        rows_ = jnp.asarray(
+            np.repeat(np.arange(len(indptr) - 1), np.diff(indptr)).astype(np.int32))
+        vals = jnp.einsum("nk,nk->n", xv[rows_], yv[:, cols_].T)
+        return SparseCsrTensor(jsparse.BCSR(
+            (vals, mask.bcsr.indices, mask.bcsr.indptr), shape=mask.bcsr.shape))
+    idx = mask.bcoo.indices
+    rows_, cols_ = idx[:, 0], idx[:, 1]
+    vals = jnp.einsum("nk,nk->n", xv[rows_], yv[:, cols_].T)
+    return SparseCooTensor(jsparse.BCOO((vals, idx), shape=mask.bcoo.shape))
+
+
+from . import nn  # noqa: F401,E402  (sparse layer/functional subpackage)

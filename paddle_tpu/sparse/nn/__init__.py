@@ -1,0 +1,123 @@
+"""``paddle.sparse.nn`` layer classes (``python/paddle/sparse/nn/layer/``)
+over :mod:`paddle_tpu.sparse.nn.functional`."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ...core.tensor import Parameter
+from ...nn import initializer as init_mod
+from ...nn.layers import Layer
+from .. import SparseCooTensor
+from . import functional  # noqa: F401
+from .functional import attention  # noqa: F401
+
+
+class ReLU(Layer):
+    def forward(self, x):
+        return functional.relu(x)
+
+
+class ReLU6(Layer):
+    def forward(self, x):
+        return functional.relu6(x)
+
+
+class LeakyReLU(Layer):
+    def __init__(self, negative_slope=0.01, name=None):
+        super().__init__()
+        self._slope = negative_slope
+
+    def forward(self, x):
+        return functional.leaky_relu(x, self._slope)
+
+
+class Softmax(Layer):
+    def __init__(self, axis=-1, name=None):
+        super().__init__()
+        self._axis = axis
+
+    def forward(self, x):
+        return functional.softmax(x, self._axis)
+
+
+class _ConvBase(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NDHWC"):
+        super().__init__()
+        ks = ((kernel_size,) * 3 if isinstance(kernel_size, int)
+              else tuple(kernel_size))
+        self._cfg = dict(stride=stride, padding=padding, dilation=dilation,
+                         groups=groups, data_format=data_format)
+        w_init = init_mod.XavierUniform()
+        self.weight = Parameter(
+            w_init(ks + (in_channels // groups, out_channels), np.float32))
+        self.bias = (Parameter(np.zeros(out_channels, np.float32))
+                     if bias_attr is not False else None)
+
+
+class Conv3D(_ConvBase):
+    """(``sparse/nn/layer/conv.py`` Conv3D)."""
+
+    def forward(self, x):
+        return functional.conv3d(x, self.weight, self.bias, **self._cfg)
+
+
+class SubmConv3D(_ConvBase):
+    """(``sparse/nn/layer/conv.py`` SubmConv3D)."""
+
+    def forward(self, x):
+        return functional.subm_conv3d(x, self.weight, self.bias, **self._cfg)
+
+
+class MaxPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
+                 data_format="NDHWC", name=None):
+        super().__init__()
+        self._cfg = dict(kernel_size=kernel_size, stride=stride,
+                         padding=padding, ceil_mode=ceil_mode,
+                         data_format=data_format)
+
+    def forward(self, x):
+        return functional.max_pool3d(x, **self._cfg)
+
+
+class BatchNorm(Layer):
+    """Per-channel batchnorm over ACTIVE SITES only
+    (``sparse/nn/layer/norm.py`` BatchNorm — the reference normalizes the
+    nnz value rows, not the dense grid)."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NDHWC",
+                 use_global_stats=None, name=None):
+        super().__init__()
+        self._momentum, self._eps = momentum, epsilon
+        self.weight = Parameter(np.ones(num_features, np.float32))
+        self.bias = Parameter(np.zeros(num_features, np.float32))
+        from ...core.tensor import to_tensor
+
+        self.register_buffer("_mean", to_tensor(np.zeros(num_features, np.float32)))
+        self.register_buffer("_variance", to_tensor(np.ones(num_features, np.float32)))
+
+    def forward(self, x):
+        import jax.numpy as jnp
+        from jax.experimental import sparse as jsparse
+
+        assert isinstance(x, SparseCooTensor)
+        v = x.bcoo.data  # (nnz, C)
+        if self.training:
+            mean = jnp.mean(v, axis=0)
+            var = jnp.var(v, axis=0)
+            m = self._momentum
+            self._mean._value = m * self._mean._value + (1 - m) * mean
+            self._variance._value = m * self._variance._value + (1 - m) * var
+        else:
+            mean, var = self._mean._value, self._variance._value
+        out = ((v - mean) / jnp.sqrt(var + self._eps) * self.weight._value
+               + self.bias._value)
+        return SparseCooTensor(
+            jsparse.BCOO((out, x.bcoo.indices), shape=x.bcoo.shape))
+
+
+SyncBatchNorm = BatchNorm  # GSPMD batch stats are already global under jit

@@ -1,0 +1,209 @@
+"""``paddle.sparse.nn.functional`` (N9 capability): sparse attention,
+sparse conv3d, activations and pooling over sparse layouts.
+
+Reference counterparts: ``python/paddle/sparse/nn/functional/*`` and the
+CUDA kernels in ``paddle/phi/kernels/sparse/`` (conv3d gather-scatter,
+``fluid/operators/sparse_attention_op.cu``).  TPU-first notes per op below:
+attention is genuinely sparse (segment softmax over the CSR pattern,
+O(nnz·d) compute); conv3d lowers to a dense ``lax.conv_general_dilated``
+over the bounding grid — on TPU the MXU conv on a dense block IS the fast
+path; the sparse layout is preserved at the boundary (submanifold output
+keeps the input's active sites, as in the reference's SubmConv3D).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.tensor import Tensor, to_tensor
+from .. import SparseCooTensor, SparseCsrTensor, _value_map, sparse_coo_tensor
+
+
+def relu(x, name=None):
+    return _value_map(x, jax.nn.relu)
+
+
+def relu6(x, name=None):
+    return _value_map(x, lambda v: jnp.clip(v, 0.0, 6.0))
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return _value_map(x, lambda v: jax.nn.leaky_relu(v, negative_slope))
+
+
+def softmax(x, axis=-1, name=None):
+    """Sparse softmax: per-row over stored values only
+    (``sparse/nn/functional/activation.py`` softmax; axis must be the last,
+    CSR row semantics)."""
+    if isinstance(x, SparseCsrTensor):
+        indptr = np.asarray(x.bcsr.indptr)
+        rows = jnp.asarray(np.repeat(
+            np.arange(len(indptr) - 1), np.diff(indptr)).astype(np.int32))
+        n_rows = len(indptr) - 1
+        v = x.bcsr.data
+        mx = jax.ops.segment_max(v, rows, num_segments=n_rows)
+        e = jnp.exp(v - mx[rows])
+        z = jax.ops.segment_sum(e, rows, num_segments=n_rows)
+        from jax.experimental import sparse as jsparse
+
+        return SparseCsrTensor(jsparse.BCSR(
+            (e / z[rows], x.bcsr.indices, x.bcsr.indptr), shape=x.bcsr.shape))
+    if isinstance(x, SparseCooTensor):
+        idx = np.asarray(x.bcoo.indices)
+        rows = jnp.asarray(idx[:, 0].astype(np.int32))
+        n_rows = x.bcoo.shape[0]
+        v = x.bcoo.data
+        mx = jax.ops.segment_max(v, rows, num_segments=n_rows)
+        e = jnp.exp(v - mx[rows])
+        z = jax.ops.segment_sum(e, rows, num_segments=n_rows)
+        from jax.experimental import sparse as jsparse
+
+        return SparseCooTensor(jsparse.BCOO(
+            (e / z[rows], x.bcoo.indices), shape=x.bcoo.shape))
+    return Tensor(jax.nn.softmax(x._value, axis=axis))
+
+
+def attention(query, key, value, sparse_mask, key_padding_mask=None,
+              attn_mask=None, name=None):
+    """Sparse attention over a CSR connectivity pattern
+    (``sparse/nn/functional/transformer.py`` attention).
+
+    query/key/value: (B, H, L, D) dense; ``sparse_mask`` a SparseCsrTensor
+    of shape (B*H, L, L) — batched CSR like the reference — or (L, L)
+    shared across heads.  Scores are computed ONLY at nnz positions
+    (O(nnz·D)), softmax is a segment-softmax per query row, and the output
+    is the per-row weighted sum of gathered V rows."""
+    q = query._value if isinstance(query, Tensor) else jnp.asarray(query)
+    k = key._value if isinstance(key, Tensor) else jnp.asarray(key)
+    v = value._value if isinstance(value, Tensor) else jnp.asarray(value)
+    B, H, L, D = q.shape
+    scale = 1.0 / np.sqrt(D)
+
+    if isinstance(sparse_mask, SparseCsrTensor):
+        bcsr = sparse_mask.bcsr
+        if len(bcsr.shape) == 2:
+            indptr = np.broadcast_to(
+                np.asarray(bcsr.indptr), (B * H, L + 1))
+            cols = np.broadcast_to(
+                np.asarray(bcsr.indices), (B * H, np.asarray(bcsr.indices).shape[-1]))
+        else:
+            indptr = np.asarray(bcsr.indptr).reshape(B * H, L + 1)
+            cols = np.asarray(bcsr.indices).reshape(B * H, -1)
+    else:
+        raise TypeError("sparse_mask must be a SparseCsrTensor")
+
+    qf = q.reshape(B * H, L, D)
+    kf = k.reshape(B * H, L, D)
+    vf = v.reshape(B * H, L, D)
+    kpm = (key_padding_mask._value if isinstance(key_padding_mask, Tensor)
+           else key_padding_mask)
+    am = attn_mask._value if isinstance(attn_mask, Tensor) else attn_mask
+
+    outs = []
+    for bh in range(B * H):
+        rows = jnp.asarray(np.repeat(
+            np.arange(L), np.diff(indptr[bh])).astype(np.int32))
+        cc = jnp.asarray(cols[bh].astype(np.int32))
+        s = jnp.einsum("nd,nd->n", qf[bh][rows], kf[bh][cc]) * scale
+        if kpm is not None:
+            b = bh // H
+            s = jnp.where(kpm[b][cc] != 0, jnp.float32(-1e9), s)
+        if am is not None:
+            b = bh // H
+            s = jnp.where(am[b][rows, cc] != 0, jnp.float32(-1e9), s)
+        mx = jax.ops.segment_max(s, rows, num_segments=L)
+        e = jnp.exp(s - mx[rows])
+        z = jax.ops.segment_sum(e, rows, num_segments=L)
+        p = e / jnp.maximum(z[rows], 1e-9)
+        o = jax.ops.segment_sum(p[:, None] * vf[bh][cc], rows, num_segments=L)
+        outs.append(o)
+    return Tensor(jnp.stack(outs).reshape(B, H, L, D))
+
+
+def _dense_conv3d(dense, weight, bias, stride, padding, dilation, groups):
+    """NDHWC conv over the dense grid via lax (MXU path)."""
+    dn = jax.lax.conv_dimension_numbers(
+        dense.shape, weight.shape, ("NDHWC", "DHWIO", "NDHWC"))
+    if isinstance(padding, int):
+        padding = [(padding, padding)] * 3
+    elif isinstance(padding, (list, tuple)) and padding and isinstance(
+            padding[0], int):
+        padding = [(p, p) for p in padding]
+    out = jax.lax.conv_general_dilated(
+        dense, weight,
+        window_strides=(stride,) * 3 if isinstance(stride, int) else tuple(stride),
+        padding=padding,
+        rhs_dilation=(dilation,) * 3 if isinstance(dilation, int) else tuple(dilation),
+        dimension_numbers=dn, feature_group_count=groups)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NDHWC", name=None):
+    """Sparse conv3d (``sparse/nn/functional/conv.py``): SparseCooTensor in
+    (N,D,H,W,C) → SparseCooTensor out; dense MXU conv over the grid, output
+    re-sparsified at nonzero sites."""
+    w = weight._value if isinstance(weight, Tensor) else jnp.asarray(weight)
+    b = bias._value if isinstance(bias, Tensor) else (
+        jnp.asarray(bias) if bias is not None else None)
+    dense = x.to_dense()._value if isinstance(x, SparseCooTensor) else x._value
+    out = _dense_conv3d(dense, w, b, stride, padding, dilation, groups)
+    arr = np.asarray(out)
+    # COO over (N,D,H,W) sites with dense C-vector values per site
+    idx = np.argwhere(np.abs(arr).sum(-1) > 0)
+    vals = out[tuple(idx.T)]
+    from jax.experimental import sparse as jsparse
+
+    bcoo = jsparse.BCOO((vals, jnp.asarray(idx.astype(np.int32))),
+                        shape=out.shape)
+    return SparseCooTensor(bcoo)
+
+
+def subm_conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                groups=1, data_format="NDHWC", key=None, name=None):
+    """Submanifold conv3d: output restricted to the INPUT's active sites
+    (``sparse/nn/functional/conv.py`` subm_conv3d — prevents active-site
+    dilation across layers, the signature property of submanifold sparse
+    CNNs)."""
+    w = weight._value if isinstance(weight, Tensor) else jnp.asarray(weight)
+    b = bias._value if isinstance(bias, Tensor) else (
+        jnp.asarray(bias) if bias is not None else None)
+    assert isinstance(x, SparseCooTensor), "subm_conv3d needs a sparse input"
+    dense = x.to_dense()._value
+    out = _dense_conv3d(dense, w, b, stride, padding, dilation, groups)
+    in_sites = np.asarray(x.bcoo.indices)[:, :4]
+    sites = np.unique(in_sites, axis=0)
+    vals = out[tuple(sites.T)]
+    from jax.experimental import sparse as jsparse
+
+    bcoo = jsparse.BCOO((vals, jnp.asarray(sites.astype(np.int32))),
+                        shape=out.shape)
+    return SparseCooTensor(bcoo)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               data_format="NDHWC", name=None):
+    """(``sparse/nn/functional/pooling.py``) max pool over the dense grid,
+    re-sparsified."""
+    dense = x.to_dense()._value if isinstance(x, SparseCooTensor) else x._value
+    ks = (kernel_size,) * 3 if isinstance(kernel_size, int) else tuple(kernel_size)
+    st = ks if stride is None else (
+        (stride,) * 3 if isinstance(stride, int) else tuple(stride))
+    pd = (padding,) * 3 if isinstance(padding, int) else tuple(padding)
+    out = jax.lax.reduce_window(
+        dense, -jnp.inf, jax.lax.max,
+        window_dimensions=(1,) + ks + (1,),
+        window_strides=(1,) + st + (1,),
+        padding=((0, 0),) + tuple((p, p) for p in pd) + ((0, 0),))
+    arr = np.asarray(out)
+    idx = np.argwhere(np.abs(arr).sum(-1) > 0)
+    vals = out[tuple(idx.T)]
+    from jax.experimental import sparse as jsparse
+
+    bcoo = jsparse.BCOO((vals, jnp.asarray(idx.astype(np.int32))),
+                        shape=out.shape)
+    return SparseCooTensor(bcoo)

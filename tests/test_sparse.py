@@ -1,0 +1,213 @@
+"""Sparse compute (N9): COO/CSR math, SDD masked_matmul, segment-softmax
+sparse attention, sparse conv3d/subm_conv3d, sparse nn layers — checked
+against dense NumPy references (the reference's ``test/legacy_test/
+test_sparse_*`` pattern)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.experimental import sparse as jsparse
+
+import paddle_tpu as paddle
+from paddle_tpu import sparse as sp
+
+
+def _coo(dense):
+    idx = np.argwhere(dense != 0).astype(np.int32)
+    vals = dense[tuple(idx.T)]
+    return sp.SparseCooTensor(
+        jsparse.BCOO((jnp.asarray(vals), jnp.asarray(idx)),
+                     shape=dense.shape))
+
+
+class TestValueOps:
+    def test_unary_preserve_pattern(self):
+        d = np.array([[1.0, 0, -2.0], [0, 0.5, 0]], "float32")
+        s = _coo(d)
+        for name, ref in [("sin", np.sin), ("sqrt", lambda v: np.sqrt(np.abs(v))),
+                          ("square", np.square), ("abs", np.abs),
+                          ("tanh", np.tanh), ("neg", np.negative),
+                          ("expm1", np.expm1)]:
+            arg = sp.abs(s) if name == "sqrt" else s
+            out = getattr(sp, name)(arg)
+            assert out.nnz == s.nnz
+            got = out.to_dense().numpy()
+            refd = np.where(d != 0, ref(np.abs(d) if name == "sqrt" else d), 0)
+            np.testing.assert_allclose(got, refd, rtol=1e-5, atol=1e-6)
+
+    def test_binary_union(self):
+        a = np.array([[1.0, 0], [0, 2.0]], "float32")
+        b = np.array([[0.0, 3.0], [0, 1.0]], "float32")
+        got = sp.subtract(_coo(a), _coo(b)).to_dense().numpy()
+        np.testing.assert_allclose(got, a - b)
+        got = sp.multiply(_coo(a), _coo(b)).to_dense().numpy()
+        np.testing.assert_allclose(got, a * b)
+
+    def test_coalesce_transpose_reshape_sum(self):
+        idx = np.array([[0, 0], [0, 0], [1, 1]], np.int32)
+        vals = np.array([1.0, 2.0, 3.0], "float32")
+        s = sp.SparseCooTensor(
+            jsparse.BCOO((jnp.asarray(vals), jnp.asarray(idx)), shape=(2, 2)))
+        c = sp.coalesce(s)
+        np.testing.assert_allclose(
+            c.to_dense().numpy(), [[3.0, 0], [0, 3.0]])
+        t = sp.transpose(_coo(np.array([[0, 1.0], [2.0, 0]], "float32")), [1, 0])
+        np.testing.assert_allclose(t.to_dense().numpy(), [[0, 2.0], [1.0, 0]])
+        r = sp.reshape(_coo(np.array([[0, 1.0], [2.0, 0]], "float32")), [4])
+        np.testing.assert_allclose(r.to_dense().numpy(), [0, 1.0, 2.0, 0])
+        assert float(sp.sum(_coo(np.array([[0, 1.0], [2.0, 0]], "float32"))).numpy()) == 3.0
+
+
+class TestSparseMatmul:
+    def test_spmm_and_mv(self):
+        d = np.zeros((4, 5), "float32")
+        d[0, 1], d[2, 3], d[3, 0] = 1.5, -2.0, 0.5
+        y = np.random.default_rng(0).standard_normal((5, 3)).astype("float32")
+        got = sp.matmul(_coo(d), paddle.to_tensor(y)).numpy()
+        np.testing.assert_allclose(got, d @ y, rtol=1e-5)
+        v = np.ones(5, "float32")
+        np.testing.assert_allclose(
+            sp.mv(_coo(d), paddle.to_tensor(v)).numpy(), d @ v, rtol=1e-5)
+
+    def test_masked_matmul_sdd(self):
+        rng = np.random.default_rng(1)
+        a = rng.standard_normal((6, 8)).astype("float32")
+        b = rng.standard_normal((8, 6)).astype("float32")
+        pattern = np.zeros((6, 6), "float32")
+        pattern[0, 1] = pattern[2, 4] = pattern[5, 5] = 1.0
+        out = sp.masked_matmul(
+            paddle.to_tensor(a), paddle.to_tensor(b), _coo(pattern))
+        ref = (a @ b) * (pattern != 0)
+        np.testing.assert_allclose(out.to_dense().numpy(), ref, rtol=1e-5)
+
+    def test_addmm(self):
+        d = np.zeros((3, 3), "float32")
+        d[1, 2] = 2.0
+        inp = np.ones((3, 3), "float32")
+        y = np.eye(3, dtype="float32")
+        got = sp.addmm(paddle.to_tensor(inp), _coo(d), paddle.to_tensor(y),
+                       beta=0.5, alpha=2.0).numpy()
+        np.testing.assert_allclose(got, 0.5 * inp + 2.0 * d @ y, rtol=1e-5)
+
+
+def _full_csr(BH, L):
+    crows = np.tile(np.arange(L + 1) * L, (BH, 1))
+    cols = np.tile(np.tile(np.arange(L), L), (BH, 1))
+    vals = np.ones((BH, L * L), "float32")
+    return sp.sparse_csr_tensor(crows, cols, vals, shape=[BH, L, L])
+
+
+class TestSparseAttention:
+    def test_full_pattern_matches_dense(self):
+        B, H, L, D = 2, 2, 4, 8
+        rng = np.random.default_rng(2)
+        q, k, v = (rng.standard_normal((B, H, L, D)).astype("float32")
+                   for _ in range(3))
+        out = sp.nn.functional.attention(
+            paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+            _full_csr(B * H, L))
+        s = np.einsum("bhld,bhmd->bhlm", q, k) / np.sqrt(D)
+        p = np.exp(s - s.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        ref = np.einsum("bhlm,bhmd->bhld", p, v)
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+
+    def test_banded_pattern_masks_scores(self):
+        B, H, L, D = 1, 1, 6, 4
+        rng = np.random.default_rng(3)
+        q, k, v = (rng.standard_normal((B, H, L, D)).astype("float32")
+                   for _ in range(3))
+        # causal band: row i attends to [max(0,i-1), i]
+        crows, cols = [0], []
+        for i in range(L):
+            c = list(range(max(0, i - 1), i + 1))
+            cols += c
+            crows.append(len(cols))
+        mask = sp.sparse_csr_tensor(
+            np.array([crows]), np.array([cols]),
+            np.ones((1, len(cols)), "float32"), shape=[1, L, L])
+        out = sp.nn.functional.attention(
+            paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+            mask).numpy()[0, 0]
+        s = (q[0, 0] @ k[0, 0].T) / np.sqrt(D)
+        dense_mask = np.full((L, L), -np.inf)
+        for i in range(L):
+            dense_mask[i, max(0, i - 1):i + 1] = 0.0
+        p = np.exp(s + dense_mask - (s + dense_mask).max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        np.testing.assert_allclose(out, p @ v[0, 0], rtol=1e-4, atol=1e-5)
+
+
+class TestSparseConv:
+    def _point_cloud(self, seed=4):
+        rng = np.random.default_rng(seed)
+        idx = np.array([[0, 0, 0, 0], [0, 1, 1, 1], [0, 2, 2, 2],
+                        [0, 0, 2, 1]], np.int32)
+        vals = rng.standard_normal((4, 3)).astype("float32")
+        return sp.SparseCooTensor(
+            jsparse.BCOO((jnp.asarray(vals), jnp.asarray(idx)),
+                         shape=(1, 3, 3, 3, 3)))
+
+    def test_conv3d_matches_dense(self):
+        x = self._point_cloud()
+        conv = sp.nn.Conv3D(3, 5, 3, padding=1)
+        out = conv(x).to_dense().numpy()
+        import jax
+        dn = jax.lax.conv_dimension_numbers(
+            (1, 3, 3, 3, 3), conv.weight._value.shape,
+            ("NDHWC", "DHWIO", "NDHWC"))
+        ref = jax.lax.conv_general_dilated(
+            x.to_dense()._value, conv.weight._value, (1, 1, 1),
+            [(1, 1)] * 3, dimension_numbers=dn) + conv.bias._value
+        np.testing.assert_allclose(out, np.asarray(ref), rtol=1e-4, atol=1e-5)
+
+    def test_subm_conv3d_preserves_sites(self):
+        x = self._point_cloud()
+        conv = sp.nn.SubmConv3D(3, 4, 3, padding=1)
+        y = conv(x)
+        in_sites = {tuple(r) for r in np.asarray(x.bcoo.indices).tolist()}
+        out_sites = {tuple(r) for r in y.indices().numpy().T.tolist()}
+        assert out_sites == in_sites  # no active-site dilation
+
+    def test_max_pool3d(self):
+        x = self._point_cloud()
+        out = sp.nn.MaxPool3D(3)(x).to_dense().numpy()
+        ref = x.to_dense().numpy().max(axis=(1, 2, 3), keepdims=True)
+        np.testing.assert_allclose(out, ref)
+
+
+class TestSparseNNLayers:
+    def test_relu6_leaky(self):
+        d = np.array([[7.0, 0], [-1.0, 3.0]], "float32")
+        np.testing.assert_allclose(
+            sp.nn.ReLU6()(_coo(d)).to_dense().numpy(), [[6.0, 0], [0, 3.0]])
+        got = sp.nn.LeakyReLU(0.1)(_coo(d)).to_dense().numpy()
+        np.testing.assert_allclose(got, [[7.0, 0], [-0.1, 3.0]], rtol=1e-6)
+
+    def test_csr_softmax_rows(self):
+        crows = np.array([[0, 2, 3]])
+        cols = np.array([[0, 2, 1]])
+        vals = np.array([[1.0, 2.0, 5.0]], "float32")
+        s = sp.sparse_csr_tensor(crows, cols, vals, shape=[1, 2, 3])
+        # flatten batch: softmax over each row's stored values
+        out = sp.nn.functional.softmax(
+            sp.sparse_csr_tensor(np.array(crows[0]), np.array(cols[0]),
+                                 np.array(vals[0]), shape=[2, 3]))
+        got = np.asarray(out.bcsr.data)
+        e = np.exp([1.0 - 2.0, 0.0])
+        np.testing.assert_allclose(got[:2], e / e.sum(), rtol=1e-5)
+        np.testing.assert_allclose(got[2], 1.0)
+
+    def test_batchnorm_normalizes_values(self):
+        rng = np.random.default_rng(5)
+        idx = np.argwhere(np.ones((1, 2, 2, 2))).astype(np.int32)
+        vals = (rng.standard_normal((8, 4)) * 3 + 7).astype("float32")
+        x = sp.SparseCooTensor(
+            jsparse.BCOO((jnp.asarray(vals), jnp.asarray(idx)),
+                         shape=(1, 2, 2, 2, 4)))
+        bn = sp.nn.BatchNorm(4)
+        out = bn(x)
+        got = out.values().numpy()
+        np.testing.assert_allclose(got.mean(0), np.zeros(4), atol=1e-4)
+        np.testing.assert_allclose(got.std(0), np.ones(4), atol=1e-2)
+        assert bn._mean.numpy().mean() > 0  # running stats updated

@@ -302,6 +302,9 @@ class Gumbel(Distribution):
 
 
 def kl_divergence(p: Distribution, q: Distribution):
+    fn = _registered_kl(p, q)
+    if fn is not None:
+        return fn(p, q)
     if isinstance(p, Normal) and isinstance(q, Normal):
         var_ratio = (p.scale / q.scale) ** 2
         t1 = ((p.loc - q.loc) / q.scale) ** 2
@@ -315,3 +318,301 @@ def kl_divergence(p: Distribution, q: Distribution):
     # generic Monte-Carlo fallback
     x = p.sample((256,))
     return Tensor(jnp.mean(_v(p.log_prob(x)) - _v(q.log_prob(x)), axis=0))
+
+
+class Cauchy(Distribution):
+    """(``distribution/cauchy.py``)."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _v(loc).astype(jnp.float32)
+        self.scale = _v(scale).astype(jnp.float32)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self._batch_shape
+        return Tensor(self.loc + self.scale * jax.random.cauchy(rng_mod.next_key(), shape))
+
+    def log_prob(self, value):
+        z = (_v(value) - self.loc) / self.scale
+        return Tensor(-jnp.log(math.pi * self.scale * (1 + z * z)))
+
+    def cdf(self, value):
+        z = (_v(value) - self.loc) / self.scale
+        return Tensor(jnp.arctan(z) / math.pi + 0.5)
+
+    def entropy(self):
+        return Tensor(jnp.log(4 * math.pi * self.scale) * jnp.ones_like(self.loc))
+
+
+class StudentT(Distribution):
+    """(``distribution/student_t.py`` capability)."""
+
+    def __init__(self, df, loc, scale, name=None):
+        self.df = _v(df).astype(jnp.float32)
+        self.loc = _v(loc).astype(jnp.float32)
+        self.scale = _v(scale).astype(jnp.float32)
+        super().__init__(jnp.broadcast_shapes(
+            self.df.shape, self.loc.shape, self.scale.shape))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self._batch_shape
+        return Tensor(self.loc + self.scale * jax.random.t(
+            rng_mod.next_key(), self.df, shape))
+
+    def log_prob(self, value):
+        z = (_v(value) - self.loc) / self.scale
+        d = self.df
+        lg = jax.scipy.special.gammaln
+        return Tensor(lg((d + 1) / 2) - lg(d / 2)
+                      - 0.5 * jnp.log(d * math.pi) - jnp.log(self.scale)
+                      - (d + 1) / 2 * jnp.log1p(z * z / d))
+
+
+class ContinuousBernoulli(Distribution):
+    """(``distribution/continuous_bernoulli.py``): density ∝ p^x (1-p)^(1-x)
+    on [0,1] with the log-normalizer C(p)."""
+
+    def __init__(self, probs, lims=(0.499, 0.501), name=None):
+        self.probs_ = jnp.clip(_v(probs).astype(jnp.float32), 1e-4, 1 - 1e-4)
+        self._lims = lims
+        super().__init__(self.probs_.shape)
+
+    def _log_norm(self):
+        p = self.probs_
+        lo, hi = self._lims
+        near_half = (p > lo) & (p < hi)
+        safe = jnp.where(near_half, 0.25, p)
+        # C(p) = log( 2 atanh(1-2p) / (1-2p) ) for p != 1/2, log 2 at 1/2
+        x = 1 - 2 * safe
+        c = jnp.log(2 * jnp.arctanh(x) / x)
+        # Taylor around 1/2: log 2 + x^2/3 + ...
+        taylor = math.log(2.0) + (1 - 2 * p) ** 2 / 3.0
+        return jnp.where(near_half, taylor, c)
+
+    def log_prob(self, value):
+        v = _v(value)
+        p = self.probs_
+        return Tensor(v * jnp.log(p) + (1 - v) * jnp.log1p(-p) + self._log_norm())
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self._batch_shape
+        u = jax.random.uniform(rng_mod.next_key(), shape)
+        p = self.probs_
+        # inverse CDF: x = [log(u(2p-1)/(1-p) + 1)] / [log(p/(1-p))]
+        near_half = jnp.abs(p - 0.5) < 1e-3
+        safe = jnp.where(near_half, 0.25, p)
+        num = jnp.log1p(u * (2 * safe - 1) / (1 - safe))
+        den = jnp.log(safe) - jnp.log1p(-safe)
+        return Tensor(jnp.where(near_half, u, num / den))
+
+    @property
+    def mean(self):
+        p = self.probs_
+        near_half = jnp.abs(p - 0.5) < 1e-3
+        safe = jnp.where(near_half, 0.25, p)
+        m = safe / (2 * safe - 1) + 1 / (2 * jnp.arctanh(1 - 2 * safe))
+        return Tensor(jnp.where(near_half, 0.5, m))
+
+
+class Binomial(Distribution):
+    """(``distribution/binomial.py``)."""
+
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = _v(total_count).astype(jnp.float32)
+        self.probs_ = _v(probs).astype(jnp.float32)
+        super().__init__(jnp.broadcast_shapes(
+            self.total_count.shape, self.probs_.shape))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self._batch_shape
+        n = int(np.max(np.asarray(self.total_count)))
+        u = jax.random.uniform(rng_mod.next_key(), shape + (n,))
+        trial_alive = jnp.arange(n) < self.total_count[..., None]
+        return Tensor(jnp.sum((u < self.probs_[..., None]) & trial_alive, -1)
+                      .astype(jnp.float32))
+
+    def log_prob(self, value):
+        v = _v(value)
+        n, p = self.total_count, jnp.clip(self.probs_, 1e-7, 1 - 1e-7)
+        lg = jax.scipy.special.gammaln
+        return Tensor(lg(n + 1) - lg(v + 1) - lg(n - v + 1)
+                      + v * jnp.log(p) + (n - v) * jnp.log1p(-p))
+
+    @property
+    def mean(self):
+        return Tensor(self.total_count * self.probs_)
+
+    @property
+    def variance(self):
+        return Tensor(self.total_count * self.probs_ * (1 - self.probs_))
+
+
+class MultivariateNormal(Distribution):
+    """(``distribution/multivariate_normal.py``) — parameterized by loc +
+    covariance_matrix (Cholesky internally, the reference's path)."""
+
+    def __init__(self, loc, covariance_matrix=None, scale_tril=None, name=None):
+        self.loc = _v(loc).astype(jnp.float32)
+        if scale_tril is not None:
+            self._tril = _v(scale_tril).astype(jnp.float32)
+        elif covariance_matrix is not None:
+            self._tril = jnp.linalg.cholesky(
+                _v(covariance_matrix).astype(jnp.float32))
+        else:
+            raise ValueError("need covariance_matrix or scale_tril")
+        super().__init__(self.loc.shape[:-1], self.loc.shape[-1:])
+
+    @property
+    def covariance_matrix(self):
+        return Tensor(self._tril @ jnp.swapaxes(self._tril, -1, -2))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self._batch_shape + self._event_shape
+        z = jax.random.normal(rng_mod.next_key(), shape)
+        return Tensor(self.loc + jnp.einsum("...ij,...j->...i", self._tril, z))
+
+    def log_prob(self, value):
+        d = self.loc.shape[-1]
+        diff = _v(value) - self.loc
+        sol = jax.scipy.linalg.solve_triangular(self._tril, diff[..., None],
+                                                lower=True)[..., 0]
+        half_logdet = jnp.sum(jnp.log(jnp.diagonal(self._tril, axis1=-2, axis2=-1)), -1)
+        return Tensor(-0.5 * jnp.sum(sol * sol, -1) - half_logdet
+                      - 0.5 * d * math.log(2 * math.pi))
+
+    def entropy(self):
+        d = self.loc.shape[-1]
+        half_logdet = jnp.sum(jnp.log(jnp.diagonal(self._tril, axis1=-2, axis2=-1)), -1)
+        return Tensor(0.5 * d * (1 + math.log(2 * math.pi)) + half_logdet)
+
+
+class ExponentialFamily(Distribution):
+    """Base for exponential-family distributions
+    (``distribution/exponential_family.py``): entropy via the Bregman
+    identity over the log-normalizer (autodiff replaces the reference's
+    manual natural-parameter bookkeeping)."""
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError
+
+    def entropy(self):
+        nparams = [jnp.asarray(p) for p in self._natural_parameters]
+        logz, grads = jax.value_and_grad(
+            lambda ps: jnp.sum(self._log_normalizer(*ps)))(tuple(nparams))
+        ent = logz - builtins_sum(
+            jnp.sum(p * g) for p, g in zip(nparams, grads))
+        # mean-carrier measure assumed 0 (as in the reference)
+        return Tensor(ent)
+
+
+def builtins_sum(it):
+    total = None
+    for x in it:
+        total = x if total is None else total + x
+    return total
+
+
+class Independent(Distribution):
+    """Reinterpret rightmost batch dims as event dims
+    (``distribution/independent.py``)."""
+
+    def __init__(self, base, reinterpreted_batch_rank, name=None):
+        self.base = base
+        self.rank = int(reinterpreted_batch_rank)
+        bs = tuple(base._batch_shape)
+        super().__init__(bs[: len(bs) - self.rank],
+                         bs[len(bs) - self.rank:] + tuple(base._event_shape))
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def log_prob(self, value):
+        lp = _v(self.base.log_prob(value))
+        return Tensor(jnp.sum(lp, axis=tuple(range(-self.rank, 0))))
+
+    def entropy(self):
+        e = _v(self.base.entropy())
+        return Tensor(jnp.sum(e, axis=tuple(range(-self.rank, 0))))
+
+
+class TransformedDistribution(Distribution):
+    """base distribution pushed through a transform chain
+    (``distribution/transformed_distribution.py``)."""
+
+    def __init__(self, base, transforms, name=None):
+        from .transform import ChainTransform
+
+        self.base = base
+        self._chain = (transforms if isinstance(transforms, ChainTransform)
+                       else ChainTransform(list(transforms)))
+        super().__init__(base._batch_shape, base._event_shape)
+
+    def sample(self, shape=()):
+        x = self.base.sample(shape)
+        return self._chain.forward(x)
+
+    def rsample(self, shape=()):
+        return self.sample(shape)
+
+    def log_prob(self, value):
+        x = self._chain.inverse(value)
+        ldj = _v(self._chain.forward_log_det_jacobian(x))
+        return Tensor(_v(self.base.log_prob(x)) - ldj)
+
+
+# --------------------------------------------------------------------------
+# KL registry (``distribution/kl.py`` register_kl)
+# --------------------------------------------------------------------------
+
+_KL_REGISTRY = {}
+
+
+def register_kl(cls_p, cls_q):
+    """Decorator registering a KL implementation for (type(p), type(q))."""
+
+    def decorator(fn):
+        _KL_REGISTRY[(cls_p, cls_q)] = fn
+        return fn
+
+    return decorator
+
+
+def _registered_kl(p, q):
+    best = None
+    for (cp, cq), fn in _KL_REGISTRY.items():
+        if isinstance(p, cp) and isinstance(q, cq):
+            best = fn
+    return best
+
+
+@register_kl(Exponential, Exponential)
+def _kl_exp_exp(p, q):
+    rr = q.rate / p.rate
+    return Tensor(jnp.log(1 / rr) + rr - 1)
+
+
+@register_kl(Gamma, Gamma)
+def _kl_gamma_gamma(p, q):
+    lg = jax.scipy.special.gammaln
+    dig = jax.scipy.special.digamma
+    a1, b1, a2, b2 = p.concentration, p.rate, q.concentration, q.rate
+    return Tensor((a1 - a2) * dig(a1) - lg(a1) + lg(a2)
+                  + a2 * (jnp.log(b1) - jnp.log(b2)) + a1 * (b2 / b1 - 1))
+
+
+@register_kl(Beta, Beta)
+def _kl_beta_beta(p, q):
+    lg = jax.scipy.special.gammaln
+    dig = jax.scipy.special.digamma
+    a1, b1, a2, b2 = p.alpha, p.beta, q.alpha, q.beta
+    t = (lg(a2) + lg(b2) - lg(a2 + b2)) - (lg(a1) + lg(b1) - lg(a1 + b1))
+    return Tensor(t + (a1 - a2) * dig(a1) + (b1 - b2) * dig(b1)
+                  + (a2 - a1 + b2 - b1) * dig(a1 + b1))
+
+
+from . import transform  # noqa: E402,F401
+from .transform import *  # noqa: E402,F401,F403

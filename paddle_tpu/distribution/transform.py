@@ -1,0 +1,328 @@
+"""Probability transforms (``python/paddle/distribution/transform.py``):
+invertible maps with log-det-Jacobian accounting, composable via
+``ChainTransform`` and consumed by ``TransformedDistribution``."""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, to_tensor
+
+__all__ = [
+    "Transform", "AbsTransform", "AffineTransform", "ChainTransform",
+    "ExpTransform", "IndependentTransform", "PowerTransform",
+    "ReshapeTransform", "SigmoidTransform", "SoftmaxTransform",
+    "StackTransform", "StickBreakingTransform", "TanhTransform",
+]
+
+
+def _v(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+class Transform:
+    """Base invertible map y = f(x) (``transform.py:59``)."""
+
+    _is_injective = True
+
+    def forward(self, x):
+        return Tensor(self._forward(_v(x)))
+
+    def inverse(self, y):
+        return Tensor(self._inverse(_v(y)))
+
+    def forward_log_det_jacobian(self, x):
+        return Tensor(self._forward_log_det_jacobian(_v(x)))
+
+    def inverse_log_det_jacobian(self, y):
+        yv = _v(y)
+        return Tensor(-self._forward_log_det_jacobian(self._inverse(yv)))
+
+    def forward_shape(self, shape):
+        return list(shape)
+
+    def inverse_shape(self, shape):
+        return list(shape)
+
+    # event dims consumed by the jacobian (0 = elementwise)
+    _domain_event_dim = 0
+    _codomain_event_dim = 0
+
+    def __call__(self, x):
+        return self.forward(x)
+
+
+class AbsTransform(Transform):
+    """y = |x| (``transform.py:350``); not injective — inverse returns the
+    positive branch like the reference."""
+
+    _is_injective = False
+
+    def _forward(self, x):
+        return jnp.abs(x)
+
+    def _inverse(self, y):
+        return y
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.zeros_like(x)
+
+
+class AffineTransform(Transform):
+    """y = loc + scale * x (``transform.py:422``)."""
+
+    def __init__(self, loc, scale):
+        self.loc, self.scale = _v(loc), _v(scale)
+
+    def _forward(self, x):
+        return self.loc + self.scale * x
+
+    def _inverse(self, y):
+        return (y - self.loc) / self.scale
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.broadcast_to(jnp.log(jnp.abs(self.scale)), x.shape)
+
+
+class ExpTransform(Transform):
+    """y = exp(x) (``transform.py:629``)."""
+
+    def _forward(self, x):
+        return jnp.exp(x)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _forward_log_det_jacobian(self, x):
+        return x
+
+
+class PowerTransform(Transform):
+    """y = x ** power on x > 0 (``transform.py:773``)."""
+
+    def __init__(self, power):
+        self.power = _v(power)
+
+    def _forward(self, x):
+        return jnp.power(x, self.power)
+
+    def _inverse(self, y):
+        return jnp.power(y, 1.0 / self.power)
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.log(jnp.abs(self.power * jnp.power(x, self.power - 1)))
+
+
+class SigmoidTransform(Transform):
+    """y = sigmoid(x) (``transform.py:960``)."""
+
+    def _forward(self, x):
+        return jax.nn.sigmoid(x)
+
+    def _inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def _forward_log_det_jacobian(self, x):
+        return -jax.nn.softplus(-x) - jax.nn.softplus(x)
+
+
+class TanhTransform(Transform):
+    """y = tanh(x) (``transform.py:1245``)."""
+
+    def _forward(self, x):
+        return jnp.tanh(x)
+
+    def _inverse(self, y):
+        return jnp.arctanh(y)
+
+    def _forward_log_det_jacobian(self, x):
+        # log(1 - tanh^2) in a numerically stable form
+        return 2.0 * (math.log(2.0) - x - jax.nn.softplus(-2.0 * x))
+
+
+class SoftmaxTransform(Transform):
+    """y = softmax(x) over the last dim (``transform.py:1003``); not a
+    bijection (dimension drop) — jacobian is not defined, matching the
+    reference which raises."""
+
+    _is_injective = False
+    _domain_event_dim = 1
+    _codomain_event_dim = 1
+
+    def _forward(self, x):
+        return jax.nn.softmax(x, -1)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _forward_log_det_jacobian(self, x):
+        raise NotImplementedError("SoftmaxTransform has no log-det-jacobian")
+
+
+class StickBreakingTransform(Transform):
+    """Unconstrained R^{K-1} -> K-simplex via stick breaking
+    (``transform.py:1179``)."""
+
+    _domain_event_dim = 1
+    _codomain_event_dim = 1
+
+    def _forward(self, x):
+        k = x.shape[-1]
+        offset = jnp.log(jnp.arange(k, 0, -1, dtype=x.dtype))
+        z = jax.nn.sigmoid(x - offset)
+        zpad = jnp.concatenate([z, jnp.ones(x.shape[:-1] + (1,), x.dtype)], -1)
+        one_minus = jnp.concatenate(
+            [jnp.ones(x.shape[:-1] + (1,), x.dtype),
+             jnp.cumprod(1 - z, -1)], -1)
+        return zpad * one_minus
+
+    def _inverse(self, y):
+        k = y.shape[-1] - 1
+        cum = jnp.cumsum(y[..., :-1], -1)
+        rest = 1.0 - jnp.concatenate(
+            [jnp.zeros(y.shape[:-1] + (1,), y.dtype), cum[..., :-1]], -1)
+        z = y[..., :-1] / rest
+        offset = jnp.log(jnp.arange(k, 0, -1, dtype=y.dtype))
+        return jnp.log(z) - jnp.log1p(-z) + offset
+
+    def _forward_log_det_jacobian(self, x):
+        k = x.shape[-1]
+        offset = jnp.log(jnp.arange(k, 0, -1, dtype=x.dtype))
+        z = jax.nn.sigmoid(x - offset)
+        # d y_i / d x_i telescopes: sum log sigmoid' + log of remaining stick
+        rest = jnp.concatenate(
+            [jnp.ones(x.shape[:-1] + (1,), x.dtype),
+             jnp.cumprod(1 - z, -1)[..., :-1]], -1)
+        return jnp.sum(jnp.log(z) + jnp.log1p(-z) + jnp.log(rest), -1)
+
+    def forward_shape(self, shape):
+        return list(shape[:-1]) + [shape[-1] + 1]
+
+    def inverse_shape(self, shape):
+        return list(shape[:-1]) + [shape[-1] - 1]
+
+
+class ChainTransform(Transform):
+    """Composition t_n ∘ ... ∘ t_1 (``transform.py:504``)."""
+
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+        self._is_injective = all(t._is_injective for t in self.transforms)
+
+    def forward(self, x):
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t.inverse(y)
+        return y
+
+    def forward_log_det_jacobian(self, x):
+        # transforms of different event ranks yield jacobian terms of
+        # different ranks (elementwise: full rank; event_dim-1: reduced) —
+        # sum elementwise terms over the event dims down to the minimal
+        # rank before accumulating, never broadcast up
+        terms = []
+        for t in self.transforms:
+            terms.append(_v(t.forward_log_det_jacobian(x)))
+            x = t.forward(x)
+        target = min(j.ndim for j in terms)
+        total = None
+        for j in terms:
+            if j.ndim > target:
+                j = jnp.sum(j, axis=tuple(range(target - j.ndim, 0)))
+            total = j if total is None else total + j
+        return Tensor(total)
+
+    def forward_shape(self, shape):
+        for t in self.transforms:
+            shape = t.forward_shape(shape)
+        return shape
+
+    def inverse_shape(self, shape):
+        for t in reversed(self.transforms):
+            shape = t.inverse_shape(shape)
+        return shape
+
+
+class IndependentTransform(Transform):
+    """Reinterpret the rightmost ``reinterpreted_batch_rank`` batch dims as
+    event dims: the jacobian sums over them (``transform.py:678``)."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self.rank = int(reinterpreted_batch_rank)
+        self._is_injective = base._is_injective
+
+    def forward(self, x):
+        return self.base.forward(x)
+
+    def inverse(self, y):
+        return self.base.inverse(y)
+
+    def forward_log_det_jacobian(self, x):
+        j = _v(self.base.forward_log_det_jacobian(x))
+        return Tensor(jnp.sum(j, axis=tuple(range(-self.rank, 0))))
+
+
+class ReshapeTransform(Transform):
+    """Event reshape (``transform.py:837``)."""
+
+    def __init__(self, in_event_shape, out_event_shape):
+        self.in_event_shape = tuple(in_event_shape)
+        self.out_event_shape = tuple(out_event_shape)
+
+    def _forward(self, x):
+        batch = x.shape[: x.ndim - len(self.in_event_shape)]
+        return jnp.reshape(x, batch + self.out_event_shape)
+
+    def _inverse(self, y):
+        batch = y.shape[: y.ndim - len(self.out_event_shape)]
+        return jnp.reshape(y, batch + self.in_event_shape)
+
+    def _forward_log_det_jacobian(self, x):
+        batch = x.shape[: x.ndim - len(self.in_event_shape)]
+        return jnp.zeros(batch, x.dtype)
+
+    def forward_shape(self, shape):
+        n = len(self.in_event_shape)
+        return list(shape[:-n]) + list(self.out_event_shape)
+
+    def inverse_shape(self, shape):
+        n = len(self.out_event_shape)
+        return list(shape[:-n]) + list(self.in_event_shape)
+
+
+class StackTransform(Transform):
+    """Apply a list of transforms to slices along ``axis``
+    (``transform.py:1059``)."""
+
+    def __init__(self, transforms, axis=0):
+        self.transforms = list(transforms)
+        self.axis = axis
+        self._is_injective = all(t._is_injective for t in self.transforms)
+
+    def _split(self, x):
+        n = len(self.transforms)
+        return [jnp.squeeze(s, self.axis)
+                for s in jnp.split(x, n, axis=self.axis)]
+
+    def forward(self, x):
+        parts = [_v(t.forward(Tensor(s)))
+                 for t, s in zip(self.transforms, self._split(_v(x)))]
+        return Tensor(jnp.stack(parts, self.axis))
+
+    def inverse(self, y):
+        parts = [_v(t.inverse(Tensor(s)))
+                 for t, s in zip(self.transforms, self._split(_v(y)))]
+        return Tensor(jnp.stack(parts, self.axis))
+
+    def forward_log_det_jacobian(self, x):
+        parts = [_v(t.forward_log_det_jacobian(Tensor(s)))
+                 for t, s in zip(self.transforms, self._split(_v(x)))]
+        return Tensor(jnp.stack(parts, self.axis))

@@ -1,0 +1,165 @@
+"""Elastic membership with TTL heartbeats over the TCPStore
+(``python/paddle/distributed/fleet/elastic/manager.py:126`` analog).
+
+The reference registers workers in etcd with TTL leases; a watcher detects
+dead/added nodes, rewrites ``DISTRIBUTED_TRAINER_ENDPOINTS`` and relaunches
+trainers with ``ELASTIC_EXIT_CODE``.  TPU-first there is no etcd dependency:
+the rendezvous TCPStore doubles as the registry — each node's heartbeat
+thread refreshes a timestamped key (a lease), and liveness is "heartbeat
+younger than the TTL".  Scale-up/down is accepted while the live count
+stays within ``[np_min, np_max]``; outside that window the job is HELD
+(reference ``manager.py`` np range semantics).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Dict, List, Optional
+
+ELASTIC_EXIT_CODE = 101  # manager.py:32
+
+
+class ElasticStatus:
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"          # live count outside [np_min, np_max]
+    RESTART = "restart"    # membership changed; relaunch with new endpoints
+    EXIT = "exit"
+
+
+class ElasticManager:
+    """TTL-heartbeat membership over a key-value store.
+
+    ``store`` needs ``set(key, value)`` / ``get(key) -> bytes|None`` (the
+    native TCPStore satisfies this; any dict-like test double works too).
+    """
+
+    def __init__(self, store, node_id: str, np_min: int = 1,
+                 np_max: Optional[int] = None, ttl: float = 6.0,
+                 heartbeat_interval: Optional[float] = None,
+                 endpoint: Optional[str] = None):
+        self._store = store
+        self.node_id = node_id
+        self.endpoint = endpoint or node_id
+        self.np_min = np_min
+        self.np_max = np_max if np_max is not None else 2 ** 30
+        self.ttl = ttl
+        self._interval = heartbeat_interval or max(0.5, ttl / 3.0)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._known: Optional[List[str]] = None
+        self._registered = False
+
+    # --- lease / heartbeat --------------------------------------------------
+    def _hb_key(self, node: str) -> str:
+        return f"elastic/hb/{node}"
+
+    def _beat_once(self):
+        self._store.set(self._hb_key(self.node_id),
+                        json.dumps({"t": time.time(), "ep": self.endpoint}))
+        if not self._registered:
+            # atomic membership index: an add-allocated slot per node — no
+            # read-modify-write of a shared list, so concurrent first beats
+            # cannot lose registrations
+            idx = self._store.add("elastic/nmembers", 1)
+            self._store.set(f"elastic/member/{idx}", self.node_id)
+            self._registered = True
+
+    def register(self):
+        """Start the lease-renewal thread (etcd ``refresh_ttl`` analog)."""
+        self._beat_once()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while not self._stop.wait(self._interval):
+            try:
+                self._beat_once()
+            except Exception:
+                pass  # store transiently down: the lease just ages
+
+    def deregister(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+
+    # --- membership ---------------------------------------------------------
+    def _members(self) -> List[str]:
+        n = int(self._store.add("elastic/nmembers", 0))
+        seen, out = set(), []
+        for i in range(1, n + 1):
+            raw = self._store.get(f"elastic/member/{i}")
+            if raw is None:
+                continue
+            node = raw.decode()
+            if node not in seen:
+                seen.add(node)
+                out.append(node)
+        return out
+
+    def _fresh_hb(self, node: str):
+        raw = self._store.get(self._hb_key(node))
+        if raw is None:
+            return None
+        rec = json.loads(raw.decode())
+        if time.time() - rec["t"] > self.ttl:
+            return None
+        return rec
+
+    def alive_nodes(self) -> List[str]:
+        """Nodes whose lease is younger than the TTL."""
+        return [n for n in self._members() if self._fresh_hb(n) is not None]
+
+    def snapshot(self):
+        """Record current membership as the baseline for watch()."""
+        self._known = sorted(self.alive_nodes())
+        return list(self._known)
+
+    def watch(self) -> str:
+        """One membership check (the reference's etcd watcher tick)."""
+        live = sorted(self.alive_nodes())
+        if not (self.np_min <= len(live) <= self.np_max):
+            return ElasticStatus.HOLD
+        if self._known is None:
+            self._known = live
+            return ElasticStatus.COMPLETED
+        if live != self._known:
+            self._known = live
+            return ElasticStatus.RESTART
+        return ElasticStatus.COMPLETED
+
+    def endpoints(self) -> str:
+        """Comma-joined routable endpoints (host:port) of live nodes — the
+        rewritten ``DISTRIBUTED_TRAINER_ENDPOINTS`` (one entry per node;
+        each node registered its ``endpoint`` at construction)."""
+        eps = []
+        for n in self._members():
+            rec = self._fresh_hb(n)
+            if rec is not None:
+                eps.append(rec.get("ep", n))
+        return ",".join(sorted(eps))
+
+
+class LocalStore:
+    """In-process store double (tests / single-host)."""
+
+    def __init__(self):
+        self._d: Dict[str, bytes] = {}
+        self._counters: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def set(self, key, value):
+        with self._lock:
+            self._d[key] = value.encode() if isinstance(value, str) else bytes(value)
+
+    def get(self, key):
+        with self._lock:
+            return self._d.get(key)
+
+    def add(self, key, amount: int = 1) -> int:
+        with self._lock:
+            self._counters[key] = self._counters.get(key, 0) + amount
+            return self._counters[key]

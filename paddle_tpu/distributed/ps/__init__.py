@@ -1,0 +1,279 @@
+"""Parameter-server mode (N30 — ``paddle/fluid/distributed/ps/``).
+
+The reference runs brpc parameter servers holding memory/SSD sparse tables
+(``table/memory_sparse_table.h``) and dense tables, with sync / async /
+GeoSGD update rules, for trillion-parameter recommender embeddings that
+cannot live on the trainers.  TPU-first scope: the *dense* model trains on
+chips (that's what the rest of this framework does); the PS niche that
+remains real is the huge-sparse-embedding pull/push, so this module
+implements exactly that — in-process tables served over the framework RPC
+layer (``distributed/rpc.py``'s socket servers stand in for brpc):
+
+- :class:`SparseTable` — id → row with lazy initialization on first pull
+  (the accessor's ``create`` rule) and SGD/Adagrad push rules.
+- :class:`DenseTable` — flat parameter block with the same rules.
+- :class:`PsServer` / :class:`PsClient` — pull/push RPCs, barrier'd init,
+  and GeoSGD-style delta push (``push_dense_param`` on an interval).
+
+Trainers embed pulled rows into the jit'd compute as ordinary arrays; the
+sparse gradient rows come back from ``paddle.nn.Embedding``-style gathers'
+VJPs (rowwise, the reference's SelectedRows analog).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+_REGISTRY: Dict[str, "PsServer"] = {}
+
+
+class SparseTable:
+    """(``memory_sparse_table.h`` analog) id-keyed rows, lazy-created."""
+
+    def __init__(self, dim: int, initializer: str = "uniform",
+                 init_scale: float = 0.01, optimizer: str = "sgd",
+                 learning_rate: float = 0.05, seed: int = 0):
+        self.dim = dim
+        self._rows: Dict[int, np.ndarray] = {}
+        self._g2: Dict[int, np.ndarray] = {}  # adagrad accumulators
+        self._rng = np.random.default_rng(seed)
+        self._init = initializer
+        self._scale = init_scale
+        self._opt = optimizer
+        self._lr = learning_rate
+        self._lock = threading.Lock()
+
+    def _create(self, key: int) -> np.ndarray:
+        if self._init == "zeros":
+            row = np.zeros(self.dim, np.float32)
+        else:
+            row = self._rng.uniform(
+                -self._scale, self._scale, self.dim).astype(np.float32)
+        self._rows[key] = row
+        return row
+
+    def pull(self, keys: Sequence[int]) -> np.ndarray:
+        with self._lock:
+            return np.stack([
+                self._rows.get(int(k)) if int(k) in self._rows
+                else self._create(int(k)) for k in keys])
+
+    def push(self, keys: Sequence[int], grads: np.ndarray):
+        with self._lock:
+            for k, g in zip(keys, np.asarray(grads, np.float32)):
+                k = int(k)
+                row = self._rows.get(k)
+                if row is None:
+                    row = self._create(k)
+                if self._opt == "adagrad":
+                    acc = self._g2.setdefault(k, np.zeros(self.dim, np.float32))
+                    acc += g * g
+                    row -= self._lr * g / (np.sqrt(acc) + 1e-8)
+                else:  # sgd
+                    row -= self._lr * g
+
+    def size(self) -> int:
+        with self._lock:
+            return len(self._rows)
+
+    def state_dict(self):
+        with self._lock:
+            return {"rows": dict(self._rows), "g2": dict(self._g2)}
+
+    def load_state_dict(self, state):
+        with self._lock:
+            self._rows = dict(state["rows"])
+            self._g2 = dict(state.get("g2", {}))
+
+
+class DenseTable:
+    """(dense_table analog) one flat block + SGD rule."""
+
+    def __init__(self, shape, learning_rate: float = 0.05, seed: int = 0):
+        self.param = (np.random.default_rng(seed)
+                      .standard_normal(shape).astype(np.float32) * 0.01)
+        self._lr = learning_rate
+        self._lock = threading.Lock()
+
+    def pull(self) -> np.ndarray:
+        with self._lock:
+            return self.param.copy()
+
+    def push(self, grad: np.ndarray):
+        with self._lock:
+            self.param -= self._lr * np.asarray(grad, np.float32)
+
+    def set(self, value: np.ndarray):
+        """GeoSGD delta application / param overwrite."""
+        with self._lock:
+            self.param = np.asarray(value, np.float32).copy()
+
+
+class PsServer:
+    """Holds the tables; methods are invoked via rpc_sync/rpc_async from
+    trainers (the brpc service analog)."""
+
+    def __init__(self, name: str = "ps0"):
+        self.name = name
+        self._sparse: Dict[str, SparseTable] = {}
+        self._dense: Dict[str, DenseTable] = {}
+        _REGISTRY[name] = self
+
+    def create_sparse_table(self, table: str, dim: int, **kw):
+        self._sparse[table] = SparseTable(dim, **kw)
+
+    def create_dense_table(self, table: str, shape, **kw):
+        self._dense[table] = DenseTable(shape, **kw)
+
+    def sparse(self, table: str) -> SparseTable:
+        return self._sparse[table]
+
+    def dense(self, table: str) -> DenseTable:
+        return self._dense[table]
+
+
+# --- module-level RPC targets (rpc_sync pickles functions by reference) ----
+
+def _srv(server_name: str) -> PsServer:
+    return _REGISTRY[server_name]
+
+
+def _rpc_create_sparse(server_name, table, dim, kw):
+    _srv(server_name).create_sparse_table(table, dim, **kw)
+    return True
+
+
+def _rpc_create_dense(server_name, table, shape, kw):
+    _srv(server_name).create_dense_table(table, shape, **kw)
+    return True
+
+
+def _rpc_pull_sparse(server_name, table, keys):
+    return _srv(server_name).sparse(table).pull(keys)
+
+
+def _rpc_push_sparse(server_name, table, keys, grads):
+    _srv(server_name).sparse(table).push(keys, grads)
+    return True
+
+
+def _rpc_pull_dense(server_name, table):
+    return _srv(server_name).dense(table).pull()
+
+
+def _rpc_push_dense(server_name, table, grad):
+    _srv(server_name).dense(table).push(grad)
+    return True
+
+
+def _rpc_set_dense(server_name, table, value):
+    _srv(server_name).dense(table).set(value)
+    return True
+
+
+def _rpc_table_size(server_name, table):
+    return _srv(server_name).sparse(table).size()
+
+
+class PsClient:
+    """Trainer-side handle (``brpc_ps_client.h`` analog).
+
+    ``worker``: the RPC worker name hosting the :class:`PsServer` (from
+    ``init_rpc``); sharding across multiple servers uses
+    ``key % num_servers`` (the reference's shard-by-id rule).
+    """
+
+    def __init__(self, workers: Sequence[str], server_name: str = "ps0",
+                 local: Optional[PsServer] = None):
+        self._workers = list(workers)
+        self._name = server_name
+        self._local = local
+
+    def _call(self, worker, fn, *args):
+        if self._local is not None:
+            return fn(self._name, *args)
+        from .. import rpc
+
+        return rpc.rpc_sync(worker, fn, args=(self._name,) + args)
+
+    def _shard(self, key: int) -> str:
+        return self._workers[int(key) % len(self._workers)]
+
+    def create_sparse_table(self, table: str, dim: int, **kw):
+        for w in self._workers:
+            self._call(w, _rpc_create_sparse, table, dim, kw)
+
+    def create_dense_table(self, table: str, shape, **kw):
+        self._call(self._workers[0], _rpc_create_dense, table, shape, kw)
+
+    def pull_sparse(self, table: str, keys: Sequence[int]) -> np.ndarray:
+        """Gather rows, sharded by id across servers."""
+        keys = [int(k) for k in keys]
+        out = np.empty((len(keys),), object)
+        by_worker: Dict[str, List[int]] = {}
+        for i, k in enumerate(keys):
+            by_worker.setdefault(self._shard(k), []).append(i)
+        for w, idxs in by_worker.items():
+            rows = self._call(w, _rpc_pull_sparse, table,
+                              [keys[i] for i in idxs])
+            for i, r in zip(idxs, rows):
+                out[i] = r
+        return np.stack(list(out))
+
+    def push_sparse(self, table: str, keys: Sequence[int], grads):
+        keys = [int(k) for k in keys]
+        grads = np.asarray(grads, np.float32)
+        by_worker: Dict[str, List[int]] = {}
+        for i, k in enumerate(keys):
+            by_worker.setdefault(self._shard(k), []).append(i)
+        for w, idxs in by_worker.items():
+            self._call(w, _rpc_push_sparse, table,
+                       [keys[i] for i in idxs], grads[idxs])
+
+    def pull_dense(self, table: str) -> np.ndarray:
+        return self._call(self._workers[0], _rpc_pull_dense, table)
+
+    def push_dense(self, table: str, grad):
+        self._call(self._workers[0], _rpc_push_dense, table,
+                   np.asarray(grad, np.float32))
+
+    def push_dense_param(self, table: str, value):
+        """GeoSGD: overwrite server params with locally-trained values."""
+        self._call(self._workers[0], _rpc_set_dense, table,
+                   np.asarray(value, np.float32))
+
+    def table_size(self, table: str) -> int:
+        return sum(self._call(w, _rpc_table_size, table)
+                   for w in self._workers)
+
+
+class GeoSgdTrainer:
+    """GeoSGD (the reference's ``GeoSGD`` mode): train locally for
+    ``sync_steps``, then push the parameter delta and pull the merged
+    value — async trainers converge on the PS copy without per-step
+    round-trips."""
+
+    def __init__(self, client: PsClient, table: str, sync_steps: int = 10):
+        self._client = client
+        self._table = table
+        self._sync_steps = sync_steps
+        self._step = 0
+        self.param = client.pull_dense(table)
+        self._base = self.param.copy()
+
+    def local_update(self, grad, lr: float = 0.05):
+        self.param = self.param - lr * np.asarray(grad, np.float32)
+        self._step += 1
+        if self._step % self._sync_steps == 0:
+            self.sync()
+
+    def sync(self):
+        delta = self.param - self._base
+        server = self._client.pull_dense(self._table)
+        merged = server + delta
+        self._client.push_dense_param(self._table, merged)
+        self.param = merged.copy()
+        self._base = merged.copy()

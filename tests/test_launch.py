@@ -5,6 +5,7 @@ import os
 import subprocess
 import sys
 import textwrap
+import time
 
 from paddle_tpu.distributed.launch.main import ELASTIC_EXIT_CODE, launch
 
@@ -67,3 +68,184 @@ def test_cli_entry(tmp_path):
              "JAX_PLATFORMS": "cpu",  # don't touch the TPU tunnel from tests
              "PYTHONPATH": "/root/repo:" + os.environ.get("PYTHONPATH", "")})
     assert out.returncode == 0, out.stderr
+
+
+class TestElasticMembership:
+    """TTL-heartbeat membership (fleet/elastic/manager.py:126 analog)."""
+
+    def test_lease_expiry_marks_dead(self):
+        from paddle_tpu.distributed import elastic as em
+
+        store = em.LocalStore()
+        a = em.ElasticManager(store, "nodeA", ttl=0.5,
+                              heartbeat_interval=0.1)
+        b = em.ElasticManager(store, "nodeB", ttl=0.5,
+                              heartbeat_interval=0.1)
+        a.register()
+        b.register()
+        try:
+            time.sleep(0.3)
+            assert sorted(a.alive_nodes()) == ["nodeA", "nodeB"]
+            b.deregister()  # stop B's lease renewal
+            time.sleep(0.8)
+            assert a.alive_nodes() == ["nodeA"]
+        finally:
+            a.deregister()
+            b.deregister()
+
+    def test_watch_detects_change_and_holds_below_min(self):
+        from paddle_tpu.distributed import elastic as em
+
+        store = em.LocalStore()
+        a = em.ElasticManager(store, "nodeA", np_min=1, ttl=0.5,
+                              heartbeat_interval=0.1)
+        a.register()
+        try:
+            a.snapshot()
+            assert a.watch() == em.ElasticStatus.COMPLETED
+            b = em.ElasticManager(store, "nodeB", ttl=0.5,
+                                  heartbeat_interval=0.1)
+            b.register()
+            time.sleep(0.2)
+            assert a.watch() == em.ElasticStatus.RESTART  # scale-up seen
+            assert a.watch() == em.ElasticStatus.COMPLETED  # new baseline
+            b.deregister()
+            time.sleep(0.8)
+            assert a.watch() == em.ElasticStatus.RESTART  # scale-down seen
+        finally:
+            a.deregister()
+
+        # below np_min -> HOLD (fresh store: one live node, min two)
+        store = em.LocalStore()
+        strict = em.ElasticManager(store, "nodeC", np_min=2, ttl=0.5,
+                                   heartbeat_interval=0.1)
+        strict.register()
+        try:
+            time.sleep(0.2)
+            assert strict.watch() == em.ElasticStatus.HOLD
+        finally:
+            strict.deregister()
+
+    def test_endpoints_lists_live(self):
+        from paddle_tpu.distributed import elastic as em
+
+        store = em.LocalStore()
+        a = em.ElasticManager(store, "host1:1", ttl=5.0)
+        b = em.ElasticManager(store, "host2:1", ttl=5.0)
+        a.register()
+        b.register()
+        try:
+            assert a.endpoints() == "host1:1,host2:1"
+        finally:
+            a.deregister()
+            b.deregister()
+
+    def test_launcher_restarts_on_membership_change(self, tmp_path):
+        """End-to-end: a second node joining triggers a pod relaunch."""
+        from paddle_tpu.distributed import elastic as em
+        from paddle_tpu.distributed.launch.main import Pod
+
+        store = em.LocalStore()
+        mgr = em.ElasticManager(store, "self", ttl=1.0,
+                                heartbeat_interval=0.2)
+        mgr.register()
+        script = tmp_path / "sleepy.py"
+        script.write_text("import time; time.sleep(30)")
+        try:
+            mgr.snapshot()
+            pod = Pod()
+            pod.spawn([sys.executable, str(script)],
+                      [dict(os.environ)], None)
+
+            joined = em.ElasticManager(store, "joiner", ttl=1.0,
+                                       heartbeat_interval=0.2)
+            joined.register()
+
+            def tick():
+                if mgr.watch() == em.ElasticStatus.RESTART:
+                    return 101
+                return None
+
+            code = pod.watch(tick=tick)
+            assert code == 101  # membership change terminated the pod
+            joined.deregister()
+        finally:
+            mgr.deregister()
+
+
+class TestElasticAtomicRegistry:
+    def test_concurrent_first_beats_not_lost(self):
+        """Reviewer-reproduced lost-update: concurrent registrations must
+        all survive (atomic add-allocated slots, no shared-list RMW)."""
+        import threading
+
+        from paddle_tpu.distributed import elastic as em
+
+        store = em.LocalStore()
+        mgrs = [em.ElasticManager(store, f"n{i}", ttl=5.0) for i in range(8)]
+        threads = [threading.Thread(target=m._beat_once) for m in mgrs]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert sorted(mgrs[0].alive_nodes()) == [f"n{i}" for i in range(8)]
+
+    def test_endpoints_are_routable_not_pids(self):
+        from paddle_tpu.distributed import elastic as em
+
+        store = em.LocalStore()
+        a = em.ElasticManager(store, "hostA:12345", ttl=5.0,
+                              endpoint="10.0.0.1:6001")
+        b = em.ElasticManager(store, "hostB:99", ttl=5.0,
+                              endpoint="10.0.0.2:6001")
+        a._beat_once()
+        b._beat_once()
+        assert a.endpoints() == "10.0.0.1:6001,10.0.0.2:6001"
+
+    def test_elastic_restart_does_not_consume_crash_budget(self, tmp_path):
+        """A membership-triggered ELASTIC_EXIT_CODE relaunches even with
+        max_restarts=0 (scale events are not crashes)."""
+        import importlib
+        from unittest import mock
+
+        lm = importlib.import_module("paddle_tpu.distributed.launch.main")
+
+        calls = {"n": 0}
+
+        class FakePod:
+            def __init__(self):
+                pass
+
+            def spawn(self, cmd, envs, log_dir):
+                pass
+
+            def watch(self, tick=None):
+                calls["n"] += 1
+                # first launch: membership change; second: clean exit
+                return lm.ELASTIC_EXIT_CODE if calls["n"] == 1 else 0
+
+        class FakeManager:
+            def endpoints(self):
+                return "127.0.0.1:1"
+
+            def snapshot(self):
+                pass
+
+            def register(self):
+                pass
+
+            def deregister(self):
+                pass
+
+            def watch(self):
+                return "completed"
+
+        fake_store = mock.MagicMock()
+        with mock.patch.object(lm, "Pod", FakePod), \
+             mock.patch("paddle_tpu.distributed.store.TCPStore",
+                        return_value=fake_store), \
+             mock.patch("paddle_tpu.distributed.elastic.ElasticManager",
+                        return_value=FakeManager()):
+            rc = lm.launch("noscript.py", elastic=True, max_restarts=0)
+        assert rc == 0
+        assert calls["n"] == 2  # relaunched once despite max_restarts=0

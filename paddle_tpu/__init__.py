@@ -62,6 +62,7 @@ from . import fft  # noqa: F401
 from . import signal  # noqa: F401
 from . import text  # noqa: F401
 from . import incubate  # noqa: F401
+from . import inference  # noqa: F401
 from . import io  # noqa: F401
 from . import jit  # noqa: F401
 from . import metric  # noqa: F401
@@ -71,6 +72,7 @@ from . import nn  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import profiler  # noqa: F401
 from . import sparse  # noqa: F401
+from . import utils  # noqa: F401
 from . import vision  # noqa: F401
 from .device import get_device, set_device  # noqa: F401
 from .framework import CPUPlace, CUDAPlace, TPUPlace, save, load  # noqa: F401

@@ -54,6 +54,13 @@ class _AmpState(threading.local):
             do_cast = base in cfg["white"] and base not in cfg["black"]
         if not do_cast:
             return args
+        from ..core import flags
+
+        if flags.flag("low_precision_op_list"):
+            from . import debugging
+
+            debugging._low_precision_ops[base] = (
+                debugging._low_precision_ops.get(base, 0) + 1)
         out = []
         for a in args:
             if isinstance(a, Tensor) and a.dtype == dtype_mod.float32:

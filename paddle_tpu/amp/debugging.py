@@ -20,6 +20,21 @@ class DebugMode:
     CHECK_ALL = 3
 
 
+# op name -> count of AMP low-precision dispatches (FLAGS_low_precision_op_list)
+_low_precision_ops: dict = {}
+
+
+def low_precision_op_list() -> dict:
+    """Ops AMP ran in low precision since the flag was enabled
+    (``paddle.amp.debugging.collect_operator_stats`` capability over
+    ``FLAGS low_precision_op_list``)."""
+    return dict(_low_precision_ops)
+
+
+def clear_low_precision_op_list():
+    _low_precision_ops.clear()
+
+
 class TensorCheckerConfig:
     """Per-op skip config (amp/debugging.py:157 analog)."""
 

@@ -1,0 +1,261 @@
+"""``paddle.inference`` (N31): the predictor API.
+
+Reference: ``paddle/fluid/inference/api/analysis_predictor.h:100`` —
+``Config`` → ``create_predictor`` → named input handles → ``run()``.
+TPU-first the "analysis + pass pipeline" is XLA: a saved model is a
+serialized StableHLO export (``paddle_tpu.jit.save``), already optimized
+and portable; loading it gives a compiled callable, so ``Predictor.run``
+is one executable dispatch.
+
+For LLM serving there is additionally :class:`LLMPredictor` — continuous
+batched generation over a paged KV block pool (the reference's
+``block_multi_head_attention`` serving path), using the Pallas paged
+kernel on TPU (``ops/pallas_paged.py``).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, to_tensor
+
+
+class Config:
+    """(``analysis_config`` analog) — model path + serving knobs."""
+
+    def __init__(self, model_path: Optional[str] = None):
+        self._model_path = model_path
+        self._kv_block_size = 16
+        self._kv_num_blocks = 256
+        self._max_batch_size = 8
+
+    def set_model(self, path: str):
+        self._model_path = path
+
+    def model_path(self) -> Optional[str]:
+        return self._model_path
+
+    def enable_paged_kv(self, num_blocks: int = 256, block_size: int = 16):
+        self._kv_num_blocks = num_blocks
+        self._kv_block_size = block_size
+
+    def set_max_batch_size(self, n: int):
+        self._max_batch_size = n
+
+    # accepted-for-parity GPU knobs (placement is XLA's on TPU)
+    def enable_use_gpu(self, *a, **k):
+        pass
+
+    def switch_ir_optim(self, *a, **k):
+        pass
+
+    def enable_memory_optim(self, *a, **k):
+        pass
+
+
+class _Handle:
+    """Input/output tensor handle (``ZeroCopyTensor`` analog)."""
+
+    def __init__(self):
+        self._value: Optional[np.ndarray] = None
+
+    def copy_from_cpu(self, arr):
+        self._value = np.asarray(arr)
+
+    def reshape(self, shape):
+        if self._value is not None:
+            self._value = self._value.reshape(shape)
+
+    def copy_to_cpu(self) -> np.ndarray:
+        return self._value
+
+    def shape(self):
+        return list(self._value.shape) if self._value is not None else []
+
+
+class Predictor:
+    """(``AnalysisPredictor`` analog) over a StableHLO export."""
+
+    def __init__(self, config: Config):
+        from ..jit import load
+
+        if config.model_path() is None:
+            raise ValueError("Config.set_model(path) required")
+        self._layer = load(config.model_path())
+        # export avals = flattened state leaves + the user inputs
+        n_in = (len(self._layer._exported.in_avals)
+                - len(self._layer._state_vals))
+        self._inputs = {f"x{i}": _Handle() for i in range(n_in)}
+        self._outputs: List[np.ndarray] = []
+
+    def get_input_names(self) -> List[str]:
+        return list(self._inputs)
+
+    def get_input_handle(self, name: str) -> _Handle:
+        return self._inputs[name]
+
+    def run(self, inputs: Optional[Sequence] = None):
+        """Execute; positional ``inputs`` (ndarrays/Tensors) may substitute
+        for handles (the convenience path)."""
+        if inputs is not None:
+            for h, a in zip(self._inputs.values(), inputs):
+                h.copy_from_cpu(a.numpy() if isinstance(a, Tensor) else a)
+        args = [to_tensor(h._value) for h in self._inputs.values()]
+        out = self._layer(*args)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        self._outputs = [np.asarray(o.numpy()) for o in outs]
+        return True
+
+    def get_output_names(self) -> List[str]:
+        return [f"out{i}" for i in range(len(self._outputs))]
+
+    def get_output_handle(self, name: str) -> _Handle:
+        h = _Handle()
+        h.copy_from_cpu(self._outputs[int(name[3:])])
+        return h
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)
+
+
+class LLMPredictor:
+    """Continuous-batched generation over a paged KV pool.
+
+    The serving analog of the reference's fused block-attention decode
+    (``block_multi_head_attention_kernel.cu``): requests join/leave the
+    batch between steps, every sequence's KV lives in shared fixed-size
+    pages, and one compiled decode program serves any batch composition
+    (routing arrays are data, not shapes)."""
+
+    def __init__(self, model, num_blocks: int = 256, block_size: int = 16,
+                 dtype=jnp.float32):
+        from ..ops.paged_attention import PagedCache
+
+        self.model = model
+        cfg = model.config
+        self.block_size = block_size
+        self.num_blocks = num_blocks
+        self._free = list(range(num_blocks - 1, 0, -1))  # 0 = null page
+        self._tables: Dict[int, List[int]] = {}
+        self._lens: Dict[int, int] = {}
+        self._last_tok: Dict[int, int] = {}
+        self._done: Dict[int, List[int]] = {}
+        self.caches = [
+            PagedCache(
+                Tensor(jnp.zeros((num_blocks, block_size,
+                                  cfg.num_key_value_heads, cfg.head_dim),
+                                 dtype)),
+                Tensor(jnp.zeros((num_blocks, block_size,
+                                  cfg.num_key_value_heads, cfg.head_dim),
+                                 dtype)))
+            for _ in range(cfg.num_hidden_layers)
+        ]
+        model.eval()
+
+    # --- block bookkeeping --------------------------------------------------
+    def _alloc_slot(self, seq_id: int) -> None:
+        table = self._tables.setdefault(seq_id, [])
+        pos = self._lens.get(seq_id, 0)
+        if pos // self.block_size >= len(table):
+            if not self._free:
+                raise RuntimeError("KV block pool exhausted")
+            table.append(self._free.pop())
+
+    def free(self, seq_id: int):
+        for b in self._tables.pop(seq_id, []):
+            self._free.append(b)
+        self._lens.pop(seq_id, None)
+        self._last_tok.pop(seq_id, None)
+
+    # --- serving ------------------------------------------------------------
+    def add_request(self, seq_id: int, input_ids: np.ndarray):
+        """Prefill one sequence: dense-cache forward (compiled once per
+        prompt length), then migrate its K/V into pages."""
+        from .. import no_grad
+
+        ids = np.asarray(input_ids, np.int64).reshape(1, -1)
+        T0 = ids.shape[1]
+        cfg = self.model.config
+        dense = [
+            (Tensor(jnp.zeros((1, T0, cfg.num_key_value_heads, cfg.head_dim),
+                              jnp.float32)),
+             Tensor(jnp.zeros((1, T0, cfg.num_key_value_heads, cfg.head_dim),
+                              jnp.float32)))
+            for _ in range(cfg.num_hidden_layers)
+        ]
+        with no_grad():
+            logits = self.model(to_tensor(ids), caches=dense,
+                                pos=to_tensor(0, dtype="int32"))
+        # migrate each layer's [1, T0, Hkv, D] into this sequence's pages
+        for t in range(T0):
+            self._alloc_slot(seq_id)
+            self._lens[seq_id] = self._lens.get(seq_id, 0) + 1
+        table = self._tables[seq_id]
+        pos = np.arange(T0)
+        blocks = np.asarray([table[p // self.block_size] for p in pos])
+        offs = pos % self.block_size
+        for cache, (kb, vb) in zip(self.caches, dense):
+            cache.k_pool._value = cache.k_pool._value.at[blocks, offs].set(
+                kb._value[0].astype(cache.k_pool._value.dtype))
+            cache.v_pool._value = cache.v_pool._value.at[blocks, offs].set(
+                vb._value[0].astype(cache.v_pool._value.dtype))
+        tok = int(np.asarray(logits.numpy())[0, -1].argmax(-1))
+        self._last_tok[seq_id] = tok
+        self._done[seq_id] = [tok]
+        return tok
+
+    def step(self, seq_ids: Optional[Sequence[int]] = None) -> Dict[int, int]:
+        """One batched greedy decode step for the active sequences."""
+        from .. import no_grad
+
+        active = list(seq_ids if seq_ids is not None else self._tables)
+        if not active:
+            return {}
+        B = len(active)
+        # allocate this step's slot per sequence + build routing arrays
+        max_blocks = 0
+        slot_blocks, slot_offsets, lens, toks, poss = [], [], [], [], []
+        for s in active:
+            self._alloc_slot(s)
+            p = self._lens[s]
+            t = self._tables[s]
+            slot_blocks.append(t[p // self.block_size])
+            slot_offsets.append(p % self.block_size)
+            lens.append(p + 1)            # cache length AFTER this token
+            poss.append(p)                # rope position of this token
+            toks.append(self._last_tok[s])
+            max_blocks = max(max_blocks, len(t))
+        tables = np.zeros((B, max_blocks), np.int32)
+        for i, s in enumerate(active):
+            t = self._tables[s]
+            tables[i, :len(t)] = t
+        for cache in self.caches:
+            cache.route(tables, np.asarray(lens, np.int32),
+                        np.asarray(slot_blocks, np.int32),
+                        np.asarray(slot_offsets, np.int32))
+        ids = np.asarray(toks, np.int64).reshape(B, 1)
+        with no_grad():
+            logits = self.model(to_tensor(ids), caches=self.caches,
+                                pos=to_tensor(np.asarray(poss, np.int32)))
+        out = np.asarray(logits.numpy())[:, -1].argmax(-1)
+        result = {}
+        for i, s in enumerate(active):
+            self._lens[s] += 1
+            tok = int(out[i])
+            self._last_tok[s] = tok
+            self._done[s].append(tok)
+            result[s] = tok
+        return result
+
+    def generate(self, seq_id: int, input_ids, max_new_tokens: int = 16):
+        """Single-request convenience: prefill + greedy decode loop."""
+        self.add_request(seq_id, input_ids)
+        for _ in range(max_new_tokens - 1):
+            self.step([seq_id])
+        toks = self._done[seq_id][:max_new_tokens]
+        self.free(seq_id)
+        return toks

@@ -122,11 +122,16 @@ def _rope_tables(head_dim: int, max_pos: int, theta: float):
 
 
 def _apply_rope(x, cos, sin):
-    """x: [B, S, H, D]; cos/sin: [S, D/2] (rotate-half convention)."""
+    """x: [B, S, H, D]; cos/sin: [S, D/2], or [B, S, D/2] for per-sequence
+    positions (paged batched decode)."""
     d2 = x.shape[-1] // 2
     x1, x2 = x[..., :d2], x[..., d2:]
-    cos = cos[None, :, None, :].astype(x.dtype)
-    sin = sin[None, :, None, :].astype(x.dtype)
+    if cos.ndim == 3:
+        cos = cos[:, :, None, :].astype(x.dtype)
+        sin = sin[:, :, None, :].astype(x.dtype)
+    else:
+        cos = cos[None, :, None, :].astype(x.dtype)
+        sin = sin[None, :, None, :].astype(x.dtype)
     return jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
 
 
@@ -184,7 +189,8 @@ class LlamaAttention(Layer):
             cos_t, sin_t = self._rope_cos, self._rope_sin
 
             def rope_at(a, p):
-                idx = p + jnp.arange(S)
+                # scalar pos: shared offset; [B] pos: per-sequence offsets
+                idx = (p[:, None] if jnp.ndim(p) == 1 else p) + jnp.arange(S)
                 return _apply_rope(a, jnp.asarray(cos_t)[idx],
                                    jnp.asarray(sin_t)[idx])
 
@@ -208,7 +214,15 @@ class LlamaAttention(Layer):
         """KV-cached attention for generation: append k/v into the static
         [B, M, Hkv, D] buffers at ``pos`` and attend over the valid prefix
         (fixed shapes + length mask — one compiled decode step serves every
-        position; the serving analog of the reference's fused decode path)."""
+        position; the serving analog of the reference's fused decode path).
+
+        A :class:`~paddle_tpu.ops.paged_attention.PagedCache` routes to the
+        block-pool path instead (vLLM-style serving; the reference's
+        ``block_multi_head_attention`` kernel)."""
+        from ..ops.paged_attention import PagedCache
+
+        if isinstance(cache, PagedCache):
+            return self._paged_attention(q, k, v, cache, B, S, hd)
         k_buf, v_buf = cache
 
         def upd(buf, new, p):
@@ -238,6 +252,32 @@ class LlamaAttention(Layer):
             return out.reshape(B, S, self.num_heads * hd)
 
         out = run_op("cached_attention", attend, q, k_buf, v_buf, pos)
+        return self.o_proj(out)
+
+    def _paged_attention(self, q, k, v, cache, B, S, hd):
+        """Decode (S=1) over the shared block pool: scatter this step's K/V
+        into each sequence's slot (block, offset) then fused paged attention
+        (``ops/pallas_paged.py`` on TPU)."""
+        from ..ops import paged_attention as pa_mod
+
+        assert S == 1, "paged cache path is decode-only (one token per step)"
+        kp, vp = cache.k_pool, cache.v_pool
+        blocks, offs = cache.slot_blocks, cache.slot_offsets
+
+        def write(pool, new):
+            return pool.at[blocks, offs].set(new[:, 0].astype(pool.dtype))
+
+        kp._rebind(run_op("paged_kv_write", write, kp, k))
+        vp._rebind(run_op("paged_kv_write", write, vp, v))
+
+        def attend(qv, kpool, vpool):
+            return pa_mod.paged_attention(
+                qv[:, 0], kpool, vpool, cache.block_tables, cache.seq_lens
+            )[:, None]
+
+        out = run_op("paged_attention", attend, q, kp, vp)
+        out = run_op("merge_heads",
+                     lambda a: a.reshape(B, S, self.num_heads * hd), out)
         return self.o_proj(out)
 
 

@@ -1,0 +1,116 @@
+"""Kernel autotune cache (N11 — ``paddle/phi/kernels/autotune/cache.h``).
+
+The reference memoizes cuDNN algorithm choices per input configuration;
+here the tunable is the Pallas block geometry (block_q, block_k) of the
+flash-attention kernel.  Tuning times each admissible candidate on the
+live device (forward + backward, blocked until ready) and memoizes the
+winner keyed by (shape, dtype, causal, device kind), persisted to a JSON
+file so later processes skip the sweep — the analog of the reference's
+serialized autotune cache.
+
+Enabled with ``FLAGS pallas_autotune`` (off by default: the sweep costs a
+few compiles on first encounter of a new shape).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, List, Optional, Tuple
+
+_CACHE_PATH = os.path.join(
+    os.path.dirname(__file__), "..", "_native", "autotune_cache.json")
+
+_memory: Dict[str, Tuple[int, int]] = {}
+_loaded = False
+
+
+def _load():
+    global _loaded
+    if _loaded:
+        return
+    _loaded = True
+    try:
+        with open(_CACHE_PATH) as f:
+            _memory.update({k: tuple(v) for k, v in json.load(f).items()})
+    except (OSError, ValueError):
+        pass
+
+
+def _save():
+    try:
+        os.makedirs(os.path.dirname(_CACHE_PATH), exist_ok=True)
+        with open(_CACHE_PATH, "w") as f:
+            json.dump({k: list(v) for k, v in _memory.items()}, f)
+    except OSError:
+        pass
+
+
+def _key(q_shape, kv_shape, dtype, causal) -> str:
+    import jax
+
+    kind = jax.devices()[0].device_kind
+    return f"flash|{tuple(q_shape)}|{tuple(kv_shape)}|{dtype}|{causal}|{kind}"
+
+
+def candidates(seq_q: int, seq_k: int, head_dim: int) -> List[Tuple[int, int]]:
+    """Admissible (block_q, block_k): MXU-aligned, dividing the sequence,
+    within a conservative VMEM budget."""
+    out = []
+    for bq in (128, 256, 512):
+        for bk in (128, 256, 512):
+            if seq_q % bq or seq_k % bk:
+                continue
+            # rough VMEM estimate: q + k + v + acc + s tiles (fp32)
+            vmem = (bq * head_dim * 2 + bk * head_dim * 2 * 2
+                    + bq * head_dim * 4 + bq * bk * 4)
+            if vmem > 12 * 1024 * 1024:
+                continue
+            out.append((bq, bk))
+    return out or [(128, 128)]
+
+
+def tune_flash_blocks(q, k, v, causal: bool,
+                      iters: int = 3) -> Tuple[int, int]:
+    """Measured sweep over block candidates; memoized + persisted."""
+    import jax
+
+    from .pallas_flash import flash_attention
+
+    _load()
+    key = _key(q.shape, k.shape, str(q.dtype), causal)
+    hit = _memory.get(key)
+    if hit is not None:
+        return hit
+
+    best, best_t = (128, 128), float("inf")
+    for bq, bk in candidates(q.shape[1], k.shape[1], q.shape[3]):
+        try:
+            def step(q_, k_, v_):
+                out, vjp = jax.vjp(
+                    lambda a, b, c: flash_attention(a, b, c, causal, bq, bk),
+                    q_, k_, v_)
+                return out, vjp(out)
+
+            jitted = jax.jit(step)
+            jax.block_until_ready(jitted(q, k, v))  # compile
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                r = jitted(q, k, v)
+            jax.block_until_ready(r)
+            dt = (time.perf_counter() - t0) / iters
+        except Exception:
+            continue
+        if dt < best_t:
+            best, best_t = (bq, bk), dt
+    _memory[key] = best
+    _save()
+    return best
+
+
+def cached_flash_blocks(q_shape, kv_shape, dtype,
+                        causal) -> Optional[Tuple[int, int]]:
+    """Cache lookup only (no tuning) — the hot-path accessor."""
+    _load()
+    return _memory.get(_key(q_shape, kv_shape, dtype, causal))

@@ -27,7 +27,10 @@ last_path: str | None = None
 def use_flash(q_shape, attn_mask) -> bool:
     import os
 
-    if os.environ.get("PADDLE_TPU_DISABLE_PALLAS") == "1":
+    from ..core import flags
+
+    if (os.environ.get("PADDLE_TPU_DISABLE_PALLAS") == "1"
+            or flags.flag("disable_pallas_kernels")):
         return False  # kill switch: force the XLA composite path
     if attn_mask is not None:
         return False
@@ -64,17 +67,32 @@ def flash_attention_fwd(q, k, v, causal: bool = False):
     global last_path
     if use_flash(q.shape, None):
         try:
+            from ..core import flags as _flags
             from .pallas_flash import flash_attention as pallas_flash
 
+            blocks = None
+            if _flags.flag("pallas_autotune"):
+                from .autotune import cached_flash_blocks, tune_flash_blocks
+
+                blocks = cached_flash_blocks(q.shape, k.shape,
+                                             str(q.dtype), causal)
+                if blocks is None and not isinstance(q, jax.core.Tracer):
+                    blocks = tune_flash_blocks(q, k, v, causal)
             # positional: custom_vjp with nondiff_argnums rejects kwargs
-            out = pallas_flash(q, k, v, causal)
+            if blocks is not None:
+                out = pallas_flash(q, k, v, causal, blocks[0], blocks[1])
+            else:
+                out = pallas_flash(q, k, v, causal)
             last_path = "pallas"
             return out
         except Exception as e:
             import os
             import warnings
 
-            if os.environ.get("PADDLE_TPU_STRICT_PALLAS") == "1":
+            from ..core import flags
+
+            if (os.environ.get("PADDLE_TPU_STRICT_PALLAS") == "1"
+                    or flags.flag("strict_pallas")):
                 raise
             warnings.warn(
                 f"pallas flash attention failed, falling back to XLA "

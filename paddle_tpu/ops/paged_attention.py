@@ -78,28 +78,97 @@ class BlockKVCache:
         return jnp.asarray(bt), jnp.asarray(lens)
 
 
+# Which path the most recent dispatch took: "pallas" | "xla" (same loud
+# fallback contract as ops/flash_attention.py).
+last_path: Optional[str] = None
+
+
+class PagedCache:
+    """Per-layer view of the shared block pool, handed to the model's
+    attention as its ``cache`` (the model writes K/V into the slot and
+    attends through the block table).  ``k_pool``/``v_pool`` are framework
+    Tensors [num_blocks, block_size, Hkv, D] so the scatter write threads
+    as jit state; the routing arrays are refreshed by the serving loop
+    before each decode step."""
+
+    def __init__(self, k_pool, v_pool):
+        self.k_pool = k_pool
+        self.v_pool = v_pool
+        self.block_tables = None   # [B, max_blocks] int32
+        self.seq_lens = None       # [B] int32 (AFTER this step's token)
+        self.slot_blocks = None    # [B] int32 — page of this step's token
+        self.slot_offsets = None   # [B] int32 — offset within the page
+
+    def route(self, block_tables, seq_lens, slot_blocks, slot_offsets):
+        self.block_tables = jnp.asarray(block_tables, jnp.int32)
+        self.seq_lens = jnp.asarray(seq_lens, jnp.int32)
+        self.slot_blocks = jnp.asarray(slot_blocks, jnp.int32)
+        self.slot_offsets = jnp.asarray(slot_offsets, jnp.int32)
+
+
+def _xla_paged_attention(q, k_cache, v_cache, block_tables, seq_lens):
+    """XLA gather path: materializes the padded [B, S, H, D] context (GQA
+    via grouped einsum, KV never head-repeated)."""
+    B, H, D = q.shape
+    max_blocks = block_tables.shape[1]
+    bs = k_cache.shape[1]
+    Hkv = k_cache.shape[2]
+    rep = H // Hkv
+    scale = 1.0 / math.sqrt(D)
+
+    # gather each sequence's pages: [B, max_blocks, bs, Hkv, D] → [B, S, Hkv, D]
+    k = k_cache[block_tables].reshape(B, max_blocks * bs, Hkv, D)
+    v = v_cache[block_tables].reshape(B, max_blocks * bs, Hkv, D)
+
+    qg = q.reshape(B, Hkv, rep, D)
+    logits = jnp.einsum("bhrd,bshd->bhrs", qg.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    pos = jnp.arange(max_blocks * bs)[None, None, None, :]
+    mask = pos < seq_lens[:, None, None, None]
+    logits = jnp.where(mask, logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhrs,bshd->bhrd", probs, v.astype(jnp.float32))
+    return out.reshape(B, H, D).astype(q.dtype)
+
+
 def paged_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
                     block_tables: jax.Array, seq_lens: jax.Array) -> jax.Array:
     """Decode-step attention over a paged KV cache.
 
     q: [B, H, D] (one new token per sequence); k/v_cache:
-    [num_blocks, block_size, H, D]; block_tables: [B, max_blocks] int32;
+    [num_blocks, block_size, Hkv, D]; block_tables: [B, max_blocks] int32;
     seq_lens: [B] int32.  Returns [B, H, D].
+
+    Dispatches to the Pallas kernel (``pallas_paged.py`` — scalar-prefetch
+    page DMA, no dense context copy) when shapes are TPU-tileable; falls
+    back to the XLA gather path with a loud warning otherwise.
     """
+    import os
+
+    global last_path
+    from ..core import flags
+
     B, H, D = q.shape
-    max_blocks = block_tables.shape[1]
-    bs = k_cache.shape[1]
-    scale = 1.0 / math.sqrt(D)
+    disable = (os.environ.get("PADDLE_TPU_DISABLE_PALLAS") == "1"
+               or flags.flag("disable_pallas_kernels"))
+    tileable = D % 128 == 0 and k_cache.shape[1] % 8 == 0
+    if not disable and tileable:
+        try:
+            from .pallas_paged import paged_attention_decode
 
-    # gather each sequence's pages: [B, max_blocks, bs, H, D] → [B, S, H, D]
-    k = k_cache[block_tables].reshape(B, max_blocks * bs, H, D)
-    v = v_cache[block_tables].reshape(B, max_blocks * bs, H, D)
+            out = paged_attention_decode(q, k_cache, v_cache,
+                                         block_tables, seq_lens)
+            last_path = "pallas"
+            return out
+        except Exception as e:
+            import warnings
 
-    logits = jnp.einsum("bhd,bshd->bhs", q.astype(jnp.float32),
-                        k.astype(jnp.float32)) * scale
-    pos = jnp.arange(max_blocks * bs)[None, None, :]
-    mask = pos < seq_lens[:, None, None]
-    logits = jnp.where(mask, logits, -1e30)
-    probs = jax.nn.softmax(logits, axis=-1)
-    out = jnp.einsum("bhs,bshd->bhd", probs, v.astype(jnp.float32))
-    return out.astype(q.dtype)
+            if (os.environ.get("PADDLE_TPU_STRICT_PALLAS") == "1"
+                    or flags.flag("strict_pallas")):
+                raise
+            warnings.warn(
+                f"pallas paged attention failed, falling back to the XLA "
+                f"gather path: {type(e).__name__}: {e}",
+                RuntimeWarning, stacklevel=2)
+    last_path = "xla"
+    return _xla_paged_attention(q, k_cache, v_cache, block_tables, seq_lens)

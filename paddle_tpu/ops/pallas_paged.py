@@ -1,0 +1,122 @@
+"""Pallas TPU paged-attention decode kernel.
+
+Capability analog of the reference's
+``phi/kernels/fusion/gpu/block_multi_head_attention_kernel.cu`` (vLLM-style
+paged KV attention), re-designed for TPU: the per-sequence block table is a
+**scalar-prefetch** argument (``pltpu.PrefetchScalarGridSpec``), so the
+index map can steer each grid step's HBM→VMEM DMA straight to the right KV
+page — the gather never materializes a contiguous [B, S, H, D] copy the
+way the XLA ``take`` path does.  Online softmax statistics live in VMEM
+scratch across the page dimension, exactly like the flash kernel
+(``pallas_flash.py``); GQA/MQA is native (query heads grouped per KV head,
+KV pages are read once).
+
+q: [B, H, D] (one decode token per sequence)
+k/v_cache: [num_blocks, block_size, Hkv, D]
+block_tables: [B, max_blocks] int32   (page ids per sequence, 0-padded)
+seq_lens: [B] int32
+→ out: [B, H, D]
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _decode_kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+                   acc_ref, m_ref, l_ref, *, scale, block_size, n_pages,
+                   rep):
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    seq_len = len_ref[b]
+    # pages beyond the sequence are skipped entirely (their DMA still reads
+    # page bt[b, j], which is 0-padded — harmless)
+    @pl.when(j * block_size < seq_len)
+    def _step():
+        q = q_ref[0]                         # [H, D]
+        k = k_ref[0]                         # [bs, Hkv, D]
+        v = v_ref[0]                         # [bs, Hkv, D]
+        h, d = q.shape
+        hkv = k.shape[1]
+        qg = q.reshape(hkv, rep, d)
+        # logits[t, kvh, r] = k[t, kvh, :] · qg[kvh, r, :]
+        s = jax.lax.dot_general(
+            k, qg, (((2,), (2,)), ((1,), (0,))),
+            preferred_element_type=jnp.float32)          # [Hkv, bs, rep]
+        s = jnp.transpose(s, (0, 2, 1)) * scale          # [Hkv, rep, bs]
+        pos = jax.lax.broadcasted_iota(jnp.int32, s.shape, 2) + j * block_size
+        s = jnp.where(pos < seq_len, s, _NEG_INF)
+
+        s2 = s.reshape(h, -1)                            # [H, bs]
+        m_prev = m_ref[:, 0]                             # [H]
+        m_new = jnp.maximum(m_prev, jnp.max(s2, axis=-1))
+        alpha = jnp.exp(m_prev - m_new)                  # [H]
+        p = jnp.exp(s2 - m_new[:, None])                 # [H, bs]
+        l_ref[:, 0] = l_ref[:, 0] * alpha + jnp.sum(p, -1)
+        m_ref[:, 0] = m_new
+        # pv[kvh, r, d] = sum_t p[kvh, r, t] v[t, kvh, d]
+        pg = p.reshape(hkv, rep, -1)
+        pv = jax.lax.dot_general(
+            pg, v, (((2,), (0,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32)          # [Hkv, rep, D]
+        acc_ref[:] = acc_ref[:] * alpha[:, None] + pv.reshape(h, d)
+
+    @pl.when(j == n_pages - 1)
+    def _finish():
+        o_ref[0] = (acc_ref[:] / jnp.maximum(l_ref[:, 0], 1e-9)[:, None]
+                    ).astype(o_ref.dtype)
+
+
+def paged_attention_decode(q, k_cache, v_cache, block_tables, seq_lens):
+    """Fused paged decode attention; returns [B, H, D]."""
+    B, H, D = q.shape
+    num_blocks, bs, Hkv, _ = k_cache.shape
+    rep = H // Hkv
+    n_pages = block_tables.shape[1]
+    scale = 1.0 / math.sqrt(D)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,   # block_tables, seq_lens
+        grid=(B, n_pages),
+        in_specs=[
+            pl.BlockSpec((1, H, D), lambda b, j, bt, ln: (b, 0, 0)),
+            # the scalar-prefetched block table drives the page DMA:
+            pl.BlockSpec((1, bs, Hkv, D),
+                         lambda b, j, bt, ln: (bt[b, j], 0, 0, 0)),
+            pl.BlockSpec((1, bs, Hkv, D),
+                         lambda b, j, bt, ln: (bt[b, j], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, H, D), lambda b, j, bt, ln: (b, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((H, D), jnp.float32),    # acc
+            pltpu.VMEM((H, 1), jnp.float32),    # running max
+            pltpu.VMEM((H, 1), jnp.float32),    # running sum
+        ],
+    )
+    kernel = functools.partial(
+        _decode_kernel, scale=scale, block_size=bs, n_pages=n_pages, rep=rep)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, H, D), q.dtype),
+        interpret=_interpret(),
+    )(block_tables, seq_lens, q, k_cache, v_cache)

@@ -107,3 +107,35 @@ def test_gqa_grads_match_reference(causal):
         np.testing.assert_allclose(np.asarray(a) / scale,
                                    np.asarray(b) / scale,
                                    rtol=2e-4, atol=2e-5)
+
+
+class TestAutotuneCache:
+    """N11 autotune-cache analog (ops/autotune.py)."""
+
+    def test_candidates_respect_divisibility(self):
+        from paddle_tpu.ops import autotune as at
+
+        cands = at.candidates(256, 256, 128)
+        assert (128, 128) in cands
+        assert all(256 % bq == 0 and 256 % bk == 0 for bq, bk in cands)
+        assert at.candidates(100, 100, 128) == [(128, 128)]  # fallback
+
+    def test_tune_persists_and_hits(self, tmp_path, monkeypatch):
+        from paddle_tpu.ops import autotune as at
+
+        monkeypatch.setattr(at, "_CACHE_PATH",
+                            str(tmp_path / "autotune.json"))
+        monkeypatch.setattr(at, "_memory", {})
+        monkeypatch.setattr(at, "_loaded", False)
+        rng = np.random.default_rng(0)
+        q = jnp.asarray(rng.standard_normal((1, 256, 2, 128)).astype("float32"))
+        k = jnp.asarray(rng.standard_normal((1, 256, 2, 128)).astype("float32"))
+        blocks = at.tune_flash_blocks(q, k, k, causal=False, iters=1)
+        assert blocks in at.candidates(256, 256, 128)
+        # memoized: second call returns instantly from memory
+        assert at.tune_flash_blocks(q, k, k, causal=False) == blocks
+        # persisted: a fresh load sees it
+        monkeypatch.setattr(at, "_memory", {})
+        monkeypatch.setattr(at, "_loaded", False)
+        assert at.cached_flash_blocks(q.shape, k.shape, str(q.dtype),
+                                      False) == blocks

@@ -131,10 +131,20 @@ class LLMPredictor:
     pages, and one compiled decode program serves any batch composition
     (routing arrays are data, not shapes)."""
 
-    def __init__(self, model, num_blocks: int = 256, block_size: int = 16,
-                 dtype=jnp.float32):
+    def __init__(self, model, num_blocks: Optional[int] = None,
+                 block_size: Optional[int] = None, dtype=jnp.float32,
+                 config: Optional[Config] = None):
         from ..ops.paged_attention import PagedCache
 
+        # serving knobs resolve Config < explicit args < defaults
+        if config is not None:
+            num_blocks = num_blocks or config._kv_num_blocks
+            block_size = block_size or config._kv_block_size
+            self.max_batch_size = config._max_batch_size
+        else:
+            self.max_batch_size = 64
+        num_blocks = num_blocks or 256
+        block_size = block_size or 16
         self.model = model
         cfg = model.config
         self.block_size = block_size
@@ -170,6 +180,7 @@ class LLMPredictor:
             self._free.append(b)
         self._lens.pop(seq_id, None)
         self._last_tok.pop(seq_id, None)
+        self._done.pop(seq_id, None)
 
     # --- serving ------------------------------------------------------------
     def add_request(self, seq_id: int, input_ids: np.ndarray):
@@ -215,6 +226,12 @@ class LLMPredictor:
         active = list(seq_ids if seq_ids is not None else self._tables)
         if not active:
             return {}
+        if len(active) > self.max_batch_size:
+            # decode in max_batch_size chunks (the Config knob's contract)
+            result = {}
+            for i in range(0, len(active), self.max_batch_size):
+                result.update(self.step(active[i:i + self.max_batch_size]))
+            return result
         B = len(active)
         # allocate this step's slot per sequence + build routing arrays
         max_blocks = 0

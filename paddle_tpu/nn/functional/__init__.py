@@ -3,8 +3,10 @@
 from .activation import *  # noqa: F401,F403
 from .attention import (  # noqa: F401
     flash_attention,
+    flash_attn_unpadded,
     scaled_dot_product_attention,
     sequence_mask,
+    sparse_attention,
 )
 from .common import *  # noqa: F401,F403
 from .conv import (  # noqa: F401
@@ -27,3 +29,6 @@ from .norm import (  # noqa: F401
     spectral_norm,
 )
 from .pooling import *  # noqa: F401,F403
+from .vision import affine_grid, grid_sample, temporal_shift  # noqa: F401
+
+from ...tensor.creation import diag_embed  # noqa: F401  (also exposed here, reference parity)

@@ -97,3 +97,64 @@ def sequence_mask(x, maxlen=None, dtype="int64", name=None):
         return (jnp.arange(m)[None, :] < v[..., None]).astype(dtype_mod.convert_dtype(dtype))
 
     return run_op("sequence_mask", f, _ensure(x))
+
+
+def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
+                     key_padding_mask=None, attn_mask=None, name=None):
+    """CSR-pattern sparse attention (``fluid/operators/sparse_attention_op``
+    surface) — delegates to the segment-softmax implementation in
+    :mod:`paddle_tpu.sparse.nn.functional`."""
+    import numpy as np
+
+    from ...sparse import sparse_csr_tensor
+    from ...sparse.nn.functional import attention as _sparse_attn
+
+    q = _ensure(query)
+    B, H, L, _ = q.shape
+    offs = np.asarray(_ensure(sparse_csr_offset)._value)
+    cols = np.asarray(_ensure(sparse_csr_columns)._value)
+    vals = np.ones(cols.reshape(B * H, -1).shape, np.float32)
+    mask = sparse_csr_tensor(offs.reshape(B * H, L + 1),
+                             cols.reshape(B * H, -1), vals,
+                             shape=[B * H, L, L])
+    return _sparse_attn(query, key, value, mask,
+                        key_padding_mask=key_padding_mask,
+                        attn_mask=attn_mask)
+
+
+def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
+                        max_seqlen_q, max_seqlen_k, scale=None,
+                        dropout=0.0, causal=False, return_softmax=False,
+                        name=None):
+    """Varlen (packed) attention (``nn/functional/flash_attention.py``
+    flash_attn_unpadded): q/k/v are [total_tokens, H, D] packed sequences
+    delimited by cumulative-length vectors.  Segment-masked attention —
+    tokens only attend within their own sequence."""
+    import numpy as np
+
+    q, k, v = _ensure(query), _ensure(key), _ensure(value)
+    cq = np.asarray(_ensure(cu_seqlens_q)._value).astype(np.int64)
+    ck = np.asarray(_ensure(cu_seqlens_k)._value).astype(np.int64)
+    seg_q = np.repeat(np.arange(len(cq) - 1), np.diff(cq))
+    seg_k = np.repeat(np.arange(len(ck) - 1), np.diff(ck))
+    pos_q = np.concatenate([np.arange(n) for n in np.diff(cq)]) if len(cq) > 1 \
+        else np.arange(q.shape[0])
+    pos_k = np.concatenate([np.arange(n) for n in np.diff(ck)]) if len(ck) > 1 \
+        else np.arange(k.shape[0])
+
+    def f(qv, kv, vv):
+        D = qv.shape[-1]
+        s = jnp.einsum("qhd,khd->hqk", qv, kv) * (
+            scale if scale is not None else 1.0 / math.sqrt(D))
+        allow = jnp.asarray(seg_q)[:, None] == jnp.asarray(seg_k)[None, :]
+        if causal:
+            allow = allow & (jnp.asarray(pos_k)[None, :]
+                             <= jnp.asarray(pos_q)[:, None])
+        s = jnp.where(allow[None], s, jnp.float32(-1e30))
+        p = jax.nn.softmax(s, -1)
+        out = jnp.einsum("hqk,khd->qhd", p.astype(vv.dtype), vv)
+        if return_softmax:
+            return out, p
+        return out
+
+    return run_op("flash_attn_unpadded", f, q, k, v)

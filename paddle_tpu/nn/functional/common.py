@@ -341,3 +341,153 @@ def channel_shuffle(x, groups, data_format="NCHW", name=None):
         return v.transpose(0, 1, 2, 4, 3).reshape(N, H, W, C)
 
     return run_op("channel_shuffle", f, _ensure(x))
+
+
+def pdist(x, p=2.0, name=None):
+    """Condensed pairwise p-distances of rows (``nn/functional/distance.py``
+    pdist): [N, D] -> [N*(N-1)/2]."""
+
+    def f(v):
+        n = v.shape[0]
+        iu, ju = np.triu_indices(n, k=1)
+        diff = v[iu] - v[ju]
+        if p == 2.0:
+            return jnp.sqrt(jnp.sum(diff * diff, -1) + 0.0)
+        return jnp.sum(jnp.abs(diff) ** p, -1) ** (1.0 / p)
+
+    return run_op("pdist", f, _ensure(x))
+
+
+def _max_unpool(x, indices, ndim, kernel_size, stride, padding, output_size,
+                data_format):
+    """Shared unpool: scatter pooled values back at their argmax positions
+    (``nn/functional/pooling.py`` max_unpool*; indices are paddle's
+    flattened per-channel spatial indices from return_mask)."""
+    ks = (kernel_size,) * ndim if isinstance(kernel_size, int) else tuple(kernel_size)
+    st = ks if stride is None else (
+        (stride,) * ndim if isinstance(stride, int) else tuple(stride))
+    pd = (padding,) * ndim if isinstance(padding, int) else tuple(padding)
+
+    def f(v, idx):
+        N, C = v.shape[0], v.shape[1]
+        in_sp = v.shape[2:]
+        if output_size is not None:
+            out_sp = tuple(output_size)[-ndim:]
+        else:
+            out_sp = tuple((in_sp[d] - 1) * st[d] - 2 * pd[d] + ks[d]
+                           for d in range(ndim))
+        total = 1
+        for s in out_sp:
+            total *= s
+        flat = jnp.zeros((N, C, total), v.dtype)
+        vi = v.reshape(N, C, -1)
+        ii = idx.reshape(N, C, -1).astype(jnp.int32)
+        flat = jax.vmap(jax.vmap(
+            lambda buf, j, val: buf.at[j].set(val)))(flat, ii, vi)
+        return flat.reshape((N, C) + out_sp)
+
+    return run_op("max_unpool", f, _ensure(x), _ensure(indices))
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    return _max_unpool(x, indices, 1, kernel_size, stride, padding,
+                       output_size, data_format)
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    return _max_unpool(x, indices, 2, kernel_size, stride, padding,
+                       output_size, data_format)
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    return _max_unpool(x, indices, 3, kernel_size, stride, padding,
+                       output_size, data_format)
+
+
+def gather_tree(ids, parents):
+    """Beam-search backtrace (``nn/decode.py`` gather_tree): ids/parents
+    [T, B, beam] -> full sequences followed backwards from the last step."""
+
+    def f(idv, par):
+        T = idv.shape[0]
+
+        def step(beams, t):
+            # beams: [B, beam] current beam slot per output path
+            tok = jnp.take_along_axis(idv[t], beams, -1)
+            nxt = jnp.take_along_axis(par[t], beams, -1)
+            return nxt, tok
+
+        init = jnp.broadcast_to(
+            jnp.arange(idv.shape[2]), idv.shape[1:]).astype(idv.dtype)
+        _, toks = jax.lax.scan(step, init, jnp.arange(T - 1, -1, -1))
+        return toks[::-1]
+
+    return run_op("gather_tree", f, _ensure(ids), _ensure(parents))
+
+
+def edit_distance(input, label, normalized=True, ignored_tokens=None,
+                  input_length=None, label_length=None, name=None):
+    """Levenshtein distance per batch row over padded int sequences
+    (``nn/functional/loss.py`` edit_distance; host DP like the reference's
+    CPU kernel).  Returns (distance [B, 1], sequence_num [1])."""
+    a = np.asarray(_ensure(input)._value)
+    b = np.asarray(_ensure(label)._value)
+    la = (np.asarray(_ensure(input_length)._value) if input_length is not None
+          else np.full(a.shape[0], a.shape[1]))
+    lb = (np.asarray(_ensure(label_length)._value) if label_length is not None
+          else np.full(b.shape[0], b.shape[1]))
+    ignored = set(ignored_tokens or [])
+    out = np.zeros((a.shape[0], 1), np.float32)
+    for i in range(a.shape[0]):
+        s = [t for t in a[i, :la[i]].tolist() if t not in ignored]
+        t = [t for t in b[i, :lb[i]].tolist() if t not in ignored]
+        m, n = len(s), len(t)
+        dp = np.arange(n + 1, dtype=np.int64)
+        for r in range(1, m + 1):
+            prev = dp.copy()
+            dp[0] = r
+            for c in range(1, n + 1):
+                dp[c] = min(prev[c] + 1, dp[c - 1] + 1,
+                            prev[c - 1] + (s[r - 1] != t[c - 1]))
+        d = float(dp[n])
+        out[i, 0] = d / max(n, 1) if normalized else d
+    return to_tensor(out), to_tensor(np.array([a.shape[0]], np.int64))
+
+
+def get_triangle_upper_mask(x):
+    """Strictly-upper-triangle additive attention mask matching ``x``'s
+    trailing [.., S, S] (fused-transformer helper)."""
+
+    def f(v):
+        S = v.shape[-1]
+        mask = jnp.triu(jnp.ones((S, S), bool), k=1)
+        return jnp.where(mask, jnp.finfo(jnp.float32).min, 0.0).astype(v.dtype)
+
+    return run_op("triangle_upper_mask", f, _ensure(x))
+
+
+class sdp_kernel:
+    """Context manager selecting the scaled-dot-product backend
+    (``nn/functional/flash_attention.py`` sdp_kernel): maps onto the
+    pallas kill-switch flag."""
+
+    def __init__(self, enable_flash=True, enable_math=True,
+                 enable_mem_efficient=True):
+        self._disable = not enable_flash
+
+    def __enter__(self):
+        from ...core import flags
+
+        self._saved = flags.flag("disable_pallas_kernels")
+        if self._disable:
+            flags.set_flags({"disable_pallas_kernels": True})
+        return self
+
+    def __exit__(self, *exc):
+        from ...core import flags
+
+        flags.set_flags({"disable_pallas_kernels": self._saved})
+        return False

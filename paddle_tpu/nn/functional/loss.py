@@ -358,3 +358,249 @@ def npair_loss(anchor, positive, labels, l2_reg=0.002):
         return jnp.mean(xent) + reg
 
     return run_op("npair_loss", f, _ensure(anchor), _ensure(positive), _ensure(labels))
+
+
+def base_softmax_with_cross_entropy(logits, label, soft_label=False,
+                                    ignore_index=-100, numeric_stable_mode=True,
+                                    return_softmax=False, axis=-1):
+    return softmax_with_cross_entropy(
+        logits, label, soft_label=soft_label, ignore_index=ignore_index,
+        return_softmax=return_softmax, axis=axis)
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction="mean", name=None):
+    """Multi-class margin loss (``nn/functional/loss.py`` multi_margin_loss):
+    mean_j max(0, margin - x_y + x_j)^p over j != y."""
+    w = weight._value if isinstance(weight, Tensor) else weight
+
+    def f(x, y):
+        C = x.shape[1]
+        y = y.reshape(-1).astype(jnp.int32)
+        xy = jnp.take_along_axis(x, y[:, None], 1)
+        hinge = jnp.maximum(0.0, margin - xy + x)
+        if p != 1:
+            hinge = hinge ** p
+        if w is not None:
+            hinge = hinge * jnp.asarray(w)[y][:, None]
+        hinge = hinge * (1 - jax.nn.one_hot(y, C, dtype=x.dtype))
+        per = jnp.sum(hinge, 1) / C
+        if reduction == "mean":
+            return jnp.mean(per)
+        if reduction == "sum":
+            return jnp.sum(per)
+        return per
+
+    return run_op("multi_margin_loss", f, _ensure(input), _ensure(label))
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean",
+                                      name=None):
+    """(loss.py triplet_margin_with_distance_loss) — user-supplied distance
+    function (defaults to pairwise L2)."""
+    a, pos, neg = _ensure(input), _ensure(positive), _ensure(negative)
+
+    def default_dist(u, v):
+        return ((u - v) ** 2).sum(-1).sqrt() if isinstance(u, Tensor) else \
+            jnp.sqrt(jnp.sum((u - v) ** 2, -1))
+
+    dist = distance_function or default_dist
+    dp = dist(a, pos)
+    dn = dist(a, neg)
+    if swap:
+        dpn = dist(pos, neg)
+        # through run_op so the tape differentiates the swapped branch
+        dn = run_op("triplet_swap_min", jnp.minimum,
+                    _ensure(dn), _ensure(dpn))
+
+    def f(dpv, dnv):
+        per = jnp.maximum(0.0, dpv - dnv + margin)
+        if reduction == "mean":
+            return jnp.mean(per)
+        if reduction == "sum":
+            return jnp.sum(per)
+        return per
+
+    return run_op("triplet_margin_with_distance_loss", f,
+                  _ensure(dp), _ensure(dn))
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean", name=None):
+    """ArcFace-family combined margin (loss.py margin_cross_entropy):
+    target logit cosθ -> cos(m1·θ + m2) − m3, all logits scaled.
+    Single-group TPU version (the reference's model-parallel split maps to
+    GSPMD sharding of the class dim)."""
+
+    def f(x, y):
+        y = y.reshape(-1).astype(jnp.int32)
+        cos_t = jnp.clip(jnp.take_along_axis(x, y[:, None], 1), -1.0, 1.0)
+        theta = jnp.arccos(cos_t)
+        target = jnp.cos(margin1 * theta + margin2) - margin3
+        onehot = jax.nn.one_hot(y, x.shape[1], dtype=x.dtype)
+        adjusted = (x * (1 - onehot) + target * onehot) * scale
+        logp = jax.nn.log_softmax(adjusted, -1)
+        per = -jnp.take_along_axis(logp, y[:, None], 1)[:, 0]
+        if reduction == "mean":
+            loss = jnp.mean(per)
+        elif reduction == "sum":
+            loss = jnp.sum(per)
+        else:
+            loss = per[:, None]
+        if return_softmax:
+            return loss, jnp.exp(logp)
+        return loss
+
+    return run_op("margin_cross_entropy", f, _ensure(logits), _ensure(label))
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Hierarchical sigmoid loss (loss.py hsigmoid_loss): default complete
+    binary tree over classes (path = binary code of the class, D =
+    ceil(log2(C)) levels, C−1 internal nodes), or custom path_table/
+    path_code.  Loss_i = Σ_levels softplus((1 − 2·code)·(w_node·x + b))."""
+    w = _ensure(weight)
+    b = _ensure(bias) if bias is not None else None
+
+    if path_table is None:
+        # 0-based heap: internal nodes 0..C-2, leaves C-1..2C-2 (exactly
+        # C-1 internal nodes — every path node has its own weight row, no
+        # aliasing for non-power-of-two C); children of i are 2i+1 / 2i+2
+        C = num_classes
+        D = max(1, int(np.ceil(np.log2(max(C, 2)))))
+        table = np.zeros((C, D), np.int32)
+        code = np.zeros((C, D), np.float32)
+        lens = np.zeros((C,), np.int32)
+        for c in range(C):
+            node = c + C - 1
+            path = []
+            while node > 0:
+                parent = (node - 1) // 2
+                path.append((parent, float(node == 2 * parent + 2)))
+                node = parent
+            path.reverse()
+            lens[c] = len(path)
+            for d, (nid, bit) in enumerate(path[:D]):
+                table[c, d] = nid
+                code[c, d] = bit
+        # levels beyond a short path repeat the last node with its code —
+        # softplus(z) - code*z summed twice is wrong, so mask instead
+        valid = np.arange(D)[None, :] < lens[:, None]
+    else:
+        table = np.asarray(_ensure(path_table)._value)
+        code = np.asarray(_ensure(path_code)._value).astype(np.float32)
+        valid = np.ones(table.shape, bool)
+
+    def f(x, y, wv, *maybe_b):
+        y = y.reshape(-1).astype(jnp.int32)
+        nodes = jnp.asarray(table)[y]            # [B, D]
+        codes = jnp.asarray(code)[y]             # [B, D]
+        vmask = jnp.asarray(valid)[y]            # [B, D]
+        wn = wv[nodes]                           # [B, D, F]
+        z = jnp.einsum("bdf,bf->bd", wn, x)
+        if maybe_b:
+            z = z + maybe_b[0][nodes].reshape(z.shape)
+        # BCE with target = code: softplus(z) - code*z
+        per = jnp.sum(jnp.where(vmask, jax.nn.softplus(z) - codes * z, 0.0),
+                      -1)
+        return jnp.mean(per)[None]
+
+    args = (_ensure(input), _ensure(label), w) + ((b,) if b is not None else ())
+    return run_op("hsigmoid_loss", f, *args)
+
+
+def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.001, reduction="mean", name=None):
+    """RNN-Transducer loss (loss.py rnnt_loss; the reference binds
+    warprnnt): exact log-domain alpha recursion over the (T, U) lattice as
+    a ``lax.scan`` over time with a prefix scan along U — pure XLA, no
+    vendored kernel."""
+
+    def f(logits, labels):
+        # logits [B, T, U+1, V] log-probs are computed here; labels [B, U]
+        B, T, U1, V = logits.shape
+        U = U1 - 1
+        logp = jax.nn.log_softmax(logits, -1)
+        lab = labels.astype(jnp.int32)
+        blank_lp = logp[..., blank]                       # [B, T, U+1]
+        emit_lp = jnp.take_along_axis(
+            logp[:, :, :U, :], lab[:, None, :, None], -1)[..., 0]  # [B, T, U]
+        tin = jnp.asarray(_ensure(input_lengths)._value).astype(jnp.int32)
+        uin = jnp.asarray(_ensure(label_lengths)._value).astype(jnp.int32)
+
+        neg_inf = jnp.float32(-1e30)
+
+        def time_step(alpha_prev, t):
+            # horizontal move (blank from t-1, same u)
+            horiz = alpha_prev + blank_lp[:, t - 1, :]
+
+            # vertical moves within this t: sequential prefix along U
+            def u_step(carry, u):
+                # carry: alpha[t, u-1]
+                val = jnp.logaddexp(horiz[:, u], carry + emit_lp[:, t, u - 1])
+                return val, val
+
+            first = horiz[:, 0]
+            _, rest = jax.lax.scan(
+                u_step, first, jnp.arange(1, U1))
+            alpha_t = jnp.concatenate([first[:, None], rest.T], 1)
+            valid = t < tin[:, None]
+            return jnp.where(valid, alpha_t, alpha_prev), None
+
+        # t = 0 row: only vertical emits
+        def u0_step(carry, u):
+            val = carry + emit_lp[:, 0, u - 1]
+            return val, val
+
+        a00 = jnp.zeros((B,), jnp.float32)
+        _, rest0 = jax.lax.scan(u0_step, a00, jnp.arange(1, U1))
+        alpha0 = jnp.concatenate([a00[:, None], rest0.T], 1)
+        alpha0 = jnp.where(jnp.arange(U1)[None, :] <= uin[:, None],
+                           alpha0, neg_inf)
+
+        alpha_T, _ = jax.lax.scan(time_step, alpha0, jnp.arange(1, T))
+        # final: alpha[T-1, U] + blank at (T-1, U), per-sequence lengths
+        idxT = jnp.clip(tin - 1, 0, T - 1)
+        final_alpha = jnp.take_along_axis(alpha_T, uin[:, None], 1)[:, 0]
+        final_blank = blank_lp[jnp.arange(B), idxT, uin]
+        nll = -(final_alpha + final_blank)
+        if reduction == "mean":
+            return jnp.mean(nll)
+        if reduction == "sum":
+            return jnp.sum(nll)
+        return nll
+
+    return run_op("rnnt_loss", f, _ensure(input), _ensure(label))
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    """Partial-FC class-center sampling (``nn/functional/common.py``
+    class_center_sample): keep all positive classes + uniformly sampled
+    negatives up to ``num_samples``; returns (remapped_label,
+    sampled_class_index)."""
+    from ...core import random as rng_mod
+
+    y = np.asarray(_ensure(label)._value).reshape(-1).astype(np.int64)
+    pos = np.unique(y)
+    need = max(0, num_samples - len(pos))
+    rest = np.setdiff1d(np.arange(num_classes, dtype=np.int64), pos,
+                        assume_unique=False)
+    if need > 0 and len(rest) > 0:
+        key = rng_mod.next_key()
+        import jax.random as jrand
+
+        perm = np.asarray(jrand.permutation(key, len(rest)))[:need]
+        sampled = np.concatenate([pos, rest[perm]])
+    else:
+        # positives are ALWAYS kept, even past num_samples (the contract;
+        # the result may then exceed num_samples, as in the reference)
+        sampled = pos
+    sampled = np.sort(sampled)
+    remap = -np.ones(num_classes, np.int64)
+    remap[sampled] = np.arange(len(sampled))
+    return to_tensor(remap[y]), to_tensor(sampled)

@@ -1,0 +1,131 @@
+"""Spatial-transformer / video functional ops
+(``python/paddle/nn/functional/vision.py``: affine_grid, grid_sample,
+temporal_shift — the reference's cuDNN spatial-transformer kernels map to
+pure gather/interpolation math that XLA fuses)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import run_op
+from ...core.tensor import Tensor, to_tensor
+
+
+def _ensure(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """theta [N, 2, 3] → sampling grid [N, H, W, 2] (vision.py affine_grid,
+    2-D case; the 3-D [N, 3, 4] variant returns [N, D, H, W, 3])."""
+    if isinstance(out_shape, Tensor):
+        out_shape = [int(v) for v in out_shape.numpy()]
+    out_shape = [int(v) for v in out_shape]
+
+    def f(th):
+        def lin(n):
+            if align_corners:
+                return jnp.linspace(-1.0, 1.0, n)
+            half = 1.0 - 1.0 / n
+            return jnp.linspace(-half, half, n)
+
+        if th.shape[-2:] == (2, 3):
+            N, _, H, W = out_shape
+            ys, xs = jnp.meshgrid(lin(H), lin(W), indexing="ij")
+            base = jnp.stack([xs, ys, jnp.ones_like(xs)], -1)  # [H, W, 3]
+            return jnp.einsum("hwk,njk->nhwj", base, th)
+        N, _, D, H, W = out_shape
+        zs, ys, xs = jnp.meshgrid(lin(D), lin(H), lin(W), indexing="ij")
+        base = jnp.stack([xs, ys, zs, jnp.ones_like(xs)], -1)
+        return jnp.einsum("dhwk,njk->ndhwj", base, th)
+
+    return run_op("affine_grid", f, _ensure(theta))
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """Sample NCHW ``x`` at normalized grid coords [N, Hg, Wg, 2]
+    (vision.py grid_sample)."""
+
+    def f(v, g):
+        N, C, H, W = v.shape
+
+        def unnorm(coord, size):
+            if align_corners:
+                return (coord + 1.0) * (size - 1) / 2.0
+            return ((coord + 1.0) * size - 1.0) / 2.0
+
+        gx = unnorm(g[..., 0], W)
+        gy = unnorm(g[..., 1], H)
+        if padding_mode == "border":
+            gx = jnp.clip(gx, 0, W - 1)
+            gy = jnp.clip(gy, 0, H - 1)
+        elif padding_mode == "reflection":
+            def reflect(c, size):
+                if align_corners:
+                    span = 2.0 * (size - 1)
+                    c = jnp.abs(jnp.mod(c, span))
+                    return jnp.where(c > size - 1, span - c, c)
+                span = 2.0 * size
+                c = jnp.mod(c + 0.5, span)
+                c = jnp.abs(c) - 0.5
+                return jnp.clip(jnp.where(c > size - 1, 2 * size - 1.5 - c - 0.5, c), 0, size - 1)
+
+            gx = reflect(gx, W)
+            gy = reflect(gy, H)
+
+        if mode == "nearest":
+            ix = jnp.clip(jnp.round(gx), 0, W - 1).astype(jnp.int32)
+            iy = jnp.clip(jnp.round(gy), 0, H - 1).astype(jnp.int32)
+            out = v[jnp.arange(N)[:, None, None], :, iy, ix]
+            return jnp.moveaxis(out, -1, 1)
+
+        x0 = jnp.floor(gx)
+        y0 = jnp.floor(gy)
+        wx = gx - x0
+        wy = gy - y0
+
+        def tap(ix, iy):
+            inb = ((ix >= 0) & (ix <= W - 1) & (iy >= 0) & (iy <= H - 1))
+            ci = jnp.clip(ix, 0, W - 1).astype(jnp.int32)
+            cy = jnp.clip(iy, 0, H - 1).astype(jnp.int32)
+            val = v[jnp.arange(N)[:, None, None], :, cy, ci]  # [N,Hg,Wg,C]
+            if padding_mode == "zeros":
+                val = jnp.where(inb[..., None], val, 0.0)
+            return val
+
+        out = (tap(x0, y0) * ((1 - wx) * (1 - wy))[..., None]
+               + tap(x0 + 1, y0) * (wx * (1 - wy))[..., None]
+               + tap(x0, y0 + 1) * ((1 - wx) * wy)[..., None]
+               + tap(x0 + 1, y0 + 1) * (wx * wy)[..., None])
+        return jnp.moveaxis(out, -1, 1)
+
+    return run_op("grid_sample", f, _ensure(x), _ensure(grid))
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
+                   name=None):
+    """TSM channel shift across the time dimension (vision.py
+    temporal_shift): the first ``shift_ratio`` of channels shift t-1, the
+    next ``shift_ratio`` shift t+1, the rest stay."""
+
+    def f(v):
+        if data_format == "NHWC":
+            v = jnp.transpose(v, (0, 3, 1, 2))
+        NT, C, H, W = v.shape
+        N = NT // seg_num
+        v5 = v.reshape(N, seg_num, C, H, W)
+        c1 = int(C * shift_ratio)
+        c2 = int(C * 2 * shift_ratio)
+        back = jnp.concatenate(
+            [v5[:, 1:, :c1], jnp.zeros_like(v5[:, :1, :c1])], 1)
+        fwd = jnp.concatenate(
+            [jnp.zeros_like(v5[:, :1, c1:c2]), v5[:, :-1, c1:c2]], 1)
+        out = jnp.concatenate([back, fwd, v5[:, :, c2:]], 2)
+        out = out.reshape(NT, C, H, W)
+        if data_format == "NHWC":
+            out = jnp.transpose(out, (0, 2, 3, 1))
+        return out
+
+    return run_op("temporal_shift", f, _ensure(x))

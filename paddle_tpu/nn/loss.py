@@ -177,3 +177,63 @@ class GaussianNLLLoss(Layer):
 
     def forward(self, input, label, variance):
         return F.gaussian_nll_loss(input, label, variance, self.full, self.epsilon, self.reduction)
+
+
+class MultiMarginLoss(Layer):
+    def __init__(self, p=1, margin=1.0, weight=None, reduction="mean",
+                 name=None):
+        super().__init__()
+        self._cfg = dict(p=p, margin=margin, weight=weight,
+                         reduction=reduction)
+
+    def forward(self, input, label):
+        return F.multi_margin_loss(input, label, **self._cfg)
+
+
+class TripletMarginWithDistanceLoss(Layer):
+    def __init__(self, distance_function=None, margin=1.0, swap=False,
+                 reduction="mean", name=None):
+        super().__init__()
+        self._cfg = dict(distance_function=distance_function, margin=margin,
+                         swap=swap, reduction=reduction)
+
+    def forward(self, input, positive, negative):
+        return F.triplet_margin_with_distance_loss(
+            input, positive, negative, **self._cfg)
+
+
+class RNNTLoss(Layer):
+    def __init__(self, blank=0, fastemit_lambda=0.001, reduction="mean",
+                 name=None):
+        super().__init__()
+        self._cfg = dict(blank=blank, fastemit_lambda=fastemit_lambda,
+                         reduction=reduction)
+
+    def forward(self, input, label, input_lengths, label_lengths):
+        return F.rnnt_loss(input, label, input_lengths, label_lengths,
+                           **self._cfg)
+
+
+class HSigmoidLoss(Layer):
+    """(``nn/layer/loss.py`` HSigmoidLoss) — owns the [C-1, F] node weight
+    (+ optional bias) of the class tree."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False, name=None):
+        super().__init__()
+        import numpy as np
+
+        from ..core.tensor import Parameter
+
+        self._num_classes = num_classes
+        scale = 1.0 / np.sqrt(feature_size)
+        rng = np.random.default_rng(0)
+        self.weight = Parameter(rng.uniform(
+            -scale, scale, (num_classes - 1, feature_size)).astype("float32"))
+        self.bias = (None if bias_attr is False else Parameter(
+            np.zeros((num_classes - 1,), "float32")))
+
+    def forward(self, input, label, path_table=None, path_code=None):
+        return F.hsigmoid_loss(input, label, self._num_classes, self.weight,
+                               bias=self.bias, path_table=path_table,
+                               path_code=path_code)

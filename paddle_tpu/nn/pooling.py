@@ -131,3 +131,26 @@ class LPPool2D(Layer):
 
     def forward(self, x):
         return F.lp_pool2d(x, *self.args)
+
+
+class _MaxUnPool(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format=None, output_size=None, name=None):
+        super().__init__()
+        self._cfg = dict(kernel_size=kernel_size, stride=stride,
+                         padding=padding, output_size=output_size)
+
+
+class MaxUnPool1D(_MaxUnPool):
+    def forward(self, x, indices):
+        return F.max_unpool1d(x, indices, **self._cfg)
+
+
+class MaxUnPool2D(_MaxUnPool):
+    def forward(self, x, indices):
+        return F.max_unpool2d(x, indices, **self._cfg)
+
+
+class MaxUnPool3D(_MaxUnPool):
+    def forward(self, x, indices):
+        return F.max_unpool3d(x, indices, **self._cfg)

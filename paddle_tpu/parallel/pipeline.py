@@ -214,6 +214,19 @@ def pipeline_forward(layer: PipelineLayer, x: Tensor, n_microbatch: int,
 
     v = layer.num_virtual_stages
     stage_layers = [layer.get_stage_layers(s) for s in range(layer.num_stages)]
+    homo = layer.__dict__.get("_stages_homo_cache")
+    if homo is None:
+        # invariant of the partition — computed once, not per train step
+        homo = _stages_homogeneous(stage_layers)
+        layer.__dict__["_stages_homo_cache"] = homo
+    if not homo:
+        # Heterogeneous stacks (the reference's arbitrary LayerDesc case,
+        # ``pp_layers.py:261``): the stacked-params SPMD ring needs one
+        # param structure per stage, so run the microbatched schedule with
+        # each stage's own layers instead — under ``to_static`` this still
+        # stages to ONE XLA program (stages keep their GSPMD placements);
+        # the SPMD ring remains the fast path for homogeneous stacks.
+        return _pipeline_forward_hetero(stage_layers, x, n_microbatch)
     if v > 1:
         # run v chained sweeps: sweep r uses segments [r*n, (r+1)*n)
         out = x
@@ -222,6 +235,37 @@ def pipeline_forward(layer: PipelineLayer, x: Tensor, n_microbatch: int,
             out = _pipeline_forward_ring(round_layers, out, n_microbatch, extra)
         return out
     return _pipeline_forward_ring(stage_layers, x, n_microbatch, extra)
+
+
+def _stage_signature(ls):
+    return tuple(
+        (type(l).__name__, tuple(tuple(p.shape)
+                                 for _, p in l.named_parameters()))
+        for l in ls)
+
+
+def _stages_homogeneous(stage_layers) -> bool:
+    sig0 = _stage_signature(stage_layers[0])
+    return all(_stage_signature(ls) == sig0 for ls in stage_layers[1:])
+
+
+def _pipeline_forward_hetero(stage_layers, x: Tensor,
+                             n_microbatch: int) -> Tensor:
+    """Microbatched schedule over per-stage heterogeneous layers; grads flow
+    through the ordinary tape."""
+    from ..tensor.manipulation import concat
+
+    B = x.shape[0]
+    assert B % n_microbatch == 0, (B, n_microbatch)
+    mb = B // n_microbatch
+    outs = []
+    for m in range(n_microbatch):
+        cur = x[m * mb:(m + 1) * mb]
+        for ls in stage_layers:
+            for l in ls:
+                cur = l(cur)
+        outs.append(cur)
+    return concat(outs, axis=0)
 
 
 def _pipeline_forward_ring(stage_layers, x: Tensor, n_microbatch: int,

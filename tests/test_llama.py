@@ -147,6 +147,7 @@ class TestLlamaParallel:
         np.testing.assert_allclose(logits.numpy(), ref.numpy(),
                                    rtol=2e-4, atol=2e-4)
 
+    @pytest.mark.slow
     def test_hybrid_train_step_loss_decreases(self, hybrid_mesh):
         cfg = LlamaConfig.tiny(sequence_parallel=True)
         paddle.seed(0)

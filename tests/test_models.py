@@ -116,6 +116,7 @@ class TestMoELlama:
         missing = [n for n, p in m.named_parameters() if p.grad is None]
         assert missing == []
 
+    @pytest.mark.slow
     def test_ep_train_step_loss_decreases(self, ep_mesh):
         cfg = LlamaConfig.tiny_moe()
         paddle.seed(0)

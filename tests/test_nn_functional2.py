@@ -1,0 +1,319 @@
+"""Round-2 nn.functional additions, checked against torch (CPU, baked-in)
+and brute-force references: spatial transformer ops, unpooling, the
+margin-loss family, hierarchical sigmoid, RNN-T loss, varlen + sparse
+attention, and beam-search/edit-distance utilities."""
+
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.nn import functional as F
+
+torch = pytest.importorskip("torch")
+
+
+def _r(*shape, seed=0):
+    return np.random.default_rng(seed).standard_normal(shape).astype("float32")
+
+
+class TestSpatialTransformer:
+    def test_affine_grid_and_sample_vs_torch(self):
+        x = _r(2, 3, 5, 5, seed=0)
+        theta = np.tile(np.array(
+            [[[0.8, 0.1, 0.05], [0.0, 0.9, -0.1]]], "float32"), (2, 1, 1))
+        grid = F.affine_grid(paddle.to_tensor(theta), [2, 3, 4, 4])
+        tgrid = torch.nn.functional.affine_grid(
+            torch.tensor(theta), (2, 3, 4, 4), align_corners=True)
+        np.testing.assert_allclose(grid.numpy(), tgrid.numpy(), atol=1e-5)
+        out = F.grid_sample(paddle.to_tensor(x), grid)
+        tout = torch.nn.functional.grid_sample(
+            torch.tensor(x), tgrid, align_corners=True)
+        np.testing.assert_allclose(out.numpy(), tout.numpy(), atol=1e-5)
+
+    @pytest.mark.parametrize("mode,pad", [("nearest", "zeros"),
+                                          ("bilinear", "border")])
+    def test_grid_sample_modes(self, mode, pad):
+        x = _r(1, 2, 4, 4, seed=1)
+        grid = np.random.default_rng(2).uniform(
+            -1.3, 1.3, (1, 3, 3, 2)).astype("float32")
+        out = F.grid_sample(paddle.to_tensor(x), paddle.to_tensor(grid),
+                            mode=mode, padding_mode=pad)
+        tout = torch.nn.functional.grid_sample(
+            torch.tensor(x), torch.tensor(grid), mode=mode,
+            padding_mode=pad, align_corners=True)
+        np.testing.assert_allclose(out.numpy(), tout.numpy(), atol=1e-5)
+
+    def test_temporal_shift(self):
+        x = _r(4, 8, 2, 2, seed=3)  # N*T=4 with seg_num 2
+        out = F.temporal_shift(paddle.to_tensor(x), seg_num=2,
+                               shift_ratio=0.25).numpy()
+        v = x.reshape(2, 2, 8, 2, 2)
+        # first quarter shifted backward in time
+        np.testing.assert_allclose(out.reshape(2, 2, 8, 2, 2)[:, 0, :2],
+                                   v[:, 1, :2])
+        # second quarter shifted forward
+        np.testing.assert_allclose(out.reshape(2, 2, 8, 2, 2)[:, 1, 2:4],
+                                   v[:, 0, 2:4])
+        # rest unchanged
+        np.testing.assert_allclose(out.reshape(2, 2, 8, 2, 2)[:, :, 4:],
+                                   v[:, :, 4:])
+
+
+class TestUnpool:
+    def test_unpool2d_inverts_pool(self):
+        x = paddle.to_tensor(_r(2, 3, 6, 6, seed=4))
+        pooled, mask = F.max_pool2d(x, 2, return_mask=True)
+        un = F.max_unpool2d(pooled, mask, 2)
+        assert un.shape == [2, 3, 6, 6]
+        # every pooled max lands back at its argmax position
+        assert np.allclose(un.numpy().max(), pooled.numpy().max())
+        np.testing.assert_allclose(np.sort(un.numpy()[un.numpy() != 0]),
+                                   np.sort(pooled.numpy().ravel()))
+
+    def test_unpool_layers(self):
+        x = paddle.to_tensor(_r(1, 2, 4, 4, seed=5))
+        pooled, mask = F.max_pool2d(x, 2, return_mask=True)
+        out = nn.MaxUnPool2D(2)(pooled, mask)
+        assert out.shape == [1, 2, 4, 4]
+
+
+class TestMarginLosses:
+    def test_multi_margin_vs_torch(self):
+        logits = _r(4, 6, seed=6)
+        y = np.array([0, 2, 5, 1])
+        for p, margin in [(1, 1.0), (2, 0.5)]:
+            got = float(F.multi_margin_loss(
+                paddle.to_tensor(logits), paddle.to_tensor(y),
+                p=p, margin=margin).numpy())
+            ref = float(torch.nn.functional.multi_margin_loss(
+                torch.tensor(logits), torch.tensor(y), p=p, margin=margin))
+            np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+    def test_triplet_with_distance_vs_torch(self):
+        a, pos, neg = _r(3, 8, seed=7), _r(3, 8, seed=8), _r(3, 8, seed=9)
+        got = float(F.triplet_margin_with_distance_loss(
+            paddle.to_tensor(a), paddle.to_tensor(pos),
+            paddle.to_tensor(neg)).numpy())
+        ref = float(torch.nn.functional.triplet_margin_with_distance_loss(
+            torch.tensor(a), torch.tensor(pos), torch.tensor(neg)))
+        np.testing.assert_allclose(got, ref, rtol=1e-4)
+
+    def test_margin_cross_entropy_reduces_to_scaled_ce(self):
+        # with no margins, must equal plain CE on scaled logits
+        logits = np.clip(_r(4, 5, seed=10), -0.9, 0.9)
+        y = np.array([1, 0, 4, 2])
+        got = float(F.margin_cross_entropy(
+            paddle.to_tensor(logits), paddle.to_tensor(y),
+            margin1=1.0, margin2=0.0, margin3=0.0, scale=10.0).numpy())
+        t = torch.tensor(logits) * 10.0
+        ref = float(torch.nn.functional.cross_entropy(t, torch.tensor(y)))
+        np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+    def test_hsigmoid_loss_runs_and_descends(self):
+        paddle.seed(0)
+        layer = nn.HSigmoidLoss(feature_size=8, num_classes=6)
+        x = paddle.to_tensor(_r(4, 8, seed=11))
+        y = paddle.to_tensor(np.array([0, 1, 2, 5]))
+        loss = layer(x, y)
+        assert np.isfinite(loss.numpy().ravel()[0])
+        loss.backward()
+        assert layer.weight.grad is not None
+
+
+class TestRNNTLoss:
+    def test_matches_brute_force(self):
+        B, T, U, V = 1, 3, 2, 4
+        logits = _r(B, T, U + 1, V, seed=12)
+        labels = np.array([[1, 2]], np.int64)
+        logp = logits - np.log(np.exp(logits).sum(-1, keepdims=True))
+
+        import itertools
+
+        def score(path):
+            t = u = s = 0
+            s = 0.0
+            for mv in path:
+                if mv == "b":
+                    s += logp[0, t, u, 0]
+                    t += 1
+                else:
+                    s += logp[0, t, u, labels[0, u]]
+                    u += 1
+            return s + logp[0, T - 1, U, 0]
+
+        paths = set(itertools.permutations("b" * (T - 1) + "e" * U))
+        m = max(score(p) for p in paths)
+        ref_nll = -(m + math.log(sum(math.exp(score(p) - m) for p in paths)))
+        got = float(F.rnnt_loss(
+            paddle.to_tensor(logits), paddle.to_tensor(labels),
+            paddle.to_tensor(np.array([T], np.int64)),
+            paddle.to_tensor(np.array([U], np.int64)),
+            reduction="none").numpy().ravel()[0])
+        np.testing.assert_allclose(got, ref_nll, rtol=1e-4)
+
+    def test_variable_lengths_batched(self):
+        B, T, U, V = 2, 4, 3, 5
+        logits = _r(B, T, U + 1, V, seed=13)
+        labels = np.array([[1, 2, 3], [2, 1, 0]], np.int64)
+        tin = np.array([4, 3], np.int64)
+        uin = np.array([3, 2], np.int64)
+        out = F.rnnt_loss(paddle.to_tensor(logits), paddle.to_tensor(labels),
+                          paddle.to_tensor(tin), paddle.to_tensor(uin),
+                          reduction="none").numpy()
+        # row 1 must equal the same sequence computed alone (padding-proof)
+        solo = F.rnnt_loss(
+            paddle.to_tensor(logits[1:, :3, :3]),
+            paddle.to_tensor(labels[1:, :2]),
+            paddle.to_tensor(np.array([3], np.int64)),
+            paddle.to_tensor(np.array([2], np.int64)),
+            reduction="none").numpy()
+        np.testing.assert_allclose(out[1], solo[0], rtol=1e-4)
+        assert nn.RNNTLoss()(paddle.to_tensor(logits), paddle.to_tensor(labels),
+                             paddle.to_tensor(tin),
+                             paddle.to_tensor(uin)).numpy().shape == ()
+
+
+class TestVarlenAndSparseAttention:
+    def test_flash_attn_unpadded_blocks_cross_sequence(self):
+        H, D = 2, 4
+        q = _r(5, H, D, seed=14)  # two sequences: lens 2 + 3
+        cu = np.array([0, 2, 5], np.int64)
+        out = F.flash_attn_unpadded(
+            paddle.to_tensor(q), paddle.to_tensor(q), paddle.to_tensor(q),
+            paddle.to_tensor(cu), paddle.to_tensor(cu), 3, 3).numpy()
+
+        def dense(seg):
+            s = np.einsum("qhd,khd->hqk", q[seg], q[seg]) / math.sqrt(D)
+            p = np.exp(s - s.max(-1, keepdims=True))
+            p /= p.sum(-1, keepdims=True)
+            return np.einsum("hqk,khd->qhd", p, q[seg])
+
+        np.testing.assert_allclose(out[:2], dense(slice(0, 2)), atol=1e-5)
+        np.testing.assert_allclose(out[2:], dense(slice(2, 5)), atol=1e-5)
+
+    def test_sparse_attention_full_pattern(self):
+        B, H, L, D = 1, 1, 4, 8
+        q, k, v = (_r(B, H, L, D, seed=s) for s in (15, 16, 17))
+        crows = np.tile(np.arange(L + 1) * L, (B * H, 1))
+        cols = np.tile(np.tile(np.arange(L), L), (B * H, 1))
+        out = F.sparse_attention(
+            paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+            paddle.to_tensor(crows), paddle.to_tensor(cols)).numpy()
+        s = np.einsum("bhld,bhmd->bhlm", q, k) / math.sqrt(D)
+        p = np.exp(s - s.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        ref = np.einsum("bhlm,bhmd->bhld", p, v)
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+class TestDecodeUtilities:
+    def test_gather_tree_backtrace(self):
+        # T=3, B=1, beam=2
+        ids = np.array([[[1, 2]], [[3, 4]], [[5, 6]]], np.int64)
+        parents = np.array([[[0, 0]], [[0, 0]], [[1, 0]]], np.int64)
+        out = F.gather_tree(paddle.to_tensor(ids),
+                            paddle.to_tensor(parents)).numpy()
+        # beam 0 at t=2 came from parent 1 at t=1 (token 4), which came
+        # from parent 0 at t=0 (token 1)
+        np.testing.assert_array_equal(out[:, 0, 0], [1, 4, 5])
+        np.testing.assert_array_equal(out[:, 0, 1], [1, 3, 6])
+
+    def test_edit_distance_normalized(self):
+        d, n = F.edit_distance(
+            paddle.to_tensor(np.array([[1, 2, 3, 0]], np.int64)),
+            paddle.to_tensor(np.array([[1, 3, 3, 9]], np.int64)),
+            normalized=False,
+            input_length=paddle.to_tensor(np.array([3], np.int64)),
+            label_length=paddle.to_tensor(np.array([3], np.int64)))
+        assert float(d.numpy().ravel()[0]) == 1.0
+        assert int(n.numpy()[0]) == 1
+
+    def test_class_center_sample(self):
+        paddle.seed(0)
+        y = paddle.to_tensor(np.array([3, 7, 3, 1], np.int64))
+        remapped, sampled = F.class_center_sample(y, num_classes=10,
+                                                  num_samples=6)
+        s = sampled.numpy()
+        assert len(s) == 6 and set([1, 3, 7]).issubset(set(s.tolist()))
+        r = remapped.numpy()
+        np.testing.assert_array_equal(s[r], y.numpy())  # remap consistent
+
+    def test_pdist_vs_scipy(self):
+        scipy_sp = pytest.importorskip("scipy.spatial.distance")
+        x = _r(6, 4, seed=18)
+        np.testing.assert_allclose(
+            F.pdist(paddle.to_tensor(x)).numpy(),
+            scipy_sp.pdist(x), atol=1e-5)
+
+    def test_sdp_kernel_context(self):
+        from paddle_tpu.ops import flash_attention as fa
+
+        with F.sdp_kernel(enable_flash=False):
+            assert not fa.use_flash((2, 256, 8, 128), None)
+        assert paddle.get_flags("disable_pallas_kernels")[
+            "disable_pallas_kernels"] is False
+
+
+class TestReviewFixes:
+    def test_triplet_swap_grads_flow(self):
+        a = paddle.to_tensor(_r(3, 8, seed=20))
+        p = paddle.to_tensor(_r(3, 8, seed=21))
+        n = paddle.to_tensor(_r(3, 8, seed=22))
+        for t in (a, p, n):
+            t.stop_gradient = False
+        loss = F.triplet_margin_with_distance_loss(a, p, n, swap=True,
+                                                   margin=10.0)
+        ref = float(torch.nn.functional.triplet_margin_with_distance_loss(
+            torch.tensor(a.numpy()), torch.tensor(p.numpy()),
+            torch.tensor(n.numpy()), swap=True, margin=10.0))
+        np.testing.assert_allclose(float(loss.numpy()), ref, rtol=1e-4)
+        loss.backward()
+        assert n.grad is not None and np.abs(n.grad.numpy()).sum() > 0
+
+    def test_hsigmoid_non_power_of_two_no_aliasing(self):
+        """num_classes=5 visits distinct weight rows per internal node and
+        the implied leaf distribution normalizes to 1."""
+        import itertools
+
+        import jax
+
+        paddle.seed(0)
+        C, D_feat = 5, 4
+        layer = nn.HSigmoidLoss(feature_size=D_feat, num_classes=C)
+        x = _r(1, D_feat, seed=23)
+        # P(c) = prod over path of sigmoid bits; must sum to 1 over classes
+        probs = []
+        for c in range(C):
+            loss = layer(paddle.to_tensor(x),
+                         paddle.to_tensor(np.array([c])))
+            probs.append(np.exp(-loss.numpy().ravel()[0]))
+        np.testing.assert_allclose(sum(probs), 1.0, rtol=1e-5)
+
+    def test_class_center_sample_keeps_all_positives(self):
+        y = paddle.to_tensor(np.arange(8, dtype=np.int64))  # 8 uniques
+        remapped, sampled = F.class_center_sample(y, num_classes=20,
+                                                  num_samples=4)
+        assert len(sampled.numpy()) == 8  # positives never dropped
+        assert (remapped.numpy() >= 0).all()
+
+    def test_llm_predictor_free_clears_done(self):
+        from paddle_tpu.inference import Config, LLMPredictor
+        from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+        paddle.seed(0)
+        m = LlamaForCausalLM(LlamaConfig.tiny())
+        cfg = Config()
+        cfg.enable_paged_kv(num_blocks=32, block_size=4)
+        cfg.set_max_batch_size(1)
+        pred = LLMPredictor(m, config=cfg)
+        assert pred.num_blocks == 32 and pred.block_size == 4
+        pred.generate(0, np.array([[5, 9]], np.int64), max_new_tokens=2)
+        assert pred._done == {} and pred._tables == {}
+        # chunked decode honors max_batch_size=1
+        pred.add_request(1, np.array([[5, 9]], np.int64))
+        pred.add_request(2, np.array([[7, 3]], np.int64))
+        out = pred.step([1, 2])
+        assert set(out) == {1, 2}

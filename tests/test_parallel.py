@@ -337,3 +337,28 @@ class TestInterleavedPipeline:
         x = paddle.to_tensor(np.random.randn(4, 4).astype("float32"))
         pl.pipeline_forward(pipe, x, n_microbatch=2).sum().backward()
         assert all(l.weight.grad is not None for l in layers)
+
+
+class TestHeterogeneousPipeline:
+    """Arbitrary per-stage stacks (the reference's LayerDesc flexibility,
+    pp_layers.py:261) — embedding-like, conv-ish and head stages mixed."""
+
+    def test_hetero_stages_forward_and_grads(self, mesh_pp4):
+        paddle.seed(0)
+        stages = [
+            nn.Linear(8, 32),              # widen
+            nn.Sequential(nn.Linear(32, 32), nn.ReLU()),
+            nn.Linear(32, 16),             # narrow
+            nn.Linear(16, 4),              # head
+        ]
+        pipe = pl.PipelineLayer(stages, num_stages=4)
+        x = paddle.to_tensor(np.random.randn(8, 8).astype("float32"))
+        out = pl.pipeline_forward(pipe, x, n_microbatch=2)
+        ref = x
+        for s in stages:
+            ref = s(ref)
+        np.testing.assert_allclose(out.numpy(), ref.numpy(),
+                                   rtol=2e-5, atol=2e-5)
+        out.sum().backward()
+        for s in (stages[0], stages[2], stages[3]):
+            assert s.weight.grad is not None

@@ -81,14 +81,6 @@ def flash_attention(query, key, value, dropout=0.0, causal=False, return_softmax
     return out, None
 
 
-def flash_attn_unpadded(q, k, v, cu_seqlens_q, cu_seqlens_k, max_seqlen_q, max_seqlen_k,
-                        scale, dropout=0.0, causal=False, return_softmax=False, name=None):
-    raise NotImplementedError(
-        "varlen flash attention: pack to dense [B,S,H,D] + mask; paged serving "
-        "uses paddle_tpu.ops.paged_attention"
-    )
-
-
 def sequence_mask(x, maxlen=None, dtype="int64", name=None):
     from ...core import dtype as dtype_mod
 
@@ -152,6 +144,12 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
                              <= jnp.asarray(pos_q)[:, None])
         s = jnp.where(allow[None], s, jnp.float32(-1e30))
         p = jax.nn.softmax(s, -1)
+        if dropout > 0.0:
+            from ...core import random as rng_mod
+
+            keep = jax.random.bernoulli(rng_mod.next_key(), 1.0 - dropout,
+                                        p.shape)
+            p = jnp.where(keep, p / (1.0 - dropout), 0.0)
         out = jnp.einsum("hqk,khd->qhd", p.astype(vv.dtype), vv)
         if return_softmax:
             return out, p

@@ -515,11 +515,20 @@ def hsigmoid_loss(input, label, num_classes, weight, bias=None,
 
 
 def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
-              fastemit_lambda=0.001, reduction="mean", name=None):
+              fastemit_lambda=0.0, reduction="mean", name=None):
     """RNN-Transducer loss (loss.py rnnt_loss; the reference binds
     warprnnt): exact log-domain alpha recursion over the (T, U) lattice as
     a ``lax.scan`` over time with a prefix scan along U — pure XLA, no
-    vendored kernel."""
+    vendored kernel.
+
+    FastEmit regularization is NOT implemented — a nonzero
+    ``fastemit_lambda`` raises rather than silently training a different
+    objective (the reference's warprnnt fork scales emit-branch gradients;
+    default here is 0.0 accordingly)."""
+    if fastemit_lambda:
+        raise NotImplementedError(
+            "fastemit_lambda != 0 is not supported; pass 0.0 (the warprnnt "
+            "FastEmit gradient scaling is not implemented)")
 
     def f(logits, labels):
         # logits [B, T, U+1, V] log-probs are computed here; labels [B, U]

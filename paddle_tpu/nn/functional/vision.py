@@ -64,21 +64,27 @@ def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
         elif padding_mode == "reflection":
             def reflect(c, size):
                 if align_corners:
+                    # reflect at 0 and size-1 (period 2·(size-1))
                     span = 2.0 * (size - 1)
                     c = jnp.abs(jnp.mod(c, span))
                     return jnp.where(c > size - 1, span - c, c)
-                span = 2.0 * size
-                c = jnp.mod(c + 0.5, span)
-                c = jnp.abs(c) - 0.5
-                return jnp.clip(jnp.where(c > size - 1, 2 * size - 1.5 - c - 0.5, c), 0, size - 1)
+                # reflect at -0.5 and size-0.5 (period 2·size)
+                m = jnp.mod(jnp.abs(c + 0.5), 2.0 * size)
+                m = jnp.where(m > size, 2.0 * size - m, m)
+                return jnp.clip(m - 0.5, 0, size - 1)
 
             gx = reflect(gx, W)
             gy = reflect(gy, H)
 
         if mode == "nearest":
-            ix = jnp.clip(jnp.round(gx), 0, W - 1).astype(jnp.int32)
-            iy = jnp.clip(jnp.round(gy), 0, H - 1).astype(jnp.int32)
-            out = v[jnp.arange(N)[:, None, None], :, iy, ix]
+            ix = jnp.round(gx)
+            iy = jnp.round(gy)
+            inb = ((ix >= 0) & (ix <= W - 1) & (iy >= 0) & (iy <= H - 1))
+            out = v[jnp.arange(N)[:, None, None], :,
+                    jnp.clip(iy, 0, H - 1).astype(jnp.int32),
+                    jnp.clip(ix, 0, W - 1).astype(jnp.int32)]
+            if padding_mode == "zeros":
+                out = jnp.where(inb[..., None], out, 0.0)
             return jnp.moveaxis(out, -1, 1)
 
         x0 = jnp.floor(gx)

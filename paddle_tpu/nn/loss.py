@@ -203,7 +203,7 @@ class TripletMarginWithDistanceLoss(Layer):
 
 
 class RNNTLoss(Layer):
-    def __init__(self, blank=0, fastemit_lambda=0.001, reduction="mean",
+    def __init__(self, blank=0, fastemit_lambda=0.0, reduction="mean",
                  name=None):
         super().__init__()
         self._cfg = dict(blank=blank, fastemit_lambda=fastemit_lambda,
@@ -223,15 +223,15 @@ class HSigmoidLoss(Layer):
         super().__init__()
         import numpy as np
 
-        from ..core.tensor import Parameter
+        from .initializer import Uniform
 
         self._num_classes = num_classes
         scale = 1.0 / np.sqrt(feature_size)
-        rng = np.random.default_rng(0)
-        self.weight = Parameter(rng.uniform(
-            -scale, scale, (num_classes - 1, feature_size)).astype("float32"))
-        self.bias = (None if bias_attr is False else Parameter(
-            np.zeros((num_classes - 1,), "float32")))
+        self.weight = self.create_parameter(
+            (num_classes - 1, feature_size), attr=weight_attr,
+            default_initializer=Uniform(-scale, scale))
+        self.bias = (None if bias_attr is False else self.create_parameter(
+            (num_classes - 1,), attr=bias_attr, is_bias=True))
 
     def forward(self, input, label, path_table=None, path_code=None):
         return F.hsigmoid_loss(input, label, self._num_classes, self.weight,

@@ -238,9 +238,12 @@ def pipeline_forward(layer: PipelineLayer, x: Tensor, n_microbatch: int,
 
 
 def _stage_signature(ls):
+    # full sublayer type structure, not just the top-level class — stages
+    # differing only in parameterless sublayers (ReLU vs Tanh inside a
+    # Sequential) must NOT be classified homogeneous
     return tuple(
-        (type(l).__name__, tuple(tuple(p.shape)
-                                 for _, p in l.named_parameters()))
+        (tuple(type(s).__name__ for s in l.sublayers(include_self=True)),
+         tuple(tuple(p.shape) for _, p in l.named_parameters()))
         for l in ls)
 
 

@@ -317,3 +317,69 @@ class TestReviewFixes:
         pred.add_request(2, np.array([[7, 3]], np.int64))
         out = pred.step([1, 2])
         assert set(out) == {1, 2}
+
+
+class TestReviewFixes2:
+    def test_grid_sample_nearest_zeros_oob(self):
+        x = np.ones((1, 1, 4, 4), "float32")
+        grid = np.array([[[[-1.8, 0.0], [0.0, 0.0]]]], "float32")
+        out = F.grid_sample(paddle.to_tensor(x), paddle.to_tensor(grid),
+                            mode="nearest", padding_mode="zeros")
+        tout = torch.nn.functional.grid_sample(
+            torch.tensor(x), torch.tensor(grid), mode="nearest",
+            padding_mode="zeros", align_corners=True)
+        np.testing.assert_allclose(out.numpy(), tout.numpy())
+        assert out.numpy()[0, 0, 0, 0] == 0.0  # oob -> zero, not border
+
+    def test_grid_sample_reflection_unaligned(self):
+        x = _r(1, 2, 4, 4, seed=30)
+        grid = np.random.default_rng(31).uniform(
+            -1.6, 1.6, (1, 3, 3, 2)).astype("float32")
+        out = F.grid_sample(paddle.to_tensor(x), paddle.to_tensor(grid),
+                            padding_mode="reflection", align_corners=False)
+        tout = torch.nn.functional.grid_sample(
+            torch.tensor(x), torch.tensor(grid), padding_mode="reflection",
+            align_corners=False)
+        np.testing.assert_allclose(out.numpy(), tout.numpy(), atol=1e-5)
+
+    def test_hetero_detection_sees_parameterless_sublayers(self):
+        from paddle_tpu.parallel.pipeline import _stages_homogeneous
+
+        a = [nn.Sequential(nn.Linear(4, 4), nn.ReLU())]
+        b = [nn.Sequential(nn.Linear(4, 4), nn.Tanh())]
+        assert not _stages_homogeneous([a, b])
+        c = [nn.Sequential(nn.Linear(4, 4), nn.ReLU())]
+        assert _stages_homogeneous([a, c])
+
+    def test_rnnt_fastemit_raises(self):
+        logits = paddle.to_tensor(_r(1, 2, 2, 3, seed=32))
+        with pytest.raises(NotImplementedError):
+            F.rnnt_loss(logits,
+                        paddle.to_tensor(np.array([[1]], np.int64)),
+                        paddle.to_tensor(np.array([2], np.int64)),
+                        paddle.to_tensor(np.array([1], np.int64)),
+                        fastemit_lambda=0.001)
+
+    def test_hsigmoid_seeded_init(self):
+        paddle.seed(1)
+        l1 = nn.HSigmoidLoss(8, 5)
+        paddle.seed(2)
+        l2 = nn.HSigmoidLoss(8, 5)
+        assert not np.allclose(l1.weight.numpy(), l2.weight.numpy())
+        paddle.seed(1)
+        l3 = nn.HSigmoidLoss(8, 5)
+        np.testing.assert_array_equal(l1.weight.numpy(), l3.weight.numpy())
+
+    def test_unpadded_dropout_applied(self):
+        paddle.seed(0)
+        q = _r(4, 2, 8, seed=33)
+        cu = np.array([0, 4], np.int64)
+        a = F.flash_attn_unpadded(
+            paddle.to_tensor(q), paddle.to_tensor(q), paddle.to_tensor(q),
+            paddle.to_tensor(cu), paddle.to_tensor(cu), 4, 4,
+            dropout=0.5).numpy()
+        b = F.flash_attn_unpadded(
+            paddle.to_tensor(q), paddle.to_tensor(q), paddle.to_tensor(q),
+            paddle.to_tensor(cu), paddle.to_tensor(cu), 4, 4,
+            dropout=0.0).numpy()
+        assert not np.allclose(a, b)

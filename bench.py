@@ -52,7 +52,7 @@ def _probe_tpu() -> bool:
     """Can a subprocess initialize the TPU backend within the timeout?"""
     code = "import jax; print('BACKEND=' + jax.default_backend())"
     backoffs = [5, 60, 120]  # the tunnel can need minutes to recover
-    for attempt in range(3):
+    for attempt in range(4):
         try:
             proc = subprocess.run(
                 [sys.executable, "-c", code], cwd=_HERE,
@@ -68,8 +68,8 @@ def _probe_tpu() -> bool:
                 f"[bench] probe attempt {attempt}: {proc.stderr[-500:]}\n")
         except subprocess.TimeoutExpired:
             sys.stderr.write(f"[bench] probe attempt {attempt}: timeout\n")
-        if attempt < 2:
-            time.sleep(backoffs[attempt])
+        if attempt < 3:
+            time.sleep(backoffs[min(attempt, len(backoffs) - 1)])
     return False
 
 

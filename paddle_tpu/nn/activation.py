@@ -225,3 +225,15 @@ class GLU(Layer):
 
     def forward(self, x):
         return F.glu(x, self.axis)
+
+
+class Softmax2D(Layer):
+    """Softmax over the channel dim of NCHW input (``nn/layer/activation.py``
+    Softmax2D)."""
+
+    def forward(self, x):
+        from ..core.dispatch import run_op
+
+        import jax
+
+        return run_op("softmax2d", lambda v: jax.nn.softmax(v, axis=-3), x)

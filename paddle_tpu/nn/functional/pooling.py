@@ -218,3 +218,117 @@ def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0, ceil_mode=False
                    ceil_mode, exclusive=False, name="lp_pool2d")
     k = _tup(kernel_size, 2)
     return run_op("lp_root", lambda v: (v * float(np.prod(k))) ** (1.0 / p), pooled)
+
+
+def _fractional_edges(n_in, n_out, u):
+    """Pseudo-random pooling boundaries (Graham, Fractional Max-Pooling):
+    alpha = n_in/n_out; edge_i = ceil(alpha*(i+u)) with edge_0 = 0 —
+    n_out regions covering [0, n_in)."""
+    alpha = n_in / n_out
+    edges = [0]
+    for i in range(1, n_out):
+        edges.append(min(n_in - 1, int(np.ceil(alpha * (i + u))) - int(np.ceil(alpha * u))))
+    edges.append(n_in)
+    return edges
+
+
+def fractional_max_pool2d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    """(``nn/functional/pooling.py`` fractional_max_pool2d) NCHW input;
+    variable-width regions from the fractional sequence, max per region.
+    Fixed-window ``kernel_size`` mode is not implemented — raises rather
+    than silently pooling different regions than the reference."""
+    from ...core import random as rng_mod
+
+    if kernel_size is not None:
+        raise NotImplementedError(
+            "fractional_max_pool2d: fixed kernel_size mode is not "
+            "implemented; use the default variable-region mode "
+            "(kernel_size=None)")
+    t = _ensure(x)
+    N, C, H, W = t._value.shape
+    oh, ow = ((output_size, output_size) if isinstance(output_size, int)
+              else tuple(output_size))
+    if random_u is None:
+        import jax.random as jrand
+
+        random_u = float(jrand.uniform(rng_mod.next_key(), ()))
+    he = _fractional_edges(H, oh, random_u)
+    we = _fractional_edges(W, ow, random_u)
+
+    def _regions():
+        for i in range(oh):
+            for j in range(ow):
+                yield (i, j, he[i], max(he[i] + 1, he[i + 1]),
+                       we[j], max(we[j] + 1, we[j + 1]))
+
+    def f(v):
+        rows = []
+        for i in range(oh):
+            cols = []
+            for j in range(ow):
+                region = v[:, :, he[i]:max(he[i] + 1, he[i + 1]),
+                           we[j]:max(we[j] + 1, we[j + 1])]
+                cols.append(jnp.max(region, axis=(2, 3)))
+            rows.append(jnp.stack(cols, -1))
+        return jnp.stack(rows, -2)
+
+    out = run_op("fractional_max_pool2d", f, t)
+    if return_mask:
+        # per-REGION argmax converted to flat H*W indices (paddle
+        # convention); a whole-image argmax would break on repeated values
+        def g(v):
+            cells = {}
+            for i, j, hs, he_, ws, we_ in _regions():
+                region = v[:, :, hs:he_, ws:we_]
+                a = jnp.argmax(region.reshape(N, C, -1), -1)
+                rw = we_ - ws
+                cells[(i, j)] = (a // rw + hs) * W + (a % rw + ws)
+            rows = [jnp.stack([cells[(i, j)] for j in range(ow)], -1)
+                    for i in range(oh)]
+            return jnp.stack(rows, -2).astype(jnp.int32)
+
+        mask = run_op("fractional_pool_mask", g, t)
+        return out, mask
+    return out
+
+
+def fractional_max_pool3d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    """NCDHW variant (variable-region mode; mask/fixed-kernel modes raise)."""
+    from ...core import random as rng_mod
+
+    if kernel_size is not None:
+        raise NotImplementedError(
+            "fractional_max_pool3d: fixed kernel_size mode is not implemented")
+    if return_mask:
+        raise NotImplementedError(
+            "fractional_max_pool3d: return_mask is not implemented")
+    t = _ensure(x)
+    N, C, D, H, W = t._value.shape
+    od, oh, ow = ((output_size,) * 3 if isinstance(output_size, int)
+                  else tuple(output_size))
+    if random_u is None:
+        import jax.random as jrand
+
+        random_u = float(jrand.uniform(rng_mod.next_key(), ()))
+    de = _fractional_edges(D, od, random_u)
+    he = _fractional_edges(H, oh, random_u)
+    we = _fractional_edges(W, ow, random_u)
+
+    def f(v):
+        slabs = []
+        for k in range(od):
+            rows = []
+            for i in range(oh):
+                cols = []
+                for j in range(ow):
+                    region = v[:, :, de[k]:max(de[k] + 1, de[k + 1]),
+                               he[i]:max(he[i] + 1, he[i + 1]),
+                               we[j]:max(we[j] + 1, we[j + 1])]
+                    cols.append(jnp.max(region, axis=(2, 3, 4)))
+                rows.append(jnp.stack(cols, -1))
+            slabs.append(jnp.stack(rows, -2))
+        return jnp.stack(slabs, -3)
+
+    return run_op("fractional_max_pool3d", f, t)

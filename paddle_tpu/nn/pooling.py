@@ -154,3 +154,25 @@ class MaxUnPool2D(_MaxUnPool):
 class MaxUnPool3D(_MaxUnPool):
     def forward(self, x, indices):
         return F.max_unpool3d(x, indices, **self._cfg)
+
+
+class FractionalMaxPool2D(Layer):
+    def __init__(self, output_size, kernel_size=None, random_u=None,
+                 return_mask=False, name=None):
+        super().__init__()
+        self._cfg = dict(output_size=output_size, kernel_size=kernel_size,
+                         random_u=random_u, return_mask=return_mask)
+
+    def forward(self, x):
+        return F.fractional_max_pool2d(x, **self._cfg)
+
+
+class FractionalMaxPool3D(Layer):
+    def __init__(self, output_size, kernel_size=None, random_u=None,
+                 return_mask=False, name=None):
+        super().__init__()
+        self._cfg = dict(output_size=output_size, kernel_size=kernel_size,
+                         random_u=random_u, return_mask=return_mask)
+
+    def forward(self, x):
+        return F.fractional_max_pool3d(x, **self._cfg)

@@ -237,3 +237,6 @@ class Scope:
 
 def global_scope():
     return Scope()
+
+
+from . import nn  # noqa: E402,F401  (static.nn control flow + sequence ops)

@@ -1,0 +1,317 @@
+"""``paddle.static.nn`` — control flow + sequence ops
+(``python/paddle/static/nn/control_flow.py``, ``sequence_lod.py``).
+
+TPU-first control flow: in eager mode the predicate is concrete, so
+``cond``/``case``/``while_loop`` dispatch in Python (fully differentiable
+through the tape — the reference's dygraph users write plain ``if``).
+Under a ``to_static``/jit trace the predicate is a tracer and the ops
+lower to ``lax.cond`` / ``lax.switch`` / ``lax.while_loop`` — compiled
+data-dependent control flow with static shapes, XLA's native form.
+
+Sequence ops use (data, length) padded batches — the LoD-tensor legacy
+layout maps to padded [B, T, ...] + per-row lengths on TPU (ragged shapes
+don't compile)."""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import run_op
+from ..core.tensor import Tensor, to_tensor
+
+
+def _ensure(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def _is_traced(t: Tensor) -> bool:
+    return isinstance(t._value, jax.core.Tracer)
+
+
+def _unwrap(tree):
+    return jax.tree.map(
+        lambda o: o._value if isinstance(o, Tensor) else o, tree,
+        is_leaf=lambda o: isinstance(o, Tensor))
+
+
+def _wrap(tree):
+    return jax.tree.map(
+        lambda v: Tensor(v) if isinstance(v, jax.Array) or isinstance(
+            v, jax.core.Tracer) else v, tree)
+
+
+# --------------------------------------------------------------------------
+# control flow (control_flow.py: cond:1436, case:942, switch_case:1065,
+# while_loop:687)
+# --------------------------------------------------------------------------
+
+def cond(pred, true_fn: Callable = None, false_fn: Callable = None,
+         name=None, return_names=None):
+    p = _ensure(pred)
+    if not _is_traced(p):
+        taken = bool(np.asarray(p._value).reshape(()))
+        return true_fn() if taken else false_fn()
+    out = jax.lax.cond(p._value.reshape(()).astype(bool),
+                       lambda: _unwrap(true_fn()),
+                       lambda: _unwrap(false_fn()))
+    return _wrap(out)
+
+
+def case(pred_fn_pairs: Sequence[Tuple], default: Callable = None,
+         name=None):
+    """First pair whose predicate holds wins (control_flow.py:942)."""
+    if default is None:
+        *pred_fn_pairs, last = pred_fn_pairs
+        default = last[1]
+    result = default
+    for pr, fn in reversed(list(pred_fn_pairs)):
+        result = (lambda pr=pr, fn=fn, rest=result:
+                  cond(pr, fn, rest if callable(rest) else (lambda: rest)))
+    return result() if callable(result) else result
+
+
+def switch_case(branch_index, branch_fns, default: Callable = None,
+                name=None):
+    """Integer-indexed branch dispatch (control_flow.py:1065).
+
+    ``branch_fns``: dict {index: fn} or list of (index, fn) or list of fns.
+    """
+    if isinstance(branch_fns, dict):
+        pairs = sorted(branch_fns.items())
+    elif branch_fns and isinstance(branch_fns[0], (tuple, list)):
+        pairs = sorted((int(i), f) for i, f in branch_fns)
+    else:
+        pairs = list(enumerate(branch_fns))
+    idx = _ensure(branch_index)
+    if default is None:
+        default = pairs[-1][1]
+    if not _is_traced(idx):
+        i = int(np.asarray(idx._value).reshape(()))
+        for k, fn in pairs:
+            if k == i:
+                return fn()
+        return default()
+    # dense branch table for lax.switch: map arbitrary keys to slots,
+    # unmatched indices take the default (last slot)
+    keys = jnp.asarray([k for k, _ in pairs])
+    slot = jnp.argmax(keys == idx._value.reshape(()).astype(keys.dtype))
+    matched = jnp.any(keys == idx._value.reshape(()).astype(keys.dtype))
+    slot = jnp.where(matched, slot, len(pairs))
+    fns = [lambda fn=fn: _unwrap(fn()) for _, fn in pairs]
+    fns.append(lambda: _unwrap(default()))
+    return _wrap(jax.lax.switch(slot, fns))
+
+
+def while_loop(cond_fn: Callable, body: Callable, loop_vars: List,
+               is_test=False, name=None):
+    """(control_flow.py:687) eager: Python loop (tape-differentiable);
+    traced: ``lax.while_loop`` (forward; XLA's native loop)."""
+    leaves = [v for v in jax.tree.leaves(
+        loop_vars, is_leaf=lambda o: isinstance(o, Tensor))
+        if isinstance(v, Tensor)]
+    traced = any(_is_traced(t) for t in leaves) or _is_traced(
+        _ensure(cond_fn(*loop_vars)))
+    if not traced:
+        vars_ = list(loop_vars)
+        while bool(np.asarray(_ensure(cond_fn(*vars_))._value).reshape(())):
+            out = body(*vars_)
+            vars_ = list(out) if isinstance(out, (list, tuple)) else [out]
+        return vars_
+
+    def c(raw):
+        return _ensure(cond_fn(*_wrap(raw)))._value.reshape(()).astype(bool)
+
+    def b(raw):
+        out = body(*_wrap(raw))
+        out = list(out) if isinstance(out, (list, tuple)) else [out]
+        return _unwrap(out)
+
+    out = jax.lax.while_loop(c, b, _unwrap(list(loop_vars)))
+    return _wrap(out)
+
+
+def Assert(cond_t, data=None, summarize=20, name=None):
+    """(control_flow.py:57) eager runtime assertion."""
+    c = _ensure(cond_t)
+    if _is_traced(c):
+        return  # compiled programs: checks run via debug_nans/checkify
+    if not bool(np.asarray(c._value).all()):
+        vals = [np.asarray(_ensure(d)._value).reshape(-1)[:summarize]
+                for d in (data or [])]
+        raise AssertionError(f"paddle.static.nn.Assert failed; data={vals}")
+
+
+def Print(input, first_n=-1, message=None, summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_layout=True,
+          print_tensor_lod=True, print_phase="both", name=None):
+    """(control_flow.py:2043) passthrough + host print (jax.debug.print
+    when traced, so it fires from compiled programs too)."""
+    t = _ensure(input)
+    if _is_traced(t):
+        jax.debug.print((message or "Print") + ": {x}", x=t._value)
+        return t
+    v = np.asarray(t._value).reshape(-1)[:summarize]
+    print(f"{message or 'Print'}: shape={list(t.shape)} values={v}")
+    return t
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """(static/nn/common.py py_func) host-callback op: ``func`` runs in
+    Python via ``jax.pure_callback`` under jit, directly in eager."""
+    xs = [_ensure(v) for v in (x if isinstance(x, (list, tuple)) else [x])]
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    shapes = [jax.ShapeDtypeStruct(tuple(o.shape), o._value.dtype)
+              for o in outs]
+
+    def raw(*vals):
+        res = func(*[np.asarray(v) for v in vals])
+        res = res if isinstance(res, (list, tuple)) else [res]
+        return tuple(np.asarray(r, dtype=s.dtype).reshape(s.shape)
+                     for r, s in zip(res, shapes))
+
+    def kernel(*vals):
+        if any(isinstance(v, jax.core.Tracer) for v in vals):
+            result = jax.pure_callback(
+                raw, tuple(shapes), *vals)
+        else:
+            result = raw(*vals)
+        return result if len(result) > 1 else result[0]
+
+    return run_op("py_func", kernel, *xs)
+
+
+# --------------------------------------------------------------------------
+# sequence ops over padded (data, length) batches (sequence_lod.py)
+# --------------------------------------------------------------------------
+
+def _length_mask(lengths, maxlen):
+    return jnp.arange(maxlen)[None, :] < lengths[:, None]
+
+
+def sequence_softmax(x, length, name=None):
+    """Per-row softmax over the valid prefix ([B, T] padded)."""
+
+    def f(v, ln):
+        mask = _length_mask(ln, v.shape[1])
+        z = jnp.where(mask, v, -jnp.inf)
+        p = jax.nn.softmax(z, axis=1)
+        return jnp.where(mask, p, 0.0)
+
+    return run_op("sequence_softmax", f, _ensure(x), _ensure(length))
+
+
+def sequence_pad(x, pad_value, maxlen=None, length=None, name=None):
+    """Pad the valid prefix with ``pad_value`` beyond ``length``."""
+    pv = float(np.asarray(_ensure(pad_value)._value).reshape(-1)[0]) \
+        if isinstance(pad_value, Tensor) else float(pad_value)
+
+    def f(v, ln):
+        mask = _length_mask(ln, v.shape[1])
+        shape = mask.shape + (1,) * (v.ndim - 2)
+        return jnp.where(mask.reshape(shape), v, pv)
+
+    return run_op("sequence_pad", f, _ensure(x), _ensure(length)), length
+
+
+def sequence_unpad(x, length, name=None):
+    """Zero out the padding (padded-batch analog of LoD unpad)."""
+
+    def f(v, ln):
+        mask = _length_mask(ln, v.shape[1])
+        shape = mask.shape + (1,) * (v.ndim - 2)
+        return v * mask.reshape(shape).astype(v.dtype)
+
+    return run_op("sequence_unpad", f, _ensure(x), _ensure(length))
+
+
+def sequence_reverse(x, length, name=None):
+    """Reverse each row's valid prefix, padding stays in place."""
+
+    def f(v, ln):
+        T = v.shape[1]
+        pos = jnp.arange(T)[None, :]
+        src = jnp.where(pos < ln[:, None], ln[:, None] - 1 - pos, pos)
+        return jnp.take_along_axis(
+            v, src.reshape(src.shape + (1,) * (v.ndim - 2)).astype(jnp.int32),
+            axis=1) if v.ndim > 2 else jnp.take_along_axis(
+            v, src.astype(jnp.int32), axis=1)
+
+    return run_op("sequence_reverse", f, _ensure(x), _ensure(length))
+
+
+def sequence_first_step(x, length, name=None):
+    return run_op("sequence_first_step", lambda v, ln: v[:, 0],
+                  _ensure(x), _ensure(length))
+
+
+def sequence_last_step(x, length, name=None):
+    def f(v, ln):
+        idx = jnp.clip(ln - 1, 0, v.shape[1] - 1).astype(jnp.int32)
+        return jnp.take_along_axis(
+            v, idx.reshape((-1, 1) + (1,) * (v.ndim - 2)), axis=1)[:, 0]
+
+    return run_op("sequence_last_step", f, _ensure(x), _ensure(length))
+
+
+def sequence_pool(x, pool_type, length=None, name=None):
+    """sum|average|max|sqrt|first|last over valid prefixes."""
+    t = _ensure(x)
+    ln = _ensure(length) if length is not None else to_tensor(
+        np.full((t.shape[0],), t.shape[1], np.int32))
+    pool_type = pool_type.lower()
+    if pool_type == "first":
+        return sequence_first_step(t, ln)
+    if pool_type == "last":
+        return sequence_last_step(t, ln)
+
+    def f(v, l2):
+        mask = _length_mask(l2, v.shape[1])
+        m = mask.reshape(mask.shape + (1,) * (v.ndim - 2)).astype(v.dtype)
+        if pool_type == "max":
+            return jnp.max(jnp.where(m > 0, v, -jnp.inf), axis=1)
+        s = jnp.sum(v * m, axis=1)
+        if pool_type == "sum":
+            return s
+        denom = jnp.maximum(l2, 1).astype(v.dtype)
+        denom = denom.reshape((-1,) + (1,) * (v.ndim - 2))
+        if pool_type == "average":
+            return s / denom
+        if pool_type == "sqrt":
+            return s / jnp.sqrt(denom)
+        raise ValueError(f"unknown pool_type {pool_type}")
+
+    return run_op("sequence_pool", f, t, ln)
+
+
+def sequence_concat(inputs, name=None):
+    """Row-wise concat of padded batches along time."""
+    from ..tensor.manipulation import concat
+
+    return concat(list(inputs), axis=1)
+
+
+def sequence_enumerate(input, win_size, pad_value=0, name=None):
+    """All win_size-grams per position (sequence_lod.py)."""
+
+    def f(v):
+        T = v.shape[1]
+        idx = jnp.arange(T)[:, None] + jnp.arange(win_size)[None, :]
+        gram = jnp.where(idx < T, v[:, jnp.clip(idx, 0, T - 1)], pad_value)
+        return gram
+
+    return run_op("sequence_enumerate", f, _ensure(input))
+
+
+def sequence_expand(x, y, ref_level=-1, name=None):
+    """Broadcast rows of ``x`` to ``y``'s time length."""
+
+    def f(xv, yv):
+        return jnp.broadcast_to(xv[:, None], (xv.shape[0], yv.shape[1])
+                                + xv.shape[1:])
+
+    return run_op("sequence_expand", f, _ensure(x), _ensure(y))

@@ -383,3 +383,51 @@ class TestReviewFixes2:
             paddle.to_tensor(cu), paddle.to_tensor(cu), 4, 4,
             dropout=0.0).numpy()
         assert not np.allclose(a, b)
+
+
+class TestFractionalPoolAndSoftmax2D:
+    def test_fractional_max_pool2d_deterministic_regions(self):
+        x = paddle.to_tensor(_r(1, 2, 7, 7, seed=40))
+        a = F.fractional_max_pool2d(x, 3, random_u=0.3)
+        b = F.fractional_max_pool2d(x, 3, random_u=0.3)
+        assert a.shape == [1, 2, 3, 3]
+        np.testing.assert_array_equal(a.numpy(), b.numpy())
+        # every output equals the max of SOME input region: global bound
+        assert a.numpy().max() <= x.numpy().max() + 1e-6
+        # region [0..e1) contains the first output cell's max
+        assert (a.numpy()[..., 0, 0] <= x.numpy().max(axis=(2, 3))).all()
+
+    def test_fractional_max_pool3d_shape(self):
+        x = paddle.to_tensor(_r(1, 2, 5, 6, 7, seed=41))
+        out = F.fractional_max_pool3d(x, 2, random_u=0.5)
+        assert out.shape == [1, 2, 2, 2, 2]
+
+    def test_softmax2d_channel_normalized(self):
+        x = paddle.to_tensor(_r(2, 3, 4, 4, seed=42))
+        s = nn.Softmax2D()(x)
+        np.testing.assert_allclose(s.numpy().sum(1), np.ones((2, 4, 4)),
+                                   rtol=1e-5)
+        ref = torch.nn.Softmax2d()(torch.tensor(x.numpy()))
+        np.testing.assert_allclose(s.numpy(), ref.numpy(), rtol=1e-5)
+
+
+class TestFractionalPoolMask:
+    def test_mask_region_local_with_repeated_values(self):
+        """Repeated values (post-ReLU maps) must still map each output
+        cell to a position INSIDE its own region."""
+        x = paddle.to_tensor(np.ones((1, 1, 4, 4), "float32"))
+        out, mask = F.fractional_max_pool2d(x, 2, random_u=0.4,
+                                            return_mask=True)
+        m = mask.numpy().reshape(-1)
+        assert len(set(m.tolist())) == 4  # four distinct source positions
+        # unpool round-trip scatters to 4 distinct cells
+        un = F.max_unpool2d(out, mask, 2, output_size=[4, 4]).numpy()
+        assert (un != 0).sum() == 4
+
+    def test_unsupported_modes_raise(self):
+        x = paddle.to_tensor(_r(1, 1, 4, 4, seed=50))
+        with pytest.raises(NotImplementedError):
+            F.fractional_max_pool2d(x, 2, kernel_size=2)
+        x3 = paddle.to_tensor(_r(1, 1, 4, 4, 4, seed=51))
+        with pytest.raises(NotImplementedError):
+            F.fractional_max_pool3d(x3, 2, return_mask=True)

@@ -1,0 +1,137 @@
+"""``paddle.static.nn`` control flow + sequence ops
+(``static/nn/control_flow.py``, ``sequence_lod.py`` capability): eager
+Python dispatch (tape-differentiable) and lax lowering under to_static."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+snn = paddle.static.nn
+
+
+def _t(x, dtype="float32"):
+    return paddle.to_tensor(np.asarray(x, dtype))
+
+
+class TestCond:
+    def test_eager_differentiable(self):
+        x = _t(2.0)
+        x.stop_gradient = False
+        out = snn.cond(_t(True, bool), lambda: x * 3.0, lambda: x * 5.0)
+        out.backward()
+        assert float(x.grad.numpy()) == 3.0
+        x.clear_grad()
+        out = snn.cond(_t(False, bool), lambda: x * 3.0, lambda: x * 5.0)
+        out.backward()
+        assert float(x.grad.numpy()) == 5.0
+
+    def test_traced_data_dependent(self):
+        @paddle.jit.to_static
+        def f(a):
+            return snn.cond(a.sum() > 0, lambda: a * 2.0, lambda: a - 1.0)
+
+        np.testing.assert_allclose(
+            f(_t(np.ones(3))).numpy(), 2 * np.ones(3))
+        np.testing.assert_allclose(
+            f(_t(-np.ones(3))).numpy(), -2 * np.ones(3))
+        # ONE compiled entry serves both branches (lax.cond, not retrace)
+        assert len(f.concrete_program_cache) == 1
+
+    def test_case_first_match_wins(self):
+        x = _t(3.0)
+        out = snn.case(
+            [(_t(False, bool), lambda: x * 1.0),
+             (_t(True, bool), lambda: x * 10.0),
+             (_t(True, bool), lambda: x * 100.0)],
+            default=lambda: x * 1000.0)
+        assert float(out.numpy()) == 30.0
+
+    def test_switch_case_traced(self):
+        @paddle.jit.to_static
+        def f(i):
+            return snn.switch_case(
+                i, {1: lambda: _t(10.0), 3: lambda: _t(30.0)},
+                default=lambda: _t(-1.0))
+
+        assert float(f(_t(1, "int32")).numpy()) == 10.0
+        assert float(f(_t(3, "int32")).numpy()) == 30.0
+        assert float(f(_t(7, "int32")).numpy()) == -1.0
+
+
+class TestWhileLoop:
+    def test_eager(self):
+        i, s = _t(0, "int64"), _t(0.0)
+        iv, sv = snn.while_loop(lambda i, s: i < 5,
+                                lambda i, s: (i + 1, s + 2.0), [i, s])
+        assert int(iv.numpy()) == 5 and float(sv.numpy()) == 10.0
+
+    def test_traced(self):
+        @paddle.jit.to_static
+        def f(n):
+            i, s = _t(0, "int64"), _t(0.0)
+            _, out = snn.while_loop(lambda i, s: i < n,
+                                    lambda i, s: (i + 1, s + 3.0), [i, s])
+            return out
+
+        assert float(f(_t(4, "int64")).numpy()) == 12.0
+        assert float(f(_t(2, "int64")).numpy()) == 6.0
+        assert len(f.concrete_program_cache) == 1
+
+
+class TestUtilities:
+    def test_assert_raises_on_false(self):
+        snn.Assert(_t(True, bool))  # no-op
+        with pytest.raises(AssertionError):
+            snn.Assert(_t(False, bool), data=[_t([1.0, 2.0])])
+
+    def test_py_func_eager_and_jit(self):
+        x = _t(np.ones(3))
+        out_spec = _t(np.zeros(3))
+        got = snn.py_func(lambda a: a * 4, x, out_spec)
+        np.testing.assert_allclose(got.numpy(), 4 * np.ones(3))
+
+        @paddle.jit.to_static
+        def f(v):
+            return snn.py_func(lambda a: a + 1, v, out_spec) * 2.0
+
+        np.testing.assert_allclose(f(x).numpy(), 4 * np.ones(3))
+
+
+class TestSequenceOps:
+    def setup_method(self, _):
+        self.x = _t(np.arange(12.0).reshape(2, 6))
+        self.ln = _t([3, 5], "int32")
+
+    def test_first_last_step(self):
+        np.testing.assert_allclose(
+            snn.sequence_first_step(self.x, self.ln).numpy(), [0.0, 6.0])
+        np.testing.assert_allclose(
+            snn.sequence_last_step(self.x, self.ln).numpy(), [2.0, 10.0])
+
+    def test_pool_modes(self):
+        np.testing.assert_allclose(
+            snn.sequence_pool(self.x, "sum", self.ln).numpy(), [3.0, 40.0])
+        np.testing.assert_allclose(
+            snn.sequence_pool(self.x, "average", self.ln).numpy(), [1.0, 8.0])
+        np.testing.assert_allclose(
+            snn.sequence_pool(self.x, "max", self.ln).numpy(), [2.0, 10.0])
+        np.testing.assert_allclose(
+            snn.sequence_pool(self.x, "sqrt", self.ln).numpy(),
+            [3.0 / np.sqrt(3), 40.0 / np.sqrt(5)], rtol=1e-6)
+
+    def test_softmax_masks_padding(self):
+        p = snn.sequence_softmax(self.x, self.ln).numpy()
+        np.testing.assert_allclose(p.sum(1), [1.0, 1.0], rtol=1e-6)
+        assert (p[0, 3:] == 0).all()
+
+    def test_reverse_prefix_only(self):
+        r = snn.sequence_reverse(self.x, self.ln).numpy()
+        np.testing.assert_allclose(r[0], [2, 1, 0, 3, 4, 5])
+        np.testing.assert_allclose(r[1], [10, 9, 8, 7, 6, 11])
+
+    def test_pad_unpad(self):
+        padded, _ = snn.sequence_pad(self.x, -1.0, length=self.ln)
+        assert (padded.numpy()[0, 3:] == -1.0).all()
+        z = snn.sequence_unpad(self.x, self.ln).numpy()
+        assert (z[0, 3:] == 0).all() and (z[1, :5] == self.x.numpy()[1, :5]).all()

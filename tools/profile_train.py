@@ -31,9 +31,11 @@ def main(outdir: str = "prof_trace") -> None:
 
     on_tpu = jax.default_backend() == "tpu"
     if on_tpu:
+        # EXACT bench.py config — same program, so the trace describes the
+        # benchmarked step and hits the bench-warmed compile cache
         cfg = LlamaConfig(
             vocab_size=32000, hidden_size=1024, intermediate_size=2816,
-            num_hidden_layers=8, num_attention_heads=8,
+            num_hidden_layers=6, num_attention_heads=8,
             num_key_value_heads=8, max_position_embeddings=2048,
             rope_theta=10000.0, dtype="bfloat16")
         batch, seq = 8, 2048

@@ -126,3 +126,197 @@ class FusedTransformerEncoderLayer(Layer):
 
     def forward(self, src, src_mask=None):
         return self.ffn(self.fused_attn(src, src_mask))
+
+
+class FusedBiasDropoutResidualLayerNorm(Layer):
+    """(``fused_transformer.py:83``) out = layer_norm(residual + dropout(x
+    + bias)) — the post-attention epilogue the reference fuses in CUDA;
+    XLA fuses the same chain."""
+
+    def __init__(self, embed_dim, dropout_rate=0.5, weight_attr=None,
+                 bias_attr=None, epsilon=1e-5, name=None):
+        super().__init__()
+        from ...nn.norm import LayerNorm
+
+        self.linear_bias = self.create_parameter(
+            (embed_dim,), attr=bias_attr, is_bias=True)
+        self.norm = LayerNorm(embed_dim, epsilon=epsilon,
+                              weight_attr=weight_attr)
+        self._p = dropout_rate
+
+    def forward(self, x, residual):
+        y = fused_dropout_add(x + self.linear_bias, residual, p=self._p,
+                              training=self.training)
+        return self.norm(y)
+
+
+class FusedTransformer(Layer):
+    """(``fused_transformer.py:905``) encoder-decoder container over the
+    fused encoder layers (the reference's class is likewise a thin
+    composition)."""
+
+    def __init__(self, d_model=512, nhead=8, num_encoder_layers=6,
+                 num_decoder_layers=6, dim_feedforward=2048, dropout=0.1,
+                 activation="relu", attn_dropout=None, act_dropout=None,
+                 normalize_before=False, weight_attr=None, bias_attr=None,
+                 custom_encoder=None, custom_decoder=None):
+        super().__init__()
+        from ...nn.container import LayerList
+
+        self.encoder = custom_encoder or LayerList([
+            FusedTransformerEncoderLayer(
+                d_model, nhead, dim_feedforward, dropout,
+                activation=activation, attn_dropout_rate=attn_dropout,
+                act_dropout_rate=act_dropout,
+                normalize_before=normalize_before)
+            for _ in range(num_encoder_layers)])
+        from ...nn.transformer import TransformerDecoder, TransformerDecoderLayer
+
+        self.decoder = custom_decoder or TransformerDecoder(
+            TransformerDecoderLayer(d_model, nhead, dim_feedforward,
+                                    dropout, activation=activation,
+                                    attn_dropout=attn_dropout,
+                                    act_dropout=act_dropout,
+                                    weight_attr=weight_attr,
+                                    bias_attr=bias_attr,
+                                    normalize_before=normalize_before),
+            num_decoder_layers)
+
+    def forward(self, src, tgt, src_mask=None, tgt_mask=None,
+                memory_mask=None):
+        from ...nn.container import LayerList
+
+        if isinstance(self.encoder, LayerList):
+            memory = src
+            for enc in self.encoder:
+                memory = enc(memory, src_mask)
+        else:  # a custom encoder module is called, not iterated
+            memory = self.encoder(src, src_mask)
+        return self.decoder(tgt, memory, tgt_mask=tgt_mask,
+                            memory_mask=memory_mask)
+
+
+class FusedMultiTransformer(Layer):
+    """(``fused_transformer.py:1025``; CUDA ``fused_multi_transformer_op``)
+    N pre/post-LN decoder blocks executed from flat per-layer weight
+    lists — the reference's serving-path stack.  The whole stack is plain
+    jnp over the fused attention path, so XLA fuses each block's
+    qkv→attention→epilogue→FFN chain."""
+
+    def __init__(self, embed_dim, num_heads, dim_feedforward,
+                 dropout_rate=0.0, activation="gelu", normalize_before=True,
+                 ln_scale_attrs=None, ln_bias_attrs=None,
+                 qkv_weight_attrs=None, qkv_bias_attrs=None,
+                 linear_weight_attrs=None, linear_bias_attrs=None,
+                 ffn_ln_scale_attrs=None, ffn_ln_bias_attrs=None,
+                 ffn1_weight_attrs=None, ffn1_bias_attrs=None,
+                 ffn2_weight_attrs=None, ffn2_bias_attrs=None,
+                 epsilon=1e-5, num_layers=-1, nranks=1, trans_qkvw=True,
+                 ring_id=-1, name=None):
+        super().__init__()
+        from ...nn.container import ParameterList
+        from ...nn.initializer import Constant
+
+        if not trans_qkvw:
+            raise NotImplementedError(
+                "FusedMultiTransformer: only the trans_qkvw=True "
+                "[3, H, D, E] qkv layout is supported")
+        if num_layers < 0:
+            num_layers = (len(qkv_weight_attrs)
+                          if isinstance(qkv_weight_attrs, (list, tuple))
+                          else 1)
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.normalize_before = normalize_before
+        self._p = dropout_rate
+        self._act = activation
+        self._eps = epsilon
+
+        def params(shape, attrs=None, is_bias=False,
+                   default_initializer=None):
+            # per-layer attr list (the reference's Assign-pretrained path)
+            # or one attr for all layers
+            return ParameterList([
+                self.create_parameter(
+                    shape,
+                    attr=(attrs[i] if isinstance(attrs, (list, tuple))
+                          else attrs),
+                    is_bias=is_bias,
+                    default_initializer=default_initializer)
+                for i in range(num_layers)])
+
+        e, ff = embed_dim, dim_feedforward
+        ones = Constant(1.0)
+        # trans_qkvw layout: [3, H, D, E] (the reference serving layout)
+        self.qkv_weights = params((3, num_heads, self.head_dim, e),
+                                  qkv_weight_attrs)
+        self.qkv_biases = params((3, num_heads, self.head_dim),
+                                 qkv_bias_attrs, True)
+        self.linear_weights = params((e, e), linear_weight_attrs)
+        self.linear_biases = params((e,), linear_bias_attrs, True)
+        self.ln_scales = params((e,), ln_scale_attrs,
+                                default_initializer=ones)
+        self.ln_biases = params((e,), ln_bias_attrs, True)
+        self.ffn_ln_scales = params((e,), ffn_ln_scale_attrs,
+                                    default_initializer=ones)
+        self.ffn_ln_biases = params((e,), ffn_ln_bias_attrs, True)
+        self.ffn1_weights = params((e, ff), ffn1_weight_attrs)
+        self.ffn1_biases = params((ff,), ffn1_bias_attrs, True)
+        self.ffn2_weights = params((ff, e), ffn2_weight_attrs)
+        self.ffn2_biases = params((e,), ffn2_bias_attrs, True)
+
+    def _ln(self, x, scale, bias):
+        return F.layer_norm(x, x.shape[-1:], weight=scale, bias=bias,
+                            epsilon=self._eps)
+
+    def forward(self, src, attn_mask=None, caches=None, pre_caches=None,
+                rotary_embs=None, rotary_emb_dims=0, seq_lens=None,
+                time_step=None, name=None):
+        if caches is not None or time_step is not None:
+            raise NotImplementedError(
+                "FusedMultiTransformer cached decode is not implemented — "
+                "serve through paddle_tpu.inference.LLMPredictor (paged KV) "
+                "or models.llama generate (static KV) instead")
+        x = src
+        d = self.head_dim
+        for i in range(self.num_layers):
+            residual = x
+            h = self._ln(x, self.ln_scales[i], self.ln_biases[i]) \
+                if self.normalize_before else x
+
+            def attn(hv, wqkv, bqkv, wo, bo, *mask):
+                B, S, E = hv.shape
+                qkv = jnp.einsum("bse,khde->bskhd", hv, wqkv) + bqkv
+                q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+                logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(
+                    jnp.asarray(d, hv.dtype))
+                if mask:
+                    logits = logits + mask[0]
+                import jax
+
+                p = jax.nn.softmax(logits, -1)
+                o = jnp.einsum("bhqk,bkhd->bqhd", p, v).reshape(B, S, E)
+                return o @ wo + bo
+
+            args = [h, self.qkv_weights[i], self.qkv_biases[i],
+                    self.linear_weights[i], self.linear_biases[i]]
+            if attn_mask is not None:
+                args.append(attn_mask)
+            out = run_op("fused_mt_attn", attn, *args)
+            x = residual + F.dropout(out, self._p, training=self.training)
+            if not self.normalize_before:
+                x = self._ln(x, self.ln_scales[i], self.ln_biases[i])
+
+            residual = x
+            h = self._ln(x, self.ffn_ln_scales[i], self.ffn_ln_biases[i]) \
+                if self.normalize_before else x
+            act = getattr(F, self._act)
+            h = F.dropout(act(h @ self.ffn1_weights[i] + self.ffn1_biases[i]),
+                          self._p, training=self.training)
+            x = residual + F.dropout(h @ self.ffn2_weights[i]
+                                     + self.ffn2_biases[i],
+                                     self._p, training=self.training)
+            if not self.normalize_before:
+                x = self._ln(x, self.ffn_ln_scales[i], self.ffn_ln_biases[i])
+        return x

@@ -264,3 +264,104 @@ class TestFlagsRound2:
             assert len(f.concrete_program_cache) == 2
         finally:
             paddle.set_flags({"jit_cache_max_entries": 64})
+
+
+class TestFusedLayersRound2:
+    def test_fused_multi_transformer_trains(self):
+        import numpy as np
+
+        import paddle_tpu as paddle
+        from paddle_tpu.incubate import nn as inn
+
+        paddle.seed(0)
+        m = inn.FusedMultiTransformer(32, 4, 64, num_layers=2)
+        x = paddle.to_tensor(np.random.default_rng(0).standard_normal(
+            (2, 5, 32)).astype("float32"))
+        y = m(x)
+        assert y.shape == [2, 5, 32]
+        y.sum().backward()
+        assert m.qkv_weights[0].grad is not None
+        assert m.ffn2_weights[1].grad is not None
+        # cached decode loudly unimplemented, never silently wrong
+        import pytest
+
+        with pytest.raises(NotImplementedError):
+            m(x, caches=[1])
+
+    def test_fused_bias_dropout_residual_ln(self):
+        import numpy as np
+
+        import paddle_tpu as paddle
+        from paddle_tpu.incubate import nn as inn
+
+        bd = inn.FusedBiasDropoutResidualLayerNorm(16, 0.0)
+        x = paddle.to_tensor(np.random.default_rng(1).standard_normal(
+            (2, 3, 16)).astype("float32"))
+        out = bd(x, x)
+        np.testing.assert_allclose(out.numpy().mean(-1), 0.0, atol=1e-5)
+        np.testing.assert_allclose(out.numpy().std(-1), 1.0, atol=1e-2)
+
+    def test_fused_transformer_encdec(self):
+        import numpy as np
+
+        import paddle_tpu as paddle
+        from paddle_tpu.incubate import nn as inn
+
+        t = inn.FusedTransformer(d_model=16, nhead=2, num_encoder_layers=1,
+                                 num_decoder_layers=1, dim_feedforward=32,
+                                 dropout=0.0)
+        src = paddle.to_tensor(np.random.default_rng(2).standard_normal(
+            (2, 4, 16)).astype("float32"))
+        tgt = paddle.to_tensor(np.random.default_rng(3).standard_normal(
+            (2, 3, 16)).astype("float32"))
+        assert t(src, tgt).shape == [2, 3, 16]
+
+
+class TestFusedMTAttrs:
+    def test_assign_attrs_load_pretrained(self):
+        import numpy as np
+
+        import paddle_tpu as paddle
+        from paddle_tpu.base.param_attr import ParamAttr
+        from paddle_tpu.incubate import nn as inn
+        from paddle_tpu.nn.initializer import Assign
+
+        rng = np.random.default_rng(0)
+        E, H, FF = 8, 2, 16
+        D = E // H
+        w0 = rng.standard_normal((3, H, D, E)).astype("float32")
+        w1 = rng.standard_normal((3, H, D, E)).astype("float32")
+        m = inn.FusedMultiTransformer(
+            E, H, FF, num_layers=2,
+            qkv_weight_attrs=[ParamAttr(initializer=Assign(w0)),
+                              ParamAttr(initializer=Assign(w1))])
+        np.testing.assert_array_equal(m.qkv_weights[0].numpy(), w0)
+        np.testing.assert_array_equal(m.qkv_weights[1].numpy(), w1)
+        np.testing.assert_array_equal(m.ln_scales[0].numpy(), np.ones(E))
+
+    def test_trans_qkvw_false_raises(self):
+        import pytest
+
+        from paddle_tpu.incubate import nn as inn
+
+        with pytest.raises(NotImplementedError):
+            inn.FusedMultiTransformer(8, 2, 16, num_layers=1,
+                                      trans_qkvw=False)
+
+    def test_fused_transformer_custom_encoder_module(self):
+        import numpy as np
+
+        import paddle_tpu as paddle
+        from paddle_tpu import nn
+        from paddle_tpu.incubate import nn as inn
+
+        enc = nn.TransformerEncoder(
+            nn.TransformerEncoderLayer(16, 2, 32, dropout=0.0), 1)
+        t = inn.FusedTransformer(d_model=16, nhead=2, num_decoder_layers=1,
+                                 dim_feedforward=32, dropout=0.0,
+                                 custom_encoder=enc)
+        src = paddle.to_tensor(np.random.default_rng(1).standard_normal(
+            (2, 4, 16)).astype("float32"))
+        tgt = paddle.to_tensor(np.random.default_rng(2).standard_normal(
+            (2, 3, 16)).astype("float32"))
+        assert t(src, tgt).shape == [2, 3, 16]

@@ -1,0 +1,20 @@
+"""``paddle.distributed.communication`` package shape (the reference splits
+the collective API into per-op modules + ``stream`` variants; the
+implementations live in :mod:`paddle_tpu.distributed.collective`)."""
+
+from ..collective import (  # noqa: F401
+    ReduceOp,
+    all_gather,
+    all_reduce,
+    alltoall,
+    alltoall_single,
+    barrier,
+    broadcast,
+    recv,
+    reduce,
+    reduce_scatter,
+    scatter,
+    send,
+    wait,
+)
+from . import stream  # noqa: F401

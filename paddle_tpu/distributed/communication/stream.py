@@ -1,0 +1,92 @@
+"""``paddle.distributed.communication.stream`` variants
+(``communication/stream/*.py``): the reference exposes every collective
+with explicit ``sync_op``/``use_calc_stream`` control over NCCL streams.
+On TPU, XLA owns stream scheduling — the knobs are accepted and the
+collectives delegate; ``sync_op=False`` returns a completed no-op task
+(XLA collectives are already async-scheduled inside the program)."""
+
+from __future__ import annotations
+
+from .. import collective as _c
+
+
+class _DoneTask:
+    """(``ProcessGroup::Task`` analog) — already complete."""
+
+    def is_completed(self):
+        return True
+
+    def wait(self):
+        return True
+
+    def synchronize(self):
+        return True
+
+
+def _task(result=None):
+    t = _DoneTask()
+    t.result = result
+    return t
+
+
+def all_reduce(tensor, op=_c.ReduceOp.SUM, group=None, sync_op=True,
+               use_calc_stream=False):
+    _c.all_reduce(tensor, op=op, group=group, sync_op=sync_op)
+    return _task(tensor)
+
+
+def all_gather(tensor_or_tensor_list, tensor, group=None, sync_op=True,
+               use_calc_stream=False):
+    _c.all_gather(tensor_or_tensor_list, tensor, group=group, sync_op=sync_op)
+    return _task(tensor_or_tensor_list)
+
+
+def reduce_scatter(tensor, tensor_or_tensor_list, op=_c.ReduceOp.SUM,
+                   group=None, sync_op=True, use_calc_stream=False):
+    _c.reduce_scatter(tensor, tensor_or_tensor_list, op=op, group=group,
+                      sync_op=sync_op)
+    return _task(tensor)
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True,
+              use_calc_stream=False):
+    _c.broadcast(tensor, src=src, group=group, sync_op=sync_op)
+    return _task(tensor)
+
+
+def reduce(tensor, dst=0, op=_c.ReduceOp.SUM, group=None, sync_op=True,
+           use_calc_stream=False):
+    _c.reduce(tensor, dst=dst, op=op, group=group, sync_op=sync_op)
+    return _task(tensor)
+
+
+def scatter(tensor, tensor_or_tensor_list=None, src=0, group=None,
+            sync_op=True, use_calc_stream=False):
+    _c.scatter(tensor, tensor_or_tensor_list, src=src, group=group,
+               sync_op=sync_op)
+    return _task(tensor)
+
+
+def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True,
+             use_calc_stream=False):
+    _c.alltoall(out_tensor_list, in_tensor_list, group=group,
+                sync_op=sync_op)
+    return _task(out_tensor_list)
+
+
+def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
+                    out_split_sizes=None, group=None, sync_op=True,
+                    use_calc_stream=False):
+    _c.alltoall_single(out_tensor, in_tensor, in_split_sizes,
+                       out_split_sizes, group=group, sync_op=sync_op)
+    return _task(out_tensor)
+
+
+def send(tensor, dst=0, group=None, sync_op=True, use_calc_stream=False):
+    _c.send(tensor, dst=dst, group=group, sync_op=sync_op)
+    return _task(tensor)
+
+
+def recv(tensor, src=0, group=None, sync_op=True, use_calc_stream=False):
+    _c.recv(tensor, src=src, group=group, sync_op=sync_op)
+    return _task(tensor)

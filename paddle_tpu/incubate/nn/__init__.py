@@ -114,15 +114,18 @@ class FusedTransformerEncoderLayer(Layer):
 
     def __init__(self, d_model, nhead, dim_feedforward, dropout_rate=0.1,
                  activation="relu", attn_dropout_rate=None,
-                 act_dropout_rate=None, normalize_before=False):
+                 act_dropout_rate=None, normalize_before=False,
+                 weight_attr=None, bias_attr=None):
         super().__init__()
         self.fused_attn = FusedMultiHeadAttention(
             d_model, nhead, dropout_rate,
             attn_dropout_rate or dropout_rate,
-            normalize_before=normalize_before)
+            normalize_before=normalize_before,
+            weight_attr=weight_attr, bias_attr=bias_attr)
         self.ffn = FusedFeedForward(
             d_model, dim_feedforward, dropout_rate, activation,
-            normalize_before=normalize_before)
+            normalize_before=normalize_before,
+            weight_attr=weight_attr, bias_attr=bias_attr)
 
     def forward(self, src, src_mask=None):
         return self.ffn(self.fused_attn(src, src_mask))
@@ -138,14 +141,18 @@ class FusedBiasDropoutResidualLayerNorm(Layer):
         super().__init__()
         from ...nn.norm import LayerNorm
 
-        self.linear_bias = self.create_parameter(
-            (embed_dim,), attr=bias_attr, is_bias=True)
+        # bias_attr configures BOTH the linear bias and the LN bias (the
+        # reference contract); False disables the linear bias entirely
+        self.linear_bias = (None if bias_attr is False
+                            else self.create_parameter(
+                                (embed_dim,), attr=bias_attr, is_bias=True))
         self.norm = LayerNorm(embed_dim, epsilon=epsilon,
-                              weight_attr=weight_attr)
+                              weight_attr=weight_attr, bias_attr=bias_attr)
         self._p = dropout_rate
 
     def forward(self, x, residual):
-        y = fused_dropout_add(x + self.linear_bias, residual, p=self._p,
+        h = x if self.linear_bias is None else x + self.linear_bias
+        y = fused_dropout_add(h, residual, p=self._p,
                               training=self.training)
         return self.norm(y)
 
@@ -221,6 +228,11 @@ class FusedMultiTransformer(Layer):
             raise NotImplementedError(
                 "FusedMultiTransformer: only the trans_qkvw=True "
                 "[3, H, D, E] qkv layout is supported")
+        if nranks > 1 or ring_id not in (-1, 0):
+            raise NotImplementedError(
+                "FusedMultiTransformer: explicit nranks/ring_id tensor "
+                "parallelism is not wired here — shard through the mesh "
+                "(paddle_tpu.distributed.fleet / shard_layer) instead")
         if num_layers < 0:
             num_layers = (len(qkv_weight_attrs)
                           if isinstance(qkv_weight_attrs, (list, tuple))
@@ -236,7 +248,9 @@ class FusedMultiTransformer(Layer):
         def params(shape, attrs=None, is_bias=False,
                    default_initializer=None):
             # per-layer attr list (the reference's Assign-pretrained path)
-            # or one attr for all layers
+            # or one attr for all layers; False = no parameter at all
+            if attrs is False:
+                return [None] * num_layers
             return ParameterList([
                 self.create_parameter(
                     shape,
@@ -278,45 +292,85 @@ class FusedMultiTransformer(Layer):
                 "FusedMultiTransformer cached decode is not implemented — "
                 "serve through paddle_tpu.inference.LLMPredictor (paged KV) "
                 "or models.llama generate (static KV) instead")
+        if (rotary_embs is not None or rotary_emb_dims
+                or pre_caches is not None or seq_lens is not None):
+            raise NotImplementedError(
+                "FusedMultiTransformer: rotary_embs/pre_caches/seq_lens are "
+                "not implemented — raising rather than silently computing "
+                "without them")
         x = src
         d = self.head_dim
+
+        def _maybe_add(t, b):
+            return t if b is None else t + b
+
         for i in range(self.num_layers):
             residual = x
-            h = self._ln(x, self.ln_scales[i], self.ln_biases[i]) \
+            h = self._ln(x, self.ln_scales[i],
+                         self.ln_biases[i]) \
                 if self.normalize_before else x
 
-            def attn(hv, wqkv, bqkv, wo, bo, *mask):
+            def attn(hv, wqkv, wo, *rest):
+                # rest = optional (bqkv, bo, mask) threaded positionally so
+                # the tape differentiates whichever biases exist
+                it = list(rest)
+                bqkv = it.pop(0) if self._has(self.qkv_biases) else None
+                bo = it.pop(0) if self._has(self.linear_biases) else None
+                mask = it[0] if it else None
                 B, S, E = hv.shape
-                qkv = jnp.einsum("bse,khde->bskhd", hv, wqkv) + bqkv
+                qkv = jnp.einsum("bse,khde->bskhd", hv, wqkv)
+                if bqkv is not None:
+                    qkv = qkv + bqkv
                 q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-                logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(
-                    jnp.asarray(d, hv.dtype))
-                if mask:
-                    logits = logits + mask[0]
-                import jax
+                if mask is None:
+                    # maskless: the fused flash path (pallas on TPU)
+                    from ...ops.flash_attention import flash_attention_fwd
 
-                p = jax.nn.softmax(logits, -1)
-                o = jnp.einsum("bhqk,bkhd->bqhd", p, v).reshape(B, S, E)
-                return o @ wo + bo
+                    o = flash_attention_fwd(q, k, v, causal=False)
+                else:
+                    import jax
 
-            args = [h, self.qkv_weights[i], self.qkv_biases[i],
-                    self.linear_weights[i], self.linear_biases[i]]
+                    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(
+                        jnp.asarray(d, hv.dtype))
+                    logits = logits + mask
+                    p = jax.nn.softmax(logits, -1)
+                    o = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+                o = o.reshape(B, S, E) @ wo
+                return o if bo is None else o + bo
+
+            args = [h, self.qkv_weights[i], self.linear_weights[i]]
+            if self._has(self.qkv_biases):
+                args.append(self.qkv_biases[i])
+            if self._has(self.linear_biases):
+                args.append(self.linear_biases[i])
             if attn_mask is not None:
                 args.append(attn_mask)
             out = run_op("fused_mt_attn", attn, *args)
             x = residual + F.dropout(out, self._p, training=self.training)
             if not self.normalize_before:
-                x = self._ln(x, self.ln_scales[i], self.ln_biases[i])
+                x = self._ln(x, self.ln_scales[i],
+                             self.ln_biases[i])
 
             residual = x
-            h = self._ln(x, self.ffn_ln_scales[i], self.ffn_ln_biases[i]) \
+            h = self._ln(x, self.ffn_ln_scales[i],
+                         self.ffn_ln_biases[i]) \
                 if self.normalize_before else x
             act = getattr(F, self._act)
-            h = F.dropout(act(h @ self.ffn1_weights[i] + self.ffn1_biases[i]),
-                          self._p, training=self.training)
-            x = residual + F.dropout(h @ self.ffn2_weights[i]
-                                     + self.ffn2_biases[i],
-                                     self._p, training=self.training)
+            h = F.dropout(
+                act(_maybe_add(h @ self.ffn1_weights[i],
+                               self.ffn1_biases[i])),
+                self._p, training=self.training)
+            x = residual + F.dropout(
+                _maybe_add(h @ self.ffn2_weights[i],
+                           self.ffn2_biases[i]),
+                self._p, training=self.training)
             if not self.normalize_before:
-                x = self._ln(x, self.ffn_ln_scales[i], self.ffn_ln_biases[i])
+                x = self._ln(x, self.ffn_ln_scales[i],
+                             self.ffn_ln_biases[i])
         return x
+
+    @staticmethod
+    def _has(plist):
+        return not (isinstance(plist, list) and plist
+                    and plist[0] is None)
+

@@ -365,3 +365,53 @@ class TestFusedMTAttrs:
         tgt = paddle.to_tensor(np.random.default_rng(2).standard_normal(
             (2, 3, 16)).astype("float32"))
         assert t(src, tgt).shape == [2, 3, 16]
+
+
+class TestFusedMTReviewFixes:
+    def test_bias_attrs_false_no_params(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.incubate import nn as inn
+
+        m = inn.FusedMultiTransformer(8, 2, 16, num_layers=1,
+                                      qkv_bias_attrs=False,
+                                      linear_bias_attrs=False,
+                                      ffn1_bias_attrs=False,
+                                      ffn2_bias_attrs=False)
+        names = [n for n, _ in m.named_parameters()]
+        assert not any("qkv_biases" in n or "linear_biases" in n
+                       or "ffn1_biases" in n or "ffn2_biases" in n
+                       for n in names)
+        import numpy as np
+
+        x = paddle.to_tensor(np.random.default_rng(0).standard_normal(
+            (1, 3, 8)).astype("float32"))
+        y = m(x)
+        assert y.shape == [1, 3, 8]
+        y.sum().backward()
+        assert m.qkv_weights[0].grad is not None
+
+    def test_unsupported_knobs_raise(self):
+        import numpy as np
+        import pytest
+
+        import paddle_tpu as paddle
+        from paddle_tpu.incubate import nn as inn
+
+        with pytest.raises(NotImplementedError):
+            inn.FusedMultiTransformer(8, 2, 16, num_layers=1, nranks=2)
+        m = inn.FusedMultiTransformer(8, 2, 16, num_layers=1)
+        x = paddle.to_tensor(np.zeros((1, 2, 8), "float32"))
+        with pytest.raises(NotImplementedError):
+            m(x, rotary_embs=x)
+
+    def test_bdrln_bias_false(self):
+        import numpy as np
+
+        import paddle_tpu as paddle
+        from paddle_tpu.incubate import nn as inn
+
+        bd = inn.FusedBiasDropoutResidualLayerNorm(8, 0.0, bias_attr=False)
+        assert bd.linear_bias is None and bd.norm.bias is None
+        x = paddle.to_tensor(np.random.default_rng(1).standard_normal(
+            (2, 3, 8)).astype("float32"))
+        assert bd(x, x).shape == [2, 3, 8]

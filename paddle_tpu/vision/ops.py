@@ -1,0 +1,629 @@
+"""``paddle.vision.ops`` — the detection operator toolbox
+(``python/paddle/vision/ops.py``): NMS, RoI pooling/align family, box
+coding, anchors, YOLO decode, deformable conv, FPN routing.
+
+TPU-first notes: the bilinear-sampling ops (roi_align, deform_conv2d) are
+pure gather+interpolation math that XLA fuses; NMS's sequential suppression
+is a host op (it is data-dependent-shaped by nature — the reference's GPU
+kernel also serializes the keep loop), run eagerly like ``nonzero``.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import run_op
+from ..core.tensor import Tensor, to_tensor
+from ..nn.container import Sequential
+
+
+def _ensure(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def _np(x):
+    return np.asarray(_ensure(x)._value)
+
+
+# --------------------------------------------------------------------------
+# NMS (ops.py:1867)
+# --------------------------------------------------------------------------
+
+def _iou_matrix(boxes: np.ndarray) -> np.ndarray:
+    x1, y1, x2, y2 = boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3]
+    area = np.maximum(0.0, x2 - x1) * np.maximum(0.0, y2 - y1)
+    ix1 = np.maximum(x1[:, None], x1[None, :])
+    iy1 = np.maximum(y1[:, None], y1[None, :])
+    ix2 = np.minimum(x2[:, None], x2[None, :])
+    iy2 = np.minimum(y2[:, None], y2[None, :])
+    inter = (np.maximum(0.0, ix2 - ix1) * np.maximum(0.0, iy2 - iy1))
+    union = area[:, None] + area[None, :] - inter
+    return inter / np.maximum(union, 1e-10)
+
+
+def _nms_single(boxes: np.ndarray, scores: Optional[np.ndarray],
+                iou_threshold: float) -> np.ndarray:
+    n = len(boxes)
+    order = (np.argsort(-scores) if scores is not None
+             else np.arange(n))
+    iou = _iou_matrix(boxes)
+    keep = []
+    alive = np.ones(n, bool)
+    for i in order:
+        if not alive[i]:
+            continue
+        keep.append(i)
+        alive &= iou[i] <= iou_threshold
+        alive[i] = False
+    return np.asarray(keep, np.int64)
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None, name=None):
+    """Greedy hard NMS (ops.py:1867); category-aware when
+    ``category_idxs``/``categories`` given.  Returns kept indices
+    (score-descending when scores are given)."""
+    b = _np(boxes).astype(np.float64)
+    s = _np(scores).astype(np.float64) if scores is not None else None
+    if category_idxs is None:
+        keep = _nms_single(b, s, iou_threshold)
+    else:
+        cats = _np(category_idxs)
+        keep_parts = []
+        for c in (categories if categories is not None
+                  else np.unique(cats).tolist()):
+            idx = np.nonzero(cats == c)[0]
+            if len(idx) == 0:
+                continue
+            kept = _nms_single(b[idx], None if s is None else s[idx],
+                               iou_threshold)
+            keep_parts.append(idx[kept])
+        keep = np.concatenate(keep_parts) if keep_parts else np.zeros(
+            0, np.int64)
+        if s is not None:
+            keep = keep[np.argsort(-s[keep])]
+    if top_k is not None:
+        keep = keep[:top_k]
+    return to_tensor(keep)
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
+               nms_top_k=400, keep_top_k=200, use_gaussian=False,
+               gaussian_sigma=2.0, background_label=0, normalized=True,
+               return_index=False, return_rois_num=True, name=None):
+    """Matrix NMS (ops.py:2236; SOLOv2 decay-based soft suppression).
+    bboxes [N, M, 4], scores [N, C, M]."""
+    bb, sc = _np(bboxes), _np(scores)
+    N, C, M = sc.shape
+    outs, indices, nums = [], [], []
+    for n in range(N):
+        rows = []
+        for c in range(C):
+            if c == background_label:
+                continue
+            s = sc[n, c]
+            sel = np.nonzero(s > score_threshold)[0]
+            if len(sel) == 0:
+                continue
+            sel = sel[np.argsort(-s[sel])][:nms_top_k]
+            iou = np.triu(_iou_matrix(bb[n, sel]), k=1)
+            max_iou = iou.max(0, initial=0.0)  # per j: max over higher-ranked
+            # compensate indexed by the SUPPRESSOR row i (SOLOv2 eq. 4):
+            # decay_j = min_i f(iou_ij) / f(max_iou_i)
+            if use_gaussian:
+                decay = np.exp(-(iou ** 2 - max_iou[:, None] ** 2)
+                               / gaussian_sigma).min(0, initial=1.0)
+            else:
+                decay = ((1 - iou) / np.maximum(1 - max_iou[:, None], 1e-10)
+                         ).min(0, initial=1.0)
+            dec_s = s[sel] * decay
+            ok = dec_s >= post_threshold
+            for j in np.nonzero(ok)[0]:
+                rows.append((c, dec_s[j], bb[n, sel[j]], n * M + sel[j]))
+        rows.sort(key=lambda r: -r[1])
+        rows = rows[:keep_top_k]
+        nums.append(len(rows))
+        for c, s_, box, gi in rows:
+            outs.append([c, s_] + box.tolist())
+            indices.append(gi)
+    out = to_tensor(np.asarray(outs, np.float32).reshape(-1, 6))
+    result = [out]
+    if return_index:
+        result.append(to_tensor(np.asarray(indices, np.int64).reshape(-1, 1)))
+    if return_rois_num:
+        result.append(to_tensor(np.asarray(nums, np.int32)))
+    return tuple(result) if len(result) > 1 else out
+
+
+# --------------------------------------------------------------------------
+# RoI family (ops.py:1393/1514/1640)
+# --------------------------------------------------------------------------
+
+def _roi_index(boxes_num, R):
+    return np.repeat(np.arange(len(boxes_num)), boxes_num)[:R]
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    """(ops.py:1640) bilinear-averaged RoI features, NCHW."""
+    oh, ow = ((output_size, output_size) if isinstance(output_size, int)
+              else tuple(output_size))
+    bn = _np(boxes_num)
+    t, b = _ensure(x), _ensure(boxes)
+    R = b._value.shape[0]
+    batch_idx = jnp.asarray(_roi_index(bn, R))
+
+    def f(xv, bv):
+        N, C, H, W = xv.shape
+        off = 0.5 if aligned else 0.0
+        x1 = bv[:, 0] * spatial_scale - off
+        y1 = bv[:, 1] * spatial_scale - off
+        x2 = bv[:, 2] * spatial_scale - off
+        y2 = bv[:, 3] * spatial_scale - off
+        rw = x2 - x1 if aligned else jnp.maximum(x2 - x1, 1.0)
+        rh = y2 - y1 if aligned else jnp.maximum(y2 - y1, 1.0)
+        sr = sampling_ratio if sampling_ratio > 0 else 2
+        # sample grid: [R, oh*sr, ow*sr]
+        gy = (y1[:, None] + (jnp.arange(oh * sr) + 0.5)[None, :]
+              * rh[:, None] / (oh * sr))
+        gx = (x1[:, None] + (jnp.arange(ow * sr) + 0.5)[None, :]
+              * rw[:, None] / (ow * sr))
+
+        def bilinear(img, yy, xx):
+            # torchvision border semantics: samples in [-1, size) clamp to
+            # the border pixel; only fully-outside samples contribute 0
+            outside = (yy < -1.0) | (yy > H) | (xx < -1.0) | (xx > W)
+            yy = jnp.clip(yy, 0.0, H - 1)
+            xx = jnp.clip(xx, 0.0, W - 1)
+            y0 = jnp.floor(yy)
+            x0 = jnp.floor(xx)
+            wy = yy - y0
+            wx = xx - x0
+
+            def tap(yi, xi):
+                return img[:, jnp.clip(yi, 0, H - 1).astype(jnp.int32),
+                           jnp.clip(xi, 0, W - 1).astype(jnp.int32)]
+
+            val = (tap(y0, x0) * ((1 - wy) * (1 - wx))[None]
+                   + tap(y0 + 1, x0) * (wy * (1 - wx))[None]
+                   + tap(y0, x0 + 1) * ((1 - wy) * wx)[None]
+                   + tap(y0 + 1, x0 + 1) * (wy * wx)[None])
+            return jnp.where(outside[None], 0.0, val)
+
+        def per_roi(r):
+            img = xv[batch_idx[r]]                       # [C, H, W]
+            yy = jnp.broadcast_to(gy[r][:, None], (oh * sr, ow * sr))
+            xx = jnp.broadcast_to(gx[r][None, :], (oh * sr, ow * sr))
+            samp = bilinear(img, yy, xx)                 # [C, oh*sr, ow*sr]
+            return samp.reshape(-1, oh, sr, ow, sr).mean((2, 4))
+
+        return jax.vmap(per_roi)(jnp.arange(R))
+
+    return run_op("roi_align", f, t, b)
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    """(ops.py:1514) quantized max pooling per RoI bin."""
+    oh, ow = ((output_size, output_size) if isinstance(output_size, int)
+              else tuple(output_size))
+    bn = _np(boxes_num)
+    xv, bv = _np(x), _np(boxes)
+    N, C, H, W = xv.shape
+    R = bv.shape[0]
+    bidx = _roi_index(bn, R)
+    out = np.zeros((R, C, oh, ow), xv.dtype)
+    for r in range(R):
+        x1 = int(round(bv[r, 0] * spatial_scale))
+        y1 = int(round(bv[r, 1] * spatial_scale))
+        x2 = int(round(bv[r, 2] * spatial_scale))
+        y2 = int(round(bv[r, 3] * spatial_scale))
+        rh = max(y2 - y1 + 1, 1)
+        rw = max(x2 - x1 + 1, 1)
+        for i in range(oh):
+            for j in range(ow):
+                ys = min(max(y1 + int(np.floor(i * rh / oh)), 0), H)
+                ye = min(max(y1 + int(np.ceil((i + 1) * rh / oh)), 0), H)
+                xs = min(max(x1 + int(np.floor(j * rw / ow)), 0), W)
+                xe = min(max(x1 + int(np.ceil((j + 1) * rw / ow)), 0), W)
+                if ye > ys and xe > xs:
+                    out[r, :, i, j] = xv[bidx[r], :, ys:ye, xs:xe].max((1, 2))
+    return to_tensor(out)
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+               name=None):
+    """(ops.py:1393) position-sensitive RoI average pooling: input channels
+    C = out_c · oh · ow; bin (i, j) reads its own channel group."""
+    oh, ow = ((output_size, output_size) if isinstance(output_size, int)
+              else tuple(output_size))
+    xv, bv = _np(x), _np(boxes)
+    bn = _np(boxes_num)
+    N, C, H, W = xv.shape
+    out_c = C // (oh * ow)
+    R = bv.shape[0]
+    bidx = _roi_index(bn, R)
+    out = np.zeros((R, out_c, oh, ow), xv.dtype)
+    for r in range(R):
+        x1, y1, x2, y2 = bv[r] * spatial_scale
+        rh = max(y2 - y1, 0.1)
+        rw = max(x2 - x1, 0.1)
+        for i in range(oh):
+            for j in range(ow):
+                ys = min(max(int(np.floor(y1 + i * rh / oh)), 0), H)
+                ye = min(max(int(np.ceil(y1 + (i + 1) * rh / oh)), 0), H)
+                xs = min(max(int(np.floor(x1 + j * rw / ow)), 0), W)
+                xe = min(max(int(np.ceil(x1 + (j + 1) * rw / ow)), 0), W)
+                c0 = (i * ow + j) * out_c
+                if ye > ys and xe > xs:
+                    out[r, :, i, j] = xv[bidx[r], c0:c0 + out_c,
+                                         ys:ye, xs:xe].mean((1, 2))
+    return to_tensor(out)
+
+
+# --------------------------------------------------------------------------
+# box utilities (ops.py:427/573/266)
+# --------------------------------------------------------------------------
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              axis=0, name=None):
+    """(ops.py:573) encode/decode boxes against priors."""
+    pb = _np(prior_box).astype(np.float64)
+    tv = _np(target_box).astype(np.float64)
+    var = (_np(prior_box_var).astype(np.float64)
+           if isinstance(prior_box_var, (Tensor, np.ndarray, list))
+           else np.full((1, 4), prior_box_var, np.float64))
+    if isinstance(prior_box_var, (list, tuple)):
+        var = np.asarray(prior_box_var, np.float64).reshape(1, 4)
+    norm = 0.0 if box_normalized else 1.0
+    pw = pb[:, 2] - pb[:, 0] + norm
+    ph = pb[:, 3] - pb[:, 1] + norm
+    pcx = pb[:, 0] + pw / 2
+    pcy = pb[:, 1] + ph / 2
+    if code_type == "encode_center_size":
+        tw = tv[:, 2] - tv[:, 0] + norm
+        th = tv[:, 3] - tv[:, 1] + norm
+        tcx = tv[:, 0] + tw / 2
+        tcy = tv[:, 1] + th / 2
+        v = var if var.shape[0] > 1 else np.broadcast_to(var, (len(pb), 4))
+        out = np.stack([
+            (tcx - pcx) / pw / v[:, 0],
+            (tcy - pcy) / ph / v[:, 1],
+            np.log(np.maximum(tw / pw, 1e-10)) / v[:, 2],
+            np.log(np.maximum(th / ph, 1e-10)) / v[:, 3],
+        ], -1)
+        return to_tensor(out.astype(np.float32))
+    # decode_center_size: deltas [M, 4] or [A, B, 4]; priors broadcast
+    # along ``axis`` (paddle semantics: priors match tv.shape[axis])
+    v = var if var.shape[0] > 1 else np.broadcast_to(var, (len(pb), 4))
+    if tv.ndim == 3:
+        # paddle: axis is the TARGET dim to broadcast ACROSS — axis=0 with
+        # tv [N, M, 4] and priors [M, 4] broadcasts priors over dim 0
+        expand = (None, slice(None)) if axis == 0 else (slice(None), None)
+        pw, ph, pcx, pcy = (a[expand] for a in (pw, ph, pcx, pcy))
+        v = v[expand]
+    dcx = v[..., 0] * tv[..., 0] * pw + pcx
+    dcy = v[..., 1] * tv[..., 1] * ph + pcy
+    dw = np.exp(v[..., 2] * tv[..., 2]) * pw
+    dh = np.exp(v[..., 3] * tv[..., 3]) * ph
+    out = np.stack([dcx - dw / 2, dcy - dh / 2,
+                    dcx + dw / 2 - norm, dcy + dh / 2 - norm], -1)
+    return to_tensor(out.astype(np.float32))
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, min_max_aspect_ratios_order=False,
+              name=None):
+    """(ops.py:427) SSD anchor generation."""
+    fh, fw = _np(input).shape[2:]
+    ih, iw = _np(image).shape[2:]
+    sw = steps[0] or iw / fw
+    sh = steps[1] or ih / fh
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if ar != 1.0 and ar not in ars:
+            ars.append(ar)
+            if flip:
+                ars.append(1.0 / ar)
+    boxes = []
+    for y in range(fh):
+        for x in range(fw):
+            cx = (x + offset) * sw
+            cy = (y + offset) * sh
+            cell = []
+            for mi, ms in enumerate(min_sizes):
+                def _ar_box(ar):
+                    bw = ms * math.sqrt(ar) / 2
+                    bh = ms / math.sqrt(ar) / 2
+                    return [(cx - bw) / iw, (cy - bh) / ih,
+                            (cx + bw) / iw, (cy + bh) / ih]
+
+                def _max_boxes():
+                    out = []
+                    for mx in (max_sizes or []):
+                        s = math.sqrt(ms * mx) / 2
+                        out.append([(cx - s) / iw, (cy - s) / ih,
+                                    (cx + s) / iw, (cy + s) / ih])
+                    return out
+
+                if min_max_aspect_ratios_order:
+                    # (min, max, other aspect ratios) — the order SSD heads
+                    # trained with the flag expect
+                    cell.append(_ar_box(1.0))
+                    cell.extend(_max_boxes())
+                    cell.extend(_ar_box(ar) for ar in ars if ar != 1.0)
+                else:
+                    cell.extend(_ar_box(ar) for ar in ars)
+                    cell.extend(_max_boxes())
+            boxes.append(cell)
+    arr = np.asarray(boxes, np.float32).reshape(fh, fw, -1, 4)
+    if clip:
+        arr = np.clip(arr, 0.0, 1.0)
+    var = np.broadcast_to(np.asarray(variance, np.float32),
+                          arr.shape).copy()
+    return to_tensor(arr), to_tensor(var)
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh=0.01,
+             downsample_ratio=32, clip_bbox=True, scale_x_y=1.0,
+             iou_aware=False, iou_aware_factor=0.5, name=None):
+    """(ops.py:266) decode one YOLO head into boxes + scores."""
+    xv = _np(x).astype(np.float64)
+    im = _np(img_size)
+    N, _, H, W = xv.shape
+    na = len(anchors) // 2
+    ioup = None
+    if iou_aware:
+        # iou-aware layout: first na channels are the IoU predictions
+        ioup = xv[:, :na].reshape(N, na, H, W)
+        xv = xv[:, na:]
+    xv = xv.reshape(N, na, 5 + class_num, H, W)
+    grid_x = np.arange(W)[None, None, None, :]
+    grid_y = np.arange(H)[None, None, :, None]
+
+    def sig(v):
+        return 1.0 / (1.0 + np.exp(-v))
+
+    bx = (sig(xv[:, :, 0]) * scale_x_y - (scale_x_y - 1) / 2 + grid_x) / W
+    by = (sig(xv[:, :, 1]) * scale_x_y - (scale_x_y - 1) / 2 + grid_y) / H
+    aw = np.asarray(anchors[0::2], np.float64)[None, :, None, None]
+    ah = np.asarray(anchors[1::2], np.float64)[None, :, None, None]
+    bw = np.exp(xv[:, :, 2]) * aw / (W * downsample_ratio)
+    bh = np.exp(xv[:, :, 3]) * ah / (H * downsample_ratio)
+    conf = sig(xv[:, :, 4])
+    if ioup is not None:
+        conf = (sig(ioup) ** iou_aware_factor
+                * conf ** (1.0 - iou_aware_factor))
+    probs = sig(xv[:, :, 5:]) * conf[:, :, None]
+    mask = conf > conf_thresh
+    imh = im[:, 0].astype(np.float64)[:, None, None, None]
+    imw = im[:, 1].astype(np.float64)[:, None, None, None]
+    x1 = (bx - bw / 2) * imw
+    y1 = (by - bh / 2) * imh
+    x2 = (bx + bw / 2) * imw
+    y2 = (by + bh / 2) * imh
+    if clip_bbox:
+        x1 = np.clip(x1, 0, imw - 1)
+        y1 = np.clip(y1, 0, imh - 1)
+        x2 = np.clip(x2, 0, imw - 1)
+        y2 = np.clip(y2, 0, imh - 1)
+    boxes = np.stack([x1, y1, x2, y2], -1) * mask[..., None]
+    scores = probs * mask[:, :, None]
+    boxes = boxes.transpose(0, 1, 3, 4, 2).reshape(N, -1, 4)
+    # paddle API shape: [N, M, class_num]
+    scores = scores.transpose(0, 1, 3, 4, 2).reshape(N, -1, class_num)
+    return to_tensor(boxes.astype(np.float32)), to_tensor(
+        scores.astype(np.float32))
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False,
+                             rois_num=None, name=None):
+    """(ops.py:1156) route RoIs to FPN levels by scale."""
+    rois = _np(fpn_rois)
+    off = 1.0 if pixel_offset else 0.0
+    scale = np.sqrt(np.maximum(rois[:, 2] - rois[:, 0] + off, 0)
+                    * np.maximum(rois[:, 3] - rois[:, 1] + off, 0))
+    lvl = np.floor(np.log2(scale / refer_scale + 1e-8)) + refer_level
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+    outs, restore = [], []
+    order = []
+    for l in range(min_level, max_level + 1):
+        idx = np.nonzero(lvl == l)[0]
+        outs.append(to_tensor(rois[idx].astype(np.float32)))
+        order.append(idx)
+    order = np.concatenate(order) if order else np.zeros(0, np.int64)
+    restore = np.empty_like(order)
+    restore[order] = np.arange(len(order))
+    result = [outs, to_tensor(restore.reshape(-1, 1))]
+    if rois_num is not None:
+        rn = _np(rois_num)
+        batch = np.repeat(np.arange(len(rn)), rn)
+        nums = [to_tensor(np.asarray(
+            [(batch[lvl == l] == b).sum() for b in range(len(rn))],
+            np.int32)) for l in range(min_level, max_level + 1)]
+        result.append(nums)
+    return tuple(result)
+
+
+# --------------------------------------------------------------------------
+# deformable conv (ops.py:753/960)
+# --------------------------------------------------------------------------
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """(ops.py:753) deformable conv v1 (v2 with ``mask``): each kernel tap
+    samples at its offset position via bilinear interpolation — pure
+    gather math, XLA-fusable."""
+    if groups != 1:
+        raise NotImplementedError(
+            "deform_conv2d: groups > 1 is not supported")
+    st = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    pd = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    dl = (dilation, dilation) if isinstance(dilation, int) else tuple(dilation)
+    t, o, w = _ensure(x), _ensure(offset), _ensure(weight)
+    args = [t, o, w]
+    if mask is not None:
+        args.append(_ensure(mask))
+    if bias is not None:
+        args.append(_ensure(bias))
+    has_mask = mask is not None
+    has_bias = bias is not None
+
+    def f(xv, ov, wv, *rest):
+        mv = rest[0] if has_mask else None
+        bv = rest[-1] if has_bias else None
+        N, C, H, W = xv.shape
+        O, Cg, kh, kw = wv.shape
+        Ho = (H + 2 * pd[0] - dl[0] * (kh - 1) - 1) // st[0] + 1
+        Wo = (W + 2 * pd[1] - dl[1] * (kw - 1) - 1) // st[1] + 1
+        ov = ov.reshape(N, deformable_groups, kh * kw, 2, Ho, Wo)
+        base_y = (jnp.arange(Ho) * st[0] - pd[0])[:, None]
+        base_x = (jnp.arange(Wo) * st[1] - pd[1])[None, :]
+
+        def bilinear(img, yy, xx):
+            # img [C, H, W]; yy/xx [Ho, Wo]
+            y0 = jnp.floor(yy)
+            x0 = jnp.floor(xx)
+            wy = yy - y0
+            wx = xx - x0
+
+            def tap(yi, xi):
+                inb = (yi >= 0) & (yi <= H - 1) & (xi >= 0) & (xi <= W - 1)
+                v = img[:, jnp.clip(yi, 0, H - 1).astype(jnp.int32),
+                        jnp.clip(xi, 0, W - 1).astype(jnp.int32)]
+                return jnp.where(inb[None], v, 0.0)
+
+            return (tap(y0, x0) * ((1 - wy) * (1 - wx))[None]
+                    + tap(y0 + 1, x0) * (wy * (1 - wx))[None]
+                    + tap(y0, x0 + 1) * ((1 - wy) * wx)[None]
+                    + tap(y0 + 1, x0 + 1) * (wy * wx)[None])
+
+        cpg = C // deformable_groups  # channels per deformable group
+
+        def per_image(img, offs, msk):
+            cols = []
+            for k in range(kh * kw):
+                ky, kx = divmod(k, kw)
+                groups_smp = []
+                for g in range(deformable_groups):
+                    yy = base_y + ky * dl[0] + offs[g, k, 0]
+                    xx = base_x + kx * dl[1] + offs[g, k, 1]
+                    smp = bilinear(img[g * cpg:(g + 1) * cpg], yy, xx)
+                    if msk is not None:
+                        smp = smp * msk[g, k][None]
+                    groups_smp.append(smp)
+                cols.append(jnp.concatenate(groups_smp, 0))  # [C, Ho, Wo]
+            return jnp.stack(cols, 1)                        # [C, K, Ho, Wo]
+
+        if mv is not None:
+            mv = mv.reshape(N, deformable_groups, kh * kw, Ho, Wo)
+            cols = jax.vmap(per_image)(xv, ov, mv)
+        else:
+            cols = jax.vmap(lambda i, of: per_image(i, of, None))(xv, ov)
+        # conv as matmul over (C, K): weight [O, C, kh, kw] (groups == 1,
+        # enforced at entry)
+        wflat = wv.reshape(O, -1)                           # [O, C*K]
+        cflat = cols.reshape(N, C * kh * kw, Ho * Wo)
+        out = jnp.einsum("ok,nkp->nop", wflat, cflat).reshape(N, O, Ho, Wo)
+        if bv is not None:
+            out = out + bv[None, :, None, None]
+        return out
+
+    return run_op("deform_conv2d", f, *args)
+
+
+from ..nn.layers import Layer  # noqa: E402  (after helpers for readability)
+
+
+class DeformConv2D(Layer):
+    """(ops.py:960) layer owning the conv weight; the offset (and v2 mask)
+    come from a separate conv the user provides, as in the reference."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        if groups != 1:
+            raise NotImplementedError(
+                "DeformConv2D: groups > 1 is not supported")
+        from ..nn.initializer import XavierUniform
+
+        ks = ((kernel_size, kernel_size)
+              if isinstance(kernel_size, int) else tuple(kernel_size))
+        self.weight = self.create_parameter(
+            (out_channels, in_channels // groups) + ks,
+            attr=weight_attr, default_initializer=XavierUniform())
+        self.bias = (None if bias_attr is False
+                     else self.create_parameter(
+                         (out_channels,), attr=bias_attr, is_bias=True))
+        self._cfg = dict(stride=stride, padding=padding, dilation=dilation,
+                         deformable_groups=deformable_groups, groups=groups)
+
+    def forward(self, x, offset, mask=None):
+        return deform_conv2d(x, offset, self.weight, self.bias,
+                             mask=mask, **self._cfg)
+
+
+class RoIAlign(Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._cfg = (output_size, spatial_scale)
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_align(x, boxes, boxes_num, self._cfg[0], self._cfg[1])
+
+
+class RoIPool(Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._cfg = (output_size, spatial_scale)
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self._cfg[0], self._cfg[1])
+
+
+class PSRoIPool(Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._cfg = (output_size, spatial_scale)
+
+    def forward(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, self._cfg[0], self._cfg[1])
+
+
+_DEFAULT = object()  # ConvNormActivation sentinel: None must DISABLE
+
+
+class ConvNormActivation(Sequential):
+    """(ops.py:1810) conv + norm + activation block; ``norm_layer=None`` /
+    ``activation_layer=None`` disable the stage (torchvision semantics)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size=3, stride=1,
+                 padding=None, groups=1, norm_layer=_DEFAULT,
+                 activation_layer=_DEFAULT, dilation=1, bias=None):
+        from ..nn import BatchNorm2D, Conv2D, ReLU
+
+        if padding is None:
+            padding = (kernel_size - 1) // 2 * dilation
+        if norm_layer is _DEFAULT:
+            norm_layer = BatchNorm2D
+        if activation_layer is _DEFAULT:
+            activation_layer = ReLU
+        if bias is None:
+            bias = norm_layer is None
+        layers = [Conv2D(in_channels, out_channels, kernel_size, stride,
+                         padding, dilation=dilation, groups=groups,
+                         bias_attr=None if bias else False)]
+        if norm_layer is not None:
+            layers.append(norm_layer(out_channels))
+        if activation_layer is not None:
+            layers.append(activation_layer())
+        super().__init__(*layers)

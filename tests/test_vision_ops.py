@@ -1,0 +1,259 @@
+"""``paddle.vision.ops`` detection toolbox (ops.py capability): NMS
+variants, RoI pooling family, box coding, anchors, YOLO decode, deformable
+conv, FPN routing — checked against analytic references."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import ops as vops
+
+
+def _t(x, dtype="float32"):
+    return paddle.to_tensor(np.asarray(x, dtype))
+
+
+class TestNMS:
+    def test_greedy_suppression(self):
+        boxes = _t([[0, 0, 10, 10], [1, 1, 11, 11], [20, 20, 30, 30]])
+        scores = _t([0.9, 0.8, 0.7])
+        np.testing.assert_array_equal(
+            vops.nms(boxes, 0.5, scores).numpy(), [0, 2])
+        # без scores: input order
+        np.testing.assert_array_equal(
+            vops.nms(boxes, 0.5).numpy(), [0, 2])
+
+    def test_category_aware(self):
+        boxes = _t([[0, 0, 10, 10], [1, 1, 11, 11]])
+        scores = _t([0.9, 0.8])
+        cats = _t([0, 1], "int64")
+        # different categories: both survive despite high overlap
+        keep = vops.nms(boxes, 0.5, scores, category_idxs=cats,
+                        categories=[0, 1]).numpy()
+        assert sorted(keep.tolist()) == [0, 1]
+
+    def test_top_k(self):
+        boxes = _t(np.stack([np.arange(4) * 20.0, np.zeros(4),
+                             np.arange(4) * 20.0 + 10, np.ones(4) * 10], 1))
+        scores = _t([0.4, 0.9, 0.1, 0.7])
+        keep = vops.nms(boxes, 0.5, scores, top_k=2).numpy()
+        np.testing.assert_array_equal(keep, [1, 3])
+
+    def test_matrix_nms_runs(self):
+        bboxes = _t(np.array([[[0, 0, 10, 10], [1, 1, 11, 11]]]))
+        scores = _t(np.array([[[0.9, 0.85]]]))  # [N=1, C=1, M=2]
+        out, idx, nums = vops.matrix_nms(bboxes, scores, 0.1,
+                                         background_label=-1,
+                                         return_index=True)
+        assert out.shape[1] == 6 and int(nums.numpy()[0]) == out.shape[0]
+        # the lower-scored heavy-overlap duplicate is DECAYED (SOLOv2 eq 4)
+        s_out = out.numpy()[:, 1]
+        assert s_out.max() == pytest.approx(0.9)
+        assert s_out.min() < 0.5  # decayed well below its raw 0.85
+
+
+class TestRoIFamily:
+    def test_roi_align_bilinear_gradient_ramp(self):
+        # linear ramp image: averaged samples must reproduce the ramp
+        H = W = 8
+        ramp = np.tile(np.arange(W, dtype="float32"), (H, 1))
+        x = _t(ramp[None, None])
+        boxes = _t([[0.0, 0.0, 7.0, 7.0]])
+        out = vops.roi_align(x, boxes, _t([1], "int32"), 4,
+                             sampling_ratio=2).numpy()[0, 0]
+        # interior output columns advance linearly along the ramp (the
+        # leftmost column is border-clamped — torchvision semantics)
+        diffs = np.diff(out.mean(0))
+        assert np.allclose(diffs[1:], diffs[1], atol=1e-5) and (diffs > 0).all()
+        assert np.allclose(out, out[0][None])  # constant along y
+
+    def test_roi_align_batch_routing(self):
+        x = np.zeros((2, 1, 4, 4), "float32")
+        x[1] = 5.0
+        out = vops.roi_align(_t(x), _t([[0, 0, 3, 3], [0, 0, 3, 3]]),
+                             _t([1, 1], "int32"), 2).numpy()
+        assert np.allclose(out[0], 0.0) and np.allclose(out[1], 5.0)
+
+    def test_roi_pool_max(self):
+        x = np.zeros((1, 1, 4, 4), "float32")
+        x[0, 0, 3, 3] = 9.0
+        out = vops.roi_pool(_t(x), _t([[0, 0, 3, 3]]), _t([1], "int32"),
+                            2).numpy()
+        assert out[0, 0, 1, 1] == 9.0 and out[0, 0, 0, 0] == 0.0
+
+    def test_psroi_pool_channel_groups(self):
+        # C = out_c * oh * ow = 1*2*2; each bin reads its own channel
+        x = np.stack([np.full((4, 4), float(c)) for c in range(4)])[None]
+        out = vops.psroi_pool(_t(x.astype("float32")), _t([[0, 0, 4, 4]]),
+                              _t([1], "int32"), 2).numpy()
+        np.testing.assert_allclose(out[0, 0], [[0, 1], [2, 3]])
+
+    def test_layer_classes(self):
+        x = _t(np.ones((1, 2, 8, 8), "float32"))
+        b = _t([[0.0, 0.0, 7.0, 7.0]])
+        n = _t([1], "int32")
+        assert vops.RoIAlign(2)(x, b, n).shape == [1, 2, 2, 2]
+        assert vops.RoIPool(2)(x, b, n).shape == [1, 2, 2, 2]
+
+
+class TestBoxUtilities:
+    def test_box_coder_roundtrip(self):
+        priors = _t([[1.0, 1.0, 5.0, 5.0], [2.0, 2.0, 8.0, 8.0]])
+        targets = _t([[1.5, 1.5, 6.0, 6.0], [2.0, 3.0, 7.0, 9.0]])
+        var = [0.1, 0.1, 0.2, 0.2]
+        enc = vops.box_coder(priors, var, targets)
+        dec = vops.box_coder(priors, var, enc,
+                             code_type="decode_center_size")
+        np.testing.assert_allclose(dec.numpy(), targets.numpy(), atol=1e-4)
+
+    def test_prior_box_shapes_and_range(self):
+        feat = _t(np.zeros((1, 3, 4, 4), "float32"))
+        img = _t(np.zeros((1, 3, 32, 32), "float32"))
+        pb, pv = vops.prior_box(feat, img, min_sizes=[8.0],
+                                aspect_ratios=[2.0], flip=True, clip=True)
+        assert pb.shape == [4, 4, 3, 4] and pv.shape == [4, 4, 3, 4]
+        assert pb.numpy().min() >= 0.0 and pb.numpy().max() <= 1.0
+
+    def test_yolo_box_decode(self):
+        rng = np.random.default_rng(0)
+        x = _t(rng.standard_normal((1, 2 * 7, 3, 3)).astype("float32"))
+        boxes, scores = vops.yolo_box(
+            x, _t([[96, 96]], "int32"), anchors=[10, 13, 16, 30],
+            class_num=2, conf_thresh=0.0, downsample_ratio=32)
+        assert boxes.shape == [1, 18, 4]
+        assert scores.shape == [1, 18, 2]  # paddle shape [N, M, class_num]
+        b = boxes.numpy()
+        assert b.min() >= 0 and b.max() <= 95  # clipped to image
+
+    def test_distribute_fpn_proposals(self):
+        rois = _t([[0, 0, 16, 16], [0, 0, 200, 200], [0, 0, 60, 60]])
+        outs, restore, nums = vops.distribute_fpn_proposals(
+            rois, 2, 5, 4, 224, rois_num=_t([3], "int32"))
+        assert sum(o.shape[0] for o in outs) == 3
+        # restore index is a permutation
+        assert sorted(restore.numpy().ravel().tolist()) == [0, 1, 2]
+        assert sum(int(n.numpy()[0]) for n in nums) == 3
+
+
+class TestDeformConv:
+    def test_zero_offset_equals_plain_conv(self):
+        rng = np.random.default_rng(1)
+        x = rng.standard_normal((1, 3, 6, 6)).astype("float32")
+        w = rng.standard_normal((4, 3, 3, 3)).astype("float32")
+        off = np.zeros((1, 18, 4, 4), "float32")
+        got = vops.deform_conv2d(_t(x), _t(off), _t(w)).numpy()
+        ref = jax.lax.conv_general_dilated(
+            jnp.asarray(x), jnp.asarray(w), (1, 1), "VALID",
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        np.testing.assert_allclose(got, np.asarray(ref), atol=1e-4)
+
+    def test_integer_offset_shifts_sampling(self):
+        # shifting every tap by +1 in x equals conv on the shifted image
+        rng = np.random.default_rng(2)
+        x = rng.standard_normal((1, 1, 6, 6)).astype("float32")
+        w = np.ones((1, 1, 1, 1), "float32")
+        off = np.zeros((1, 2, 6, 6), "float32")
+        off[:, 1] = 1.0  # (dy, dx) per tap: dx=+1
+        got = vops.deform_conv2d(_t(x), _t(off), _t(w)).numpy()
+        ref = np.zeros_like(x)
+        ref[..., :, :-1] = x[..., :, 1:]  # shifted left; oob -> 0
+        np.testing.assert_allclose(got, ref, atol=1e-5)
+
+    def test_v2_mask_scales(self):
+        x = np.ones((1, 1, 4, 4), "float32")
+        w = np.ones((1, 1, 1, 1), "float32")
+        off = np.zeros((1, 2, 4, 4), "float32")
+        mask = np.full((1, 1, 4, 4), 0.5, "float32")
+        got = vops.deform_conv2d(_t(x), _t(off), _t(w),
+                                 mask=_t(mask)).numpy()
+        np.testing.assert_allclose(got, 0.5 * np.ones_like(x))
+
+    def test_layer_trains(self):
+        layer = vops.DeformConv2D(2, 3, 3, padding=1)
+        x = _t(np.random.default_rng(3).standard_normal(
+            (1, 2, 5, 5)).astype("float32"))
+        off = _t(np.zeros((1, 18, 5, 5), "float32"))
+        out = layer(x, off)
+        assert out.shape == [1, 3, 5, 5]
+        out.sum().backward()
+        assert layer.weight.grad is not None
+
+
+class TestConvNormActivation:
+    def test_block(self):
+        blk = vops.ConvNormActivation(3, 8, 3)
+        x = _t(np.random.default_rng(4).standard_normal(
+            (2, 3, 8, 8)).astype("float32"))
+        assert blk(x).shape == [2, 8, 8, 8]
+        assert (blk(x).numpy() >= 0).all()  # ReLU'd
+
+
+class TestReviewFixes:
+    def test_matrix_nms_actually_decays(self):
+        bboxes = _t(np.array([[[0, 0, 10, 10], [0.5, 0.5, 10.5, 10.5],
+                               [1, 1, 11, 11]]]))
+        scores = _t(np.array([[[0.9, 0.8, 0.7]]]))
+        out, nums = vops.matrix_nms(bboxes, scores, 0.1,
+                                    background_label=-1)
+        s = out.numpy()[:, 1]
+        assert s.max() == pytest.approx(0.9)      # top box undecayed
+        assert (np.sort(s)[:-1] < [0.7, 0.8]).all()  # duplicates decayed
+
+    def test_yolo_box_iou_aware(self):
+        rng = np.random.default_rng(5)
+        na, C = 2, 2
+        x = _t(rng.standard_normal(
+            (1, na * (5 + C) + na, 3, 3)).astype("float32"))
+        boxes, scores = vops.yolo_box(
+            x, _t([[96, 96]], "int32"), anchors=[10, 13, 16, 30],
+            class_num=C, conf_thresh=0.0, iou_aware=True,
+            iou_aware_factor=0.5)
+        assert boxes.shape == [1, 18, 4] and scores.shape == [1, 18, 2]
+
+    def test_conv_norm_activation_none_disables(self):
+        blk = vops.ConvNormActivation(3, 8, 3, norm_layer=None,
+                                      activation_layer=None)
+        names = [type(l).__name__ for l in blk]
+        assert names == ["Conv2D"]
+        # conv keeps its bias when no norm follows
+        assert blk[0].bias is not None
+
+    def test_deform_groups_raise_at_init(self):
+        with pytest.raises(NotImplementedError):
+            vops.DeformConv2D(4, 4, 3, groups=2)
+        with pytest.raises(NotImplementedError):
+            vops.deform_conv2d(_t(np.zeros((1, 4, 4, 4), "float32")),
+                               _t(np.zeros((1, 18, 2, 2), "float32")),
+                               _t(np.zeros((4, 2, 3, 3), "float32")),
+                               groups=2)
+
+    def test_box_coder_3d_decode_axis(self):
+        priors = np.array([[1.0, 1.0, 5.0, 5.0], [2.0, 2.0, 8.0, 8.0]],
+                          "float32")
+        deltas2 = np.zeros((2, 4), "float32")
+        base = vops.box_coder(_t(priors), [1, 1, 1, 1], _t(deltas2),
+                              code_type="decode_center_size").numpy()
+        # 3-D [A=3, B=2, 4] deltas, axis=0: priors broadcast along A
+        deltas3 = np.zeros((3, 2, 4), "float32")
+        out = vops.box_coder(_t(priors), [1, 1, 1, 1], _t(deltas3),
+                             code_type="decode_center_size", axis=0).numpy()
+        assert out.shape == (3, 2, 4)
+        for a in range(3):
+            np.testing.assert_allclose(out[a], base, atol=1e-5)
+
+    def test_prior_box_min_max_order(self):
+        feat = _t(np.zeros((1, 3, 1, 1), "float32"))
+        img = _t(np.zeros((1, 3, 32, 32), "float32"))
+        default, _ = vops.prior_box(feat, img, min_sizes=[8.0],
+                                    max_sizes=[16.0], aspect_ratios=[2.0])
+        ordered, _ = vops.prior_box(feat, img, min_sizes=[8.0],
+                                    max_sizes=[16.0], aspect_ratios=[2.0],
+                                    min_max_aspect_ratios_order=True)
+        d = default.numpy().reshape(-1, 4)
+        o = ordered.numpy().reshape(-1, 4)
+        # same box set, different order: min first in both; max second when
+        # the flag is set (it is last by default)
+        np.testing.assert_allclose(np.sort(d, 0), np.sort(o, 0), atol=1e-6)
+        np.testing.assert_allclose(o[1], d[-1], atol=1e-6)

@@ -34,14 +34,17 @@ def _np(x):
 # NMS (ops.py:1867)
 # --------------------------------------------------------------------------
 
-def _iou_matrix(boxes: np.ndarray) -> np.ndarray:
+def _iou_matrix(boxes: np.ndarray, normalized: bool = True) -> np.ndarray:
+    off = 0.0 if normalized else 1.0
     x1, y1, x2, y2 = boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3]
-    area = np.maximum(0.0, x2 - x1) * np.maximum(0.0, y2 - y1)
+    area = (np.maximum(0.0, x2 - x1 + off)
+            * np.maximum(0.0, y2 - y1 + off))
     ix1 = np.maximum(x1[:, None], x1[None, :])
     iy1 = np.maximum(y1[:, None], y1[None, :])
     ix2 = np.minimum(x2[:, None], x2[None, :])
     iy2 = np.minimum(y2[:, None], y2[None, :])
-    inter = (np.maximum(0.0, ix2 - ix1) * np.maximum(0.0, iy2 - iy1))
+    inter = (np.maximum(0.0, ix2 - ix1 + off)
+             * np.maximum(0.0, iy2 - iy1 + off))
     union = area[:, None] + area[None, :] - inter
     return inter / np.maximum(union, 1e-10)
 
@@ -111,7 +114,7 @@ def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
             if len(sel) == 0:
                 continue
             sel = sel[np.argsort(-s[sel])][:nms_top_k]
-            iou = np.triu(_iou_matrix(bb[n, sel]), k=1)
+            iou = np.triu(_iou_matrix(bb[n, sel], normalized), k=1)
             max_iou = iou.max(0, initial=0.0)  # per j: max over higher-ranked
             # compensate indexed by the SUPPRESSOR row i (SOLOv2 eq. 4):
             # decay_j = min_i f(iou_ij) / f(max_iou_i)
@@ -132,12 +135,13 @@ def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
             outs.append([c, s_] + box.tolist())
             indices.append(gi)
     out = to_tensor(np.asarray(outs, np.float32).reshape(-1, 6))
-    result = [out]
-    if return_index:
-        result.append(to_tensor(np.asarray(indices, np.int64).reshape(-1, 1)))
-    if return_rois_num:
-        result.append(to_tensor(np.asarray(nums, np.int32)))
-    return tuple(result) if len(result) > 1 else out
+    # paddle contract (reference ops.py:2335): ALWAYS (out, rois_num, index)
+    # with None placeholders for the disabled outputs
+    rois_num_t = (to_tensor(np.asarray(nums, np.int32))
+                  if return_rois_num else None)
+    index_t = (to_tensor(np.asarray(indices, np.int64).reshape(-1, 1))
+               if return_index else None)
+    return out, rois_num_t, index_t
 
 
 # --------------------------------------------------------------------------
@@ -167,7 +171,16 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
         y2 = bv[:, 3] * spatial_scale - off
         rw = x2 - x1 if aligned else jnp.maximum(x2 - x1, 1.0)
         rh = y2 - y1 if aligned else jnp.maximum(y2 - y1, 1.0)
-        sr = sampling_ratio if sampling_ratio > 0 else 2
+        if sampling_ratio > 0:
+            sr = sampling_ratio
+        else:
+            # reference adaptive grid: ceil(roi_size / pooled_size), shared
+            # across RoIs here (static shapes) via the largest RoI
+            bv_np = np.asarray(b._value)
+            max_side = max(float(np.max(bv_np[:, 2] - bv_np[:, 0])),
+                           float(np.max(bv_np[:, 3] - bv_np[:, 1])), 1.0)
+            sr = max(1, int(np.ceil(max_side * spatial_scale
+                                    / max(oh, ow))))
         # sample grid: [R, oh*sr, ow*sr]
         gy = (y1[:, None] + (jnp.arange(oh * sr) + 0.5)[None, :]
               * rh[:, None] / (oh * sr))
@@ -208,15 +221,19 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
 
 
 def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
-    """(ops.py:1514) quantized max pooling per RoI bin."""
+    """(ops.py:1514) quantized max pooling per RoI bin.  Bin boundaries are
+    computed host-side from the (concrete) boxes; the pooling itself runs
+    through ``run_op`` on the feature map, so gradients flow to ``x`` (the
+    reference has a grad kernel — this op must train)."""
     oh, ow = ((output_size, output_size) if isinstance(output_size, int)
               else tuple(output_size))
     bn = _np(boxes_num)
-    xv, bv = _np(x), _np(boxes)
-    N, C, H, W = xv.shape
+    t = _ensure(x)
+    bv = _np(boxes)
+    N, C, H, W = t._value.shape
     R = bv.shape[0]
     bidx = _roi_index(bn, R)
-    out = np.zeros((R, C, oh, ow), xv.dtype)
+    bins = []  # (batch, [(ys, ye, xs, xe) per output cell])
     for r in range(R):
         x1 = int(round(bv[r, 0] * spatial_scale))
         y1 = int(round(bv[r, 1] * spatial_scale))
@@ -224,45 +241,74 @@ def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
         y2 = int(round(bv[r, 3] * spatial_scale))
         rh = max(y2 - y1 + 1, 1)
         rw = max(x2 - x1 + 1, 1)
+        cells = []
         for i in range(oh):
             for j in range(ow):
                 ys = min(max(y1 + int(np.floor(i * rh / oh)), 0), H)
                 ye = min(max(y1 + int(np.ceil((i + 1) * rh / oh)), 0), H)
                 xs = min(max(x1 + int(np.floor(j * rw / ow)), 0), W)
                 xe = min(max(x1 + int(np.ceil((j + 1) * rw / ow)), 0), W)
+                cells.append((ys, ye, xs, xe))
+        bins.append((int(bidx[r]), cells))
+
+    def f(xv):
+        rois = []
+        for b_i, cells in bins:
+            vals = []
+            for ys, ye, xs, xe in cells:
                 if ye > ys and xe > xs:
-                    out[r, :, i, j] = xv[bidx[r], :, ys:ye, xs:xe].max((1, 2))
-    return to_tensor(out)
+                    vals.append(jnp.max(xv[b_i, :, ys:ye, xs:xe], (1, 2)))
+                else:
+                    vals.append(jnp.zeros((C,), xv.dtype))
+            rois.append(jnp.stack(vals, -1).reshape(C, oh, ow))
+        return jnp.stack(rois)
+
+    return run_op("roi_pool", f, t)
 
 
 def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
                name=None):
     """(ops.py:1393) position-sensitive RoI average pooling: input channels
-    C = out_c · oh · ow; bin (i, j) reads its own channel group."""
+    C = out_c · oh · ow; bin (i, j) reads its own channel group.  Bin
+    boundaries host-side, pooling through ``run_op`` (differentiable)."""
     oh, ow = ((output_size, output_size) if isinstance(output_size, int)
               else tuple(output_size))
-    xv, bv = _np(x), _np(boxes)
+    t = _ensure(x)
+    bv = _np(boxes)
     bn = _np(boxes_num)
-    N, C, H, W = xv.shape
+    N, C, H, W = t._value.shape
     out_c = C // (oh * ow)
     R = bv.shape[0]
     bidx = _roi_index(bn, R)
-    out = np.zeros((R, out_c, oh, ow), xv.dtype)
+    bins = []
     for r in range(R):
         x1, y1, x2, y2 = bv[r] * spatial_scale
         rh = max(y2 - y1, 0.1)
         rw = max(x2 - x1, 0.1)
+        cells = []
         for i in range(oh):
             for j in range(ow):
                 ys = min(max(int(np.floor(y1 + i * rh / oh)), 0), H)
                 ye = min(max(int(np.ceil(y1 + (i + 1) * rh / oh)), 0), H)
                 xs = min(max(int(np.floor(x1 + j * rw / ow)), 0), W)
                 xe = min(max(int(np.ceil(x1 + (j + 1) * rw / ow)), 0), W)
-                c0 = (i * ow + j) * out_c
+                cells.append(((i * ow + j) * out_c, ys, ye, xs, xe))
+        bins.append((int(bidx[r]), cells))
+
+    def f(xv):
+        rois = []
+        for b_i, cells in bins:
+            vals = []
+            for c0, ys, ye, xs, xe in cells:
                 if ye > ys and xe > xs:
-                    out[r, :, i, j] = xv[bidx[r], c0:c0 + out_c,
-                                         ys:ye, xs:xe].mean((1, 2))
-    return to_tensor(out)
+                    vals.append(jnp.mean(
+                        xv[b_i, c0:c0 + out_c, ys:ye, xs:xe], (1, 2)))
+                else:
+                    vals.append(jnp.zeros((out_c,), xv.dtype))
+            rois.append(jnp.stack(vals, -1).reshape(out_c, oh, ow))
+        return jnp.stack(rois)
+
+    return run_op("psroi_pool", f, t)
 
 
 # --------------------------------------------------------------------------
@@ -286,16 +332,17 @@ def box_coder(prior_box, prior_box_var, target_box,
     pcx = pb[:, 0] + pw / 2
     pcy = pb[:, 1] + ph / 2
     if code_type == "encode_center_size":
-        tw = tv[:, 2] - tv[:, 0] + norm
-        th = tv[:, 3] - tv[:, 1] + norm
-        tcx = tv[:, 0] + tw / 2
-        tcy = tv[:, 1] + th / 2
+        # paddle contract: EVERY target against EVERY prior -> [N, M, 4]
+        tw = (tv[:, 2] - tv[:, 0] + norm)[:, None]
+        th = (tv[:, 3] - tv[:, 1] + norm)[:, None]
+        tcx = (tv[:, 0] + (tv[:, 2] - tv[:, 0] + norm) / 2)[:, None]
+        tcy = (tv[:, 1] + (tv[:, 3] - tv[:, 1] + norm) / 2)[:, None]
         v = var if var.shape[0] > 1 else np.broadcast_to(var, (len(pb), 4))
         out = np.stack([
-            (tcx - pcx) / pw / v[:, 0],
-            (tcy - pcy) / ph / v[:, 1],
-            np.log(np.maximum(tw / pw, 1e-10)) / v[:, 2],
-            np.log(np.maximum(th / ph, 1e-10)) / v[:, 3],
+            (tcx - pcx[None, :]) / pw[None, :] / v[None, :, 0],
+            (tcy - pcy[None, :]) / ph[None, :] / v[None, :, 1],
+            np.log(np.maximum(tw / pw[None, :], 1e-10)) / v[None, :, 2],
+            np.log(np.maximum(th / ph[None, :], 1e-10)) / v[None, :, 3],
         ], -1)
         return to_tensor(out.astype(np.float32))
     # decode_center_size: deltas [M, 4] or [A, B, 4]; priors broadcast

@@ -44,7 +44,7 @@ class TestNMS:
     def test_matrix_nms_runs(self):
         bboxes = _t(np.array([[[0, 0, 10, 10], [1, 1, 11, 11]]]))
         scores = _t(np.array([[[0.9, 0.85]]]))  # [N=1, C=1, M=2]
-        out, idx, nums = vops.matrix_nms(bboxes, scores, 0.1,
+        out, nums, idx = vops.matrix_nms(bboxes, scores, 0.1,
                                          background_label=-1,
                                          return_index=True)
         assert out.shape[1] == 6 and int(nums.numpy()[0]) == out.shape[0]
@@ -103,10 +103,14 @@ class TestBoxUtilities:
         priors = _t([[1.0, 1.0, 5.0, 5.0], [2.0, 2.0, 8.0, 8.0]])
         targets = _t([[1.5, 1.5, 6.0, 6.0], [2.0, 3.0, 7.0, 9.0]])
         var = [0.1, 0.1, 0.2, 0.2]
-        enc = vops.box_coder(priors, var, targets)
+        enc = vops.box_coder(priors, var, targets)  # [N, M, 4]
+        assert enc.shape == [2, 2, 4]
         dec = vops.box_coder(priors, var, enc,
-                             code_type="decode_center_size")
-        np.testing.assert_allclose(dec.numpy(), targets.numpy(), atol=1e-4)
+                             code_type="decode_center_size", axis=0).numpy()
+        for n in range(2):
+            for m in range(2):
+                np.testing.assert_allclose(dec[n, m], targets.numpy()[n],
+                                           atol=1e-4)
 
     def test_prior_box_shapes_and_range(self):
         feat = _t(np.zeros((1, 3, 4, 4), "float32"))
@@ -195,8 +199,8 @@ class TestReviewFixes:
         bboxes = _t(np.array([[[0, 0, 10, 10], [0.5, 0.5, 10.5, 10.5],
                                [1, 1, 11, 11]]]))
         scores = _t(np.array([[[0.9, 0.8, 0.7]]]))
-        out, nums = vops.matrix_nms(bboxes, scores, 0.1,
-                                    background_label=-1)
+        out, nums, _ = vops.matrix_nms(bboxes, scores, 0.1,
+                                       background_label=-1)
         s = out.numpy()[:, 1]
         assert s.max() == pytest.approx(0.9)      # top box undecayed
         assert (np.sort(s)[:-1] < [0.7, 0.8]).all()  # duplicates decayed
@@ -257,3 +261,153 @@ class TestReviewFixes:
         # the flag is set (it is last by default)
         np.testing.assert_allclose(np.sort(d, 0), np.sort(o, 0), atol=1e-6)
         np.testing.assert_allclose(o[1], d[-1], atol=1e-6)
+
+
+class TestTransformsRound2:
+    """Completed vision.transforms surface (transforms.py + functional.py):
+    photometric/geometric identity properties + shape contracts."""
+
+    def _img(self):
+        return np.random.default_rng(0).uniform(
+            0, 1, (3, 16, 16)).astype("float32")
+
+    def test_photometric_identities(self):
+        from paddle_tpu.vision import transforms as T
+
+        img = self._img()
+        np.testing.assert_allclose(T.adjust_brightness(img, 1.0), img)
+        np.testing.assert_allclose(T.adjust_contrast(img, 1.0), img,
+                                   atol=1e-6)
+        np.testing.assert_allclose(T.adjust_saturation(img, 1.0), img,
+                                   atol=1e-6)
+        np.testing.assert_allclose(T.adjust_hue(img, 0.0), img, atol=1e-4)
+        # full hue turn returns to the original
+        np.testing.assert_allclose(
+            T.adjust_hue(T.adjust_hue(img, 0.5), 0.5), img, atol=1e-4)
+
+    def test_geometric_identities(self):
+        from paddle_tpu.vision import transforms as T
+
+        img = self._img()
+        np.testing.assert_allclose(T.rotate(img, 0.0), img, atol=1e-4)
+        np.testing.assert_allclose(T.rotate(img, 180.0),
+                                   img[..., ::-1, ::-1], atol=1e-3)
+        pts = [(0, 0), (15, 0), (15, 15), (0, 15)]
+        np.testing.assert_allclose(T.perspective(img, pts, pts), img,
+                                   atol=1e-4)
+        np.testing.assert_allclose(T.vflip(img), img[..., ::-1, :])
+        assert T.crop(img, 2, 3, 5, 6).shape == (3, 5, 6)
+
+    def test_transform_classes(self):
+        from paddle_tpu.vision import transforms as T
+
+        img = self._img()
+        assert T.ColorJitter(0.2, 0.2, 0.2, 0.1)(img).shape == img.shape
+        assert T.RandomResizedCrop(8)(img).shape == (3, 8, 8)
+        assert (T.RandomErasing(prob=1.0)(img.copy()) != img).any()
+        g = T.Grayscale(3)(img)
+        np.testing.assert_allclose(g[0], g[1])
+        assert T.Pad((1, 2))(img).shape == (3, 20, 18)
+        np.testing.assert_allclose(T.RandomVerticalFlip(prob=1.0)(img),
+                                   img[..., ::-1, :])
+        assert T.RandomAffine(10, translate=(0.1, 0.1), scale=(0.9, 1.1),
+                              shear=5)(img).shape == img.shape
+        assert T.RandomPerspective(prob=1.0)(img).shape == img.shape
+        assert T.Transpose()(img.transpose(1, 2, 0)).shape == img.shape
+
+    def test_base_transform_keys(self):
+        from paddle_tpu.vision import transforms as T
+
+        class AddOne(T.BaseTransform):
+            def _apply_image(self, im):
+                return im + 1
+
+            def _apply_mask(self, m):
+                return m
+
+        t = AddOne(keys=("image", "mask"))
+        img, mask = self._img(), np.zeros((16, 16))
+        oi, om = t((img, mask))
+        np.testing.assert_allclose(oi, img + 1)
+        np.testing.assert_allclose(om, mask)
+
+
+class TestVisionReviewFixes:
+    def test_roi_pools_differentiable(self):
+        x = _t(np.random.default_rng(6).standard_normal(
+            (1, 4, 8, 8)).astype("float32"))
+        x.stop_gradient = False
+        out = vops.roi_pool(x, _t([[0, 0, 7, 7]]), _t([1], "int32"), 2)
+        out.sum().backward()
+        assert x.grad is not None and np.abs(x.grad.numpy()).sum() > 0
+        x.clear_grad()
+        out = vops.psroi_pool(x, _t([[0, 0, 8, 8]]), _t([1], "int32"), 2)
+        out.sum().backward()
+        assert x.grad is not None and np.abs(x.grad.numpy()).sum() > 0
+
+    def test_matrix_nms_paddle_tuple_contract(self):
+        bboxes = _t(np.array([[[0, 0, 10, 10], [1, 1, 11, 11]]]))
+        scores = _t(np.array([[[0.9, 0.85]]]))
+        out, rois_num, index = vops.matrix_nms(
+            bboxes, scores, 0.1, background_label=-1, return_index=True)
+        assert index is not None and rois_num is not None
+        out2, rois_num2, index2 = vops.matrix_nms(
+            bboxes, scores, 0.1, background_label=-1)
+        assert index2 is None and rois_num2 is not None
+        _, rn3, _ = vops.matrix_nms(bboxes, scores, 0.1,
+                                    background_label=-1,
+                                    return_rois_num=False)
+        assert rn3 is None
+
+    def test_box_coder_encode_n_by_m(self):
+        priors = _t([[1.0, 1.0, 5.0, 5.0], [2.0, 2.0, 8.0, 8.0]])
+        targets = _t([[1.5, 1.5, 6.0, 6.0], [2.0, 3.0, 7.0, 9.0],
+                      [0.0, 0.0, 4.0, 4.0]])
+        enc = vops.box_coder(priors, [1, 1, 1, 1], targets)
+        assert enc.shape == [3, 2, 4]  # N targets x M priors
+        # decoding column m of the encoding against prior m recovers target
+        dec = vops.box_coder(priors, [1, 1, 1, 1],
+                             enc, code_type="decode_center_size",
+                             axis=0).numpy()
+        for nidx in range(3):
+            for m in range(2):
+                np.testing.assert_allclose(dec[nidx, m],
+                                           targets.numpy()[nidx], atol=1e-4)
+
+    def test_matrix_nms_unnormalized_iou(self):
+        # identical 1-px boxes: normalized IoU is 0/0, unnormalized is 1 —
+        # the duplicate must decay only in unnormalized mode
+        bboxes = _t(np.array([[[5, 5, 5, 5], [5, 5, 5, 5]]]))
+        scores = _t(np.array([[[0.9, 0.8]]]))
+        out_n, _, _ = vops.matrix_nms(bboxes, scores, 0.1,
+                                      background_label=-1, normalized=False)
+        s = np.sort(out_n.numpy()[:, 1])
+        assert s[-1] == pytest.approx(0.9) and s[0] < 0.1
+
+    def test_rotate_expand_keeps_content(self):
+        from paddle_tpu.vision import transforms as T
+
+        img = np.zeros((1, 10, 10), "float32")
+        img[0, 0, 0] = 7.0  # corner pixel would be lost without expand
+        out = T.rotate(img, 45.0, expand=True)
+        assert out.shape[-1] > 10 and out.shape[-2] > 10
+        assert out.max() > 3.0  # corner content survived
+
+    def test_random_erasing_per_channel_value(self):
+        from paddle_tpu.vision import transforms as T
+
+        img = np.ones((3, 16, 16), "float32")
+        out = T.RandomErasing(prob=1.0, value=(0.1, 0.2, 0.3))(img.copy())
+        changed = out != img
+        assert changed.any()
+        # each channel erased with ITS value
+        for c, v in enumerate((0.1, 0.2, 0.3)):
+            vals = out[c][changed[c]]
+            np.testing.assert_allclose(vals, v, atol=1e-6)
+
+    def test_adjust_range_by_dtype_not_content(self):
+        from paddle_tpu.vision import transforms as T
+
+        dark = np.full((3, 4, 4), 1, np.uint8)  # max value 1 but uint8
+        out = T.adjust_brightness(dark, 50.0)
+        assert out.max() == 50.0  # not clipped to 1.0

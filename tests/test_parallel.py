@@ -362,3 +362,25 @@ class TestHeterogeneousPipeline:
         out.sum().backward()
         for s in (stages[0], stages[2], stages[3]):
             assert s.weight.grad is not None
+
+
+class TestCommunicationStream:
+    """stream.* collective variants (communication/stream/*.py surface)."""
+
+    def test_all_reduce_task_contract(self, mesh_dp2_mp4):
+        from paddle_tpu.distributed.communication import stream
+
+        t = paddle.to_tensor(np.ones(4, "float32"))
+        task = stream.all_reduce(t, sync_op=False, use_calc_stream=True)
+        assert task.is_completed() and task.wait() and task.synchronize()
+
+    def test_package_reexports(self):
+        from paddle_tpu.distributed import communication as comm
+
+        for name in ("all_reduce", "all_gather", "reduce_scatter",
+                     "broadcast", "alltoall", "send", "recv", "ReduceOp"):
+            assert hasattr(comm, name)
+        for name in ("all_reduce", "all_gather", "reduce_scatter",
+                     "broadcast", "scatter", "reduce", "alltoall",
+                     "alltoall_single", "send", "recv"):
+            assert hasattr(comm.stream, name)

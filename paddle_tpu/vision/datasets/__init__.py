@@ -81,3 +81,103 @@ class Cifar10(Dataset):
 
 class Cifar100(Cifar10):
     pass
+
+
+def _default_loader(path):
+    from PIL import Image
+
+    with open(path, "rb") as f:
+        img = Image.open(f)
+        return img.convert("RGB")
+
+
+IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".pgm",
+                  ".tif", ".tiff", ".webp", ".npy")
+
+
+class DatasetFolder(Dataset):
+    """(``vision/datasets/folder.py`` DatasetFolder) generic
+    class-per-subfolder dataset: root/class_x/xxx.ext -> (sample, label)."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        import os
+
+        self.root = root
+        self.loader = loader or _default_loader
+        self.transform = transform
+        extensions = extensions or IMG_EXTENSIONS
+
+        classes = sorted(
+            d for d in os.listdir(root)
+            if os.path.isdir(os.path.join(root, d)))
+        if not classes:
+            raise RuntimeError(f"no class folders found under {root}")
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+
+        def valid(path):
+            if is_valid_file is not None:
+                return is_valid_file(path)
+            return path.lower().endswith(tuple(extensions))
+
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for dirpath, _, files in sorted(os.walk(cdir)):
+                for fn in sorted(files):
+                    p = os.path.join(dirpath, fn)
+                    if valid(p):
+                        self.samples.append((p, self.class_to_idx[c]))
+        if not self.samples:
+            raise RuntimeError(f"no valid files found under {root}")
+
+    def __getitem__(self, idx):
+        path, label = self.samples[idx]
+        if path.endswith(".npy"):
+            sample = np.load(path)
+        else:
+            sample = self.loader(path)
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return sample, label
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(Dataset):
+    """(``folder.py`` ImageFolder) flat/recursive image listing — samples
+    only, no labels."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        import os
+
+        self.loader = loader or _default_loader
+        self.transform = transform
+        extensions = extensions or IMG_EXTENSIONS
+
+        def valid(path):
+            if is_valid_file is not None:
+                return is_valid_file(path)
+            return path.lower().endswith(tuple(extensions))
+
+        self.samples = []
+        for dirpath, _, files in sorted(os.walk(root)):
+            for fn in sorted(files):
+                p = os.path.join(dirpath, fn)
+                if valid(p):
+                    self.samples.append(p)
+        if not self.samples:
+            raise RuntimeError(f"no valid files found under {root}")
+
+    def __getitem__(self, idx):
+        path = self.samples[idx]
+        sample = np.load(path) if path.endswith(".npy") else self.loader(path)
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return [sample]
+
+    def __len__(self):
+        return len(self.samples)

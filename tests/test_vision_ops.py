@@ -411,3 +411,51 @@ class TestVisionReviewFixes:
         dark = np.full((3, 4, 4), 1, np.uint8)  # max value 1 but uint8
         out = T.adjust_brightness(dark, 50.0)
         assert out.max() == 50.0  # not clipped to 1.0
+
+
+class TestFolderDatasets:
+    def test_dataset_folder_and_image_folder(self, tmp_path):
+        from PIL import Image
+
+        from paddle_tpu.vision import transforms as T
+        from paddle_tpu.vision.datasets import DatasetFolder, ImageFolder
+
+        rng = np.random.default_rng(0)
+        for cls in ("cat", "dog"):
+            (tmp_path / cls).mkdir()
+            for i in range(3):
+                Image.fromarray(
+                    (rng.uniform(0, 255, (8, 8, 3))).astype("uint8")
+                ).save(tmp_path / cls / f"{i}.png")
+
+        ds = DatasetFolder(str(tmp_path), transform=T.Compose([T.ToTensor()]))
+        assert len(ds) == 6 and ds.classes == ["cat", "dog"]
+        img, label = ds[0]
+        assert img.shape == (3, 8, 8) and label == 0
+        assert ds[5][1] == 1
+
+        flat = ImageFolder(str(tmp_path))
+        assert len(flat) == 6
+        (s,) = flat[0]
+        assert np.asarray(s).shape == (8, 8, 3)
+
+    def test_folder_dataset_through_dataloader(self, tmp_path):
+        from PIL import Image
+
+        import paddle_tpu as paddle
+        from paddle_tpu.vision import transforms as T
+        from paddle_tpu.vision.datasets import DatasetFolder
+
+        rng = np.random.default_rng(1)
+        for cls in ("a", "b"):
+            (tmp_path / cls).mkdir()
+            for i in range(4):
+                Image.fromarray(
+                    (rng.uniform(0, 255, (8, 8, 3))).astype("uint8")
+                ).save(tmp_path / cls / f"{i}.png")
+        ds = DatasetFolder(str(tmp_path),
+                           transform=T.Compose([T.ToTensor()]))
+        loader = paddle.io.DataLoader(ds, batch_size=4, shuffle=False)
+        xb, yb = next(iter(loader))
+        assert list(xb.shape) == [4, 3, 8, 8]
+        assert list(np.asarray(yb.numpy()).reshape(-1)) == [0, 0, 0, 0]

@@ -5,3 +5,5 @@ from __future__ import annotations
 
 from . import functional  # noqa: F401
 from .features import LogMelSpectrogram, MFCC, MelSpectrogram, Spectrogram  # noqa: F401
+
+from . import datasets  # noqa: F401,E402

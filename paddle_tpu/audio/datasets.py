@@ -1,0 +1,86 @@
+"""``paddle.audio.datasets`` (``python/paddle/audio/datasets/``: ESC50,
+TESS over an AudioClassificationDataset base).  Zero-egress environment:
+when the archives are absent the datasets synthesize deterministic
+label-correlated waveforms (same fallback pattern as vision MNIST) so the
+feature pipeline and training loops stay exercisable end-to-end."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..io.dataset import Dataset
+
+
+class AudioClassificationDataset(Dataset):
+    """(``audio/datasets/dataset.py``) base: waveform -> optional feature
+    transform -> (feature, label)."""
+
+    def __init__(self, files=None, labels=None, feature_type="raw",
+                 sample_rate=16000, duration=1.0, archive=None, **kwargs):
+        self.feature_type = feature_type
+        self.sample_rate = sample_rate
+        self._files = files or []
+        self._labels = labels or []
+        self._synth = not self._files
+        self._n_samples = int(sample_rate * duration)
+
+    def _waveform(self, idx):
+        if not self._synth:
+            raise NotImplementedError("archive loading needs soundfile")
+        label = self._labels[idx]
+        rng = np.random.RandomState(idx)
+        t = np.arange(self._n_samples) / self.sample_rate
+        freq = 110.0 * (1 + label)          # label-correlated pitch
+        wave = (np.sin(2 * np.pi * freq * t)
+                + 0.1 * rng.standard_normal(self._n_samples))
+        return wave.astype(np.float32)
+
+    def __getitem__(self, idx):
+        wave = self._waveform(idx)
+        label = np.asarray([self._labels[idx]], np.int64)
+        if self.feature_type == "raw":
+            return wave, label
+        from . import features
+
+        cls = {"spectrogram": features.Spectrogram,
+               "melspectrogram": features.MelSpectrogram,
+               "logmelspectrogram": features.LogMelSpectrogram,
+               "mfcc": features.MFCC}[self.feature_type]
+        from ..core.tensor import to_tensor
+
+        feat = cls(sr=self.sample_rate) if self.feature_type != "spectrogram" \
+            else cls()
+        out = feat(to_tensor(wave[None]))
+        return np.asarray(out.numpy())[0], label
+
+    def __len__(self):
+        return len(self._labels)
+
+
+class ESC50(AudioClassificationDataset):
+    """(``audio/datasets/esc50.py``) 50-class environmental sounds;
+    synthetic fallback waveforms in this offline environment."""
+
+    n_classes = 50
+
+    def __init__(self, mode="train", split=1, feature_type="raw",
+                 archive=None, **kwargs):
+        n = 400 if mode == "train" else 100
+        rng = np.random.RandomState(0 if mode == "train" else 1)
+        labels = rng.randint(0, self.n_classes, n).tolist()
+        super().__init__(labels=labels, feature_type=feature_type,
+                         sample_rate=16000, duration=1.0, **kwargs)
+
+
+class TESS(AudioClassificationDataset):
+    """(``audio/datasets/tess.py``) 7-emotion speech; synthetic fallback."""
+
+    n_classes = 7
+
+    def __init__(self, mode="train", n_folds=5, split=1,
+                 feature_type="raw", archive=None, **kwargs):
+        n = 280 if mode == "train" else 70
+        rng = np.random.RandomState(2 if mode == "train" else 3)
+        labels = rng.randint(0, self.n_classes, n).tolist()
+        super().__init__(labels=labels, feature_type=feature_type,
+                         sample_rate=16000, duration=1.0, **kwargs)

@@ -23,6 +23,7 @@ class AudioClassificationDataset(Dataset):
         self._labels = labels or []
         self._synth = not self._files
         self._n_samples = int(sample_rate * duration)
+        self._feat = None  # built once on first use (not per item)
 
     def _waveform(self, idx):
         if not self._synth:
@@ -40,17 +41,18 @@ class AudioClassificationDataset(Dataset):
         label = np.asarray([self._labels[idx]], np.int64)
         if self.feature_type == "raw":
             return wave, label
-        from . import features
-
-        cls = {"spectrogram": features.Spectrogram,
-               "melspectrogram": features.MelSpectrogram,
-               "logmelspectrogram": features.LogMelSpectrogram,
-               "mfcc": features.MFCC}[self.feature_type]
         from ..core.tensor import to_tensor
 
-        feat = cls(sr=self.sample_rate) if self.feature_type != "spectrogram" \
-            else cls()
-        out = feat(to_tensor(wave[None]))
+        if self._feat is None:
+            from . import features
+
+            cls = {"spectrogram": features.Spectrogram,
+                   "melspectrogram": features.MelSpectrogram,
+                   "logmelspectrogram": features.LogMelSpectrogram,
+                   "mfcc": features.MFCC}[self.feature_type]
+            self._feat = (cls() if self.feature_type == "spectrogram"
+                          else cls(sr=self.sample_rate))
+        out = self._feat(to_tensor(wave[None]))
         return np.asarray(out.numpy())[0], label
 
     def __len__(self):

@@ -1,0 +1,151 @@
+"""``paddle.incubate.optimizer`` — LookAhead and ModelAverage wrappers
+(``python/paddle/incubate/optimizer/lookahead.py`` / ``modelaverage.py``).
+Both are pure parameter-space bookkeeping over the inner optimizer, so
+they compose with every optimizer/AMP/sharding path."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..optimizer.optimizer import Optimizer
+
+
+class LookAhead(Optimizer):
+    """(lookahead.py LookAhead) k fast steps, then slow weights pull toward
+    the fast weights: slow += alpha·(fast − slow); fast ← slow."""
+
+    def __init__(self, inner_optimizer: Optimizer, alpha: float = 0.5,
+                 k: int = 5, name=None):
+        if not 0.0 <= alpha <= 1.0:
+            raise ValueError(f"alpha must be in [0, 1], got {alpha}")
+        if k < 1:
+            raise ValueError(f"k must be a positive integer, got {k}")
+        self.__dict__["inner_optimizer"] = inner_optimizer
+        self.__dict__["alpha"] = alpha
+        self.__dict__["k"] = k
+        self.__dict__["_la_step"] = 0
+        self.__dict__["_slow"] = {}
+
+    def __getattr__(self, name):
+        return getattr(self.__dict__["inner_optimizer"], name)
+
+    def __setattr__(self, name, value):
+        if name in ("alpha", "k", "_la_step", "_slow"):
+            self.__dict__[name] = value
+        else:
+            setattr(self.__dict__["inner_optimizer"], name, value)
+
+    def step(self):
+        inner = self.__dict__["inner_optimizer"]
+        params = inner._parameter_list or []
+        for p in params:
+            if id(p) not in self._slow:
+                self._slow[id(p)] = (p, p._value)
+        inner.step()
+        self.__dict__["_la_step"] = self._la_step + 1
+        if self._la_step % self.k == 0:
+            for pid, (p, slow) in list(self._slow.items()):
+                new_slow = slow + self.alpha * (p._value - slow)
+                p._value = new_slow
+                self._slow[pid] = (p, new_slow)
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+    def clear_grad(self, set_to_zero=True):
+        self.__dict__["inner_optimizer"].clear_grad(set_to_zero)
+
+    def state_dict(self):
+        import numpy as np
+
+        sd = dict(self.__dict__["inner_optimizer"].state_dict())
+        sd["@lookahead_step"] = self._la_step
+        # persist slow weights positionally (parameter order is stable):
+        # resuming mid-cycle must pull toward the ORIGINAL anchor
+        params = self.__dict__["inner_optimizer"]._parameter_list or []
+        sd["@lookahead_slow"] = [
+            np.asarray(self._slow[id(p)][1]) if id(p) in self._slow else None
+            for p in params]
+        return sd
+
+    def set_state_dict(self, state):
+        state = dict(state)  # never mutate the caller's dict
+        self.__dict__["_la_step"] = state.pop("@lookahead_step", 0)
+        slows = state.pop("@lookahead_slow", [])
+        params = self.__dict__["inner_optimizer"]._parameter_list or []
+        self.__dict__["_slow"] = {
+            id(p): (p, jnp.asarray(s))
+            for p, s in zip(params, slows) if s is not None}
+        return self.__dict__["inner_optimizer"].set_state_dict(state)
+
+
+class ModelAverage(Optimizer):
+    """(modelaverage.py ModelAverage) running average of parameter values
+    over a trailing window; ``apply()`` swaps the averaged weights in for
+    evaluation, ``restore()`` swaps training weights back."""
+
+    def __init__(self, average_window_rate: float, parameters=None,
+                 min_average_window: int = 10000,
+                 max_average_window: int = 10000, name=None):
+        if parameters is None:
+            raise ValueError(
+                "ModelAverage requires parameters= (nothing to average "
+                "otherwise; apply() would silently be a no-op)")
+        super().__init__(learning_rate=0.0, parameters=parameters)
+        self.avg_rate = average_window_rate
+        self.min_window = min_average_window
+        self.max_window = max_average_window
+        self._num_updates = 0
+        self._acc = {}       # id -> (param, sum, count)
+        self._saved = None
+
+    def step(self):
+        """Accumulate the current parameter values (call after the inner
+        optimizer's step)."""
+        self._num_updates += 1
+        window = max(self.min_window,
+                     min(self.max_window,
+                         int(self._num_updates * self.avg_rate)))
+        for p in (self._parameter_list or []):
+            pid = id(p)
+            _, acc, cnt = self._acc.get(pid, (p, jnp.zeros_like(p._value), 0))
+            acc = acc + p._value
+            cnt += 1
+            if cnt > window:  # slide: keep the trailing window mass
+                acc = acc * (window / cnt)
+                cnt = window
+            self._acc[pid] = (p, acc, cnt)
+
+    def apply(self, executor=None, need_restore=True):
+        """Swap averaged values in (context-manager style usable too)."""
+        self._saved = {}
+        for pid, (p, acc, cnt) in self._acc.items():
+            if cnt == 0:
+                continue
+            self._saved[pid] = (p, p._value)
+            p._value = acc / cnt
+        if not need_restore:
+            self._saved = None
+        return self
+
+    def restore(self, executor=None):
+        for pid, (p, val) in (self._saved or {}).items():
+            p._value = val
+        self._saved = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.restore()
+        return False
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        self.step()

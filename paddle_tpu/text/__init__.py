@@ -131,3 +131,5 @@ def viterbi_decode(potentials, transition_params, lengths,
         return score, path
 
     return run_op("viterbi_decode", f, pot, trans, lens)
+
+from .datasets import WMT14, WMT16, Imikolov, Movielens  # noqa: F401,E402

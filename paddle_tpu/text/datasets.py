@@ -1,0 +1,118 @@
+"""``paddle.text.datasets`` completion (``python/paddle/text/datasets/``:
+imikolov.py, movielens.py, wmt14.py/wmt16.py).  Zero-egress: deterministic
+synthetic corpora with the same sample structure as the real datasets
+(n-gram tuples, rating triples, padded translation pairs)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..io.dataset import Dataset
+
+
+class Imikolov(Dataset):
+    """(imikolov.py) PTB-style n-gram LM samples: each item is a window of
+    ``N`` token ids (first N-1 = context, last = target)."""
+
+    VOCAB = 2048
+
+    def __init__(self, mode="train", data_type="NGRAM", window_size=5,
+                 min_word_freq=50, **kwargs):
+        n = 8000 if mode == "train" else 1000
+        rng = np.random.RandomState(0 if mode == "train" else 1)
+        # a Markov-ish stream so context actually predicts the target
+        stream = np.zeros(n + window_size, np.int64)
+        for i in range(1, len(stream)):
+            stream[i] = (stream[i - 1] * 31 + rng.randint(0, 7)) % self.VOCAB
+        if data_type.upper() != "NGRAM":
+            raise NotImplementedError(
+                f"Imikolov data_type={data_type!r}: only NGRAM windows are "
+                "implemented (SEQ pairs are not)")
+        self._windows = np.lib.stride_tricks.sliding_window_view(
+            stream, window_size)[:n]
+        self.data_type = data_type
+
+    def __getitem__(self, idx):
+        w = self._windows[idx]
+        return tuple(np.asarray([t]) for t in w)
+
+    def __len__(self):
+        return len(self._windows)
+
+
+class Movielens(Dataset):
+    """(movielens.py) (user features, movie features, rating) triples."""
+
+    N_USERS, N_MOVIES = 943, 1682
+
+    def __init__(self, mode="train", test_ratio=0.1, rand_seed=0, **kwargs):
+        rng = np.random.RandomState(rand_seed)
+        n_total = 10000
+        users = rng.randint(0, self.N_USERS, n_total)
+        movies = rng.randint(0, self.N_MOVIES, n_total)
+        # rating correlated with (user+movie) hash -> learnable signal
+        ratings = ((users * 7 + movies * 13) % 5 + 1).astype(np.float32)
+        n_test = int(n_total * test_ratio)
+        sl = slice(n_test, None) if mode == "train" else slice(0, n_test)
+        self._users = users[sl]
+        self._movies = movies[sl]
+        self._ratings = ratings[sl]
+
+    def __getitem__(self, idx):
+        u = self._users[idx]
+        m = self._movies[idx]
+        user_feat = np.asarray([u, u % 2, u % 7, u % 21], np.int64)
+        movie_feat = np.asarray([m, m % 19], np.int64)
+        return user_feat, movie_feat, np.asarray(
+            [self._ratings[idx]], np.float32)
+
+    def __len__(self):
+        return len(self._ratings)
+
+
+class _WMTBase(Dataset):
+    """Padded (src_ids, src_len, tgt_in, tgt_out, tgt_len) pairs — the
+    padded-batch analog of the reference's LoD translation samples."""
+
+    SRC_VOCAB = 4000
+    TGT_VOCAB = 4000
+    BOS, EOS = 0, 1
+
+    def __init__(self, mode="train", seq_len=16, seed=0, n=2000):
+        rng = np.random.RandomState(seed if mode == "train" else seed + 1)
+        n = n if mode == "train" else n // 10
+        self._src = rng.randint(2, self.SRC_VOCAB, (n, seq_len)).astype(np.int64)
+        self._lens = rng.randint(4, seq_len + 1, n)
+        # "translation": reversed source mapped into the target vocab —
+        # deterministic, so a seq2seq model can actually fit it
+        self._tgt = np.zeros_like(self._src)
+        for i in range(n):
+            L = self._lens[i]
+            self._tgt[i, :L] = (self._src[i, :L][::-1] * 3) % (self.TGT_VOCAB - 2) + 2  # keep BOS/EOS out of band
+            self._src[i, L:] = self.EOS
+            self._tgt[i, L:] = self.EOS
+
+    def __getitem__(self, idx):
+        L = self._lens[idx]
+        tgt_in = np.concatenate([[self.BOS], self._tgt[idx][:-1]])
+        return (self._src[idx], np.asarray([L], np.int64),
+                tgt_in.astype(np.int64), self._tgt[idx],
+                np.asarray([L], np.int64))
+
+    def __len__(self):
+        return len(self._src)
+
+
+class WMT14(_WMTBase):
+    """(wmt14.py) en-fr pairs; synthetic fallback."""
+
+    def __init__(self, mode="train", dict_size=4000, **kwargs):
+        super().__init__(mode=mode, seed=14)
+
+
+class WMT16(_WMTBase):
+    """(wmt16.py) en-de pairs; synthetic fallback."""
+
+    def __init__(self, mode="train", src_dict_size=4000, trg_dict_size=4000,
+                 lang="en", **kwargs):
+        super().__init__(mode=mode, seed=16)

@@ -119,3 +119,98 @@ class TestAudioDatasets:
         loader = paddle.io.DataLoader(ESC50(mode="dev"), batch_size=8)
         xb, yb = next(iter(loader))
         assert list(xb.shape) == [8, 16000]
+
+
+class TestTextDatasetsRound2:
+    def test_imikolov_ngram_windows(self):
+        from paddle_tpu.text import Imikolov
+
+        ds = Imikolov(window_size=5)
+        assert len(ds) == 8000
+        sample = ds[10]
+        assert len(sample) == 5
+        # deterministic
+        np.testing.assert_array_equal(ds[10][0], sample[0])
+
+    def test_movielens_feature_triple(self):
+        from paddle_tpu.text import Movielens
+
+        tr = Movielens(mode="train")
+        te = Movielens(mode="test")
+        assert len(tr) == 9000 and len(te) == 1000
+        u, m, r = tr[0]
+        assert u.shape == (4,) and m.shape == (2,) and 1 <= r[0] <= 5
+
+    def test_wmt_pairs_learnable_mapping(self):
+        from paddle_tpu.text import WMT16
+
+        ds = WMT16(mode="train")
+        src, sl, tin, tout, tl = ds[3]
+        L = int(sl[0])
+        # tgt_out is the deterministic transform of reversed src prefix
+        np.testing.assert_array_equal(
+            tout[:L], (src[:L][::-1] * 3) % 3998 + 2)
+        assert (tout[:L] >= 2).all()  # BOS/EOS out of band
+        # teacher forcing shift: tin = [BOS] + tout[:-1]
+        assert tin[0] == 0
+        np.testing.assert_array_equal(tin[1:L], tout[:L - 1])
+
+    def test_through_dataloader(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.text import WMT14
+
+        loader = paddle.io.DataLoader(WMT14(mode="test"), batch_size=4)
+        batch = next(iter(loader))
+        assert list(batch[0].shape) == [4, 16]
+
+
+class TestIncubateOptimizers:
+    def test_lookahead_sync_every_k(self):
+        import paddle_tpu as paddle
+        from paddle_tpu import nn
+        from paddle_tpu.incubate.optimizer import LookAhead
+
+        paddle.seed(0)
+        net = nn.Linear(4, 4)
+        inner = paddle.optimizer.SGD(learning_rate=0.5,
+                                     parameters=net.parameters())
+        opt = LookAhead(inner, alpha=0.5, k=2)
+        x = paddle.to_tensor(np.ones((2, 4), "float32"))
+        w0 = net.weight.numpy().copy()
+        net(x).sum().backward()
+        opt.step()
+        opt.clear_grad()
+        w_after_1 = net.weight.numpy().copy()  # pure fast step
+        net(x).sum().backward()
+        opt.step()
+        opt.clear_grad()
+        w_after_2 = net.weight.numpy()         # k reached: pulled to halfway
+        fast_step = w_after_1 - w0
+        # after two identical-gradient fast steps, fast = w0 + 2*step;
+        # slow sync: w = w0 + alpha*2*step = w0 + step
+        np.testing.assert_allclose(w_after_2, w0 + fast_step, atol=1e-5)
+
+    def test_model_average_apply_restore(self):
+        import paddle_tpu as paddle
+        from paddle_tpu import nn
+        from paddle_tpu.incubate.optimizer import ModelAverage
+
+        paddle.seed(1)
+        net = nn.Linear(4, 4)
+        inner = paddle.optimizer.SGD(learning_rate=0.5,
+                                     parameters=net.parameters())
+        ma = ModelAverage(0.5, parameters=net.parameters(),
+                          min_average_window=10, max_average_window=100)
+        x = paddle.to_tensor(np.ones((2, 4), "float32"))
+        vals = []
+        for _ in range(3):
+            net(x).sum().backward()
+            inner.step()
+            inner.clear_grad()
+            ma.step()
+            vals.append(net.weight.numpy().copy())
+        cur = net.weight.numpy().copy()
+        with ma.apply():
+            np.testing.assert_allclose(net.weight.numpy(),
+                                       np.mean(vals, 0), atol=1e-6)
+        np.testing.assert_allclose(net.weight.numpy(), cur)

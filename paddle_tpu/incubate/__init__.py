@@ -7,3 +7,5 @@ The reference's incubate tree holds the fused transformer building blocks
 from . import nn  # noqa: F401
 
 from . import optimizer  # noqa: F401,E402
+
+from . import distributed  # noqa: F401,E402

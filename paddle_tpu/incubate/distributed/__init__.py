@@ -1,0 +1,3 @@
+"""``paddle.incubate.distributed`` package shape."""
+
+from . import models  # noqa: F401

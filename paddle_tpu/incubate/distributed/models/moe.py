@@ -1,0 +1,11 @@
+"""``paddle.incubate.distributed.models.moe`` parity path
+(``python/paddle/incubate/distributed/models/moe/moe_layer.py:263``): the
+implementation lives in :mod:`paddle_tpu.parallel.moe` (GShard dense
+dispatch/combine over the expert mesh axis)."""
+
+from ....parallel.moe import (  # noqa: F401
+    FusedMoEMLP,
+    GShardGate,
+    MoELayer,
+    SwitchGate,
+)

@@ -172,3 +172,47 @@ _global_bias_init = None
 def _apply_initializer(init, shape, dtype):
     d = dtype_mod.convert_dtype(dtype)
     return init(tuple(int(s) for s in shape), d)
+
+
+class Bilinear(Initializer):
+    """(``nn/initializer/Bilinear``) transposed-conv upsampling kernels:
+    weight [C_out, C_in, kh, kw] filled with the bilinear interpolation
+    stencil."""
+
+    def __call__(self, shape, dtype):
+        import numpy as np
+
+        if len(shape) != 4:
+            raise ValueError(f"Bilinear expects a 4-D conv weight, got {shape}")
+        _, _, kh, kw = shape
+        fh, fw = (kh + 1) // 2, (kw + 1) // 2
+        cy = fh - 1 if kh % 2 == 1 else fh - 0.5
+        cx = fw - 1 if kw % 2 == 1 else fw - 0.5
+        og = np.ogrid[:kh, :kw]
+        stencil = ((1 - abs(og[0] - cy) / fh)
+                   * (1 - abs(og[1] - cx) / fw)).astype("float32")
+        w = np.zeros(shape, "float32")
+        w[range(shape[0]), range(shape[0]) if shape[0] == shape[1] else 0] = stencil
+        return jnp.asarray(w, dtype)
+
+
+class LazyGuard:
+    """(``nn/initializer/lazy_init.py`` LazyGuard) context that defers
+    parameter materialization in the reference; eager-by-design here —
+    a no-op context kept for API parity."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+# legacy *Initializer aliases (fluid-era names the reference still exports)
+ConstantInitializer = Constant
+NormalInitializer = Normal
+TruncatedNormalInitializer = TruncatedNormal
+UniformInitializer = Uniform
+XavierInitializer = XavierUniform
+MSRAInitializer = KaimingUniform
+NumpyArrayInitializer = Assign

@@ -1,0 +1,15 @@
+"""``paddle.distributed.fleet.meta_parallel`` parity path
+(``fleet/meta_parallel/__init__.py`` surface): TP layers, pipeline
+schedule, sharding stages — implementations in :mod:`paddle_tpu.parallel`."""
+
+from ...parallel.mp_layers import (  # noqa: F401
+    ColumnParallelLinear,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+)
+from ...parallel.pipeline import LayerDesc, PipelineLayer, SharedLayerDesc  # noqa: F401
+from ...parallel.sharding import (  # noqa: F401
+    GroupShardedOptimizerStage2,
+    GroupShardedStage2,
+    GroupShardedStage3,
+)

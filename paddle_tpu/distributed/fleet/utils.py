@@ -1,0 +1,11 @@
+"""``paddle.distributed.fleet.utils`` parity path: recompute + the
+sequence-parallel PyLayer helpers (``fleet/utils/sequence_parallel_utils.py``,
+``fleet/recompute/recompute.py``)."""
+
+from ...parallel.recompute import recompute  # noqa: F401
+from ...parallel.sequence_parallel import (  # noqa: F401
+    AllGatherOp,
+    GatherOp,
+    ReduceScatterOp,
+    ScatterOp,
+)

@@ -1,0 +1,12 @@
+"""``paddle.distributed.sharding`` parity path
+(``python/paddle/distributed/sharding/group_sharded.py``): implementation
+in :mod:`paddle_tpu.parallel.sharding` (declarative ZeRO placements over
+the ``sharding`` mesh axis, HLO-proven in ``tests/test_zero_proof.py``)."""
+
+from ..parallel.sharding import (  # noqa: F401
+    GroupShardedOptimizerStage2,
+    GroupShardedStage2,
+    GroupShardedStage3,
+    group_sharded_parallel,
+    save_group_sharded_model,
+)

@@ -87,3 +87,27 @@ def test_fleet_sharded_optimizer():
     loss.backward()
     opt.step()
     opt.clear_grad()
+
+
+class TestParityPaths:
+    """Reference import paths users actually type (fleet.utils,
+    fleet.meta_parallel, distributed.sharding) resolve to the real
+    implementations."""
+
+    def test_distributed_sharding_path(self):
+        from paddle_tpu.distributed.sharding import group_sharded_parallel
+        from paddle_tpu.parallel.sharding import (
+            group_sharded_parallel as impl,
+        )
+
+        assert group_sharded_parallel is impl
+
+    def test_fleet_utils_and_meta_parallel(self):
+        from paddle_tpu.distributed.fleet import meta_parallel, utils
+        from paddle_tpu.parallel.mp_layers import ColumnParallelLinear
+        from paddle_tpu.parallel.recompute import recompute
+
+        assert utils.recompute is recompute
+        assert meta_parallel.ColumnParallelLinear is ColumnParallelLinear
+        assert hasattr(meta_parallel, "PipelineLayer")
+        assert hasattr(utils, "ScatterOp")

@@ -127,3 +127,36 @@ def enable_static():
 
 def in_dynamic_mode() -> bool:
     return True
+
+from . import version  # noqa: F401,E402
+from .version import full_version as __version__  # noqa: F401,E402
+from .nn.initializer import LazyGuard  # noqa: F401,E402
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """(``tensor/to_string.py`` set_printoptions) — numpy renders Tensor
+    reprs here, so the knobs map onto numpy's printoptions."""
+    import numpy as _np
+
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    _np.set_printoptions(**kw)
+
+
+def disable_signal_handler():
+    """(paddle.disable_signal_handler) — the reference unhooks its C++
+    fault handlers; there are none here, so this is a documented no-op."""
+
+
+def get_cudnn_version():
+    return None

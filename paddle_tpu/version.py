@@ -1,0 +1,30 @@
+"""``paddle.version`` (generated ``python/paddle/version.py`` analog)."""
+
+full_version = "2.6.0+tpu"
+major = "2"
+minor = "6"
+patch = "0"
+rc = "0"
+cuda_version = "False"
+cudnn_version = "False"
+xpu_version = "False"
+istaged = False
+commit = "tpu-native"
+with_pip_cuda_libraries = "OFF"
+
+
+def show():
+    print(f"paddle_tpu {full_version} (commit {commit}); "
+          "backend: XLA/TPU via JAX")
+
+
+def cuda():
+    return cuda_version
+
+
+def cudnn():
+    return cudnn_version
+
+
+def xpu():
+    return xpu_version

@@ -1,0 +1,190 @@
+// Native sparse embedding table for parameter-server mode (N30).
+//
+// Capability analog of the reference's C++ memory sparse table
+// (paddle/fluid/distributed/ps/table/memory_sparse_table.h): id-keyed
+// rows with lazy creation, SGD/Adagrad update rules, thread-safe access.
+// Bound via ctypes (no pybind in-image); the Python PsServer routes its
+// hot pull/push loops here so the serving path is native like the
+// reference's brpc tables.
+//
+// C ABI:
+//   void* sparse_table_create(int dim, float lr, int optimizer /*0=sgd,1=adagrad*/,
+//                             float init_scale, unsigned long long seed);
+//   void  sparse_table_destroy(void* t);
+//   int   sparse_table_pull(void* t, const long long* keys, int n, float* out);
+//   int   sparse_table_push(void* t, const long long* keys, int n, const float* grads);
+//   long long sparse_table_size(void* t);
+//   int   sparse_table_dump(void* t, long long* keys_out, float* rows_out,
+//                           float* g2_out, long long cap); // snapshot
+//   int   sparse_table_load(void* t, const long long* keys, const float* rows,
+//                           const float* g2, long long n);  // REPLACES rows
+//   void  sparse_table_clear(void* t);
+
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Row {
+  std::vector<float> value;
+  std::vector<float> g2;  // adagrad accumulator (lazily sized)
+};
+
+struct Table {
+  int dim;
+  float lr;
+  int optimizer;  // 0 = sgd, 1 = adagrad
+  float init_scale;
+  uint64_t seed;
+  std::mutex mu;
+  std::unordered_map<int64_t, Row> rows;
+
+  // deterministic per-key init: splitmix64 -> uniform(-scale, scale)
+  void init_row(int64_t key, std::vector<float>* out) const {
+    out->resize(dim);
+    uint64_t x = seed ^ static_cast<uint64_t>(key);
+    for (int i = 0; i < dim; ++i) {
+      x += 0x9e3779b97f4a7c15ULL;
+      uint64_t z = x;
+      z = (z ^ (z >> 30)) * 0xbf58476d1ce4e5b9ULL;
+      z = (z ^ (z >> 27)) * 0x94d049bb133111ebULL;
+      z = z ^ (z >> 31);
+      double u = static_cast<double>(z >> 11) / 9007199254740992.0;  // [0,1)
+      (*out)[i] = static_cast<float>((u * 2.0 - 1.0) * init_scale);
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* sparse_table_create(int dim, float lr, int optimizer, float init_scale,
+                          unsigned long long seed) {
+  if (dim <= 0) return nullptr;
+  Table* t = new Table();
+  t->dim = dim;
+  t->lr = lr;
+  t->optimizer = optimizer;
+  t->init_scale = init_scale;
+  t->seed = seed;
+  return t;
+}
+
+void sparse_table_destroy(void* handle) {
+  delete static_cast<Table*>(handle);
+}
+
+int sparse_table_pull(void* handle, const long long* keys, int n,
+                      float* out) {
+  Table* t = static_cast<Table*>(handle);
+  if (!t || n < 0) return -1;
+  std::lock_guard<std::mutex> lock(t->mu);
+  for (int i = 0; i < n; ++i) {
+    auto it = t->rows.find(keys[i]);
+    if (it == t->rows.end()) {
+      Row row;
+      t->init_row(keys[i], &row.value);
+      it = t->rows.emplace(keys[i], std::move(row)).first;
+    }
+    std::memcpy(out + static_cast<size_t>(i) * t->dim,
+                it->second.value.data(), sizeof(float) * t->dim);
+  }
+  return 0;
+}
+
+int sparse_table_push(void* handle, const long long* keys, int n,
+                      const float* grads) {
+  Table* t = static_cast<Table*>(handle);
+  if (!t || n < 0) return -1;
+  std::lock_guard<std::mutex> lock(t->mu);
+  for (int i = 0; i < n; ++i) {
+    auto it = t->rows.find(keys[i]);
+    if (it == t->rows.end()) {
+      Row row;
+      t->init_row(keys[i], &row.value);
+      it = t->rows.emplace(keys[i], std::move(row)).first;
+    }
+    Row& row = it->second;
+    const float* g = grads + static_cast<size_t>(i) * t->dim;
+    if (t->optimizer == 1) {  // adagrad
+      if (row.g2.empty()) row.g2.assign(t->dim, 0.0f);
+      for (int d = 0; d < t->dim; ++d) {
+        row.g2[d] += g[d] * g[d];
+        row.value[d] -= t->lr * g[d] / (std::sqrt(row.g2[d]) + 1e-8f);
+      }
+    } else {  // sgd
+      for (int d = 0; d < t->dim; ++d) row.value[d] -= t->lr * g[d];
+    }
+  }
+  return 0;
+}
+
+long long sparse_table_size(void* handle) {
+  Table* t = static_cast<Table*>(handle);
+  if (!t) return -1;
+  std::lock_guard<std::mutex> lock(t->mu);
+  return static_cast<long long>(t->rows.size());
+}
+
+int sparse_table_dump(void* handle, long long* keys_out, float* rows_out,
+                      float* g2_out, long long cap) {
+  Table* t = static_cast<Table*>(handle);
+  if (!t) return -1;
+  std::lock_guard<std::mutex> lock(t->mu);
+  long long i = 0;
+  for (const auto& kv : t->rows) {
+    if (i >= cap) return -2;  // caller's buffer too small
+    keys_out[i] = kv.first;
+    std::memcpy(rows_out + static_cast<size_t>(i) * t->dim,
+                kv.second.value.data(), sizeof(float) * t->dim);
+    if (g2_out) {
+      if (kv.second.g2.empty()) {
+        std::memset(g2_out + static_cast<size_t>(i) * t->dim, 0,
+                    sizeof(float) * t->dim);
+      } else {
+        std::memcpy(g2_out + static_cast<size_t>(i) * t->dim,
+                    kv.second.g2.data(), sizeof(float) * t->dim);
+      }
+    }
+    ++i;
+  }
+  return static_cast<int>(i);
+}
+
+void sparse_table_clear(void* handle) {
+  Table* t = static_cast<Table*>(handle);
+  if (!t) return;
+  std::lock_guard<std::mutex> lock(t->mu);
+  t->rows.clear();
+}
+
+int sparse_table_load(void* handle, const long long* keys, const float* rows,
+                      const float* g2, long long n) {
+  // REPLACE semantics: the restored table holds exactly the checkpointed
+  // rows (matching the python backend), never stale survivors
+  Table* t = static_cast<Table*>(handle);
+  if (!t) return -1;
+  std::lock_guard<std::mutex> lock(t->mu);
+  t->rows.clear();
+  for (long long i = 0; i < n; ++i) {
+    Row row;
+    row.value.assign(rows + static_cast<size_t>(i) * t->dim,
+                     rows + static_cast<size_t>(i + 1) * t->dim);
+    if (g2) {
+      row.g2.assign(g2 + static_cast<size_t>(i) * t->dim,
+                    g2 + static_cast<size_t>(i + 1) * t->dim);
+      bool all_zero = true;
+      for (float v : row.g2) if (v != 0.0f) { all_zero = false; break; }
+      if (all_zero) row.g2.clear();
+    }
+    t->rows[keys[i]] = std::move(row);
+  }
+  return 0;
+}
+
+}  // extern "C"

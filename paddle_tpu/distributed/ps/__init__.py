@@ -30,8 +30,137 @@ import numpy as np
 _REGISTRY: Dict[str, "PsServer"] = {}
 
 
+class NativeSparseTable:
+    """C++ sparse table (``csrc/sparse_table.cpp`` — the reference's
+    ``memory_sparse_table.h`` is likewise native): lazy deterministic row
+    init, SGD/Adagrad push rules, thread-safe, dump/load snapshots."""
+
+    def __init__(self, dim: int, initializer: str = "uniform",
+                 init_scale: float = 0.01, optimizer: str = "sgd",
+                 learning_rate: float = 0.05, seed: int = 0):
+        import ctypes
+
+        from ...core import native
+
+        lib = native.load("sparse_table")
+        lib.sparse_table_create.restype = ctypes.c_void_p
+        lib.sparse_table_create.argtypes = [
+            ctypes.c_int, ctypes.c_float, ctypes.c_int, ctypes.c_float,
+            ctypes.c_ulonglong]
+        lib.sparse_table_destroy.argtypes = [ctypes.c_void_p]
+        lib.sparse_table_pull.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_longlong),
+            ctypes.c_int, ctypes.POINTER(ctypes.c_float)]
+        lib.sparse_table_push.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_longlong),
+            ctypes.c_int, ctypes.POINTER(ctypes.c_float)]
+        lib.sparse_table_size.restype = ctypes.c_longlong
+        lib.sparse_table_size.argtypes = [ctypes.c_void_p]
+        lib.sparse_table_dump.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_longlong),
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+            ctypes.c_longlong]
+        lib.sparse_table_load.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_longlong),
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+            ctypes.c_longlong]
+        lib.sparse_table_clear.argtypes = [ctypes.c_void_p]
+        self._lib = lib
+        self._ct = ctypes
+        self.dim = dim
+        scale = init_scale if initializer != "zeros" else 0.0
+        self._h = lib.sparse_table_create(
+            dim, learning_rate, 1 if optimizer == "adagrad" else 0,
+            scale, seed)
+        if not self._h:
+            raise RuntimeError("sparse_table_create failed")
+
+    def _keys(self, keys):
+        arr = np.ascontiguousarray(np.asarray(keys, np.int64).reshape(-1))
+        return arr, arr.ctypes.data_as(
+            self._ct.POINTER(self._ct.c_longlong))
+
+    def pull(self, keys: Sequence[int]) -> np.ndarray:
+        karr, kptr = self._keys(keys)
+        out = np.empty((len(karr), self.dim), np.float32)
+        rc = self._lib.sparse_table_pull(
+            self._h, kptr, len(karr),
+            out.ctypes.data_as(self._ct.POINTER(self._ct.c_float)))
+        if rc != 0:
+            raise RuntimeError(f"sparse_table_pull rc={rc}")
+        return out
+
+    def push(self, keys: Sequence[int], grads: np.ndarray):
+        karr, kptr = self._keys(keys)
+        g = np.ascontiguousarray(np.asarray(grads, np.float32))
+        if g.shape != (len(karr), self.dim):
+            # validate BEFORE crossing the ctypes boundary — a mismatched
+            # buffer would be an out-of-bounds read in native code
+            raise ValueError(
+                f"push grads shape {g.shape} != ({len(karr)}, {self.dim})")
+        rc = self._lib.sparse_table_push(
+            self._h, kptr, len(karr),
+            g.ctypes.data_as(self._ct.POINTER(self._ct.c_float)))
+        if rc != 0:
+            raise RuntimeError(f"sparse_table_push rc={rc}")
+
+    def size(self) -> int:
+        return int(self._lib.sparse_table_size(self._h))
+
+    def state_dict(self):
+        # retry with the fresh size on -2: a concurrent pull may insert a
+        # row between size() and the dump (live-serving checkpoint)
+        for _ in range(8):
+            n = self.size()
+            cap = n + 64  # headroom for rows created while dumping
+            keys = np.empty(cap, np.int64)
+            rows = np.empty((cap, self.dim), np.float32)
+            g2 = np.empty((cap, self.dim), np.float32)
+            rc = self._lib.sparse_table_dump(
+                self._h,
+                keys.ctypes.data_as(self._ct.POINTER(self._ct.c_longlong)),
+                rows.ctypes.data_as(self._ct.POINTER(self._ct.c_float)),
+                g2.ctypes.data_as(self._ct.POINTER(self._ct.c_float)), cap)
+            if rc >= 0:
+                return {"keys": keys[:rc].copy(), "rows": rows[:rc].copy(),
+                        "g2": g2[:rc].copy()}
+        raise RuntimeError("sparse_table_dump kept racing row creation")
+
+    def load_state_dict(self, state):
+        keys = np.ascontiguousarray(np.asarray(state["keys"], np.int64))
+        rows = np.ascontiguousarray(np.asarray(state["rows"], np.float32))
+        if rows.shape != (len(keys), self.dim):
+            raise ValueError(
+                f"load rows shape {rows.shape} != ({len(keys)}, {self.dim})")
+        g2 = state.get("g2")
+        if g2 is not None:
+            g2 = np.ascontiguousarray(np.asarray(g2, np.float32))
+            if g2.shape != rows.shape:
+                raise ValueError(f"g2 shape {g2.shape} != {rows.shape}")
+            g2p = g2.ctypes.data_as(self._ct.POINTER(self._ct.c_float))
+        else:
+            g2p = self._ct.cast(None, self._ct.POINTER(self._ct.c_float))
+        rc = self._lib.sparse_table_load(
+            self._h,
+            keys.ctypes.data_as(self._ct.POINTER(self._ct.c_longlong)),
+            rows.ctypes.data_as(self._ct.POINTER(self._ct.c_float)),
+            g2p, len(keys))
+        if rc != 0:
+            raise RuntimeError(f"sparse_table_load rc={rc}")
+
+    def __del__(self):
+        try:
+            if getattr(self, "_h", None):
+                self._lib.sparse_table_destroy(self._h)
+                self._h = None
+        except Exception:
+            pass
+
+
 class SparseTable:
-    """(``memory_sparse_table.h`` analog) id-keyed rows, lazy-created."""
+    """(``memory_sparse_table.h`` analog) id-keyed rows, lazy-created.
+    Pure-python reference implementation; :class:`NativeSparseTable` is the
+    C++ hot path (``PsServer.create_sparse_table(backend="native")``)."""
 
     def __init__(self, dim: int, initializer: str = "uniform",
                  init_scale: float = 0.01, optimizer: str = "sgd",
@@ -80,13 +209,34 @@ class SparseTable:
             return len(self._rows)
 
     def state_dict(self):
+        # array snapshot {"keys", "rows", "g2"} — the SAME format as
+        # NativeSparseTable, so checkpoints move between backends
         with self._lock:
-            return {"rows": dict(self._rows), "g2": dict(self._g2)}
+            keys = np.asarray(sorted(self._rows), np.int64)
+            rows = (np.stack([self._rows[int(k)] for k in keys])
+                    if len(keys) else np.zeros((0, self.dim), np.float32))
+            g2 = np.stack([
+                self._g2.get(int(k), np.zeros(self.dim, np.float32))
+                for k in keys]) if len(keys) else np.zeros(
+                (0, self.dim), np.float32)
+            return {"keys": keys, "rows": rows, "g2": g2}
 
     def load_state_dict(self, state):
+        keys = np.asarray(state["keys"], np.int64)
+        rows = np.asarray(state["rows"], np.float32)
+        if rows.shape != (len(keys), self.dim):
+            raise ValueError(
+                f"load rows shape {rows.shape} != ({len(keys)}, {self.dim})")
+        g2 = state.get("g2")
         with self._lock:
-            self._rows = dict(state["rows"])
-            self._g2 = dict(state.get("g2", {}))
+            self._rows = {int(k): rows[i].copy()
+                          for i, k in enumerate(keys)}
+            self._g2 = {}
+            if g2 is not None:
+                g2 = np.asarray(g2, np.float32)
+                for i, k in enumerate(keys):
+                    if g2[i].any():
+                        self._g2[int(k)] = g2[i].copy()
 
 
 class DenseTable:
@@ -122,8 +272,10 @@ class PsServer:
         self._dense: Dict[str, DenseTable] = {}
         _REGISTRY[name] = self
 
-    def create_sparse_table(self, table: str, dim: int, **kw):
-        self._sparse[table] = SparseTable(dim, **kw)
+    def create_sparse_table(self, table: str, dim: int, backend="python",
+                            **kw):
+        cls = NativeSparseTable if backend == "native" else SparseTable
+        self._sparse[table] = cls(dim, **kw)
 
     def create_dense_table(self, table: str, shape, **kw):
         self._dense[table] = DenseTable(shape, **kw)

@@ -127,3 +127,118 @@ class TestPsOverRpc:
             assert c.table_size("emb") == 2
         finally:
             rpc.shutdown()
+
+
+class TestNativeSparseTable:
+    """C++ table (csrc/sparse_table.cpp) — same contract as the python
+    one, native hot path like the reference's memory_sparse_table."""
+
+    def test_pull_deterministic_and_push_sgd(self):
+        t = ps.NativeSparseTable(dim=4, learning_rate=0.5,
+                                 initializer="zeros")
+        r1 = t.pull([7, 9])
+        np.testing.assert_array_equal(r1, np.zeros((2, 4), np.float32))
+        t.push([7], np.ones((1, 4), np.float32))
+        np.testing.assert_allclose(t.pull([7])[0], -0.5 * np.ones(4))
+        assert t.size() == 2
+
+    def test_lazy_init_stable_across_pulls(self):
+        t = ps.NativeSparseTable(dim=8, init_scale=0.1, seed=42)
+        a = t.pull([123456789])
+        b = t.pull([123456789])
+        np.testing.assert_array_equal(a, b)
+        assert np.abs(a).max() <= 0.1 and np.abs(a).sum() > 0
+
+    def test_adagrad_rule(self):
+        t = ps.NativeSparseTable(dim=2, optimizer="adagrad",
+                                 learning_rate=1.0, initializer="zeros")
+        t.push([5], np.array([[2.0, 2.0]], np.float32))
+        np.testing.assert_allclose(t.pull([5])[0], [-1.0, -1.0], rtol=1e-5)
+
+    def test_dump_load_roundtrip(self):
+        t = ps.NativeSparseTable(dim=3, seed=1)
+        t.pull([1, 2, 3])
+        sd = t.state_dict()
+        t2 = ps.NativeSparseTable(dim=3, seed=999)
+        t2.load_state_dict(sd)
+        np.testing.assert_array_equal(t.pull([2]), t2.pull([2]))
+        assert t2.size() == 3
+
+    def test_through_ps_server(self):
+        srv = ps.PsServer("native0")
+        c = ps.PsClient(["native0"], server_name="native0", local=srv)
+        c.create_sparse_table("emb", 4, backend="native",
+                              initializer="zeros", learning_rate=1.0)
+        rows = c.pull_sparse("emb", [10, 20])
+        np.testing.assert_array_equal(rows, np.zeros((2, 4)))
+        c.push_sparse("emb", [10], np.ones((1, 4), np.float32))
+        np.testing.assert_allclose(c.pull_sparse("emb", [10])[0],
+                                   -np.ones(4))
+
+    def test_concurrent_push_threadsafe(self):
+        import threading
+
+        t = ps.NativeSparseTable(dim=4, learning_rate=0.001,
+                                 initializer="zeros")
+        t.pull([0])
+
+        def worker():
+            for _ in range(200):
+                t.push([0], np.ones((1, 4), np.float32))
+
+        threads = [threading.Thread(target=worker) for _ in range(4)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        # 800 SGD steps of lr*1.0 each: exact under the mutex
+        np.testing.assert_allclose(t.pull([0])[0], -0.8 * np.ones(4),
+                                   rtol=1e-4)
+
+
+class TestNativeTableReviewFixes:
+    def test_push_shape_validated(self):
+        t = ps.NativeSparseTable(dim=4, initializer="zeros")
+        with pytest.raises(ValueError):
+            t.push([1, 2], np.ones((1, 4), np.float32))
+        with pytest.raises(ValueError):
+            t.push([1], np.ones((1, 3), np.float32))
+
+    def test_load_shape_validated(self):
+        t = ps.NativeSparseTable(dim=8)
+        with pytest.raises(ValueError):
+            t.load_state_dict({"keys": np.arange(10),
+                               "rows": np.zeros((10, 4), np.float32)})
+
+    def test_adagrad_state_survives_snapshot(self):
+        t = ps.NativeSparseTable(dim=2, optimizer="adagrad",
+                                 learning_rate=1.0, initializer="zeros")
+        t.push([5], np.array([[2.0, 2.0]], np.float32))
+        sd = t.state_dict()
+        t2 = ps.NativeSparseTable(dim=2, optimizer="adagrad",
+                                  learning_rate=1.0, initializer="zeros")
+        t2.load_state_dict(sd)
+        # same next-step behavior as the uninterrupted table
+        t.push([5], np.array([[2.0, 2.0]], np.float32))
+        t2.push([5], np.array([[2.0, 2.0]], np.float32))
+        np.testing.assert_allclose(t2.pull([5]), t.pull([5]), rtol=1e-6)
+
+    def test_load_replaces_not_merges(self):
+        t = ps.NativeSparseTable(dim=2, initializer="zeros")
+        t.pull(list(range(100)))
+        sd_small = {"keys": np.arange(50, dtype=np.int64),
+                    "rows": np.ones((50, 2), np.float32)}
+        t.load_state_dict(sd_small)
+        assert t.size() == 50  # stale rows 50..99 gone
+
+    def test_cross_backend_checkpoint(self):
+        py = ps.SparseTable(dim=3, seed=7)
+        py.pull([1, 2, 3])
+        py.push([2], np.ones((1, 3), np.float32))
+        nat = ps.NativeSparseTable(dim=3, seed=99)
+        nat.load_state_dict(py.state_dict())
+        np.testing.assert_allclose(nat.pull([2]), py.pull([2]))
+        # and back
+        py2 = ps.SparseTable(dim=3, seed=0)
+        py2.load_state_dict(nat.state_dict())
+        np.testing.assert_allclose(py2.pull([1]), py.pull([1]))

@@ -160,3 +160,69 @@ def disable_signal_handler():
 
 def get_cudnn_version():
     return None
+
+from .base.param_attr import ParamAttr  # noqa: F401,E402
+import numpy as _np_dtype_mod  # noqa: E402
+dtype = _np_dtype_mod.dtype  # paddle.dtype: the dtype TYPE (numpy-compatible)
+from .nn.functional import pdist  # noqa: F401,E402
+from .tensor import reverse  # noqa: F401,E402
+
+
+class CUDAPinnedPlace:
+    """Place shim (no pinned host memory distinction on this runtime)."""
+
+    def __repr__(self):
+        return "Place(cpu)"
+
+
+def get_cuda_rng_state():
+    """CUDA RNG aliases onto the single functional RNG state."""
+    return get_rng_state()
+
+
+def set_cuda_rng_state(state):
+    return set_rng_state(state)
+
+
+def batch(reader, batch_size, drop_last=False):
+    """(``paddle.batch``) legacy reader decorator: group an item reader
+    into lists of samples (the reference contract — no stacking, so
+    ragged/dict samples pass through untouched)."""
+    if not isinstance(batch_size, int) or batch_size <= 0:
+        raise ValueError(
+            f"batch_size must be a positive integer, got {batch_size!r}")
+
+    def batched():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return batched
+
+
+def check_shape(shape, op_name="check_shape",
+                expected_shape_type=(list, tuple, Tensor),
+                expected_element_type=(int, Tensor),
+                expected_tensor_dtype=("int32", "int64")):
+    """(``base/data_feeder.py`` check_shape) validate a shape argument;
+    Tensor shapes and numpy/python int elements are accepted."""
+    import numpy as _np
+
+    if isinstance(shape, Tensor):
+        if str(shape.dtype) not in expected_tensor_dtype:
+            raise TypeError(
+                f"{op_name}: shape tensor dtype must be in "
+                f"{expected_tensor_dtype}, got {shape.dtype}")
+        return
+    if not isinstance(shape, expected_shape_type):
+        raise TypeError(f"{op_name}: shape must be {expected_shape_type}")
+    for s in shape:
+        if isinstance(s, Tensor):
+            continue
+        if not isinstance(s, (int, _np.integer)) or int(s) < -1:
+            raise ValueError(f"{op_name}: invalid shape entry: {s!r}")

@@ -158,3 +158,61 @@ def _attach_inplace():
 _attach_methods()
 _attach_dunders()
 _attach_inplace()
+
+
+# --------------------------------------------------------------------------
+# In-place variants (`op_`): generated over the functional ops — each
+# rebinds the input to the op's result (the reference generates these in
+# eager codegen; semantics on the immutable substrate = functional op +
+# _rebind functionalization).
+# --------------------------------------------------------------------------
+
+_INPLACE_NAMES = [
+    "abs", "acos", "addmm", "asin", "atan", "bitwise_and", "bitwise_not",
+    "bitwise_or", "bitwise_xor", "bitwise_left_shift", "bitwise_right_shift",
+    "ceil", "clip", "copysign", "cos", "cosh", "cumprod", "cumsum",
+    "digamma", "divide", "equal", "erf", "exp", "expm1", "floor",
+    "floor_divide", "floor_mod", "frac", "gammaln", "gcd",
+    "greater_equal", "greater_than", "hypot", "i0", "index_add",
+    "index_fill", "index_put", "lcm", "ldexp", "less_equal", "less_than",
+    "lgamma", "log", "log10", "log1p", "log2", "logical_and", "logical_not",
+    "logical_or", "logical_xor", "logit", "masked_fill", "mod", "multiply",
+    "nan_to_num", "neg", "polygamma", "pow", "reciprocal", "remainder",
+    "round", "rsqrt", "scale", "sigmoid", "sin", "sinh", "sqrt", "square",
+    "subtract", "t", "tan", "tanh", "tril", "triu", "trunc",
+]
+
+
+def _make_inplace(fn):
+    def op_(x, *args, **kwargs):
+        return x._rebind(fn(x, *args, **kwargs))
+
+    op_.__name__ = fn.__name__ + "_"
+    op_.__doc__ = f"In-place variant of :func:`{fn.__name__}`."
+    return op_
+
+
+_g = globals()
+for _name in _INPLACE_NAMES:
+    _fn = _g.get(_name)
+    if _fn is None:
+        raise AssertionError(
+            f"_INPLACE_NAMES entry {_name!r} has no functional op")
+    _inplace = _name + "_"
+    if _inplace not in _g:
+        _g[_inplace] = _make_inplace(_fn)
+    # Tensor-method form too (x.sin_() — the reference's primary calling
+    # convention for in-place ops); the generation loop runs after
+    # _attach_methods, so attach explicitly
+    if not hasattr(Tensor, _inplace):
+        setattr(Tensor, _inplace, _g[_inplace])
+# cauchy_/geometric_ come from tensor/random.py directly
+for _inplace in ("cauchy_", "geometric_"):
+    if not hasattr(Tensor, _inplace):
+        setattr(Tensor, _inplace, _g[_inplace])
+del _g, _name, _fn
+
+
+def reverse(x, axis, name=None):
+    """Legacy alias of :func:`flip` (the reference still exports it)."""
+    return flip(x, axis)

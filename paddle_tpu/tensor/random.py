@@ -159,3 +159,25 @@ def randn_like(x, dtype=None, name=None):
 
 def shuffle(x, axis=0, name=None):
     return Tensor(jax.random.permutation(rng.next_key(), x._value, axis=axis, independent=False))
+
+
+def cauchy_(x, loc=0, scale=1, name=None):
+    """In-place Cauchy fill (``tensor/random.py`` cauchy_)."""
+    key = rng.next_key()
+    out = run_op(
+        "cauchy_",
+        lambda v: (loc + scale * jax.random.cauchy(key, v.shape)).astype(v.dtype),
+        x)
+    return x._rebind(out)
+
+
+def geometric_(x, probs, name=None):
+    """In-place geometric fill (``tensor/random.py`` geometric_)."""
+    key = rng.next_key()
+
+    def f(v):
+        u = jax.random.uniform(key, v.shape)
+        p = jnp.asarray(probs, jnp.float32)
+        return (jnp.floor(jnp.log1p(-u) / jnp.log1p(-p)) + 1).astype(v.dtype)
+
+    return x._rebind(run_op("geometric_", f, x))

@@ -231,3 +231,95 @@ class TestStat:
         np.testing.assert_allclose(paddle.std(x).numpy(), a.std(ddof=1), rtol=1e-5)
         np.testing.assert_allclose(paddle.var(x, unbiased=False).numpy(), a.var(), rtol=1e-5)
         np.testing.assert_allclose(paddle.median(x).numpy(), np.median(a), rtol=1e-6)
+
+
+class TestApiSurfaceComplete:
+    def test_reference_all_fully_covered(self):
+        """Every name the reference exports from ``paddle.__all__`` must
+        resolve here (406 names incl. the generated in-place variants)."""
+        import ast
+        import pathlib
+
+        import paddle_tpu as paddle
+
+        ref = pathlib.Path("/root/reference/python/paddle/__init__.py")
+        if not ref.exists():
+            pytest.skip("reference tree not mounted")
+        src = ref.read_text(errors="ignore")
+        names = []
+        for n in ast.walk(ast.parse(src)):
+            if isinstance(n, ast.Assign):
+                for tgt in n.targets:
+                    if getattr(tgt, "id", "") == "__all__":
+                        names = [ast.literal_eval(e) for e in n.value.elts]
+        assert len(names) > 400
+        missing = [m for m in names if not hasattr(paddle, m)]
+        assert missing == [], f"paddle.__all__ gaps: {missing}"
+
+    def test_inplace_variants_rebind(self):
+        import numpy as np
+
+        import paddle_tpu as paddle
+        from paddle_tpu import tensor as T
+
+        x = paddle.to_tensor(np.array([1.0, 4.0], "float32"))
+        ret = T.sqrt_(x)
+        np.testing.assert_allclose(x.numpy(), [1.0, 2.0])
+        assert ret is x  # in-place contract: returns the same tensor
+        y = paddle.to_tensor(np.array([[1.0, 2.0], [3.0, 4.0]], "float32"))
+        T.t_(y)
+        np.testing.assert_allclose(y.numpy(), [[1.0, 3.0], [2.0, 4.0]])
+
+    def test_batch_reader(self):
+        import numpy as np
+
+        import paddle_tpu as paddle
+
+        def rdr():
+            for i in range(5):
+                yield (np.full((2,), i, "float32"), np.array([i]))
+
+        batches = list(paddle.batch(rdr, 2)())
+        assert len(batches) == 3  # 2 + 2 + 1 (drop_last False)
+        # reference contract: a list of SAMPLES, not a stacked array
+        assert isinstance(batches[0], list) and len(batches[0]) == 2
+        assert batches[0][0][0].shape == (2,)
+        assert len(list(paddle.batch(rdr, 2, drop_last=True)())) == 2
+        import pytest as _pytest
+
+        with _pytest.raises(ValueError):
+            paddle.batch(rdr, 0)
+
+
+class TestInplaceTensorMethods:
+    def test_method_form_works(self):
+        import numpy as np
+
+        import paddle_tpu as paddle
+
+        x = paddle.to_tensor(np.array([1.0, 4.0], "float32"))
+        ret = x.sqrt_()
+        np.testing.assert_allclose(x.numpy(), [1.0, 2.0])
+        assert ret is x
+        y = paddle.to_tensor(np.array([0.0, -1.0], "float32"))
+        y.abs_()
+        np.testing.assert_allclose(y.numpy(), [0.0, 1.0])
+        z = paddle.to_tensor(np.zeros(100, "float32"))
+        paddle.seed(0)
+        z.cauchy_()
+        assert np.abs(z.numpy()).sum() > 0
+
+    def test_check_shape_reference_signature(self):
+        import numpy as np
+        import pytest as _pytest
+
+        import paddle_tpu as paddle
+
+        paddle.check_shape([2, -1, 3], "normal")      # positional op_name
+        paddle.check_shape([np.int64(3), 4])          # numpy ints OK
+        paddle.check_shape(paddle.to_tensor(np.array([2, 3], np.int64)))
+        with _pytest.raises(ValueError):
+            paddle.check_shape([2, -5])
+        with _pytest.raises(TypeError):
+            paddle.check_shape(
+                paddle.to_tensor(np.array([2.0], np.float32)))

@@ -616,3 +616,5 @@ def _kl_beta_beta(p, q):
 
 from . import transform  # noqa: E402,F401
 from .transform import *  # noqa: E402,F401,F403
+
+from . import constraint, variable  # noqa: E402,F401

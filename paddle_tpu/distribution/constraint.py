@@ -1,0 +1,50 @@
+"""Value constraints (``python/paddle/distribution/constraint.py``):
+predicates over supports, used by transforms/variables for domain checks."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, to_tensor
+
+
+def _v(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+class Constraint:
+    def __call__(self, value):
+        raise NotImplementedError
+
+
+class Real(Constraint):
+    def __call__(self, value):
+        v = _v(value)
+        return to_tensor(v == v)  # finite-domain check: not NaN
+
+
+class Range(Constraint):
+    def __init__(self, lower, upper):
+        self._lower = lower
+        self._upper = upper
+
+    def __call__(self, value):
+        v = _v(value)
+        return to_tensor((self._lower <= v) & (v <= self._upper))
+
+
+class Positive(Constraint):
+    def __call__(self, value):
+        return to_tensor(_v(value) >= 0.0)
+
+
+class Simplex(Constraint):
+    def __call__(self, value):
+        v = _v(value)
+        ok = (v >= 0).all(-1) & (jnp.abs(v.sum(-1) - 1.0) < 1e-6)
+        return to_tensor(ok)
+
+
+real = Real()
+positive = Positive()
+simplex = Simplex()

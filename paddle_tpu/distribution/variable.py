@@ -1,0 +1,67 @@
+"""Random-variable descriptors (``python/paddle/distribution/variable.py``):
+event-dim + constraint metadata that transforms/distributions consult."""
+
+from __future__ import annotations
+
+from . import constraint
+
+
+class Variable:
+    def __init__(self, is_discrete=False, event_rank=0, constraint=None):
+        self._is_discrete = is_discrete
+        self._event_rank = event_rank
+        self._constraint = constraint
+
+    @property
+    def is_discrete(self):
+        return self._is_discrete
+
+    @property
+    def event_rank(self):
+        return self._event_rank
+
+    def constraint(self, value):
+        return self._constraint(value)
+
+
+class Real(Variable):
+    def __init__(self, event_rank=0):
+        super().__init__(False, event_rank, constraint.real)
+
+
+class Positive(Variable):
+    def __init__(self, event_rank=0):
+        super().__init__(False, event_rank, constraint.positive)
+
+
+class Independent(Variable):
+    """Reinterpret ``reinterpreted_batch_rank`` rightmost batch dims of the
+    base variable as event dims."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self._base = base
+        super().__init__(
+            base.is_discrete,
+            base.event_rank + reinterpreted_batch_rank,
+            base._constraint)
+
+    def constraint(self, value):
+        return self._base.constraint(value)
+
+
+class Stack(Variable):
+    def __init__(self, vars, axis=0):
+        self._vars = list(vars)
+        self._axis = axis
+        super().__init__(
+            any(v.is_discrete for v in self._vars),
+            max(v.event_rank for v in self._vars),
+            None)
+
+    @property
+    def is_discrete(self):
+        return any(v.is_discrete for v in self._vars)
+
+
+real = Real()
+positive = Positive()

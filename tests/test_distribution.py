@@ -205,3 +205,28 @@ class TestChainMixedEventRank:
         J = jax.jacobian(f)(x._value)
         ref = np.linalg.slogdet(np.asarray(J))[1]
         np.testing.assert_allclose(float(ldj.numpy()), ref, rtol=1e-4)
+
+
+class TestConstraintAndVariable:
+    def test_constraints(self):
+        from paddle_tpu.distribution import constraint
+
+        assert bool(constraint.positive(_t(2.0)).numpy())
+        assert not bool(constraint.positive(_t(-1.0)).numpy())
+        assert bool(constraint.Range(0, 1)(_t(0.5)).numpy())
+        assert not bool(constraint.Range(0, 1)(_t(2.0)).numpy())
+        assert bool(constraint.Simplex()(_t([0.3, 0.7])).numpy())
+        assert not bool(constraint.Simplex()(_t([0.3, 0.3])).numpy())
+        assert bool(constraint.real(_t(1.0)).numpy())
+        assert not bool(constraint.real(_t(float("nan"))).numpy())
+
+    def test_variables(self):
+        from paddle_tpu.distribution import variable
+
+        assert variable.real.event_rank == 0
+        assert not variable.real.is_discrete
+        ind = variable.Independent(variable.positive, 2)
+        assert ind.event_rank == 2
+        assert bool(ind.constraint(_t(1.0)).numpy())
+        st = variable.Stack([variable.real, variable.positive])
+        assert st.event_rank == 0 and not st.is_discrete

@@ -47,6 +47,12 @@ class Tensor:
 
     def __init__(self, value, stop_gradient: bool = True, name: Optional[str] = None):
         if isinstance(value, Tensor):
+            if value._value is None:
+                # sparse tensors carry no dense payload (paddle.sparse);
+                # re-wrapping one must not silently produce a broken Tensor
+                raise RuntimeError(
+                    f"{type(value).__name__} holds no dense buffer; call "
+                    ".to_dense() before converting to a dense Tensor")
             value = value._value
         elif not isinstance(value, (jax.Array, jax.core.Tracer)):
             value = jnp.asarray(value)
@@ -345,6 +351,10 @@ def _inexact(x) -> bool:
 def to_tensor(data, dtype=None, place=None, stop_gradient=True) -> Tensor:
     """``paddle.to_tensor`` analog."""
     if isinstance(data, Tensor):
+        if data._value is None:  # sparse facade — no dense payload
+            raise RuntimeError(
+                f"{type(data).__name__} holds no dense buffer; call "
+                ".to_dense() before converting to a dense Tensor")
         v = data._value
     else:
         v = data

@@ -31,7 +31,14 @@ def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean"
             logp = jax.nn.log_softmax(logits, axis=axis)
         else:
             logp = jnp.log(jnp.clip(logits, 1e-30, None))
-        if soft_label or (lab.ndim == logits.ndim and jnp.issubdtype(lab.dtype, jnp.floating)):
+        # Soft-label path only when asked for, or when a floating label
+        # actually carries a class distribution (class axis matches logits);
+        # a float [N, 1] hard-label tensor is cast to indices like the
+        # reference kernel does (phi cross_entropy_with_softmax).
+        if soft_label or (jnp.issubdtype(lab.dtype, jnp.floating)
+                          and lab.ndim == logits.ndim
+                          and lab.shape[axis] == logits.shape[axis]
+                          and lab.shape[axis] != 1):
             soft = lab
             if label_smoothing > 0:
                 k = logits.shape[axis]

@@ -15,35 +15,109 @@ from jax.experimental import sparse as jsparse
 from ..core.tensor import Tensor, to_tensor
 
 
-class SparseCooTensor(Tensor):
-    """Wrapper marking a Tensor as sparse COO; holds a BCOO internally."""
+class _SparseTensorBase(Tensor):
+    """Shared sparse facade: Tensor bookkeeping WITHOUT a dense payload.
+
+    A sparse tensor holds only its BCOO/BCSR (``phi/core/
+    sparse_coo_tensor.h:32`` stores indices+values, never a dense mirror).
+    ``_value`` is rebound to None after the canonical ``Tensor.__init__``
+    so any accidental dense-op path fails loudly instead of silently
+    costing O(dense) memory; materialization is explicit via
+    ``.to_dense()``."""
+
+    __slots__ = ()
+
+    def _init_meta(self, stop_gradient):
+        Tensor.__init__(self, jnp.zeros((0,)), stop_gradient=stop_gradient)
+        self._value = None
+
+    def _sp(self):  # the underlying jax sparse object
+        raise NotImplementedError
+
+    @property
+    def shape(self):
+        return list(self._sp().shape)
+
+    @property
+    def dtype(self):
+        return self._sp().data.dtype
+
+    @property
+    def ndim(self):
+        return len(self._sp().shape)
+
+    @property
+    def dim(self):
+        return len(self._sp().shape)
+
+    @property
+    def size(self):
+        shp = self._sp().shape
+        return int(np.prod(shp)) if shp else 1
+
+    def _no_dense(self):
+        raise RuntimeError(
+            f"{type(self).__name__} holds no dense buffer; call "
+            ".to_dense() to materialize explicitly")
+
+    def numpy(self):
+        self._no_dense()
+
+    def __array__(self, dtype=None):
+        self._no_dense()
+
+    def tolist(self):
+        self._no_dense()
+
+    def item(self, *args):
+        self._no_dense()
+
+    def values(self):
+        return Tensor(self._sp().data)
+
+    def to_dense(self):
+        return Tensor(self._sp().todense())
+
+
+class SparseCooTensor(_SparseTensorBase):
+    """Sparse COO tensor riding jax BCOO; no dense materialization."""
 
     __slots__ = ("bcoo",)
 
     def __init__(self, bcoo, stop_gradient=True):
         self.bcoo = bcoo
-        super().__init__(bcoo.todense(), stop_gradient=stop_gradient)
+        self._init_meta(stop_gradient)
+
+    def _sp(self):
+        return self.bcoo
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={list(self.bcoo.shape)}, "
+                f"dtype={self.bcoo.data.dtype}, nnz={int(self.bcoo.nse)})")
 
     def indices(self):
         return Tensor(self.bcoo.indices.T)
-
-    def values(self):
-        return Tensor(self.bcoo.data)
-
-    def to_dense(self):
-        return Tensor(self.bcoo.todense())
 
     @property
     def nnz(self):
         return int(self.bcoo.nse)
 
 
-class SparseCsrTensor(Tensor):
+class SparseCsrTensor(_SparseTensorBase):
+    """Sparse CSR tensor riding jax BCSR; no dense materialization."""
+
     __slots__ = ("bcsr",)
 
     def __init__(self, bcsr, stop_gradient=True):
         self.bcsr = bcsr
-        super().__init__(bcsr.todense(), stop_gradient=stop_gradient)
+        self._init_meta(stop_gradient)
+
+    def _sp(self):
+        return self.bcsr
+
+    def __repr__(self):
+        return (f"SparseCsrTensor(shape={list(self.bcsr.shape)}, "
+                f"dtype={self.bcsr.data.dtype})")
 
     def crows(self):
         return Tensor(self.bcsr.indptr)
@@ -51,11 +125,24 @@ class SparseCsrTensor(Tensor):
     def cols(self):
         return Tensor(self.bcsr.indices)
 
-    def values(self):
-        return Tensor(self.bcsr.data)
+    @property
+    def nnz(self):
+        return int(np.asarray(self.bcsr.indices).size)
 
-    def to_dense(self):
-        return Tensor(self.bcsr.todense())
+
+def _to_coo(x):
+    """CSR → COO view in O(nnz) (host indptr expansion); COO passes through."""
+    if isinstance(x, SparseCooTensor):
+        return x
+    bcsr = x.bcsr
+    indptr = np.asarray(bcsr.indptr)
+    if indptr.ndim != 1:
+        raise ValueError("batched CSR → COO not supported here")
+    rows = np.repeat(np.arange(len(indptr) - 1), np.diff(indptr))
+    idx = np.stack([rows, np.asarray(bcsr.indices)], 1).astype(np.int32)
+    return SparseCooTensor(jsparse.BCOO(
+        (bcsr.data, jnp.asarray(idx)), shape=bcsr.shape),
+        stop_gradient=x.stop_gradient)
 
 
 def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None, stop_gradient=True):
@@ -85,17 +172,49 @@ def matmul(x, y, name=None):
     return dense_matmul(x, y)
 
 
+def _coo_to_csr(coo, assume_canonical=False):
+    """2-D COO → CSR in O(nnz) (host row-sort + bincount indptr).
+    ``assume_canonical`` skips the dedup when the indices are already
+    unique (e.g. a union-op output)."""
+    c = coo.bcoo if assume_canonical else jsparse.bcoo_sum_duplicates(coo.bcoo)
+    idx = np.asarray(c.indices)
+    n_rows = c.shape[0]
+    order = np.lexsort((idx[:, 1], idx[:, 0]))
+    counts = np.bincount(idx[:, 0], minlength=n_rows)
+    indptr = np.zeros(n_rows + 1, np.int32)
+    np.cumsum(counts, out=indptr[1:])
+    return SparseCsrTensor(jsparse.BCSR(
+        (c.data[jnp.asarray(order)],
+         jnp.asarray(idx[order, 1].astype(np.int32)), jnp.asarray(indptr)),
+        shape=c.shape), stop_gradient=coo.stop_gradient)
+
+
+def _binary_dispatch(x, y, fn):
+    """Sparse∘sparse → union op over COO views (O(nnz)); sparse∘dense →
+    dense result via explicit materialization; dense∘dense → dense.
+    CSR∘CSR round-trips back to CSR (paddle's binary ops are
+    format-preserving)."""
+    xs = isinstance(x, _SparseTensorBase)
+    ys = isinstance(y, _SparseTensorBase)
+    if xs and ys:
+        out = _coo_union_binary(_to_coo(x), _to_coo(y), fn)
+        if (isinstance(x, SparseCsrTensor) and isinstance(y, SparseCsrTensor)
+                and out.ndim == 2):
+            return _coo_to_csr(out, assume_canonical=True)
+        return out
+    xv = x.to_dense()._value if xs else (
+        x._value if isinstance(x, Tensor) else jnp.asarray(x))
+    yv = y.to_dense()._value if ys else (
+        y._value if isinstance(y, Tensor) else jnp.asarray(y))
+    return Tensor(fn(xv, yv))
+
+
 def add(x, y, name=None):
-    if isinstance(x, SparseCooTensor) and isinstance(y, SparseCooTensor):
-        return Tensor(x.bcoo.todense() + y.bcoo.todense())
-    return Tensor(x._value + y._value)
+    return _binary_dispatch(x, y, jnp.add)
 
 
 def relu(x, name=None):
-    if isinstance(x, SparseCooTensor):
-        bcoo = jsparse.BCOO((jax.nn.relu(x.bcoo.data), x.bcoo.indices), shape=x.bcoo.shape)
-        return SparseCooTensor(bcoo)
-    return Tensor(jax.nn.relu(x._value))
+    return _value_map(x, jax.nn.relu)
 
 
 def is_same_shape(x, y):
@@ -208,18 +327,24 @@ def coalesce(x, name=None):
 
 
 def transpose(x, perm, name=None):
-    if isinstance(x, SparseCooTensor):
-        return SparseCooTensor(
-            jsparse.bcoo_transpose(x.bcoo, permutation=tuple(perm)),
-            stop_gradient=x.stop_gradient)
+    if isinstance(x, _SparseTensorBase):
+        was_csr = isinstance(x, SparseCsrTensor)
+        coo = _to_coo(x)
+        out = SparseCooTensor(
+            jsparse.bcoo_transpose(coo.bcoo, permutation=tuple(perm)),
+            stop_gradient=coo.stop_gradient)
+        return _coo_to_csr(out) if was_csr and out.ndim == 2 else out
     return Tensor(jnp.transpose(x._value, tuple(perm)))
 
 
 def reshape(x, shape, name=None):
-    if isinstance(x, SparseCooTensor):
-        return SparseCooTensor(
-            jsparse.bcoo_reshape(x.bcoo, new_sizes=tuple(shape)),
-            stop_gradient=x.stop_gradient)
+    if isinstance(x, _SparseTensorBase):
+        was_csr = isinstance(x, SparseCsrTensor)
+        coo = _to_coo(x)
+        out = SparseCooTensor(
+            jsparse.bcoo_reshape(coo.bcoo, new_sizes=tuple(shape)),
+            stop_gradient=coo.stop_gradient)
+        return _coo_to_csr(out) if was_csr and out.ndim == 2 else out
     return Tensor(jnp.reshape(x._value, tuple(shape)))
 
 
@@ -237,39 +362,59 @@ def sum(x, axis=None, dtype=None, keepdim=False, name=None):
 # Binary ops over matching layouts (``sparse/binary.py``)
 # ---------------------------------------------------------------------------
 
+def _row_keys(idx):
+    """View an (n, d) int index array as n lexicographic scalar keys."""
+    a = np.ascontiguousarray(idx.astype(np.int64))
+    return a.view([("", a.dtype)] * a.shape[1]).ravel()
+
+
 def _coo_union_binary(x, y, fn):
     """Elementwise op over the union of two COO patterns (host-computed
-    index union; value math stays in jax)."""
-    xi = np.asarray(x.bcoo.indices)
-    yi = np.asarray(y.bcoo.indices)
-    keys = {tuple(r) for r in xi.tolist()} | {tuple(r) for r in yi.tolist()}
-    union = np.array(sorted(keys), dtype=np.int32).reshape(len(keys), xi.shape[1])
+    index union; value math stays in jax).  O(nnz log nnz) host work and
+    O(nnz) memory — no densification (``phi/kernels/sparse/
+    elementwise_kernel`` semantics)."""
+    if tuple(x.bcoo.shape) != tuple(y.bcoo.shape):
+        raise ValueError(
+            f"sparse binary op shape mismatch: {tuple(x.bcoo.shape)} vs "
+            f"{tuple(y.bcoo.shape)}")
+    xb = jsparse.bcoo_sum_duplicates(x.bcoo)
+    yb = jsparse.bcoo_sum_duplicates(y.bcoo)
+    xi = np.asarray(xb.indices)
+    yi = np.asarray(yb.indices)
+    union = np.unique(np.concatenate([xi, yi], 0), axis=0).astype(np.int32)
+    uk = _row_keys(union)
 
-    def gather_vals(bcoo, idx):
-        dense = bcoo.todense()
-        return dense[tuple(idx[:, d] for d in range(idx.shape[1]))]
+    def gather_vals(bcoo, src_idx):
+        # position of each union index in this operand's nnz list (-1 =
+        # absent → reads the appended explicit zero); vectorized searchsorted
+        sk = _row_keys(src_idx)
+        if sk.size == 0:
+            return jnp.zeros((len(uk),), bcoo.data.dtype)
+        order = np.argsort(sk)
+        pos = np.searchsorted(sk, uk, sorter=order)
+        pos = np.clip(pos, 0, sk.size - 1)
+        hit = sk[order[pos]] == uk
+        sel = np.where(hit, order[pos], -1).astype(np.int32)
+        data = jnp.concatenate(
+            [bcoo.data, jnp.zeros((1,), bcoo.data.dtype)])
+        return data[sel]
 
-    vals = fn(gather_vals(x.bcoo, union), gather_vals(y.bcoo, union))
-    return SparseCooTensor(jsparse.BCOO((vals, jnp.asarray(union)),
-                                        shape=x.bcoo.shape))
+    vals = fn(gather_vals(xb, xi), gather_vals(yb, yi))
+    return SparseCooTensor(
+        jsparse.BCOO((vals, jnp.asarray(union)), shape=x.bcoo.shape),
+        stop_gradient=x.stop_gradient and y.stop_gradient)
 
 
 def subtract(x, y, name=None):
-    if isinstance(x, SparseCooTensor) and isinstance(y, SparseCooTensor):
-        return _coo_union_binary(x, y, jnp.subtract)
-    return Tensor(x._value - y._value)
+    return _binary_dispatch(x, y, jnp.subtract)
 
 
 def multiply(x, y, name=None):
-    if isinstance(x, SparseCooTensor) and isinstance(y, SparseCooTensor):
-        return _coo_union_binary(x, y, jnp.multiply)
-    return Tensor(x._value * y._value)
+    return _binary_dispatch(x, y, jnp.multiply)
 
 
 def divide(x, y, name=None):
-    if isinstance(x, SparseCooTensor) and isinstance(y, SparseCooTensor):
-        return _coo_union_binary(x, y, jnp.divide)
-    return Tensor(x._value / y._value)
+    return _binary_dispatch(x, y, jnp.divide)
 
 
 def mv(x, vec, name=None):

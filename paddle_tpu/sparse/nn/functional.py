@@ -33,35 +33,53 @@ def leaky_relu(x, negative_slope=0.01, name=None):
     return _value_map(x, lambda v: jax.nn.leaky_relu(v, negative_slope))
 
 
+def _segment_softmax(v, rows, n_rows):
+    """Numerically-stable softmax over stored values grouped by segment id."""
+    mx = jax.ops.segment_max(v, rows, num_segments=n_rows)
+    e = jnp.exp(v - mx[rows])
+    z = jax.ops.segment_sum(e, rows, num_segments=n_rows)
+    return e / z[rows]
+
+
 def softmax(x, axis=-1, name=None):
     """Sparse softmax: per-row over stored values only
     (``sparse/nn/functional/activation.py`` softmax; axis must be the last,
-    CSR row semantics)."""
+    CSR row semantics).  Batched [B, L, L] CSR gets a distinct segment id
+    per (batch, row) pair so batches never mix."""
+    from jax.experimental import sparse as jsparse
+
     if isinstance(x, SparseCsrTensor):
         indptr = np.asarray(x.bcsr.indptr)
-        rows = jnp.asarray(np.repeat(
-            np.arange(len(indptr) - 1), np.diff(indptr)).astype(np.int32))
-        n_rows = len(indptr) - 1
-        v = x.bcsr.data
-        mx = jax.ops.segment_max(v, rows, num_segments=n_rows)
-        e = jnp.exp(v - mx[rows])
-        z = jax.ops.segment_sum(e, rows, num_segments=n_rows)
-        from jax.experimental import sparse as jsparse
-
+        ip = indptr if indptr.ndim == 2 else indptr[None]
+        B, Lp1 = ip.shape
+        n_rows = B * (Lp1 - 1)
+        if x.bcsr.data.ndim == 2:
+            # batched BCSR stores a fixed nnz_max lane per batch; ragged
+            # batches carry pad entries past indptr[b][-1] — give pads a
+            # dummy segment id so they never enter any real row's softmax
+            width = x.bcsr.data.shape[1]
+            per_batch = []
+            for b in range(B):
+                rb = np.full(width, n_rows, np.int32)  # dummy segment
+                real = np.repeat(np.arange(Lp1 - 1), np.diff(ip[b]))
+                rb[: real.size] = real + b * (Lp1 - 1)
+                per_batch.append(rb)
+            rows = jnp.asarray(np.concatenate(per_batch))
+        else:
+            rows = jnp.asarray(np.repeat(
+                np.arange(Lp1 - 1), np.diff(ip[0])).astype(np.int32))
+        out = _segment_softmax(
+            x.bcsr.data.reshape(-1), rows, n_rows + 1)
         return SparseCsrTensor(jsparse.BCSR(
-            (e / z[rows], x.bcsr.indices, x.bcsr.indptr), shape=x.bcsr.shape))
+            (out.reshape(x.bcsr.data.shape), x.bcsr.indices, x.bcsr.indptr),
+            shape=x.bcsr.shape), stop_gradient=x.stop_gradient)
     if isinstance(x, SparseCooTensor):
         idx = np.asarray(x.bcoo.indices)
         rows = jnp.asarray(idx[:, 0].astype(np.int32))
-        n_rows = x.bcoo.shape[0]
-        v = x.bcoo.data
-        mx = jax.ops.segment_max(v, rows, num_segments=n_rows)
-        e = jnp.exp(v - mx[rows])
-        z = jax.ops.segment_sum(e, rows, num_segments=n_rows)
-        from jax.experimental import sparse as jsparse
-
+        out = _segment_softmax(x.bcoo.data, rows, x.bcoo.shape[0])
         return SparseCooTensor(jsparse.BCOO(
-            (e / z[rows], x.bcoo.indices), shape=x.bcoo.shape))
+            (out, x.bcoo.indices), shape=x.bcoo.shape),
+            stop_gradient=x.stop_gradient)
     return Tensor(jax.nn.softmax(x._value, axis=axis))
 
 
@@ -100,6 +118,10 @@ def attention(query, key, value, sparse_mask, key_padding_mask=None,
     kpm = (key_padding_mask._value if isinstance(key_padding_mask, Tensor)
            else key_padding_mask)
     am = attn_mask._value if isinstance(attn_mask, Tensor) else attn_mask
+    if am is not None and (am.ndim != 2 or am.shape != (L, L)):
+        raise ValueError(
+            f"attn_mask must be 2-D [seq_len, seq_len]=({L}, {L}) shared "
+            f"across batch/heads (got shape {tuple(am.shape)})")
 
     outs = []
     for bh in range(B * H):
@@ -107,12 +129,15 @@ def attention(query, key, value, sparse_mask, key_padding_mask=None,
             np.arange(L), np.diff(indptr[bh])).astype(np.int32))
         cc = jnp.asarray(cols[bh].astype(np.int32))
         s = jnp.einsum("nd,nd->n", qf[bh][rows], kf[bh][cc]) * scale
+        # Reference kernel (fluid/operators/sparse_attention_op.cu) masks a
+        # score where the mask value EQUALS 0 (paddle convention: 0 = masked
+        # out, nonzero = attend); attn_mask is a single [L, L] tensor shared
+        # across batch and heads.
         if kpm is not None:
             b = bh // H
-            s = jnp.where(kpm[b][cc] != 0, jnp.float32(-1e9), s)
+            s = jnp.where(kpm[b][cc] == 0, jnp.float32(-1e9), s)
         if am is not None:
-            b = bh // H
-            s = jnp.where(am[b][rows, cc] != 0, jnp.float32(-1e9), s)
+            s = jnp.where(am[rows, cc] == 0, jnp.float32(-1e9), s)
         mx = jax.ops.segment_max(s, rows, num_segments=L)
         e = jnp.exp(s - mx[rows])
         z = jax.ops.segment_sum(e, rows, num_segments=L)

@@ -146,6 +146,17 @@ class TestLosses:
         expected = -np.log(p[[0, 2], [1, 5]]).mean()
         np.testing.assert_allclose(float(loss), expected, rtol=1e-5)
 
+    def test_cross_entropy_float_column_hard_label(self):
+        # ADVICE r2: a float [N, 1] hard-label tensor must take the index
+        # path (cast to int), not broadcast through the soft-label branch
+        logits = np_t([4, 10])
+        labels = np.array([[1.0], [3.0], [5.0], [7.0]], "float32")
+        loss = F.cross_entropy(paddle.to_tensor(logits), paddle.to_tensor(labels))
+        p = np.exp(logits - logits.max(-1, keepdims=True))
+        p = p / p.sum(-1, keepdims=True)
+        expected = -np.log(p[np.arange(4), [1, 3, 5, 7]]).mean()
+        np.testing.assert_allclose(float(loss), expected, rtol=1e-5)
+
     def test_mse_l1(self):
         a, b = np_t([5]), np_t([5], seed=3)
         np.testing.assert_allclose(

@@ -20,6 +20,90 @@ def _coo(dense):
                      shape=dense.shape))
 
 
+class TestSparseMemorySemantics:
+    """VERDICT r2 #4: sparse tensors hold ONLY indices+values; a tensor
+    whose dense form is 40 GB must construct and compute in O(nnz)."""
+
+    def test_huge_coo_never_densifies(self):
+        n, nnz = 100_000, 1000  # dense float32 = 40 GB — would OOM the box
+        rng = np.random.default_rng(0)
+        idx = rng.integers(0, n, (nnz, 2)).astype(np.int32)
+        vals = rng.standard_normal(nnz).astype("float32")
+        s = sp.sparse_coo_tensor(idx.T, vals, shape=[n, n])
+        assert s.shape == [n, n] and s.nnz == nnz
+        out = sp.sin(s)  # value op: O(nnz)
+        assert out.nnz == nnz
+        u = sp.add(s, sp.neg(s))  # union op: O(nnz), no densify
+        np.testing.assert_allclose(
+            np.asarray(u.values().numpy()), 0.0, atol=1e-6)
+        assert "nnz=1000" in repr(s)
+        # every implicit dense-access path must fail loudly
+        with pytest.raises(RuntimeError):
+            s.numpy()
+        with pytest.raises(RuntimeError):
+            np.asarray(s)
+        with pytest.raises(RuntimeError):
+            s.tolist()
+
+    def test_csr_and_mixed_fallbacks(self):
+        # review r3: CSR∘CSR and sparse∘dense paths must keep working
+        # without a dense mirror
+        a = np.array([[1.0, 0, 2.0], [0, 3.0, 0]], "float32")
+        b = np.array([[0.0, 4.0, 1.0], [1.0, 0, 0]], "float32")
+
+        def csr(d):
+            crows = [0]
+            cols, vals = [], []
+            for r in d:
+                nz = np.nonzero(r)[0]
+                cols += nz.tolist()
+                vals += r[nz].tolist()
+                crows.append(len(cols))
+            return sp.sparse_csr_tensor(
+                np.array(crows, np.int32), np.array(cols, np.int32),
+                np.array(vals, "float32"), shape=list(d.shape))
+
+        got = sp.add(csr(a), csr(b)).to_dense().numpy()
+        np.testing.assert_allclose(got, a + b)
+        got = sp.multiply(_coo(a), paddle.to_tensor(b)).numpy()
+        np.testing.assert_allclose(got, a * b)
+        got = sp.relu(csr(a - 2.0 * b)).to_dense().numpy()
+        np.testing.assert_allclose(got, np.maximum(a - 2 * b, 0))
+        got = sp.transpose(csr(a), [1, 0]).to_dense().numpy()
+        np.testing.assert_allclose(got, a.T)
+        # CSR∘CSR round-trips to CSR (format-preserving like the reference)
+        out = sp.add(csr(a), csr(b))
+        assert isinstance(out, sp.SparseCsrTensor)
+        assert out.crows().numpy()[-1] == out.values().numpy().shape[0]
+        t = sp.transpose(csr(a), [1, 0])
+        assert isinstance(t, sp.SparseCsrTensor)
+
+    def test_rewrap_and_shape_mismatch_fail_loudly(self):
+        a = np.array([[1.0, 0], [0, 2.0]], "float32")
+        s = _coo(a)
+        with pytest.raises(RuntimeError):
+            paddle.Tensor(s)  # re-wrap must not yield a broken dense Tensor
+        with pytest.raises(RuntimeError):
+            paddle.to_tensor(s)
+        big = _coo(np.eye(3, dtype="float32"))
+        with pytest.raises(ValueError):
+            sp.add(s, big)  # shape mismatch must raise, not drop entries
+
+    def test_huge_csr_never_densifies(self):
+        n = 100_000
+        crows = np.zeros(n + 1, np.int32)
+        crows[1:3] = [2, 2]
+        crows[3:] = 2
+        s = sp.sparse_csr_tensor(
+            crows, np.array([5, 9], np.int32),
+            np.array([1.0, 2.0], "float32"), shape=[n, n])
+        assert s.shape == [n, n]
+        out = sp.nn.functional.softmax(s)
+        np.testing.assert_allclose(
+            np.asarray(out.bcsr.data),
+            np.exp([-1.0, 0.0]) / np.exp([-1.0, 0.0]).sum(), rtol=1e-5)
+
+
 class TestValueOps:
     def test_unary_preserve_pattern(self):
         d = np.array([[1.0, 0, -2.0], [0, 0.5, 0]], "float32")
@@ -138,6 +222,48 @@ class TestSparseAttention:
         np.testing.assert_allclose(out, p @ v[0, 0], rtol=1e-4, atol=1e-5)
 
 
+class TestSparseAttentionMasks:
+    """ADVICE r2: paddle-convention masks (0 = masked out) + 2-D attn_mask."""
+
+    def _qkv(self, B, H, L, D, seed=7):
+        rng = np.random.default_rng(seed)
+        return tuple(rng.standard_normal((B, H, L, D)).astype("float32")
+                     for _ in range(3))
+
+    @staticmethod
+    def _dense_ref(q, k, v, extra_bias):
+        # extra_bias: (B, H, L, L) additive (-inf at masked positions)
+        D = q.shape[-1]
+        s = np.einsum("bhld,bhmd->bhlm", q, k) / np.sqrt(D) + extra_bias
+        p = np.exp(s - s.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        return np.einsum("bhlm,bhmd->bhld", p, v)
+
+    def test_key_padding_mask(self):
+        B, H, L, D = 2, 2, 4, 8
+        q, k, v = self._qkv(B, H, L, D)
+        kpm = np.ones((B, L), "float32")
+        kpm[0, 3] = 0.0  # batch 0: last key is padding
+        kpm[1, 0] = 0.0
+        out = sp.nn.functional.attention(
+            paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+            _full_csr(B * H, L), key_padding_mask=paddle.to_tensor(kpm))
+        bias = np.where(kpm[:, None, None, :] == 0, -1e9, 0.0)
+        np.testing.assert_allclose(
+            out.numpy(), self._dense_ref(q, k, v, bias), rtol=1e-4, atol=1e-5)
+
+    def test_attn_mask_2d_shared(self):
+        B, H, L, D = 2, 2, 4, 8
+        q, k, v = self._qkv(B, H, L, D, seed=8)
+        am = np.tril(np.ones((L, L), "float32"))  # 2-D causal, shared
+        out = sp.nn.functional.attention(
+            paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+            _full_csr(B * H, L), attn_mask=paddle.to_tensor(am))
+        bias = np.where(am[None, None] == 0, -1e9, 0.0)
+        np.testing.assert_allclose(
+            out.numpy(), self._dense_ref(q, k, v, bias), rtol=1e-4, atol=1e-5)
+
+
 class TestSparseConv:
     def _point_cloud(self, seed=4):
         rng = np.random.default_rng(seed)
@@ -197,6 +323,32 @@ class TestSparseNNLayers:
         e = np.exp([1.0 - 2.0, 0.0])
         np.testing.assert_allclose(got[:2], e / e.sum(), rtol=1e-5)
         np.testing.assert_allclose(got[2], 1.0)
+
+    def test_csr_softmax_batched_3d(self):
+        # ADVICE r2: paddle's documented [B, L, L] layout must work directly
+        crows = np.array([[0, 2, 3], [0, 1, 3]])
+        cols = np.array([[0, 2, 1], [2, 0, 1]])
+        vals = np.array([[1.0, 2.0, 5.0], [4.0, 1.0, 3.0]], "float32")
+        s = sp.sparse_csr_tensor(crows, cols, vals, shape=[2, 2, 3])
+        out = np.asarray(sp.nn.functional.softmax(s).bcsr.data)
+        # batch 0 row 0: softmax([1, 2]); row 1: [5] -> 1
+        e = np.exp([-1.0, 0.0])
+        np.testing.assert_allclose(out[0, :2], e / e.sum(), rtol=1e-5)
+        np.testing.assert_allclose(out[0, 2], 1.0)
+        # batch 1 row 0: [4] -> 1; row 1: softmax([1, 3])
+        np.testing.assert_allclose(out[1, 0], 1.0)
+        e = np.exp([-2.0, 0.0])
+        np.testing.assert_allclose(out[1, 1:], e / e.sum(), rtol=1e-5)
+
+    def test_csr_softmax_batched_ragged(self):
+        # per-batch nnz differs: pad lanes must stay out of every softmax
+        crows = np.array([[0, 1, 1], [0, 1, 2]])
+        cols = np.array([[0, 0], [1, 0]])  # batch 0: 1 real + 1 pad
+        vals = np.array([[2.0, 99.0], [4.0, 1.0]], "float32")
+        s = sp.sparse_csr_tensor(crows, cols, vals, shape=[2, 2, 2])
+        out = np.asarray(sp.nn.functional.softmax(s).bcsr.data)
+        np.testing.assert_allclose(out[0, 0], 1.0)  # single-entry row
+        np.testing.assert_allclose(out[1], [1.0, 1.0], rtol=1e-6)
 
     def test_batchnorm_normalizes_values(self):
         rng = np.random.default_rng(5)

@@ -1,0 +1,20 @@
+#!/bin/bash
+# Probe the TPU tunnel persistently; the moment it is up, run bench.py
+# (which warms the persistent XLA compile cache) and record the result.
+# Round-3 standing priority #1 (VERDICT.md): land an on-chip number.
+cd "$(dirname "$0")/.." || exit 1
+for i in $(seq 1 120); do
+  if timeout 300 python -c "import jax; assert jax.default_backend()=='tpu'" 2>/dev/null; then
+    echo "[tpu_watch] TPU up at attempt $i ($(date -u +%H:%M:%S))"
+    python bench.py >bench_tpu_attempt.json 2>bench_tpu_attempt.log
+    rc=$?
+    echo "[tpu_watch] bench rc=$rc"
+    cat bench_tpu_attempt.json
+    tail -30 bench_tpu_attempt.log
+    exit 0
+  fi
+  echo "[tpu_watch] attempt $i: tunnel down ($(date -u +%H:%M:%S))"
+  sleep 240
+done
+echo "[tpu_watch] gave up after all attempts"
+exit 1

@@ -122,7 +122,13 @@ class PipelineParallelModel(Layer):
 
         inner = self._layers
         if mode == "1F1B" and hasattr(inner, "train_batch_1f1b"):
-            loss = inner.train_batch_1f1b(inputs, labels, n_micro)
+            # recompute is opt-in like the reference (fleet/recompute): off
+            # → forward-once 1F1B buffering activations; on → re-run each
+            # stage forward at its backward tick (less memory, ~1/3 extra
+            # stage FLOPs)
+            loss = inner.train_batch_1f1b(
+                inputs, labels, n_micro,
+                recompute=bool(self._strategy.recompute))
         elif hasattr(inner, "loss_fn") and inner.loss_fn is not None:
             from ...parallel.pipeline import pipeline_forward
 

@@ -428,7 +428,7 @@ class LlamaForCausalLM(Layer):
         return self.lm_head(h)
 
     def train_batch_1f1b(self, input_ids, labels, n_microbatch: int,
-                         criterion=None):
+                         criterion=None, recompute: bool = False):
         """One true-1F1B pipelined train step (the ``train_batch`` analog of
         the reference's ``PipelineParallel.forward_backward_pipeline``,
         ``pipeline_parallel.py:440``): embedding runs on the tape, the
@@ -479,7 +479,8 @@ class LlamaForCausalLM(Layer):
 
         aux_w = cfg.aux_loss_weight if cfg.num_experts > 0 else 0.0
         return pipeline_train_1f1b(pipe, h, labels, head_params, head_apply,
-                                   n_microbatch, aux_weight=aux_w)
+                                   n_microbatch, aux_weight=aux_w,
+                                   recompute=recompute)
 
     def generate(self, input_ids, max_new_tokens: int = 32,
                  temperature: float = 1.0, top_k: int = 0, top_p: float = 1.0,

@@ -26,6 +26,7 @@ planning.
 from __future__ import annotations
 
 import functools
+import warnings
 import weakref
 from typing import Any, Dict, List, Optional
 
@@ -35,6 +36,16 @@ from ..core import dispatch as _dispatch
 from ..core import flags
 from ..core import random as rng_mod
 from ..core.tensor import Tensor
+
+# Trace failures that mean "this function cannot be staged" (data-dependent
+# Python control flow on traced tensors, host-only ops under jit): the
+# graph-break cases the reference's SOT tracer handles by falling back to
+# eager (``jit/sot/`` guard/graph-break semantics, ``eval_frame.c:480``).
+_GRAPH_BREAK_ERRORS = (
+    jax.errors.ConcretizationTypeError,   # covers TracerBoolConversionError
+    jax.errors.TracerArrayConversionError,
+    jax.errors.TracerIntegerConversionError,
+)
 
 
 def _is_tracer(v) -> bool:
@@ -134,11 +145,15 @@ class StaticFunction:
     """The callable returned by ``to_static`` (``StaticFunction`` analog)."""
 
     def __init__(self, function, input_spec=None, build_strategy=None,
-                 full_graph=True, backend=None, donate_state=None):
+                 full_graph=False, backend=None, donate_state=None):
         functools.update_wrapper(self, function)
         self._fn = function
         self._input_spec = input_spec
         self._cache: Dict[Any, Any] = {}
+        # full_graph=False (reference SOT default): trace failures graph-
+        # break to eager; full_graph=True (AST mode contract): they raise.
+        self._full_graph = full_graph
+        self._eager_fallback = False  # graph-break verdict, cached per fn
         self._donate = (
             donate_state if donate_state is not None else flags.flag("use_donated_buffers")
         )
@@ -157,7 +172,10 @@ class StaticFunction:
         per_inst = self.__dict__.setdefault("_bound", {})
         bound = per_inst.get(id(instance))
         if bound is None:
-            bound = StaticFunction(self._fn.__get__(instance, owner), self._input_spec)
+            bound = StaticFunction(self._fn.__get__(instance, owner),
+                                   self._input_spec,
+                                   full_graph=self._full_graph,
+                                   donate_state=self._donate)
             per_inst[id(instance)] = bound
         return bound
 
@@ -171,17 +189,39 @@ class StaticFunction:
         return (sig, mode)
 
     def __call__(self, *args, **kwargs):
-        if _tracing_depth > 0:
-            return self._fn(*args, **kwargs)  # nested: inline into outer trace
+        # nested call: inline into the outer trace; cached graph-break
+        # verdict: stay eager
+        if _tracing_depth > 0 or self._eager_fallback:
+            return self._fn(*args, **kwargs)
         key = self._cache_key(args, kwargs)
-        entry = self._cache.get(key)
-        if entry is None:
-            entry = self._build(key, args, kwargs)
-        state_tensors, jitted = entry
-        state_vals = [t._value for t in state_tensors]
-        keys = rng_mod.get_rng_state()
-        arg_vals = _tree_map_tensors((args, kwargs), lambda t: t._value)
-        out_raw, new_state, new_keys, new_grads = jitted(state_vals, arg_vals, keys)
+        try:
+            entry = self._cache.get(key)
+            if entry is None:
+                entry = self._build(key, args, kwargs)
+            state_tensors, jitted = entry
+            state_vals = [t._value for t in state_tensors]
+            keys = rng_mod.get_rng_state()
+            arg_vals = _tree_map_tensors((args, kwargs), lambda t: t._value)
+            out_raw, new_state, new_keys, new_grads = jitted(
+                state_vals, arg_vals, keys)
+        except _GRAPH_BREAK_ERRORS as e:
+            # SOT-style graph break: the function cannot be staged (data-
+            # dependent Python control flow, host-only op under jit).
+            # Note: by the time the break is detected the Python body has
+            # already run during discovery and partially during tracing, so
+            # non-Tensor side effects (logging, counters) may repeat.
+            self._cache.pop(key, None)
+            if self._full_graph:
+                raise  # AST-mode contract: whole graph or an error
+            self._eager_fallback = True
+            warnings.warn(
+                f"to_static: graph break in "
+                f"{getattr(self._fn, '__name__', self._fn)!r} "
+                f"({type(e).__name__}); falling back to eager execution "
+                "for this function. Use jax-compatible control flow "
+                "(paddle.static.nn.cond / while_loop) to keep it compiled.",
+                stacklevel=2)
+            return self._fn(*args, **kwargs)
         for t, v in zip(state_tensors, new_state):
             t._value = v
         for t, g in zip(state_tensors, new_grads):
@@ -198,6 +238,10 @@ class StaticFunction:
         trusting that GSPMD "will do it".  The entry is cached, so a
         subsequent ``__call__`` with the same shapes reuses the build.
         """
+        if self._eager_fallback:
+            raise RuntimeError(
+                f"{getattr(self._fn, '__name__', self._fn)!r} graph-broke "
+                "and runs eagerly — there is no compiled program to inspect")
         key = self._cache_key(args, kwargs)
         entry = self._cache.get(key)
         if entry is None:
@@ -206,7 +250,11 @@ class StaticFunction:
         state_vals = [t._value for t in state_tensors]
         keys = rng_mod.get_rng_state()
         arg_vals = _tree_map_tensors((args, kwargs), lambda t: t._value)
-        return jitted.lower(state_vals, arg_vals, keys).compile().as_text()
+        try:
+            return jitted.lower(state_vals, arg_vals, keys).compile().as_text()
+        except _GRAPH_BREAK_ERRORS:
+            self._cache.pop(key, None)  # don't leave a poisoned entry
+            raise
 
     def _build(self, key, args, kwargs):
         # ---- pass 1: discovery --------------------------------------------
@@ -230,32 +278,36 @@ class StaticFunction:
             originals = [
                 (t, t._value, t._grad_node, t._out_index, t.grad) for t in state_tensors
             ]
-            for t, v in zip(state_tensors, state_vals):
-                t._value = v
-                t._grad_node = None
-                t._out_index = 0
-                t.grad = None
             rng_saved = rng_mod.get_rng_state()
-            rng_mod.set_rng_state(keys)
-            a, k = _rebuild_args(arg_vals, template)
-            _tracing_depth += 1
             try:
-                out = fn(*a, **k)
+                for t, v in zip(state_tensors, state_vals):
+                    t._value = v
+                    t._grad_node = None
+                    t._out_index = 0
+                    t.grad = None
+                rng_mod.set_rng_state(keys)
+                a, k = _rebuild_args(arg_vals, template)
+                _tracing_depth += 1
+                try:
+                    out = fn(*a, **k)
+                finally:
+                    _tracing_depth -= 1
+                new_state = [t._value for t in state_tensors]
+                new_grads = [
+                    t.grad._value
+                    if (t.grad is not None and _is_tracer(t.grad._value))
+                    else None
+                    for t in state_tensors
+                ]
+                new_keys = rng_mod.get_rng_state()
+                out_raw = _tree_map_tensors(out, lambda t: t._value)
+                return out_raw, new_state, new_keys, new_grads
             finally:
-                _tracing_depth -= 1
-            new_state = [t._value for t in state_tensors]
-            new_grads = [
-                t.grad._value
-                if (t.grad is not None and _is_tracer(t.grad._value))
-                else None
-                for t in state_tensors
-            ]
-            new_keys = rng_mod.get_rng_state()
-            rng_mod.set_rng_state(rng_saved)
-            out_raw = _tree_map_tensors(out, lambda t: t._value)
-            for t, v, gn, oi, g in originals:
-                t._value, t._grad_node, t._out_index, t.grad = v, gn, oi, g
-            return out_raw, new_state, new_keys, new_grads
+                # always roll back — a trace failure (graph break) must not
+                # leave dead tracers in live tensors
+                rng_mod.set_rng_state(rng_saved)
+                for t, v, gn, oi, g in originals:
+                    t._value, t._grad_node, t._out_index, t.grad = v, gn, oi, g
 
         donate = (0,) if self._donate else ()
         jitted = jax.jit(pure, donate_argnums=donate)
@@ -285,17 +337,23 @@ def _rebuild_args(arg_vals, template):
 
 
 def to_static(function=None, input_spec=None, build_strategy=None, backend=None,
-              full_graph=True, **kwargs):
-    """Decorator/wrapper compiling a function or Layer (jit/api.py:171)."""
+              full_graph=False, **kwargs):
+    """Decorator/wrapper compiling a function or Layer (jit/api.py:171).
+
+    ``full_graph=False`` (the reference's SOT default): a trace failure
+    (data-dependent Python control flow, host-only op) graph-breaks to
+    eager execution with a one-time warning.  ``full_graph=True`` (the AST
+    mode contract): trace failures raise."""
 
     def decorate(fn):
         from ..nn.layers import Layer
 
         if isinstance(fn, Layer):
             layer = fn
-            layer.forward = StaticFunction(layer.forward, input_spec)
+            layer.forward = StaticFunction(layer.forward, input_spec,
+                                           full_graph=full_graph)
             return layer
-        return StaticFunction(fn, input_spec)
+        return StaticFunction(fn, input_spec, full_graph=full_graph)
 
     if function is not None:
         return decorate(function)

@@ -35,6 +35,8 @@ def main():
     p.add_argument("--lr", type=float, default=3e-4)
     p.add_argument("--sequence_parallel", action="store_true")
     p.add_argument("--recompute", action="store_true")
+    p.add_argument("--auto", action="store_true",
+                   help="pick dp/mp/pp/sharding with the cost-model planner")
     p.add_argument("--save_dir", default=None)
     p.add_argument("--resume", default=None)
     p.add_argument("--cpu", action="store_true")
@@ -62,22 +64,42 @@ def main():
 
     paddle.seed(42)
 
+    mk = (LlamaConfig.tiny if args.model == "tiny" else LlamaConfig.llama3_8b)
+    cfg = mk(sequence_parallel=args.sequence_parallel,
+             recompute=args.recompute)
+
     # fleet API end to end (fleet/fleet.py:167 usage pattern): one strategy
     # object wires mesh + placements + pipeline schedule + sharded optimizer
-    strategy = fleet.DistributedStrategy()
-    strategy.hybrid_configs = {
-        "dp_degree": args.dp, "mp_degree": args.mp, "pp_degree": args.pp,
-        "sharding_degree": args.sharding,
-        "pp_configs": {"accumulate_steps": args.micro_batches},
-    }
+    if args.auto:
+        # cost-model planner (engine.py:61 capability): describe the
+        # workload, let the tuner choose dp/mp/pp/sharding/micro-batch
+        from paddle_tpu.distributed.auto_tuner import ModelSpec
+
+        n_params = (cfg.vocab_size * cfg.hidden_size
+                    + cfg.num_hidden_layers
+                    * (4 * cfg.hidden_size ** 2
+                       + 3 * cfg.hidden_size * cfg.intermediate_size))
+        strategy = fleet.auto_tune_strategy(ModelSpec(
+            num_params=n_params, num_layers=cfg.num_hidden_layers,
+            num_heads=cfg.num_attention_heads, hidden=cfg.hidden_size,
+            seq_len=args.seq_len, global_batch=args.batch_size))
+        h = strategy.hybrid_configs
+        args.dp, args.mp = h["dp_degree"], h["mp_degree"]
+        args.pp, args.sharding = h["pp_degree"], h["sharding_degree"]
+        args.micro_batches = strategy.pipeline_configs["accumulate_steps"]
+        print("auto-tuned parallel plan (best first):")
+        print(strategy.auto_tune_plan.report())
+    else:
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {
+            "dp_degree": args.dp, "mp_degree": args.mp, "pp_degree": args.pp,
+            "sharding_degree": args.sharding,
+            "pp_configs": {"accumulate_steps": args.micro_batches},
+        }
     strategy.sequence_parallel = args.sequence_parallel
     if args.recompute:
         strategy.recompute = True
     fleet.init(is_collective=True, strategy=strategy)
-
-    mk = (LlamaConfig.tiny if args.model == "tiny" else LlamaConfig.llama3_8b)
-    cfg = mk(sequence_parallel=args.sequence_parallel,
-             recompute=args.recompute)
     model = fleet.distributed_model(LlamaForCausalLM(cfg))
     criterion = LlamaPretrainingCriterion(cfg)
     sched = paddle.optimizer.lr.CosineAnnealingDecay(

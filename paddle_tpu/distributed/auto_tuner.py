@@ -1,9 +1,12 @@
 """Parallel-config auto-tuner.
 
-Capability analog of ``python/paddle/distributed/auto_tuner/tuner.py``:
-enumerate {dp, mp, pp, sharding, micro-batch} candidates over the device
-count, prune with divisibility + a memory model, run measured trials, pick
-the fastest.
+Capability analog of ``python/paddle/distributed/auto_tuner/tuner.py`` plus
+the static auto-parallel cost model (``auto_parallel/static/cost/``,
+``auto_parallel/static/engine.py:61``): enumerate {dp, mp, pp, sharding,
+micro-batch} candidates over the device count, prune with divisibility + a
+memory model, rank with an analytical step-time cost model (compute +
+pipeline bubble + TP/DP collective time over ICI), and optionally refine
+with measured trials.
 
 TPU-first pruning: ``mp`` stays small and innermost (ICI-neighbor
 collectives), ``pp`` must divide the layer count, ZeRO ``sharding`` divides
@@ -49,8 +52,74 @@ class ModelSpec:
     optimizer_state_factor: int = 6    # AdamW master+m+v in f32 over bf16
 
 
+@dataclass
+class HardwareSpec:
+    """Per-chip numbers the cost model charges against (v5p defaults)."""
+
+    peak_flops: float = 459e12    # bf16 peak per chip
+    hbm_bytes: float = 95e9
+    ici_bandwidth: float = 9e10   # bytes/s per direction, nearest-neighbor
+    achievable_mfu: float = 0.5   # discount on peak for the compute term
+
+
 def _divisors(n: int) -> List[int]:
     return [d for d in range(1, n + 1) if n % d == 0]
+
+
+def estimate_step_time(cfg: TuneConfig, model: ModelSpec,
+                       hw: Optional[HardwareSpec] = None) -> float:
+    """Analytical seconds/step for one candidate — the compiled-cost
+    analog of the reference's ``static/cost`` op-level model, collapsed to
+    the three terms that dominate on TPU:
+
+    * compute: ``6·N·tokens`` train FLOPs, split over every device, at a
+      discounted peak;
+    * pipeline bubble: ``(pp−1)/M`` idle fraction of the 1F1B schedule;
+    * collectives: Megatron-TP all-reduces of activation bytes per layer
+      (ring cost over ``mp``) + one grad all-reduce over ``dp·sharding``.
+    """
+    hw = hw or HardwareSpec()
+    m = model
+    if m.num_params == 0:
+        return 0.0
+    tokens = m.global_batch * m.seq_len
+    flops = 6.0 * m.num_params * tokens
+    compute = flops / cfg.world / (hw.peak_flops * hw.achievable_mfu)
+
+    per_rank_batch = max(1, m.global_batch // max(cfg.dp * cfg.sharding, 1))
+    n_micro = max(1, per_rank_batch // max(cfg.micro_batch, 1))
+    compute *= 1.0 + (cfg.pp - 1) / n_micro  # 1F1B bubble fraction
+
+    comm = 0.0
+    if cfg.mp > 1:
+        act_bytes = (cfg.micro_batch * m.seq_len * m.hidden *
+                     m.bytes_per_param)
+        ring = 2.0 * act_bytes * (cfg.mp - 1) / cfg.mp / hw.ici_bandwidth
+        # 2 all-reduces fwd + 2 bwd per layer, per microbatch
+        comm += 4.0 * ring * (m.num_layers / cfg.pp) * n_micro
+    sync = cfg.dp * cfg.sharding
+    if sync > 1:
+        grad_bytes = m.num_params * m.bytes_per_param / (cfg.mp * cfg.pp)
+        comm += 2.0 * grad_bytes * (sync - 1) / sync / hw.ici_bandwidth
+    return compute + comm
+
+
+@dataclass
+class TunePlan:
+    """Winner + scored candidate table from :meth:`AutoTuner.plan`."""
+
+    best: TuneConfig
+    table: List[Dict]
+
+    def report(self) -> str:
+        lines = [f"{'dp':>3} {'mp':>3} {'pp':>3} {'shard':>5} {'mb':>3} "
+                 f"{'est_ms':>10} {'est_GB':>8}"]
+        for r in self.table:
+            lines.append(
+                f"{r['dp']:>3} {r['mp']:>3} {r['pp']:>3} {r['sharding']:>5} "
+                f"{r['micro_batch']:>3} {r['est_step_s'] * 1e3:>10.4g} "
+                f"{r['est_mem_gb']:>8.3g}")
+        return "\n".join(lines)
 
 
 class AutoTuner:
@@ -115,6 +184,26 @@ class AutoTuner:
         act = (cfg.micro_batch * m.seq_len * m.hidden *
                (m.num_layers / cfg.pp) * 34 / cfg.mp)
         return p_bytes + g_bytes + o_bytes + act
+
+    # --- cost-model planning ---------------------------------------------
+    def plan(self, hw: Optional[HardwareSpec] = None,
+             top_k: int = 8) -> "TunePlan":
+        """Rank every feasible candidate by the analytical cost model and
+        return the winner + the scored table (``engine.py:61`` 'plan over
+        candidates with a cost model' capability, no trials needed)."""
+        hw = hw or HardwareSpec(hbm_bytes=self.hbm)
+        rows = []
+        for cfg in self.candidates():
+            t = estimate_step_time(cfg, self.model, hw)
+            rows.append({**cfg.as_dict(), "est_step_s": t,
+                         "est_mem_gb": self.estimate_memory(cfg) / 1e9,
+                         "cfg": cfg})
+        rows.sort(key=lambda r: r["est_step_s"])
+        if not rows:
+            raise RuntimeError(
+                f"auto-tuner: no feasible parallel config for "
+                f"{self.n} devices (model {self.model})")
+        return TunePlan(best=rows[0]["cfg"], table=rows[:top_k])
 
     # --- trials -----------------------------------------------------------
     def tune(self, trial_fn: Callable[[TuneConfig], float],

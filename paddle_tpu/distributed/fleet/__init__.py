@@ -28,18 +28,30 @@ __all__ = [
     "DistributedStrategy", "init", "distributed_model",
     "distributed_optimizer", "get_hybrid_communicate_group",
     "worker_index", "worker_num", "is_first_worker", "barrier_worker",
-    "PipelineParallelModel",
+    "PipelineParallelModel", "auto_tune_strategy",
 ]
 
 _state = {"initialized": False, "strategy": None}
 
 
 def init(role_maker: Any = None, is_collective: bool = True,
-         strategy: Optional[DistributedStrategy] = None):
+         strategy: Optional[DistributedStrategy] = None,
+         auto: bool = False, model_spec: Any = None):
     """Initialize fleet: build the hybrid mesh from the strategy and the
     process-level env (``fleet/fleet.py:167`` analog).  ``role_maker`` is
     accepted for API parity and ignored — co-scheduled TPU pods have no PS
-    roles."""
+    roles.
+
+    ``auto=True`` (with no explicit ``strategy``) runs the auto-tuner's
+    cost-model planner over all feasible {dp, mp, pp, sharding,
+    micro-batch} splits of the visible devices and initializes with the
+    winner (``engine.py:61`` + ``auto_tuner/tuner.py`` capability).  Pass
+    ``model_spec`` (an :class:`~paddle_tpu.distributed.auto_tuner.
+    ModelSpec`) to describe the workload; the chosen plan is stored on the
+    returned strategy as ``auto_tune_plan`` (``plan.report()`` prints the
+    scored table)."""
+    if auto and strategy is None:
+        strategy = auto_tune_strategy(model_spec)
     strategy = strategy or DistributedStrategy()
     h = strategy.hybrid_configs
     topology.init_mesh(dp=h["dp_degree"], mp=h["mp_degree"],
@@ -48,6 +60,32 @@ def init(role_maker: Any = None, is_collective: bool = True,
     env.init_parallel_env()
     _state["initialized"] = True
     _state["strategy"] = strategy
+    return strategy
+
+
+def auto_tune_strategy(model_spec: Any = None,
+                       n_devices: Optional[int] = None) -> DistributedStrategy:
+    """Plan a DistributedStrategy with the auto-tuner's cost model."""
+    from ..auto_tuner import AutoTuner, ModelSpec
+
+    n = n_devices or jax.device_count()
+    spec = model_spec or ModelSpec(
+        num_params=8e9, num_layers=32, num_heads=32, hidden=4096,
+        seq_len=4096, global_batch=max(n, 8))
+    plan = AutoTuner(n, spec).plan()
+    best = plan.best
+    strategy = DistributedStrategy()
+    strategy.hybrid_configs = {
+        "dp_degree": best.dp, "mp_degree": best.mp, "pp_degree": best.pp,
+        "sharding_degree": best.sharding}
+    per_rank = max(1, spec.global_batch // max(best.dp * best.sharding, 1))
+    if best.pp > 1:
+        strategy.pipeline_configs = {
+            "accumulate_steps": max(1, per_rank // best.micro_batch),
+            "schedule_mode": "1F1B"}
+    # sharding_degree > 1 already enabled strategy.sharding via the
+    # hybrid_configs setter
+    strategy.auto_tune_plan = plan
     return strategy
 
 
@@ -121,23 +159,30 @@ class PipelineParallelModel(Layer):
         mode = cfg.get("schedule_mode", "1F1B")
 
         inner = self._layers
+        loss = None
         if mode == "1F1B" and hasattr(inner, "train_batch_1f1b"):
-            # recompute is opt-in like the reference (fleet/recompute): off
-            # → forward-once 1F1B buffering activations; on → re-run each
-            # stage forward at its backward tick (less memory, ~1/3 extra
-            # stage FLOPs)
-            loss = inner.train_batch_1f1b(
-                inputs, labels, n_micro,
-                recompute=bool(self._strategy.recompute))
-        elif hasattr(inner, "loss_fn") and inner.loss_fn is not None:
-            from ...parallel.pipeline import pipeline_forward
+            from ...parallel.pipeline_1f1b import PipelineSegmentationError
 
-            out = pipeline_forward(inner, inputs, n_micro)
-            loss = inner.loss_fn(out, labels)
-        else:
-            raise RuntimeError(
-                "train_batch needs a model with train_batch_1f1b (1F1B "
-                "schedule) or a PipelineLayer with loss_fn (F-then-B)")
+            try:
+                # recompute is opt-in like the reference (fleet/recompute):
+                # off → forward-once 1F1B buffering activations; on →
+                # re-run each stage forward at its backward tick (less
+                # memory, ~1/3 extra stage FLOPs)
+                loss = inner.train_batch_1f1b(
+                    inputs, labels, n_micro,
+                    recompute=bool(self._strategy.recompute))
+            except PipelineSegmentationError:
+                loss = None  # fully heterogeneous stack → F-then-B below
+        if loss is None:
+            if hasattr(inner, "loss_fn") and inner.loss_fn is not None:
+                from ...parallel.pipeline import pipeline_forward
+
+                out = pipeline_forward(inner, inputs, n_micro)
+                loss = inner.loss_fn(out, labels)
+            else:
+                raise RuntimeError(
+                    "train_batch needs a model with train_batch_1f1b (1F1B "
+                    "schedule) or a PipelineLayer with loss_fn (F-then-B)")
 
         if scaler is not None:
             scaler.scale(loss).backward()

@@ -113,6 +113,18 @@ class PipelineLayer(Layer):
         lo, hi = self._bounds[stage]
         return self.run_order[lo:hi]
 
+    def train_batch_1f1b(self, inputs, labels, n_microbatch: int,
+                         recompute: bool = False):
+        """True 1F1B for this desc-defined stack (auto-segmented into
+        prefix / homogeneous block / suffix — see
+        :func:`~paddle_tpu.parallel.pipeline_1f1b.pipeline_train_1f1b_auto`);
+        lets ``fleet.distributed_model`` pipeline ANY sequential model, not
+        just ones with a bespoke schedule hook."""
+        from .pipeline_1f1b import pipeline_train_1f1b_auto
+
+        return pipeline_train_1f1b_auto(self, inputs, labels, n_microbatch,
+                                        recompute=recompute)
+
     def forward(self, x):
         for item, desc in zip(self.run_order, self._descs):
             if isinstance(desc, SharedLayerDesc) and desc.forward_func is not None:

@@ -659,6 +659,142 @@ def pipeline_train_1f1b(layer, x: Tensor, targets: Tensor,
                   targets=targets)
 
 
+def _layer_sig(obj):
+    """Structural signature of one pipeline item: type tree + param shapes +
+    per-sublayer scalar config (epsilon, activation names, ...).  Only items
+    with equal signatures may share one staged ``stage_fn`` — structural
+    equality alone is NOT enough (Block(act='relu') vs Block(act='gelu')
+    must not merge, since the schedule runs every stage through stage 0's
+    template)."""
+    from ..nn.layers import Layer
+
+    if isinstance(obj, Layer):
+        def cfg(l):
+            return tuple(sorted(
+                (k, v) for k, v in vars(l).items()
+                if not k.startswith("_") and k != "training"
+                and isinstance(v, (int, float, bool, str))))
+
+        return (tuple((type(s).__name__, cfg(s))
+                      for s in obj.sublayers(include_self=True)),
+                tuple(tuple(p.shape) for _, p in obj.named_parameters()))
+    # bare callables: only the SAME object repeated may merge
+    return ("callable", id(obj))
+
+
+class PipelineSegmentationError(RuntimeError):
+    """The stack has no homogeneous block divisible into pp·v stages —
+    callers fall back to the F-then-B microbatched schedule."""
+
+
+class _BlockPipe:
+    """Adapter exposing a homogeneous layer block with the
+    ``num_stages``/``get_stage_layers`` interface of PipelineLayer."""
+
+    def __init__(self, block, n, v):
+        assert len(block) % (n * v) == 0
+        self.num_virtual_stages = v
+        self.num_stages = n * v
+        per = len(block) // (n * v)
+        self._stages = [block[s * per:(s + 1) * per]
+                        for s in range(n * v)]
+
+    def get_stage_layers(self, s):
+        return self._stages[s]
+
+
+def pipeline_train_1f1b_auto(pipe, inputs, labels, n_microbatch: int,
+                             recompute: bool = False,
+                             axis: str = PP_AXIS) -> Tensor:
+    """True 1F1B for an arbitrary sequential stack (``LayerDesc`` case,
+    ``pp_layers.py:261`` + ``fleet/model.py:32``).
+
+    The stack is auto-segmented into [prefix | homogeneous block | suffix]:
+    the longest run of structurally identical layers becomes the pipelined
+    block (its length must divide by ``pp·v``); the prefix (e.g. embedding)
+    runs on the autograd tape before the schedule, and the suffix (final
+    norm / head) plus ``pipe.loss_fn`` run per-microbatch on the last
+    stage inside the schedule — exactly how the Llama path treats
+    embedding and LM head.  Raises with guidance when no such block exists
+    (callers then use the F-then-B microbatched fallback)."""
+    from ..distributed import topology as topo
+    from ..nn.layers import Layer
+    from .pipeline import SharedLayerDesc
+
+    if pipe.loss_fn is None:
+        raise RuntimeError("1F1B needs PipelineLayer(loss_fn=...)")
+    mesh = topo.get_mesh()
+    n = mesh.shape[axis]
+    v = getattr(pipe, "num_virtual_stages", 1)
+    items = list(pipe.run_order)
+    descs = list(getattr(pipe, "_descs", items))
+    # SharedLayerDesc items (tied weights, custom forward_func) never join
+    # the staged block — position-unique signature keeps them in
+    # prefix/suffix where the desc dispatch below handles them
+    sigs = [("shared", i) if isinstance(d, SharedLayerDesc)
+            else _layer_sig(o)
+            for i, (o, d) in enumerate(zip(items, descs))]
+
+    # longest contiguous run of one signature whose length divides pp·v
+    best = None  # (len, start, end)
+    i = 0
+    while i < len(sigs):
+        j = i
+        while j < len(sigs) and sigs[j] == sigs[i]:
+            j += 1
+        run = j - i
+        usable = run - run % (n * v)
+        if usable >= n * v and (best is None or usable > best[0]):
+            best = (usable, i, i + usable)
+        i = j
+    if best is None:
+        raise PipelineSegmentationError(
+            f"no homogeneous layer block divisible into {n * v} pipeline "
+            "stages; use schedule_mode='F-then-B' for fully heterogeneous "
+            "stacks")
+    _, lo, hi = best
+
+    def _apply(item, desc, x):
+        # SharedLayerDesc dispatch matches PipelineLayer.forward
+        if isinstance(desc, SharedLayerDesc) and desc.forward_func is not None:
+            return desc.forward_func(item, x)
+        return item(x)
+
+    block = items[lo:hi]
+
+    x = inputs
+    for item, desc in zip(items[:lo], descs[:lo]):
+        x = _apply(item, desc, x)
+
+    suffix = list(zip(items[hi:], descs[hi:]))
+    suffix_layers = [o for o, _ in suffix if isinstance(o, Layer)]
+    head_layers = suffix_layers + (
+        [pipe.loss_fn] if isinstance(pipe.loss_fn, Layer) else [])
+    head_params = [p for l in head_layers for _, p in l.named_parameters()]
+
+    def head_apply(head_values, act, tgt):
+        flat = list(head_values)
+        saved = []
+        it = iter(flat)
+        for l in head_layers:
+            for _, p in l.named_parameters():
+                saved.append((p, p._value))
+                p._value = next(it)
+        try:
+            cur = Tensor(act, stop_gradient=True)
+            for item, desc in suffix:
+                cur = _apply(item, desc, cur)
+            loss = pipe.loss_fn(cur, Tensor(tgt, stop_gradient=True))
+            return loss._value if isinstance(loss, Tensor) else loss
+        finally:
+            for p, val in saved:
+                p._value = val
+
+    return pipeline_train_1f1b(
+        _BlockPipe(block, n, v), x, labels, head_params, head_apply,
+        n_microbatch, axis=axis, recompute=recompute)
+
+
 def stack_device_major(per_vstage: Sequence, n: int, v: int):
     """Stack per-virtual-stage pytrees into device-major ``[n·v, ...]`` rows:
     row ``d·v + k`` ← virtual stage ``k·n + d`` (depth-first placement)."""

@@ -166,6 +166,18 @@ import numpy as _np_dtype_mod  # noqa: E402
 dtype = _np_dtype_mod.dtype  # paddle.dtype: the dtype TYPE (numpy-compatible)
 from .nn.functional import pdist  # noqa: F401,E402
 from .tensor import reverse  # noqa: F401,E402
+from .tensor import (  # noqa: F401,E402  (TensorArray family + tail)
+    array_length,
+    array_read,
+    array_write,
+    create_array,
+    fill_diagonal,
+    fill_diagonal_,
+    gaussian_,
+    tensor_array_to_tensor,
+)
+from .signal import istft, stft  # noqa: F401,E402
+from . import onnx  # noqa: F401,E402
 
 
 class CUDAPinnedPlace:

@@ -180,6 +180,8 @@ _INPLACE_NAMES = [
     "nan_to_num", "neg", "polygamma", "pow", "reciprocal", "remainder",
     "round", "rsqrt", "scale", "sigmoid", "sin", "sinh", "sqrt", "square",
     "subtract", "t", "tan", "tanh", "tril", "triu", "trunc",
+    "erfinv", "lerp", "not_equal", "put_along_axis", "atanh", "acosh",
+    "asinh",
 ]
 
 
@@ -216,3 +218,55 @@ del _g, _name, _fn
 def reverse(x, axis, name=None):
     """Legacy alias of :func:`flip` (the reference still exports it)."""
     return flip(x, axis)
+
+
+def fill_diagonal(x, value, offset=0, wrap=False, name=None):
+    """Functional form of ``Tensor.fill_diagonal_``
+    (``tensor/manipulation.py`` fill_diagonal_ kernel semantics): fill the
+    main diagonal (2-D; ``wrap`` restarts it every ``ncols`` rows like
+    numpy)."""
+    from ..core.dispatch import run_op
+
+    import numpy as _np
+
+    def f(v):
+        rows, cols = v.shape[-2], v.shape[-1]
+        if v.ndim == 2 and wrap and rows > cols:
+            # numpy wrap semantics: flat stride cols+1, restarting past the
+            # bottom; offset shifts the start
+            start = offset if offset >= 0 else -offset * cols
+            flat = _np.arange(start, rows * cols, cols + 1)
+            r, c = flat // cols, flat % cols
+            return v.at[r, c].set(value)
+        n = min(rows, cols)
+        i = _np.arange(n)
+        r = i + max(-offset, 0)
+        c = i + max(offset, 0)
+        keep = (r < rows) & (c < cols)
+        return v.at[..., r[keep], c[keep]].set(value)
+
+    return run_op("fill_diagonal", f, x)
+
+
+def fill_diagonal_(x, value, offset=0, wrap=False, name=None):
+    """In-place variant of :func:`fill_diagonal`."""
+    return x._rebind(fill_diagonal(x, value, offset=offset, wrap=wrap))
+
+
+def gaussian_(x, mean=0.0, std=1.0, seed=0, name=None):
+    """Fill ``x`` in place with N(mean, std²) samples
+    (``tensor/random.py`` gaussian_)."""
+    return x._rebind(gaussian(x.shape, mean=mean, std=std,
+                              dtype=str(x.dtype)))
+
+
+Tensor.fill_diagonal_ = fill_diagonal_
+Tensor.gaussian_ = gaussian_
+
+from .array import (  # noqa: E402,F401
+    array_length,
+    array_read,
+    array_write,
+    create_array,
+    tensor_array_to_tensor,
+)

@@ -1,0 +1,86 @@
+"""TensorArray ops (``python/paddle/tensor/array.py`` capability).
+
+TPU-first: in dynamic mode the reference's TensorArray IS a Python list
+(``array.py:52,126,196,310`` all short-circuit to list ops), and under
+``to_static`` a Python list of traced Tensors stages cleanly into one XLA
+program as long as indices are Python ints — which is exactly the
+reference's dygraph contract.  No LOD_TENSOR_ARRAY variable is needed on
+an SPMD substrate.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..core.tensor import Tensor, to_tensor
+
+
+def _as_index(i) -> int:
+    """Indices are host ints (the reference reads ``i.item()`` in dygraph);
+    a traced index would make list length data-dependent."""
+    if isinstance(i, Tensor):
+        arr = np.asarray(i._value)
+        return int(arr.reshape(-1)[0])
+    return int(i)
+
+
+def create_array(dtype="float32", initialized_list=None) -> List[Tensor]:
+    """(``array.py:261``) returns a Python list, optionally pre-filled."""
+    array: List[Tensor] = []
+    if initialized_list is not None:
+        if not isinstance(initialized_list, (list, tuple)):
+            raise TypeError(
+                "initialized_list must be list/tuple, got "
+                f"{type(initialized_list)}")
+        for val in initialized_list:
+            if not isinstance(val, Tensor):
+                raise TypeError(
+                    f"all values must be Tensor, got {type(val)}")
+        array = list(initialized_list)
+    return array
+
+
+def array_length(array) -> Tensor:
+    """(``array.py:27``)"""
+    return to_tensor(np.int64(len(array)))
+
+
+def array_read(array, i) -> Tensor:
+    """(``array.py:86``) read position ``i``."""
+    return array[_as_index(i)]
+
+
+def array_write(x, i, array: Optional[list] = None) -> list:
+    """(``array.py:164``) write ``x`` at position ``i`` (extending with the
+    reference's sparse-write semantics: writing past the end grows the
+    list); returns the array."""
+    if array is None:
+        array = []
+    idx = _as_index(i)
+    if idx < len(array):
+        array[idx] = x
+    else:
+        while len(array) < idx:
+            array.append(None)
+        array.append(x)
+    return array
+
+
+def tensor_array_to_tensor(input: Sequence[Tensor], axis: int = 1,
+                           use_stack: bool = False, name=None):
+    """(``manipulation.py:45``) fuse the array into one Tensor; returns
+    ``(tensor, per-element sizes along axis)`` like the reference's dygraph
+    path."""
+    from .manipulation import concat, stack
+
+    if not isinstance(input, (list, tuple)):
+        raise TypeError("tensor_array_to_tensor input must be a list")
+    op = stack if use_stack else concat
+    res = op(list(input), axis=axis)
+    if use_stack:
+        sizes = np.ones(len(input), np.int64)
+    else:
+        sizes = np.array([int(x.shape[axis]) for x in input], np.int64)
+    return res, to_tensor(sizes)

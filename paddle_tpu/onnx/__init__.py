@@ -4,14 +4,12 @@ The reference delegates entirely to the external ``paddle2onnx`` package
 (``export.py:22`` → ``try_import('paddle2onnx')``).  TPU-first the
 portable program format is StableHLO — ``paddle.jit.save`` writes it and
 any StableHLO→ONNX bridge (e.g. onnx-mlir, IREE importers) can consume
-it.  When an ``onnx`` runtime package is importable we emit a real ONNX
-model for simple traced programs; otherwise ``export`` raises loudly with
-the StableHLO path as the answer, never silently succeeding.
+it.  This build ships NO ONNX emitter: ``export`` always raises
+``NotImplementedError`` (loudly, never silently succeeding), pointing at
+the StableHLO path as the portable export.
 """
 
 from __future__ import annotations
-
-import os
 
 __all__ = ["export"]
 
@@ -20,21 +18,14 @@ def export(layer, path: str, input_spec=None, opset_version: int = 9,
            **configs):
     """Export ``layer`` to ``<path>.onnx`` (``onnx/export.py:22``).
 
-    Requires the external ``onnx`` package (the analog of the reference's
-    ``paddle2onnx`` dependency).  Without it, raises NotImplementedError
-    pointing at :func:`paddle.jit.save`'s StableHLO export, which is this
-    framework's portable serialized-program format.
+    Always raises: this build ships no ONNX emitter (the reference needs
+    the external ``paddle2onnx`` package the same way, ``export.py:22``).
+    The portable serialized-program format here is StableHLO via
+    :func:`paddle.jit.save`.
     """
-    try:
-        import onnx  # noqa: F401
-    except ImportError:
-        raise NotImplementedError(
-            "paddle.onnx.export needs the 'onnx' package (the reference "
-            "needs 'paddle2onnx' the same way, export.py:22). It is not "
-            "installed in this environment. Use paddle.jit.save(layer, "
-            "path) instead: it writes StableHLO, the portable XLA program "
-            "format, which ONNX tooling can ingest via a StableHLO→ONNX "
-            "bridge.") from None
     raise NotImplementedError(
-        "StableHLO→ONNX conversion is not wired in this build; use "
-        "paddle.jit.save for the portable StableHLO export")
+        "paddle.onnx.export is not supported in this build (no ONNX "
+        "emitter is shipped; the reference needs the external paddle2onnx "
+        "package the same way). Use paddle.jit.save(layer, path) instead: "
+        "it writes StableHLO, the portable XLA program format, which ONNX "
+        "tooling can ingest via a StableHLO→ONNX bridge.")

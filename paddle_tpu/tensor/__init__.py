@@ -168,7 +168,7 @@ _attach_inplace()
 # --------------------------------------------------------------------------
 
 _INPLACE_NAMES = [
-    "abs", "acos", "addmm", "asin", "atan", "bitwise_and", "bitwise_not",
+    "abs", "acos", "add", "addmm", "asin", "atan", "bitwise_and", "bitwise_not",
     "bitwise_or", "bitwise_xor", "bitwise_left_shift", "bitwise_right_shift",
     "ceil", "clip", "copysign", "cos", "cosh", "cumprod", "cumsum",
     "digamma", "divide", "equal", "erf", "exp", "expm1", "floor",
@@ -222,28 +222,44 @@ def reverse(x, axis, name=None):
 
 def fill_diagonal(x, value, offset=0, wrap=False, name=None):
     """Functional form of ``Tensor.fill_diagonal_``
-    (``tensor/manipulation.py`` fill_diagonal_ kernel semantics): fill the
-    main diagonal (2-D; ``wrap`` restarts it every ``ncols`` rows like
-    numpy)."""
+    (``tensor/manipulation.py`` fill_diagonal_ wrapper over the phi
+    ``fill_diagonal`` kernel, ``fill_diagonal_kernel.cc`` CalStride): 2-D
+    fills the main diagonal (``wrap`` restarts it every ``ncols`` rows
+    like numpy); >2-D requires all dims equal and fills the grand
+    diagonal ``x[i, i, ..., i]`` (the reference forces ``wrap=True`` and
+    supports no offset there)."""
     from ..core.dispatch import run_op
 
     import numpy as _np
 
     def f(v):
+        if v.ndim > 2:
+            if len(set(v.shape)) != 1:
+                raise ValueError(
+                    "fill_diagonal on a >2-D tensor requires all "
+                    f"dimensions equal, got shape {tuple(v.shape)}")
+            if offset != 0:
+                raise ValueError(
+                    "fill_diagonal offset is only supported for 2-D input")
+            i = _np.arange(v.shape[0])
+            return v.at[tuple([i] * v.ndim)].set(value)
         rows, cols = v.shape[-2], v.shape[-1]
-        if v.ndim == 2 and wrap and rows > cols:
+        if wrap and rows > cols:
             # numpy wrap semantics: flat stride cols+1, restarting past the
             # bottom; offset shifts the start
             start = offset if offset >= 0 else -offset * cols
             flat = _np.arange(start, rows * cols, cols + 1)
             r, c = flat // cols, flat % cols
             return v.at[r, c].set(value)
-        n = min(rows, cols)
+        # NB: `min`/`max` here are paddle's reductions (star-imported)
+        import builtins
+
+        n = builtins.min(rows, cols)
         i = _np.arange(n)
-        r = i + max(-offset, 0)
-        c = i + max(offset, 0)
+        r = i + builtins.max(-offset, 0)
+        c = i + builtins.max(offset, 0)
         keep = (r < rows) & (c < cols)
-        return v.at[..., r[keep], c[keep]].set(value)
+        return v.at[r[keep], c[keep]].set(value)
 
     return run_op("fill_diagonal", f, x)
 
@@ -255,8 +271,9 @@ def fill_diagonal_(x, value, offset=0, wrap=False, name=None):
 
 def gaussian_(x, mean=0.0, std=1.0, seed=0, name=None):
     """Fill ``x`` in place with N(mean, std²) samples
-    (``tensor/random.py`` gaussian_)."""
-    return x._rebind(gaussian(x.shape, mean=mean, std=std,
+    (``tensor/random.py`` gaussian_); a nonzero ``seed`` gives a
+    reproducible fill like the reference."""
+    return x._rebind(gaussian(x.shape, mean=mean, std=std, seed=seed,
                               dtype=str(x.dtype)))
 
 

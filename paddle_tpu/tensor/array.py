@@ -53,17 +53,20 @@ def array_read(array, i) -> Tensor:
 
 
 def array_write(x, i, array: Optional[list] = None) -> list:
-    """(``array.py:164``) write ``x`` at position ``i`` (extending with the
-    reference's sparse-write semantics: writing past the end grows the
-    list); returns the array."""
+    """(``array.py:164``) write ``x`` at position ``i``; like the
+    reference's dygraph path, ``i`` may be at most ``len(array)`` (append),
+    never beyond — holes would crash concat/stack later.  Returns the
+    array."""
     if array is None:
         array = []
     idx = _as_index(i)
+    if idx > len(array):
+        raise ValueError(
+            f"array_write index {idx} is past the end of the array "
+            f"(len {len(array)}); the reference asserts i <= len(array)")
     if idx < len(array):
         array[idx] = x
     else:
-        while len(array) < idx:
-            array.append(None)
         array.append(x)
     return array
 

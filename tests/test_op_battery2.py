@@ -287,3 +287,62 @@ class TestEdgeValidation:
                 threshold=paddle.to_tensor(np.array([0.5], "float32")),
                 seed=s)
             assert int(i.numpy().ravel()[0]) == 0
+
+
+class TestRound4AdviceFixes:
+    """Regressions for the r3 ADVICE items (fill_diagonal >2-D grand
+    diagonal, array_write bounds, gaussian_ seed) + the latent 2-D offset
+    bug (builtins.min/max shadowed by paddle reductions)."""
+
+    def test_fill_diagonal_2d_offset(self):
+        got = paddle.zeros([4, 4]).fill_diagonal_(2.0, offset=1).numpy()
+        ref = np.zeros((4, 4), "float32")
+        ref[np.arange(3), np.arange(3) + 1] = 2.0
+        np.testing.assert_array_equal(got, ref)
+        got = paddle.zeros([4, 4]).fill_diagonal_(3.0, offset=-2).numpy()
+        ref = np.zeros((4, 4), "float32")
+        ref[np.arange(2) + 2, np.arange(2)] = 3.0
+        np.testing.assert_array_equal(got, ref)
+
+    def test_fill_diagonal_2d_wrap_matches_numpy(self):
+        got = paddle.zeros([5, 3]).fill_diagonal_(1.0, wrap=True).numpy()
+        ref = np.zeros((5, 3), "float32")
+        np.fill_diagonal(ref, 1.0, wrap=True)
+        np.testing.assert_array_equal(got, ref)
+
+    def test_fill_diagonal_grand_diagonal_3d(self):
+        # reference phi CalStride semantics: >2-D fills x[i, i, ..., i]
+        got = paddle.zeros([3, 3, 3]).fill_diagonal_(5.0).numpy()
+        ref = np.zeros((3, 3, 3), "float32")
+        i = np.arange(3)
+        ref[i, i, i] = 5.0
+        np.testing.assert_array_equal(got, ref)
+
+    def test_fill_diagonal_3d_requires_equal_dims(self):
+        with pytest.raises(ValueError):
+            paddle.zeros([2, 3, 4]).fill_diagonal_(1.0)
+
+    def test_array_write_rejects_past_end(self):
+        arr = T.create_array()
+        T.array_write(paddle.to_tensor([1.0]), 0, arr)
+        T.array_write(paddle.to_tensor([2.0]), 1, arr)   # append OK
+        T.array_write(paddle.to_tensor([9.0]), 0, arr)   # overwrite OK
+        with pytest.raises(ValueError):
+            T.array_write(paddle.to_tensor([0.0]), 5, arr)
+
+    def test_gaussian_inplace_seed_reproducible(self):
+        a = paddle.zeros([16]).gaussian_(seed=42).numpy()
+        b = paddle.zeros([16]).gaussian_(seed=42).numpy()
+        c = paddle.zeros([16]).gaussian_(seed=43).numpy()
+        np.testing.assert_array_equal(a, b)
+        assert not np.array_equal(a, c)
+
+    def test_module_level_add_(self):
+        x = paddle.to_tensor([1.0, 2.0])
+        y = T.add_(x, paddle.to_tensor([1.0, 1.0]))
+        np.testing.assert_array_equal(x.numpy(), [2.0, 3.0])
+        np.testing.assert_array_equal(y.numpy(), [2.0, 3.0])
+
+    def test_onnx_export_raises_not_implemented(self):
+        with pytest.raises(NotImplementedError):
+            paddle.onnx.export(None, "/tmp/x")

@@ -47,6 +47,12 @@ _GRAPH_BREAK_ERRORS = (
     jax.errors.TracerIntegerConversionError,
 )
 
+# After this many distinct signatures graph-break, the whole function goes
+# eager: it is structurally untraceable (e.g. a data-dependent branch hit by
+# every new batch length) and re-attempting discovery+staging per shape would
+# cost two eager executions per call forever.
+_EAGER_KEYS_LIMIT = 8
+
 
 def _is_tracer(v) -> bool:
     return isinstance(v, jax.core.Tracer)
@@ -153,7 +159,17 @@ class StaticFunction:
         # full_graph=False (reference SOT default): trace failures graph-
         # break to eager; full_graph=True (AST mode contract): they raise.
         self._full_graph = full_graph
-        self._eager_fallback = False  # graph-break verdict, cached per fn
+        # Graph-break verdicts, cached PER CACHE KEY (shape/dtype/mode
+        # signature) like the reference SOT's per-code-location guards
+        # (``jit/sot/``): a break on one specialization must not stop other
+        # signatures from compiling or evict their live cache entries.
+        # Once _EAGER_KEYS_LIMIT distinct signatures have broken, the
+        # function is judged structurally untraceable (e.g. a data-dependent
+        # branch hit by every new batch length) and _eager_all short-circuits
+        # further trace attempts — bounding both the set and the repeated
+        # discovery/staging cost.
+        self._eager_keys: set = set()
+        self._eager_all = False
         self._donate = (
             donate_state if donate_state is not None else flags.flag("use_donated_buffers")
         )
@@ -189,21 +205,29 @@ class StaticFunction:
         return (sig, mode)
 
     def __call__(self, *args, **kwargs):
-        # nested call: inline into the outer trace; cached graph-break
-        # verdict: stay eager
-        if _tracing_depth > 0 or self._eager_fallback:
+        # nested call: inline into the outer trace
+        if _tracing_depth > 0 or self._eager_all:
             return self._fn(*args, **kwargs)
         key = self._cache_key(args, kwargs)
+        # cached graph-break verdict for THIS signature: stay eager (other
+        # signatures keep their compiled entries / may still attempt tracing)
+        if key in self._eager_keys:
+            return self._fn(*args, **kwargs)
         try:
             entry = self._cache.get(key)
-            if entry is None:
-                entry = self._build(key, args, kwargs)
+            fresh = entry is None
+            if fresh:
+                entry = self._build(args, kwargs)
             state_tensors, jitted = entry
             state_vals = [t._value for t in state_tensors]
             keys = rng_mod.get_rng_state()
             arg_vals = _tree_map_tensors((args, kwargs), lambda t: t._value)
             out_raw, new_state, new_keys, new_grads = jitted(
                 state_vals, arg_vals, keys)
+            if fresh:
+                # cache only after the first call succeeds: a graph-breaking
+                # build must never FIFO-evict a live compiled entry
+                self._cache_insert(key, entry)
         except _GRAPH_BREAK_ERRORS as e:
             # SOT-style graph break: the function cannot be staged (data-
             # dependent Python control flow, host-only op under jit).
@@ -213,12 +237,15 @@ class StaticFunction:
             self._cache.pop(key, None)
             if self._full_graph:
                 raise  # AST-mode contract: whole graph or an error
-            self._eager_fallback = True
+            self._eager_keys.add(key)
+            if len(self._eager_keys) >= _EAGER_KEYS_LIMIT:
+                self._eager_all = True
             warnings.warn(
                 f"to_static: graph break in "
                 f"{getattr(self._fn, '__name__', self._fn)!r} "
                 f"({type(e).__name__}); falling back to eager execution "
-                "for this function. Use jax-compatible control flow "
+                "for this input signature (other shapes/dtypes may still "
+                "compile). Use jax-compatible control flow "
                 "(paddle.static.nn.cond / while_loop) to keep it compiled.",
                 stacklevel=2)
             return self._fn(*args, **kwargs)
@@ -238,25 +265,32 @@ class StaticFunction:
         trusting that GSPMD "will do it".  The entry is cached, so a
         subsequent ``__call__`` with the same shapes reuses the build.
         """
-        if self._eager_fallback:
+        key = self._cache_key(args, kwargs)
+        if self._eager_all or key in self._eager_keys:
             raise RuntimeError(
                 f"{getattr(self._fn, '__name__', self._fn)!r} graph-broke "
-                "and runs eagerly — there is no compiled program to inspect")
-        key = self._cache_key(args, kwargs)
+                "for this input signature and runs eagerly — there is no "
+                "compiled program to inspect")
         entry = self._cache.get(key)
-        if entry is None:
-            entry = self._build(key, args, kwargs)
+        fresh = entry is None
+        if fresh:
+            entry = self._build(args, kwargs)
         state_tensors, jitted = entry
         state_vals = [t._value for t in state_tensors]
         keys = rng_mod.get_rng_state()
         arg_vals = _tree_map_tensors((args, kwargs), lambda t: t._value)
-        try:
-            return jitted.lower(state_vals, arg_vals, keys).compile().as_text()
-        except _GRAPH_BREAK_ERRORS:
-            self._cache.pop(key, None)  # don't leave a poisoned entry
-            raise
+        text = jitted.lower(state_vals, arg_vals, keys).compile().as_text()
+        if fresh:
+            self._cache_insert(key, entry)
+        return text
 
-    def _build(self, key, args, kwargs):
+    def _cache_insert(self, key, entry):
+        self._cache[key] = entry
+        limit = flags.flag("jit_cache_max_entries")
+        while len(self._cache) > limit:  # FIFO eviction (SOT cache-size knob)
+            self._cache.pop(next(iter(self._cache)))
+
+    def _build(self, args, kwargs):
         # ---- pass 1: discovery --------------------------------------------
         rec = _Recorder()
         rec.seed(_tree_tensors([args, kwargs], []))
@@ -311,12 +345,7 @@ class StaticFunction:
 
         donate = (0,) if self._donate else ()
         jitted = jax.jit(pure, donate_argnums=donate)
-        entry = (state_tensors, jitted)
-        self._cache[key] = entry
-        limit = flags.flag("jit_cache_max_entries")
-        while len(self._cache) > limit:  # FIFO eviction (SOT cache-size knob)
-            self._cache.pop(next(iter(self._cache)))
-        return entry
+        return (state_tensors, jitted)
 
 
 def _rebuild_args(arg_vals, template):

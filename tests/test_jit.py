@@ -44,7 +44,8 @@ class TestBasics:
 class TestGraphBreakFallback:
     """VERDICT r2 #5: trace failures (data-dependent Python control flow,
     host-only ops under jit) fall back to eager with a one-time warning and
-    a cached per-function verdict — the SOT graph-break analog."""
+    a cached per-signature verdict — the SOT graph-break analog (r4: verdict
+    keyed by cache key, so other shapes may still compile)."""
 
     def test_tensor_dependent_if_falls_back(self):
         def f(x):
@@ -57,7 +58,7 @@ class TestGraphBreakFallback:
         with pytest.warns(UserWarning, match="graph break"):
             out = fn(x)
         np.testing.assert_allclose(out.numpy(), 2 * np.ones(3), rtol=1e-6)
-        assert fn._eager_fallback
+        assert fn._eager_keys
         # negative branch also runs correctly (pure Python now)
         y = paddle.to_tensor(-np.ones((3,), np.float32))
         np.testing.assert_allclose(fn(y).numpy(), -2 * np.ones(3), rtol=1e-6)
@@ -116,7 +117,7 @@ class TestGraphBreakFallback:
         model = nn.Linear(4, 2)
         fn = paddle.jit.to_static(model.forward)
         fn(paddle.to_tensor(np_t([3, 4])))
-        assert not fn._eager_fallback
+        assert not fn._eager_keys
         assert len(fn._cache) == 1
 
     def test_full_graph_true_raises(self):
@@ -132,7 +133,7 @@ class TestGraphBreakFallback:
         with pytest.raises((jax.errors.ConcretizationTypeError,
                             jax.errors.TracerArrayConversionError)):
             fn(paddle.to_tensor(np.ones((3,), np.float32)))
-        assert not fn._eager_fallback
+        assert not fn._eager_keys
 
     def test_lowered_text_after_fallback_is_loud(self):
         def f(x):
@@ -146,6 +147,100 @@ class TestGraphBreakFallback:
             fn(x)
         with pytest.raises(RuntimeError, match="graph-broke"):
             fn.lowered_text(x)
+
+    def test_break_is_per_signature(self):
+        """VERDICT r3 #7: the eager verdict is keyed by the cache key, not
+        the whole function — a shape that trips data-dependent control flow
+        must not stop other shapes from compiling (reference SOT guards
+        break per code location/specialization, ``jit/sot/``)."""
+        def f(x):
+            if x.shape[0] == 1 and float(x.sum()) > 0:  # breaks only (1,)
+                return x * 2
+            return x + 1
+
+        fn = paddle.jit.to_static(f)
+        bad = paddle.to_tensor(np.ones((1,), np.float32))
+        with pytest.warns(UserWarning, match="graph break"):
+            np.testing.assert_allclose(fn(bad).numpy(), [2.0])
+        assert len(fn._eager_keys) == 1
+        # a different signature still compiles...
+        good = paddle.to_tensor(np.ones((4,), np.float32))
+        np.testing.assert_allclose(fn(good).numpy(), 2 * np.ones(4))
+        assert len(fn._cache) == 1
+        assert "HloModule" in fn.lowered_text(good)
+        # ...and the broken signature stays eager (no new warning, correct)
+        import warnings as _w
+
+        with _w.catch_warnings():
+            _w.simplefilter("error")
+            np.testing.assert_allclose(fn(bad).numpy(), [2.0])
+
+    def test_break_does_not_evict_compiled_entries(self):
+        """A compiled signature keeps serving its cached executable after a
+        different signature graph-breaks."""
+        def f(x):
+            if x.shape[0] == 2 and float(x.sum()) > 1e9:
+                return x * 0
+            return x * 3
+
+        fn = paddle.jit.to_static(f)
+        ok = paddle.to_tensor(np.ones((5,), np.float32))
+        np.testing.assert_allclose(fn(ok).numpy(), 3 * np.ones(5))
+        assert len(fn._cache) == 1
+        entry_before = next(iter(fn._cache.values()))
+        with pytest.warns(UserWarning, match="graph break"):
+            fn(paddle.to_tensor(np.ones((2,), np.float32)))
+        assert next(iter(fn._cache.values())) is entry_before
+        np.testing.assert_allclose(fn(ok).numpy(), 3 * np.ones(5))
+
+    def test_break_does_not_evict_even_at_cache_limit(self):
+        """A doomed build must not FIFO-evict a live entry even when the
+        cache is at jit_cache_max_entries (entries are only inserted after a
+        successful first call)."""
+        from paddle_tpu.core import flags
+
+        old = flags.flag("jit_cache_max_entries")
+        flags.set_flags({"jit_cache_max_entries": 1})
+        try:
+            def f(x):
+                if x.shape[0] == 2 and float(x.sum()) > 1e9:
+                    return x * 0
+                return x * 3
+
+            fn = paddle.jit.to_static(f)
+            ok = paddle.to_tensor(np.ones((5,), np.float32))
+            fn(ok)
+            entry_before = next(iter(fn._cache.values()))
+            with pytest.warns(UserWarning, match="graph break"):
+                fn(paddle.to_tensor(np.ones((2,), np.float32)))
+            assert len(fn._cache) == 1
+            assert next(iter(fn._cache.values())) is entry_before
+        finally:
+            flags.set_flags({"jit_cache_max_entries": old})
+
+    def test_break_cap_goes_function_wide(self):
+        """After _EAGER_KEYS_LIMIT distinct breaking signatures the whole
+        function goes eager (bounds the verdict set and the per-new-shape
+        discovery/staging cost)."""
+        from paddle_tpu.jit.api import _EAGER_KEYS_LIMIT
+
+        def f(x):
+            n = int(x.sum())  # breaks for every signature
+            return x + n
+
+        fn = paddle.jit.to_static(f)
+        with pytest.warns(UserWarning):
+            for i in range(_EAGER_KEYS_LIMIT):
+                fn(paddle.to_tensor(np.ones((i + 1,), np.float32)))
+        assert fn._eager_all
+        assert len(fn._eager_keys) == _EAGER_KEYS_LIMIT
+        # further new shapes skip tracing entirely, stay correct, no warning
+        import warnings as _w
+
+        with _w.catch_warnings():
+            _w.simplefilter("error")
+            out = fn(paddle.to_tensor(np.ones((50,), np.float32)))
+        np.testing.assert_allclose(out.numpy(), 51 * np.ones(50))
 
 
 class TestTrainStep:

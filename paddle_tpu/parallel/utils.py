@@ -116,14 +116,28 @@ def param_spec(p: Tensor) -> PartitionSpec:
 def apply_param_shardings(layer, mesh: Optional[Mesh] = None):
     """device_put every annotated parameter/buffer onto the mesh — the analog
     of fleet's broadcast-on-init (``fleet/model.py:32``), except placement is
-    declarative and XLA moves only the local shard."""
+    declarative and XLA moves only the local shard.
+
+    Under a trace (AOT lowering with init fused into the program, e.g.
+    ``tools/aot_lower_8b.py``) a ``device_put`` annotation is dropped by the
+    lowering, so traced values get ``with_sharding_constraint`` instead —
+    the same GSPMD placement, expressed as a program annotation."""
     mesh = mesh or topology.get_mesh()
     if mesh is None:
         return layer
+
+    def place(v, spec):
+        if isinstance(v, jax.core.Tracer):
+            if in_manual_mode():
+                # inside shard_map the value is a per-shard view — a
+                # full-mesh constraint would be wrong (module contract)
+                return v
+            return jax.lax.with_sharding_constraint(
+                v, NamedSharding(mesh, spec))
+        return jax.device_put(v, NamedSharding(mesh, spec))
+
     for _, p in layer.named_parameters():
-        spec = param_spec(p)
-        p._value = jax.device_put(p._value, NamedSharding(mesh, spec))
+        p._value = place(p._value, param_spec(p))
     for _, b in layer.named_buffers():
-        spec = param_spec(b)
-        b._value = jax.device_put(b._value, NamedSharding(mesh, spec))
+        b._value = place(b._value, param_spec(b))
     return layer

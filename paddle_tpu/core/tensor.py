@@ -112,18 +112,35 @@ class Tensor:
 
     # --- conversion ---------------------------------------------------------
     def numpy(self):
-        return np.asarray(self._value)
+        """Full value as numpy.
+
+        On a multi-process mesh, a value sharded across hosts is gathered
+        with ``multihost_utils.process_allgather`` — a COLLECTIVE: every
+        process must reach this call in lockstep (the SPMD contract; the
+        reference's dist-tensor fetch gathers cross-rank the same way).
+        Calling it rank-conditionally (``if rank == 0: t.numpy()``) will
+        hang the job.  ``item``/``tolist``/``float()``/``print`` route
+        through here and share the contract.
+        """
+        v = self._value
+        if (isinstance(v, jax.Array) and not v.is_fully_addressable
+                and not v.is_fully_replicated):
+            from jax.experimental import multihost_utils
+
+            return np.asarray(
+                multihost_utils.process_allgather(v, tiled=True))
+        return np.asarray(v)
 
     def item(self, *args):
         if args:
-            return self._value[args].item() if len(args) > 1 else np.asarray(self._value).flat[args[0]].item()
-        return np.asarray(self._value).item()
+            return self._value[args].item() if len(args) > 1 else self.numpy().flat[args[0]].item()
+        return self.numpy().item()
 
     def tolist(self):
-        return np.asarray(self._value).tolist()
+        return self.numpy().tolist()
 
     def __array__(self, dtype=None):
-        a = np.asarray(self._value)
+        a = self.numpy()
         return a.astype(dtype) if dtype is not None else a
 
     def astype(self, dtype):
@@ -265,16 +282,16 @@ class Tensor:
             yield self[i]
 
     def __bool__(self):
-        return bool(np.asarray(self._value))
+        return bool(self.numpy())
 
     def __float__(self):
-        return float(np.asarray(self._value))
+        return float(self.numpy())
 
     def __int__(self):
-        return int(np.asarray(self._value))
+        return int(self.numpy())
 
     def __index__(self):
-        return int(np.asarray(self._value))
+        return int(self.numpy())
 
     def __hash__(self):
         return id(self)
@@ -285,12 +302,12 @@ class Tensor:
             return f"Tensor(shape={self.shape}, dtype={self.dtype}{grad_info}, traced)"
         return (
             f"Tensor(shape={self.shape}, dtype={self.dtype}{grad_info},\n"
-            f"       {np.asarray(self._value)})"
+            f"       {self.numpy()})"
         )
 
     def __format__(self, spec):
         if self.ndim == 0:
-            return format(np.asarray(self._value).item(), spec)
+            return format(self.numpy().item(), spec)
         return repr(self)
 
 

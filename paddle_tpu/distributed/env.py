@@ -22,10 +22,23 @@ def init_parallel_env(strategy=None):
 
     Multi-host: uses jax.distributed.initialize (coordination service =
     TCPStore analog, tcp_store.h:121). Single-host: no-op.
+
+    ``PADDLE_TPU_CPU_SIM=<n>`` (set by the cpu-sim launcher/spawn path):
+    this worker is a simulated CPU "host" with ``n`` virtual devices.  The
+    platform pin MUST go through ``jax.config`` here — a sitecustomize-pinned
+    accelerator plugin ignores the ``JAX_PLATFORMS`` env var, and probing it
+    can hang on a dead tunnel.
     """
     global _initialized
     if _initialized:
         return
+    sim = os.environ.get("PADDLE_TPU_CPU_SIM")
+    if sim:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={int(sim)}")
+        jax.config.update("jax_platforms", "cpu")
     coord = os.environ.get("PADDLE_MASTER") or os.environ.get("MASTER_ADDR")
     nprocs = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
     pid = int(os.environ.get("PADDLE_TRAINER_ID", "0"))

@@ -52,7 +52,8 @@ def _routable_ip(master_host: str) -> str:
 
 
 def _build_env(rank: int, nprocs: int, master: str, base: Dict[str, str],
-               cpu_sim: bool, log_dir: Optional[str]) -> Dict[str, str]:
+               cpu_sim: bool, log_dir: Optional[str],
+               sim_devices: int = 1) -> Dict[str, str]:
     env = dict(base)
     env.update({
         # paddle-compat names (launch/controllers/collective.py env set)
@@ -66,9 +67,17 @@ def _build_env(rank: int, nprocs: int, master: str, base: Dict[str, str],
         "PADDLE_TPU_LAUNCHED": "1",
     })
     if cpu_sim:
-        # each simulated worker is an independent 1-device CPU "host"
-        env["PADDLE_TPU_CPU_SIM"] = "1"
+        # each simulated worker is an independent CPU "host" with
+        # ``sim_devices`` virtual devices; init_parallel_env consumes
+        # PADDLE_TPU_CPU_SIM (env var JAX_PLATFORMS alone is not honored
+        # when a sitecustomize pins an accelerator plugin — the worker must
+        # call jax.config.update, which init_parallel_env does)
+        env["PADDLE_TPU_CPU_SIM"] = str(sim_devices)
         env["JAX_PLATFORMS"] = "cpu"
+        flags = env.get("XLA_FLAGS", "")
+        if "host_platform_device_count" not in flags:
+            env["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={sim_devices}")
     return env
 
 
@@ -143,7 +152,8 @@ def launch(script: str, script_args: List[str] = (), nproc_per_node: int = 1,
            master: Optional[str] = None, log_dir: Optional[str] = None,
            cpu_sim: bool = False, max_restarts: int = 0,
            elastic: bool = False, np_min: int = 1,
-           np_max: Optional[int] = None, elastic_ttl: float = 6.0) -> int:
+           np_max: Optional[int] = None, elastic_ttl: float = 6.0,
+           sim_devices: int = 1) -> int:
     """Programmatic launch (spawn.py:450-style entry); returns exit code.
 
     ``max_restarts`` > 0 enables elastic behavior: workers exiting with
@@ -194,7 +204,7 @@ def launch(script: str, script_args: List[str] = (), nproc_per_node: int = 1,
         while True:
             envs = [
                 _build_env(r, nproc_per_node, master, dict(os.environ),
-                           cpu_sim, log_dir)
+                           cpu_sim, log_dir, sim_devices=sim_devices)
                 for r in range(nproc_per_node)
             ]
             if manager is not None:
@@ -240,6 +250,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--log_dir", default=None)
     p.add_argument("--backend", default=None,
                    help="'cpu' forces CPU-simulation workers")
+    p.add_argument("--sim_devices", type=int, default=1,
+                   help="virtual CPU devices per cpu-sim worker "
+                        "(>1 implies --backend cpu)")
     p.add_argument("--max_restarts", type=int, default=0)
     p.add_argument("--elastic", action="store_true",
                    help="TTL-heartbeat membership over the TCPStore")
@@ -253,10 +266,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     return launch(
         args.script, args.script_args,
         nproc_per_node=args.nproc_per_node, master=args.master,
-        log_dir=args.log_dir, cpu_sim=(args.backend == "cpu"),
+        log_dir=args.log_dir,
+        cpu_sim=(args.backend == "cpu" or args.sim_devices > 1),
         max_restarts=args.max_restarts, elastic=args.elastic,
         np_min=args.np_min, np_max=args.np_max,
-        elastic_ttl=args.elastic_ttl)
+        elastic_ttl=args.elastic_ttl, sim_devices=args.sim_devices)
 
 
 if __name__ == "__main__":

@@ -3,8 +3,9 @@ processes running ``func`` with rendezvous env injected.
 
 TPU-first note: on a real pod you launch one controller per host (use
 ``paddle_tpu.distributed.launch``); ``spawn`` exists for the CPU-simulation
-path and API parity — each child is an independent single-device CPU
-process, exactly the reference's per-GPU fork semantics."""
+path and API parity — each child is an independent CPU "host" with
+``sim_devices`` virtual devices (default 1, the reference's per-GPU fork
+semantics)."""
 
 from __future__ import annotations
 
@@ -20,7 +21,8 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _worker(func, rank: int, nprocs: int, master: str, args: Tuple):
+def _worker(func, rank: int, nprocs: int, master: str, args: Tuple,
+            sim_devices: int):
     os.environ.update({
         "PADDLE_TRAINER_ID": str(rank),
         "PADDLE_TRAINERS_NUM": str(nprocs),
@@ -28,20 +30,27 @@ def _worker(func, rank: int, nprocs: int, master: str, args: Tuple):
         "MASTER_ADDR": master.split(":")[0],
         "MASTER_PORT": master.split(":")[1],
         "JAX_PLATFORMS": os.environ.get("JAX_PLATFORMS", "cpu"),
-        "PADDLE_TPU_CPU_SIM": "1",
+        # consumed by init_parallel_env: CPU platform pin (via jax.config,
+        # the env var alone is not honored) + virtual device count
+        "PADDLE_TPU_CPU_SIM": str(sim_devices),
     })
     func(*args)
 
 
 def spawn(func, args=(), nprocs: int = 1, join: bool = True,
           daemon: bool = False, **options):
-    """Run ``func(*args)`` in ``nprocs`` processes; returns the context."""
+    """Run ``func(*args)`` in ``nprocs`` processes; returns the context.
+
+    ``sim_devices=<n>`` (option): virtual CPU devices per worker in the
+    CPU-simulation path (default 1 — the reference's per-GPU fork shape)."""
     master = options.get("master") or f"127.0.0.1:{_free_port()}"
+    sim_devices = int(options.get("sim_devices", 1))
     ctx = mp.get_context("spawn")
     procs = []
     for rank in range(nprocs):
         p = ctx.Process(target=_worker,
-                        args=(func, rank, nprocs, master, tuple(args)),
+                        args=(func, rank, nprocs, master, tuple(args),
+                              sim_devices),
                         daemon=daemon)
         p.start()
         procs.append(p)

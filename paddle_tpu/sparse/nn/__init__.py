@@ -107,8 +107,16 @@ class BatchNorm(Layer):
         assert isinstance(x, SparseCooTensor)
         v = x.bcoo.data  # (nnz, C)
         if self.training:
-            mean = jnp.mean(v, axis=0)
-            var = jnp.var(v, axis=0)
+            # under jit, conv/pool outputs carry zero-valued padding lanes at
+            # OOB sites (functional.py padded-lane contract) — mask them out
+            # of the statistics or clustered clouds skew toward zero
+            rows = functional.valid_site_rows(
+                x.bcoo.indices, x.bcoo.shape[:x.bcoo.indices.shape[-1]])
+            n = jnp.maximum(jnp.sum(rows), 1)
+            vm = jnp.where(rows[:, None], v, 0.0)
+            mean = jnp.sum(vm, axis=0) / n
+            var = jnp.sum(
+                jnp.where(rows[:, None], (v - mean) ** 2, 0.0), axis=0) / n
             m = self._momentum
             self._mean._value = m * self._mean._value + (1 - m) * mean
             self._variance._value = m * self._variance._value + (1 - m) * var

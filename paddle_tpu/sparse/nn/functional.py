@@ -5,10 +5,12 @@ Reference counterparts: ``python/paddle/sparse/nn/functional/*`` and the
 CUDA kernels in ``paddle/phi/kernels/sparse/`` (conv3d gather-scatter,
 ``fluid/operators/sparse_attention_op.cu``).  TPU-first notes per op below:
 attention is genuinely sparse (segment softmax over the CSR pattern,
-O(nnz·d) compute); conv3d lowers to a dense ``lax.conv_general_dilated``
-over the bounding grid — on TPU the MXU conv on a dense block IS the fast
-path; the sparse layout is preserved at the boundary (submanifold output
-keeps the input's active sites, as in the reference's SubmConv3D).
+O(nnz·d) compute); conv3d/subm_conv3d/max_pool3d are O(nnz·K)
+gather-GEMM-scatter over active sites — the reference's rulebook design
+(``conv_kernel.cu``) rebuilt as jnp sort/searchsorted site lookups (static
+shapes, jit-traceable) with all K kernel-offset GEMMs batched into one
+einsum for the MXU.  Compute and memory never scale with the dense grid
+volume.
 """
 
 from __future__ import annotations
@@ -147,45 +149,173 @@ def attention(query, key, value, sparse_mask, key_padding_mask=None,
     return Tensor(jnp.stack(outs).reshape(B, H, L, D))
 
 
-def _dense_conv3d(dense, weight, bias, stride, padding, dilation, groups):
-    """NDHWC conv over the dense grid via lax (MXU path)."""
-    dn = jax.lax.conv_dimension_numbers(
-        dense.shape, weight.shape, ("NDHWC", "DHWIO", "NDHWC"))
-    if isinstance(padding, int):
-        padding = [(padding, padding)] * 3
-    elif isinstance(padding, (list, tuple)) and padding and isinstance(
-            padding[0], int):
-        padding = [(p, p) for p in padding]
-    out = jax.lax.conv_general_dilated(
-        dense, weight,
-        window_strides=(stride,) * 3 if isinstance(stride, int) else tuple(stride),
-        padding=padding,
-        rhs_dilation=(dilation,) * 3 if isinstance(dilation, int) else tuple(dilation),
-        dimension_numbers=dn, feature_group_count=groups)
-    if bias is not None:
-        out = out + bias
-    return out
+
+# ---------------------------------------------------------------------------
+# Sparse conv3d / pooling: O(nnz) gather-GEMM-scatter over active sites
+# (the reference's rulebook design, ``phi/kernels/sparse/gpu/conv_kernel.cu``,
+# rebuilt TPU-first: the rulebook is jnp sort/searchsorted over linearized
+# site keys — static shapes, fully jit-traceable — and the per-kernel-offset
+# GEMMs are batched into ONE einsum so the MXU sees a single large
+# contraction.  Compute and memory scale with nnz·K, never with the dense
+# grid volume.)
+#
+# Padded-lane contract: under jit, output nnz lanes are static (input nnz
+# for subm, nnz·K for conv/pool), so lanes that don't correspond to a real
+# output site carry OUT-OF-RANGE indices (BCOO's padding convention — they
+# are dropped by ``to_dense`` and can never match a chained rulebook
+# lookup) and zero values.  Row-wise consumers must mask by
+# :func:`valid_site_rows` (sparse BatchNorm does).  Eagerly the lanes are
+# compacted away and nnz is exact.
+# ---------------------------------------------------------------------------
+
+_INT32_MAX = 2**31 - 1
 
 
-def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
-           data_format="NDHWC", name=None):
-    """Sparse conv3d (``sparse/nn/functional/conv.py``): SparseCooTensor in
-    (N,D,H,W,C) → SparseCooTensor out; dense MXU conv over the grid, output
-    re-sparsified at nonzero sites."""
+def _triple(v):
+    return (v,) * 3 if isinstance(v, int) else tuple(v)
+
+
+def _key_dtype(total: int):
+    """Site keys must cover the linearized grid volume."""
+    if total <= _INT32_MAX:
+        return jnp.int32
+    if jax.config.jax_enable_x64:
+        return jnp.int64
+    raise ValueError(
+        f"sparse conv/pool site-key space ({total} sites) exceeds int32 and "
+        "jax_enable_x64 is off — enable it (jax.config.update("
+        "'jax_enable_x64', True)) to use grids this large")
+
+
+def _site_keys(sites, dims, dtype):
+    """Linearize (n, d, h, w) int sites into sortable scalar keys."""
+    N, D, H, W = dims
+    s = sites.astype(dtype)
+    return ((s[..., 0] * D + s[..., 1]) * H + s[..., 2]) * W + s[..., 3]
+
+
+def _is_traced(*vals) -> bool:
+    return any(isinstance(v, jax.core.Tracer) for v in vals)
+
+
+def valid_site_rows(indices, dims):
+    """Mask of stored rows whose site is in range (False = padding lane)."""
+    return jnp.all(indices < jnp.asarray(dims, indices.dtype), axis=-1)
+
+
+def _coalesce(bcoo, traced: bool):
+    """Sum duplicate indices (the replaced dense path summed them via
+    ``to_dense``; the rulebook lookup needs one row per site).  Under jit
+    the nse stays static (padded); eagerly it compacts to the true nse."""
+    from jax.experimental import sparse as jsparse
+
+    if traced:
+        return jsparse.bcoo_sum_duplicates(bcoo, nse=bcoo.nse)
+    return jsparse.bcoo_sum_duplicates(bcoo)
+
+
+def _prep_conv(x, weight, bias, stride, padding, dilation, groups):
     w = weight._value if isinstance(weight, Tensor) else jnp.asarray(weight)
     b = bias._value if isinstance(bias, Tensor) else (
         jnp.asarray(bias) if bias is not None else None)
-    dense = x.to_dense()._value if isinstance(x, SparseCooTensor) else x._value
-    out = _dense_conv3d(dense, w, b, stride, padding, dilation, groups)
-    arr = np.asarray(out)
-    # COO over (N,D,H,W) sites with dense C-vector values per site
-    idx = np.argwhere(np.abs(arr).sum(-1) > 0)
-    vals = out[tuple(idx.T)]
-    from jax.experimental import sparse as jsparse
+    assert isinstance(x, SparseCooTensor), "sparse conv3d needs a sparse input"
+    kd, kh, kw, cin_g, cout = w.shape
+    cin = x.bcoo.data.shape[-1]
+    if cin != cin_g * groups or cout % groups:
+        raise ValueError(
+            f"conv3d channel mismatch: input C={cin}, weight expects "
+            f"{cin_g}×{groups} in and {cout} out (groups={groups})")
+    bcoo = _coalesce(x.bcoo, _is_traced(x.bcoo.indices, x.bcoo.data, w))
+    # static kernel-offset table (the rulebook's K axis)
+    dil = _triple(dilation)
+    offs = np.array([(i * dil[0], j * dil[1], k * dil[2])
+                     for i in range(kd) for j in range(kh) for k in range(kw)],
+                    np.int32)
+    return (bcoo.indices, bcoo.data, w.reshape(-1, cin_g, cout), b, groups,
+            _triple(stride), _triple(padding), offs)
 
-    bcoo = jsparse.BCOO((vals, jnp.asarray(idx.astype(np.int32))),
-                        shape=out.shape)
-    return SparseCooTensor(bcoo)
+
+def _grouped_matmul(gathered, wk, groups):
+    """All K kernel-offset GEMMs as one MXU contraction, grouped conv aware.
+
+    gathered: (K, nnz, Cin) neighbor features; wk: (K, Cin/g, Cout).
+    Output channels are group-major (standard conv groups semantics)."""
+    K, nnz, cin = gathered.shape
+    cout = wk.shape[-1]
+    g = groups
+    gg = gathered.reshape(K, nnz, g, cin // g)
+    wg = wk.reshape(K, cin // g, g, cout // g)
+    return jnp.einsum("kngc,kcgo->ngo", gg, wg).reshape(nnz, cout)
+
+
+def _gather_neighbors(in_sites, feats, query_sites, valid, dims, kdtype):
+    """For each (K, M, 4) query site, the input feature row at that site (0
+    where absent/invalid): sort + searchsorted over linearized keys — the
+    jnp rulebook lookup.  Requires coalesced input (one row per site);
+    padding lanes carry OOB sites whose keys can never match a query."""
+    keys = _site_keys(in_sites, dims, kdtype)
+    order = jnp.argsort(keys)
+    sorted_keys = keys[order]
+    qkeys = _site_keys(query_sites, dims, kdtype)
+    pos = jnp.clip(jnp.searchsorted(sorted_keys, qkeys), 0, keys.shape[0] - 1)
+    found = valid & (sorted_keys[pos] == qkeys)
+    gathered = jnp.take(feats, order[pos.reshape(-1)], axis=0)
+    gathered = gathered.reshape(*qkeys.shape, feats.shape[-1])
+    return jnp.where(found[..., None], gathered, 0.0)
+
+
+def _candidate_outputs(in_sites, offs, pd, st, out_sp, odims, kdtype):
+    """Candidate output site keys for every (input site, kernel offset):
+    o = (site + pad - δ) / stride where divisible and in range; invalid
+    candidates get the sentinel key ``total`` (sorts last)."""
+    num = in_sites[None, :, 1:4] + jnp.asarray(
+        np.array(pd, np.int32) - offs)[:, None, :]             # (K, nnz, 3)
+    div_ok = jnp.all(num % jnp.asarray(st, jnp.int32) == 0, axis=-1)
+    osp = num // jnp.asarray(st, jnp.int32)
+    in_range = jnp.all(
+        (osp >= 0) & (osp < jnp.asarray(out_sp, jnp.int32)), axis=-1)
+    valid = div_ok & in_range
+    batch = jnp.broadcast_to(in_sites[None, :, :1], osp[..., :1].shape)
+    cand_sites = jnp.concatenate([batch, osp], axis=-1)        # (K, nnz, 4)
+    total = int(np.prod(odims))
+    keys = jnp.where(valid, _site_keys(cand_sites, odims, kdtype),
+                     jnp.asarray(total, kdtype))
+    return keys, total
+
+
+def _scatter_to_sites(cand_keys, flat_rows, odims, total, reduce, kdtype):
+    """Combine candidate rows landing on the same output site (the
+    rulebook's scatter): sort by key, segment-reduce, decode keys back to
+    sites.  Returns (vals, out_sites, real) with padded lanes at OOB
+    sites."""
+    n_lanes = flat_rows.shape[0]
+    flat_keys = cand_keys.reshape(-1)
+    order = jnp.argsort(flat_keys)
+    skeys = flat_keys[order]
+    srows = flat_rows[order]
+    head = jnp.concatenate([jnp.ones((1,), bool), skeys[1:] != skeys[:-1]])
+    seg = jnp.cumsum(head) - 1
+    vals = reduce(srows, seg, n_lanes)
+    seg_keys = jax.ops.segment_min(
+        jnp.where(skeys < total, skeys, total), seg, num_segments=n_lanes)
+    real = seg_keys < total
+    sk = jnp.where(real, seg_keys, 0)
+    No, Do, Ho, Wo = odims
+    out_sites = jnp.stack(
+        [sk // (Wo * Ho * Do), (sk // (Wo * Ho)) % Do,
+         (sk // Wo) % Ho, sk % Wo], axis=-1).astype(jnp.int32)
+    out_sites = jnp.where(real[:, None], out_sites,
+                          jnp.asarray(odims, jnp.int32))
+    vals = jnp.where(real[:, None], vals, 0.0)
+    return vals, out_sites, real
+
+
+def _maybe_compact(vals, out_sites, real, traced):
+    if traced:
+        return vals, out_sites
+    realn = np.asarray(real)
+    return (jnp.asarray(np.asarray(vals)[realn]),
+            jnp.asarray(np.asarray(out_sites)[realn]))
 
 
 def subm_conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
@@ -193,42 +323,115 @@ def subm_conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
     """Submanifold conv3d: output restricted to the INPUT's active sites
     (``sparse/nn/functional/conv.py`` subm_conv3d — prevents active-site
     dilation across layers, the signature property of submanifold sparse
-    CNNs)."""
-    w = weight._value if isinstance(weight, Tensor) else jnp.asarray(weight)
-    b = bias._value if isinstance(bias, Tensor) else (
-        jnp.asarray(bias) if bias is not None else None)
-    assert isinstance(x, SparseCooTensor), "subm_conv3d needs a sparse input"
-    dense = x.to_dense()._value
-    out = _dense_conv3d(dense, w, b, stride, padding, dilation, groups)
-    in_sites = np.asarray(x.bcoo.indices)[:, :4]
-    sites = np.unique(in_sites, axis=0)
-    vals = out[tuple(sites.T)]
+    CNNs).  O(nnz·K): for each active site and kernel offset, the neighbor
+    feature is looked up in the site table, and all K GEMMs run as one
+    batched (grouped) einsum."""
+    in_sites, feats, wk, b, g, st, pd, offs = _prep_conv(
+        x, weight, bias, stride, padding, dilation, groups)
+    if st != (1, 1, 1):
+        raise ValueError("subm_conv3d requires stride 1 "
+                         "(active sites must be preserved)")
+    dims = x.shape[:4]
+    kdtype = _key_dtype(int(np.prod(dims)))
+    # neighbor of output site o at kernel offset δ: o + δ - padding
+    shift = jnp.asarray(offs - np.array(pd, np.int32))        # (K, 3)
+    qsp = in_sites[None, :, 1:4] + shift[:, None, :]          # (K, nnz, 3)
+    valid = jnp.all((qsp >= 0) & (qsp < jnp.asarray(dims[1:], jnp.int32)),
+                    axis=-1)
+    query = jnp.concatenate(
+        [jnp.broadcast_to(in_sites[None, :, :1], qsp[..., :1].shape), qsp],
+        axis=-1)
+    gathered = _gather_neighbors(in_sites, feats, query, valid, dims, kdtype)
+    out = _grouped_matmul(gathered, wk, g)
+    rows = valid_site_rows(in_sites, dims)  # coalesce padding lanes
+    if b is not None:
+        out = out + b
+    out = jnp.where(rows[:, None], out, 0.0)
     from jax.experimental import sparse as jsparse
 
-    bcoo = jsparse.BCOO((vals, jnp.asarray(sites.astype(np.int32))),
-                        shape=out.shape)
+    bcoo = jsparse.BCOO((out, in_sites),
+                        shape=tuple(dims) + (wk.shape[-1],))
     return SparseCooTensor(bcoo)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NDHWC", name=None):
+    """Sparse conv3d (``sparse/nn/functional/conv.py``): SparseCooTensor in
+    (N,D,H,W,C) → SparseCooTensor out over the sites REACHED by any active
+    input (the rulebook's output set).  O(nnz·K): each (input site, kernel
+    offset) pair contributes ``feats[i] @ W[k]`` to one candidate output
+    site; candidates are combined by a sort + segment-sum scatter.  See the
+    module-level padded-lane contract for jit behavior."""
+    in_sites, feats, wk, b, g, st, pd, offs = _prep_conv(
+        x, weight, bias, stride, padding, dilation, groups)
+    dims = x.shape[:4]
+    out_sp = tuple(
+        (dims[1 + i] + 2 * pd[i] - (int(offs[:, i].max()) + 1)) // st[i] + 1
+        for i in range(3))
+    odims = (dims[0],) + out_sp
+    kdtype = _key_dtype(int(np.prod(odims)))
+    K = offs.shape[0]
+
+    cand_keys, total = _candidate_outputs(
+        in_sites, offs, pd, st, out_sp, odims, kdtype)
+    # contribution of each candidate: feats[i] @ W[k] (grouped, one einsum)
+    nnz = feats.shape[0]
+    contrib = _conv_contrib(feats, wk, g, K)
+    traced = _is_traced(in_sites, feats, wk)
+    vals, out_sites, real = _scatter_to_sites(
+        cand_keys, contrib.reshape(K * nnz, -1), odims, total,
+        lambda r, s, n: jax.ops.segment_sum(r, s, num_segments=n), kdtype)
+    if b is not None:
+        vals = jnp.where(real[:, None], vals + b, vals)
+    vals, out_sites = _maybe_compact(vals, out_sites, real, traced)
+    from jax.experimental import sparse as jsparse
+
+    bcoo = jsparse.BCOO((vals, out_sites),
+                        shape=odims + (wk.shape[-1],))
+    return SparseCooTensor(bcoo)
+
+
+def _conv_contrib(feats, wk, groups, K):
+    """(K, nnz, Cout) per-candidate contributions, grouped-conv aware."""
+    nnz, cin = feats.shape
+    cout = wk.shape[-1]
+    g = groups
+    fg = feats.reshape(nnz, g, cin // g)
+    wg = wk.reshape(K, cin // g, g, cout // g)
+    return jnp.einsum("ngc,kcgo->kngo", fg, wg).reshape(K, nnz, cout)
 
 
 def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                data_format="NDHWC", name=None):
-    """(``sparse/nn/functional/pooling.py``) max pool over the dense grid,
-    re-sparsified."""
-    dense = x.to_dense()._value if isinstance(x, SparseCooTensor) else x._value
-    ks = (kernel_size,) * 3 if isinstance(kernel_size, int) else tuple(kernel_size)
-    st = ks if stride is None else (
-        (stride,) * 3 if isinstance(stride, int) else tuple(stride))
-    pd = (padding,) * 3 if isinstance(padding, int) else tuple(padding)
-    out = jax.lax.reduce_window(
-        dense, -jnp.inf, jax.lax.max,
-        window_dimensions=(1,) + ks + (1,),
-        window_strides=(1,) + st + (1,),
-        padding=((0, 0),) + tuple((p, p) for p in pd) + ((0, 0),))
-    arr = np.asarray(out)
-    idx = np.argwhere(np.abs(arr).sum(-1) > 0)
-    vals = out[tuple(idx.T)]
+    """(``sparse/nn/functional/pooling.py``) sparse max pool: per output
+    site, the max over the PRESENT input sites in its window (the
+    reference's rulebook pool, ``pool_kernel.cu``) — O(nnz·K), traced.  See
+    the module-level padded-lane contract for jit behavior."""
+    assert isinstance(x, SparseCooTensor), "sparse max_pool3d needs sparse input"
+    ks = _triple(kernel_size)
+    st = ks if stride is None else _triple(stride)
+    pd = _triple(padding)
+    traced = _is_traced(x.bcoo.indices, x.bcoo.data)
+    bcoo = _coalesce(x.bcoo, traced)
+    in_sites, feats = bcoo.indices, bcoo.data
+    dims = x.shape[:4]
+    offs = np.array([(i, j, k) for i in range(ks[0])
+                     for j in range(ks[1]) for k in range(ks[2])], np.int32)
+    out_sp = tuple((dims[1 + i] + 2 * pd[i] - ks[i]) // st[i] + 1
+                   for i in range(3))
+    odims = (dims[0],) + out_sp
+    kdtype = _key_dtype(int(np.prod(odims)))
+    K, nnz = offs.shape[0], feats.shape[0]
+
+    cand_keys, total = _candidate_outputs(
+        in_sites, offs, pd, st, out_sp, odims, kdtype)
+    flat_feats = jnp.broadcast_to(
+        feats[None], (K,) + feats.shape).reshape(K * nnz, -1)
+    vals, out_sites, real = _scatter_to_sites(
+        cand_keys, flat_feats, odims, total,
+        lambda r, s, n: jax.ops.segment_max(r, s, num_segments=n), kdtype)
+    vals, out_sites = _maybe_compact(vals, out_sites, real, traced)
     from jax.experimental import sparse as jsparse
 
-    bcoo = jsparse.BCOO((vals, jnp.asarray(idx.astype(np.int32))),
-                        shape=out.shape)
+    bcoo = jsparse.BCOO((vals, out_sites), shape=odims + (feats.shape[-1],))
     return SparseCooTensor(bcoo)

@@ -10,6 +10,7 @@ from jax.experimental import sparse as jsparse
 
 import paddle_tpu as paddle
 from paddle_tpu import sparse as sp
+from paddle_tpu.core.tensor import Tensor
 
 
 def _coo(dense):
@@ -363,3 +364,196 @@ class TestSparseNNLayers:
         np.testing.assert_allclose(got.mean(0), np.zeros(4), atol=1e-4)
         np.testing.assert_allclose(got.std(0), np.ones(4), atol=1e-2)
         assert bn._mean.numpy().mean() > 0  # running stats updated
+
+
+class TestSparseConvOnnz:
+    """VERDICT r3 #4: conv must be O(nnz), jit-traceable, never O(volume)."""
+
+    def _cloud(self, grid, nnz, cin=3, seed=0):
+        rng = np.random.default_rng(seed)
+        # distinct sites via linear-key sampling
+        keys = rng.choice(grid ** 3, size=nnz, replace=False)
+        d, h, w = keys // grid**2, (keys // grid) % grid, keys % grid
+        idx = np.stack([np.zeros(nnz, np.int32), d, h, w], 1).astype(np.int32)
+        vals = rng.standard_normal((nnz, cin)).astype("float32")
+        return sp.SparseCooTensor(
+            jsparse.BCOO((jnp.asarray(vals), jnp.asarray(idx)),
+                         shape=(1, grid, grid, grid, cin)))
+
+    def test_subm_conv3d_under_jit(self):
+        import jax
+
+        x = self._cloud(8, 16)
+        w = jnp.asarray(np.random.default_rng(1).standard_normal(
+            (3, 3, 3, 3, 4)).astype("float32"))
+
+        def f(idx, vals, w):
+            xx = sp.SparseCooTensor(jsparse.BCOO(
+                (vals, idx), shape=(1, 8, 8, 8, 3)))
+            y = sp.nn.functional.subm_conv3d(xx, Tensor(w), padding=1)
+            return y.bcoo.data
+
+        jitted = jax.jit(f)
+        got = jitted(x.bcoo.indices, x.bcoo.data, w)
+        eager = f(x.bcoo.indices, x.bcoo.data, w)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(eager),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_conv3d_under_jit_matches_eager_dense(self):
+        import jax
+
+        x = self._cloud(6, 12)
+        w = jnp.asarray(np.random.default_rng(2).standard_normal(
+            (3, 3, 3, 3, 2)).astype("float32"))
+
+        def f(idx, vals, w):
+            xx = sp.SparseCooTensor(jsparse.BCOO(
+                (vals, idx), shape=(1, 6, 6, 6, 3)))
+            y = sp.nn.functional.conv3d(xx, Tensor(w), padding=1, stride=2)
+            return y.to_dense()._value  # padded lanes must vanish in dense
+
+        got = np.asarray(jax.jit(f)(x.bcoo.indices, x.bcoo.data, w))
+        dn = jax.lax.conv_dimension_numbers(
+            (1, 6, 6, 6, 3), w.shape, ("NDHWC", "DHWIO", "NDHWC"))
+        ref = jax.lax.conv_general_dilated(
+            x.to_dense()._value, w, (2, 2, 2), [(1, 1)] * 3,
+            dimension_numbers=dn)
+        np.testing.assert_allclose(got, np.asarray(ref), rtol=1e-4, atol=1e-5)
+
+    def test_max_pool3d_under_jit(self):
+        import jax
+
+        x = self._cloud(8, 20)
+
+        def f(idx, vals):
+            xx = sp.SparseCooTensor(jsparse.BCOO(
+                (vals, idx), shape=(1, 8, 8, 8, 3)))
+            return sp.nn.functional.max_pool3d(xx, 2).to_dense()._value
+
+        got = np.asarray(jax.jit(f)(x.bcoo.indices, x.bcoo.data))
+        eager = np.asarray(f(x.bcoo.indices, x.bcoo.data))
+        np.testing.assert_allclose(got, eager, rtol=1e-5)
+
+    def test_large_grid_memory_scales_with_nnz(self):
+        """A 512^3 grid (402 GB dense fp32 at C=3) with 64 active sites:
+        the O(nnz) rulebook conv must run in O(nnz·K) memory."""
+        grid, nnz = 512, 64
+        x = self._cloud(grid, nnz)
+        w = jnp.asarray(np.random.default_rng(3).standard_normal(
+            (3, 3, 3, 3, 4)).astype("float32"))
+        y = sp.nn.functional.subm_conv3d(x, Tensor(w), padding=1)
+        assert y.bcoo.data.shape == (nnz, 4)
+        assert tuple(y.shape) == (1, grid, grid, grid, 4)
+        z = sp.nn.functional.conv3d(x, Tensor(w), padding=1)
+        assert z.bcoo.data.shape[0] <= nnz * 27  # rulebook bound, not volume
+        p = sp.nn.functional.max_pool3d(x, 2)
+        assert p.bcoo.data.shape[0] <= nnz
+
+    def test_subm_conv3d_matches_dense_on_active_sites(self):
+        """Gathered-GEMM result equals the dense conv at every active site."""
+        import jax
+
+        x = self._cloud(8, 24, seed=5)
+        w = jnp.asarray(np.random.default_rng(6).standard_normal(
+            (3, 3, 3, 3, 4)).astype("float32"))
+        b = jnp.asarray(np.random.default_rng(7).standard_normal(
+            4).astype("float32"))
+        y = sp.nn.functional.subm_conv3d(x, Tensor(w), Tensor(b), padding=1)
+        dn = jax.lax.conv_dimension_numbers(
+            (1, 8, 8, 8, 3), w.shape, ("NDHWC", "DHWIO", "NDHWC"))
+        ref = jax.lax.conv_general_dilated(
+            x.to_dense()._value, w, (1, 1, 1), [(1, 1)] * 3,
+            dimension_numbers=dn) + b
+        # coalescing sorts sites; compare at the OUTPUT's own site order
+        sites = np.asarray(y.bcoo.indices)
+        assert ({tuple(r) for r in sites.tolist()}
+                == {tuple(r) for r in np.asarray(x.bcoo.indices).tolist()})
+        np.testing.assert_allclose(
+            np.asarray(y.bcoo.data),
+            np.asarray(ref)[tuple(sites.T)], rtol=1e-4, atol=1e-5)
+
+    def test_subm_conv3d_sums_duplicate_indices(self):
+        """COO inputs with duplicate sites are coalesced (summed) before the
+        rulebook lookup — same semantics as the dense path's to_dense."""
+        import jax
+
+        rng = np.random.default_rng(9)
+        idx = np.array([[0, 1, 1, 1], [0, 1, 1, 1], [0, 2, 2, 2]], np.int32)
+        vals = rng.standard_normal((3, 3)).astype("float32")
+        x = sp.SparseCooTensor(jsparse.BCOO(
+            (jnp.asarray(vals), jnp.asarray(idx)), shape=(1, 4, 4, 4, 3)))
+        w = jnp.asarray(rng.standard_normal((3, 3, 3, 3, 2)).astype("float32"))
+        y = sp.nn.functional.subm_conv3d(x, Tensor(w), padding=1)
+        dn = jax.lax.conv_dimension_numbers(
+            (1, 4, 4, 4, 3), w.shape, ("NDHWC", "DHWIO", "NDHWC"))
+        ref = jax.lax.conv_general_dilated(
+            x.to_dense()._value, w, (1, 1, 1), [(1, 1)] * 3,
+            dimension_numbers=dn)
+        got = y.to_dense().numpy()
+        np.testing.assert_allclose(
+            got[0, 1, 1, 1], np.asarray(ref)[0, 1, 1, 1], rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(
+            got[0, 2, 2, 2], np.asarray(ref)[0, 2, 2, 2], rtol=1e-4, atol=1e-5)
+
+    def test_int32_overflow_guard(self):
+        """>int32 site-key spaces raise loudly without x64 (never silently
+        drop output); with x64 (this env) they use int64 keys and WORK."""
+        import jax
+
+        from paddle_tpu.sparse.nn.functional import _key_dtype
+
+        assert _key_dtype(2**31 - 1) == jnp.int32
+        if jax.config.jax_enable_x64:
+            assert _key_dtype(2048 ** 3) == jnp.int64
+            # end-to-end on a 2048³ grid (34 TB dense fp32 at C=3)
+            x = self._cloud(8, 4)
+            big = sp.SparseCooTensor(jsparse.BCOO(
+                (x.bcoo.data, x.bcoo.indices),
+                shape=(1, 2048, 2048, 2048, 3)))
+            w = jnp.asarray(np.random.default_rng(20).standard_normal(
+                (3, 3, 3, 3, 2)).astype("float32"))
+            y = sp.nn.functional.subm_conv3d(big, Tensor(w), padding=1)
+            assert y.bcoo.data.shape == (4, 2)
+        else:
+            with pytest.raises(ValueError, match="int32"):
+                _key_dtype(2048 ** 3)
+
+    def test_grouped_conv3d(self):
+        """groups>1 via the grouped einsum matches the dense grouped conv."""
+        import jax
+
+        x = self._cloud(6, 10, cin=4, seed=11)
+        w = jnp.asarray(np.random.default_rng(12).standard_normal(
+            (3, 3, 3, 2, 6)).astype("float32"))  # Cin/g=2, g=2, Cout=6
+        y = sp.nn.functional.conv3d(x, Tensor(w), padding=1, groups=2)
+        dn = jax.lax.conv_dimension_numbers(
+            (1, 6, 6, 6, 4), w.shape, ("NDHWC", "DHWIO", "NDHWC"))
+        ref = jax.lax.conv_general_dilated(
+            x.to_dense()._value, w, (1, 1, 1), [(1, 1)] * 3,
+            dimension_numbers=dn, feature_group_count=2)
+        np.testing.assert_allclose(
+            y.to_dense().numpy(), np.asarray(ref), rtol=1e-4, atol=1e-5)
+
+    def test_batchnorm_ignores_padding_lanes_under_jit(self):
+        """jit Conv3D→BatchNorm must produce the same statistics as eager
+        (the padded lanes are masked out of mean/var)."""
+        import jax
+
+        x = self._cloud(6, 6, cin=3, seed=13)  # clustered: nnz << K·nnz
+        w = jnp.asarray(np.random.default_rng(14).standard_normal(
+            (3, 3, 3, 3, 4)).astype("float32"))
+        bn_j = sp.nn.BatchNorm(4)
+        bn_e = sp.nn.BatchNorm(4)
+
+        def stats(idx, vals, bn):
+            xx = sp.SparseCooTensor(jsparse.BCOO(
+                (vals, idx), shape=(1, 6, 6, 6, 3)))
+            y = sp.nn.functional.conv3d(xx, Tensor(w), padding=1)
+            bn(y)
+            return bn._mean._value, bn._variance._value
+
+        mj, vj = jax.jit(lambda i, v: stats(i, v, bn_j))(
+            x.bcoo.indices, x.bcoo.data)
+        me, ve = stats(x.bcoo.indices, x.bcoo.data, bn_e)
+        np.testing.assert_allclose(np.asarray(mj), np.asarray(me), rtol=1e-4)
+        np.testing.assert_allclose(np.asarray(vj), np.asarray(ve), rtol=1e-4)

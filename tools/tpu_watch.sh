@@ -11,6 +11,16 @@ for i in $(seq 1 120); do
     echo "[tpu_watch] bench rc=$rc"
     cat bench_tpu_attempt.json
     tail -30 bench_tpu_attempt.log
+    # VERDICT r4: after a successful on-chip bench, immediately capture the
+    # profiler trace for the MFU gap analysis (same program, warm cache)
+    if grep -q '"degraded"' bench_tpu_attempt.json; then
+      echo "[tpu_watch] bench degraded; not profiling"
+    else
+      echo "[tpu_watch] capturing XPlane trace"
+      timeout 1800 python tools/profile_train.py prof_trace \
+        >profile_attempt.log 2>&1
+      echo "[tpu_watch] profile rc=$? (prof_trace/, profile_attempt.log)"
+    fi
     exit 0
   fi
   echo "[tpu_watch] attempt $i: tunnel down ($(date -u +%H:%M:%S))"

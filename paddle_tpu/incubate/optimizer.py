@@ -13,6 +13,19 @@ from ..core.tensor import Tensor
 from ..optimizer.optimizer import Optimizer
 
 
+def _no_static_minimize(name: str) -> None:
+    """Incubate optimizers train eagerly; inside a static Program recording
+    their minimize would mutate params at build time and record inconsistent
+    alias events — refuse loudly (use the base optimizers for static
+    training, or to_static over the whole step)."""
+    from ..core import dispatch as _dispatch
+
+    if _dispatch._op_observer is not None:
+        raise NotImplementedError(
+            f"{name}.minimize is not supported inside a static Program "
+            "recording; use a paddle.optimizer optimizer for static "
+            "training or paddle.jit.to_static over the train step")
+
 class LookAhead(Optimizer):
     """(lookahead.py LookAhead) k fast steps, then slow weights pull toward
     the fast weights: slow += alpha·(fast − slow); fast ← slow."""
@@ -54,6 +67,7 @@ class LookAhead(Optimizer):
 
     def minimize(self, loss, startup_program=None, parameters=None,
                  no_grad_set=None):
+        _no_static_minimize(type(self).__name__)
         loss.backward()
         self.step()
         self.clear_grad()
@@ -148,4 +162,5 @@ class ModelAverage(Optimizer):
 
     def minimize(self, loss, startup_program=None, parameters=None,
                  no_grad_set=None):
+        _no_static_minimize(type(self).__name__)
         self.step()

@@ -20,6 +20,7 @@ import jax.numpy as jnp
 
 from ..core import dtype as dtype_mod
 from ..core.autograd import no_grad
+from ..core.dispatch import notify_rebind as run_op_notify_rebind
 from ..core.dispatch import run_op
 from ..core.tensor import Parameter, Tensor
 from .lr import LRScheduler
@@ -131,10 +132,13 @@ class Optimizer:
         if use_master:
             master._value = new_w._value
             p._value = new_w._value.astype(p._value.dtype)
+            run_op_notify_rebind(master, new_w)
         else:
             p._value = new_w._value
+        run_op_notify_rebind(p, new_w)  # static recorder: p now carries new_w
         for st, nv in zip(slot_tensors, outs[1:]):
             st._value = nv._value
+            run_op_notify_rebind(st, nv)
 
     def _update(self, w, g, lr, wd, slots, p):
         """Pure update: (w, g, *slots) -> (new_w, *new_slots). jnp only."""
@@ -154,6 +158,15 @@ class Optimizer:
     clear_gradients = clear_grad
 
     def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        from ..core import dispatch as _dispatch
+
+        if _dispatch._op_observer is not None:
+            # static-graph training (``optimizer.py:103`` minimize in a
+            # Program): append the grad node + recorded update ops
+            from .. import static as static_mod
+
+            return static_mod._static_minimize(self, loss, parameters,
+                                               no_grad_set=no_grad_set)
         loss.backward()
         self.step()
         return None, None

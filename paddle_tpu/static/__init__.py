@@ -9,9 +9,17 @@ list — every framework op already dispatches through ``run_op``, so under
 list (optionally as one jitted XLA program).  In-place rebinds are recorded
 as alias events so SSA resolution stays correct.
 
-Scope: forward/inference graphs.  Static *training* in this framework is
-``paddle.jit.to_static`` over the whole train step (SURVEY.md §7 layer 3)
-— the Program facade intentionally does not re-implement append_backward.
+Static *training* (``append_backward`` + ``Optimizer.minimize`` inside a
+Program): the backward is ONE recorded grad node whose fn is ``jax.grad``
+of the replayed forward w.r.t. the parameter values — regenerated
+symbolically by XLA, never a replay of stale tape closures — and the
+optimizer's update ops record like any other op (with rebind/alias events
+for the param writes).  Mutated training state (params, slots) persists
+across ``Executor.run`` calls in a ``Scope`` (``global_scope()`` by
+default), matching the reference's scope-variable semantics.  The
+preferred TPU-first path for training remains ``paddle.jit.to_static`` over
+the whole step; the Program path exists for reference-API parity (static
+LR is frozen at build time; master-weight AMP uses the to_static path).
 """
 
 from __future__ import annotations
@@ -68,6 +76,10 @@ class Program:
         self.nodes: List[_Node] = []
         self.placeholders: Dict[str, int] = {}  # name -> tensor id
         self._keepalive: List[Tensor] = []      # keep ids unique/alive
+        # training state (param/slot tensor ids) persisted across
+        # Executor.run calls via the Scope; filled by append_backward /
+        # _static_minimize
+        self.state_ids: List[int] = []
 
     # --- observer callbacks (dispatch hook) -------------------------------
     def on_op(self, name, fn, args, kwraw, result):
@@ -100,29 +112,48 @@ class Program:
 
     # --- replay -----------------------------------------------------------
     def replay(self, env: Dict[int, Any]):
-        for node in self.nodes:
-            if node.kind == "alias":
-                if node.src_id in env:
-                    env[node.out_ids[0]] = env[node.src_id]
-                continue
-            args = []
-            for aid, snap in zip(node.arg_ids, node.arg_snaps):
-                if aid is not None and aid in env:
-                    args.append(env[aid])
-                else:
-                    args.append(snap)
-            out = node.fn(*args, **node.kwargs)
-            outs = out if isinstance(out, (list, tuple)) else [out]
-            for oid, o in zip(node.out_ids, outs):
-                if oid is not None:
-                    env[oid] = o
-        return env
+        return _replay_nodes(self.nodes, env)
 
     def global_block(self):
         return self
 
+    def _id_tensor(self, tid: int) -> Tensor:
+        # lazily-built id→tensor map, invalidated when keepalive grows
+        cache = getattr(self, "_id_map", None)
+        if cache is None or cache[0] != len(self._keepalive):
+            cache = (len(self._keepalive),
+                     {id(t): t for t in self._keepalive})
+            self._id_map = cache
+        t = cache[1].get(tid)
+        if t is None:
+            raise KeyError(f"tensor id {tid} not held by this Program")
+        return t
+
+    def _id_value(self, tid: int):
+        return self._id_tensor(tid)._value
+
     def __repr__(self):
         return f"Program(nodes={len(self.nodes)}, feeds={list(self.placeholders)})"
+
+
+def _replay_nodes(nodes: Sequence[_Node], env: Dict[int, Any]):
+    for node in nodes:
+        if node.kind == "alias":
+            if node.src_id in env:
+                env[node.out_ids[0]] = env[node.src_id]
+            continue
+        args = []
+        for aid, snap in zip(node.arg_ids, node.arg_snaps):
+            if aid is not None and aid in env:
+                args.append(env[aid])
+            else:
+                args.append(snap)
+        out = node.fn(*args, **node.kwargs)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        for oid, o in zip(node.out_ids, outs):
+            if oid is not None:
+                env[oid] = o
+    return env
 
 
 _default_main_program = Program()
@@ -191,9 +222,14 @@ class Executor:
 
     def run(self, program: Optional[Program] = None, feed=None,
             fetch_list: Optional[Sequence] = None, use_jit: bool = False,
-            return_numpy: bool = True):
+            return_numpy: bool = True, scope: Optional["Scope"] = None):
         program = program or _default_main_program
         feed = feed or {}
+        if scope is None:
+            # per-program default scope: ids are CPython object ids, so a
+            # process-global default would let a dead program's entry alias
+            # a recycled id in a new program (and pin dead arrays forever)
+            scope = program._scope = getattr(program, "_scope", None) or Scope()
         env: Dict[int, Any] = {}
         for name, value in feed.items():
             if name not in program.placeholders:
@@ -201,23 +237,39 @@ class Executor:
             if isinstance(value, Tensor):
                 value = value._value
             env[program.placeholders[name]] = jax.numpy.asarray(value)
+        # training state (params/slots) persists across runs in the scope;
+        # first run falls back to the record-time snapshots
+        for sid in program.state_ids:
+            env[sid] = (scope.vars[sid] if sid in scope.vars
+                        else program._id_value(sid))
 
         if use_jit:
-            fn = self._jit_cache.get(id(program))
+            # key includes the recorded length/state so a program extended
+            # after a jit run (e.g. minimize appended later) re-stages
+            key = (id(program), len(program.nodes), len(program.state_ids))
+            fn = self._jit_cache.get(key)
             if fn is None:
                 names = tuple(sorted(program.placeholders))
+                sids = tuple(program.state_ids)
 
-                def replay_pure(feed_vals, _names=names, _prog=program):
+                def replay_pure(feed_vals, state_vals, _names=names,
+                                _sids=sids, _prog=program):
                     e = dict(zip((_prog.placeholders[n] for n in _names),
                                  feed_vals))
+                    e.update(zip(_sids, state_vals))
                     return _prog.replay(e)
 
                 fn = jax.jit(replay_pure)
-                self._jit_cache[id(program)] = fn
+                self._jit_cache[key] = fn
             env = fn([env[program.placeholders[n]]
-                      for n in sorted(program.placeholders)])
+                      for n in sorted(program.placeholders)],
+                     [env[sid] for sid in program.state_ids])
         else:
             program.replay(env)
+
+        for sid in program.state_ids:
+            if sid in env:
+                scope.vars[sid] = env[sid]
 
         results = []
         for f in fetch_list or []:
@@ -227,16 +279,134 @@ class Executor:
         return results
 
 
+def append_backward(loss: Tensor, parameter_list=None, no_grad_set=None):
+    """Append gradient computation to the default main program
+    (``base/backward.py`` append_backward analog).
+
+    TPU-first: instead of emitting one grad op per forward op, the WHOLE
+    backward is a single recorded node whose fn is ``jax.grad`` of the
+    replayed forward with respect to the parameter values — XLA
+    differentiates the real program, so replays with new feeds always get
+    fresh gradients (no stale tape closures).  Returns ``[(param, grad)]``
+    pairs like the reference.
+    """
+    from ..core.tensor import Parameter
+
+    prog = _default_main_program
+    if parameter_list is None:
+        seen, params = set(), []
+        for t in prog._keepalive:
+            if (isinstance(t, Parameter) and not t.stop_gradient
+                    and id(t) not in seen):
+                seen.add(id(t))
+                params.append(t)
+    else:
+        params = [p for p in parameter_list if not p.stop_gradient]
+    if no_grad_set:
+        drop = {id(p) for p in no_grad_set}
+        params = [p for p in params if id(p) not in drop]
+    if not params:
+        raise ValueError("append_backward: no trainable parameters recorded")
+
+    fwd_nodes = list(prog.nodes)           # freeze the forward subgraph
+    param_ids = [id(p) for p in params]
+    feed_names = sorted(prog.placeholders)
+    feed_ids = [prog.placeholders[n] for n in feed_names]
+    loss_id = id(loss)
+
+    def fwd_pure(param_vals, feed_vals):
+        env = dict(zip(param_ids, param_vals))
+        env.update(zip(feed_ids, feed_vals))
+        env = _replay_nodes(fwd_nodes, env)
+        out = env[loss_id]
+        if getattr(out, "size", 1) != 1:
+            raise ValueError("append_backward requires a scalar loss")
+        return out.reshape(())
+
+    grad_of_params = jax.grad(fwd_pure, argnums=0)
+
+    def grad_node_fn(*vals):
+        n = len(param_ids)
+        return tuple(grad_of_params(list(vals[:n]), list(vals[n:])))
+
+    # eager-run once (build-time feeds) so the grad wrappers exist and the
+    # optimizer's recorded update ops can reference them by id
+    cur_param_vals = [p._value for p in params]
+    cur_feed_vals = [prog._id_value(i) for i in feed_ids]
+    grads_now = grad_node_fn(*cur_param_vals, *cur_feed_vals)
+    grad_wrappers = [Tensor(g, stop_gradient=True) for g in grads_now]
+    for p, gw in zip(params, grad_wrappers):
+        p.grad = gw
+    prog.on_op("append_backward_grad", grad_node_fn,
+               params + [prog._id_tensor(i) for i in feed_ids], {},
+               grad_wrappers)
+    for pid in param_ids:
+        if pid not in prog.state_ids:
+            prog.state_ids.append(pid)
+    return list(zip(params, grad_wrappers))
+
+
+def _static_minimize(opt, loss: Tensor, parameters=None, no_grad_set=None):
+    """``Optimizer.minimize`` inside an active Program recording: append
+    the grad node, record the update ops (with rebind/alias events), and
+    register params + optimizer slots as scope-persisted state.  The eager
+    wrappers are rolled back so building the graph does not train."""
+    if getattr(opt, "_use_master_weights", False):
+        raise NotImplementedError(
+            "multi_precision (master-weight AMP) is not supported in the "
+            "static Program path — use paddle.jit.to_static over the train "
+            "step instead (it threads master weights correctly)")
+    prog = _default_main_program
+    params_grads = append_backward(
+        loss, parameters if parameters else opt._parameter_list,
+        no_grad_set=no_grad_set)
+    psnap = [(p, p._value) for p, _ in params_grads]
+    pre_step = opt._step_count
+    n_nodes_before = len(prog.nodes)
+    opt.step()                     # records opt_* ops + alias events
+    opt._step_count = pre_step
+    for p, v in psnap:             # build must not train
+        p._value = v
+    # slots were freshly created during the recording step; roll each back
+    # to the recorded op's arg snapshot — its true init (zeros for SGD/Adam
+    # moments, but e.g. Adagrad's initial_accumulator_value, Rprop's lr
+    # step sizes and NAdam's mu_prod=1 are NOT zero) — and persist them
+    slot_ids = {id(t) for st in opt._state.values() for t in st.values()}
+    for node in prog.nodes[n_nodes_before:]:
+        if node.kind != "op":
+            continue
+        for aid, snap in zip(node.arg_ids, node.arg_snaps):
+            if aid in slot_ids:
+                t = next(t for st in opt._state.values()
+                         for t in st.values() if id(t) == aid)
+                t._value = snap
+    for st in opt._state.values():
+        for t in st.values():
+            if id(t) not in prog.state_ids:
+                prog.state_ids.append(id(t))
+            prog._keepalive.append(t)
+    for p, g in params_grads:
+        p.grad = None
+    return None, params_grads
+
+
 def name_scope(prefix):
     return contextlib.nullcontext()
 
 
 class Scope:
-    pass
+    """Variable store persisting training state across ``Executor.run``
+    calls (``base/scope.py`` analog): maps tensor id → value."""
+
+    def __init__(self):
+        self.vars: Dict[int, Any] = {}
+
+
+_global_scope = Scope()
 
 
 def global_scope():
-    return Scope()
+    return _global_scope
 
 
 from . import nn  # noqa: E402,F401  (static.nn control flow + sequence ops)

@@ -304,3 +304,183 @@ class TestCostModelCalibration:
                            hw=HardwareSpec.cpu_sim(), max_trials=2)
         # rows must be re-scored against the cpu_sim model
         assert [r["est_step_s"] for r in plan.table] != v5p_est
+
+
+class TestStaticTraining:
+    """Static-graph training (VERDICT r3 missing #6): append_backward +
+    Optimizer.minimize inside a Program, scope-persisted state, jit replay.
+    Reference: ``base/backward.py`` append_backward + static optimizer."""
+
+    def _build(self, opt_cls, **opt_kw):
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 1))
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [16, 8], "float32")
+            y = static.data("y", [16, 1], "float32")
+            loss = ((net(x) - y) ** 2).mean()
+            opt = opt_cls(parameters=net.parameters(), **opt_kw)
+            _, params_grads = opt.minimize(loss)
+        return net, prog, loss, params_grads
+
+    def _train(self, prog, loss, steps=60, use_jit=False, scope="new"):
+        exe = static.Executor()
+        # scope="new": isolated scope per call; scope=None: the Executor's
+        # per-program default scope
+        scope = static.Scope() if scope == "new" else scope
+        rng = np.random.default_rng(0)
+        W = rng.normal(size=(8, 1)).astype(np.float32)
+        first = l = None
+        for _ in range(steps):
+            xb = rng.normal(size=(16, 8)).astype(np.float32)
+            (l,) = exe.run(prog, feed={"x": xb, "y": xb @ W},
+                           fetch_list=[loss], use_jit=use_jit, scope=scope)
+            if first is None:
+                first = float(l)
+        return first, float(l)
+
+    def test_append_backward_returns_param_grads(self):
+        net, prog, loss, pg = self._build(paddle.optimizer.SGD,
+                                          learning_rate=0.1)
+        assert len(pg) == 4  # 2 weights + 2 biases
+        for p, g in pg:
+            assert tuple(g.shape) == tuple(p.shape)
+        # params are scope state; grad node + update ops recorded
+        assert len(prog.state_ids) >= 4
+        names = [n.name for n in prog.nodes if n.name]
+        assert "append_backward_grad" in names
+        assert any(n.startswith("opt_") for n in names)
+
+    def test_sgd_training_converges_eager_and_jit(self):
+        net, prog, loss, _ = self._build(paddle.optimizer.SGD,
+                                         learning_rate=0.1)
+        snap = [p.numpy().copy() for p in net.parameters()]
+        first, last = self._train(prog, loss)
+        assert last < 0.1 * first, (first, last)
+        # the eager wrappers are untouched — training state lives in the
+        # scope (reference scope-variable semantics)
+        for p, s in zip(net.parameters(), snap):
+            np.testing.assert_array_equal(p.numpy(), s)
+        first, last = self._train(prog, loss, use_jit=True)
+        assert last < 0.1 * first, (first, last)
+
+    def test_adam_slots_persist_in_scope(self):
+        net, prog, loss, pg = self._build(paddle.optimizer.Adam,
+                                          learning_rate=0.02)
+        # slots (m, v, t per param) registered beyond the params themselves
+        assert len(prog.state_ids) > len(pg)
+        scope = static.Scope()
+        first, last = self._train(prog, loss, steps=80, scope=scope)
+        assert last < 0.2 * first, (first, last)
+        assert len(scope.vars) == len(prog.state_ids)
+
+    def test_separate_scopes_are_independent(self):
+        net, prog, loss, _ = self._build(paddle.optimizer.SGD,
+                                         learning_rate=0.1)
+        s1, s2 = static.Scope(), static.Scope()
+        self._train(prog, loss, steps=30, scope=s1)
+        first2, _ = self._train(prog, loss, steps=1, scope=s2)
+        # scope 2 starts from init, not from scope 1's trained state
+        assert first2 > 1.0
+
+    def test_adagrad_nonzero_slot_init_preserved(self):
+        """Slot rollback must restore the recorded INIT value, not zeros
+        (Adagrad's initial_accumulator_value is 0.06 by default here)."""
+        net, prog, loss, pg = self._build(
+            paddle.optimizer.Adagrad, learning_rate=0.05,
+            initial_accumulator_value=0.5)
+        # the slot wrappers must carry the init value after the build
+        opt_nodes = [n for n in prog.nodes
+                     if n.name and n.name.startswith("opt_")]
+        assert opt_nodes
+        inits = [s for n in opt_nodes for a, s in zip(n.arg_ids, n.arg_snaps)
+                 if a in prog.state_ids and np.ndim(s) > 0
+                 and np.allclose(np.asarray(s), 0.5)]
+        assert inits, "accumulator init 0.5 not in recorded snapshots"
+        first, last = self._train(prog, loss, steps=60)
+        assert last < 0.3 * first, (first, last)
+
+    def test_master_weights_raise_loudly(self):
+        paddle.seed(0)
+        net = nn.Linear(4, 1)
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [4, 4], "float32")
+            loss = net(x).mean()
+            opt = paddle.optimizer.AdamW(parameters=net.parameters(),
+                                         multi_precision=True)
+            with pytest.raises(NotImplementedError, match="multi_precision"):
+                opt.minimize(loss)
+
+    def test_no_grad_set_freezes_param(self):
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 1))
+        frozen = net[0].weight
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [16, 8], "float32")
+            y = static.data("y", [16, 1], "float32")
+            loss = ((net(x) - y) ** 2).mean()
+            opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                       parameters=net.parameters())
+            _, pg = opt.minimize(loss, no_grad_set={frozen})
+        assert all(p is not frozen for p, _ in pg)
+        scope = static.Scope()
+        self._train(prog, loss, steps=10, scope=scope)
+        assert id(frozen) not in scope.vars  # never became training state
+
+    def test_jit_cache_sees_program_extension(self):
+        """A program extended after a jitted forward run (minimize appended
+        later) must re-stage — not silently replay the old graph."""
+        paddle.seed(0)
+        net = nn.Linear(8, 1)
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [16, 8], "float32")
+            y = static.data("y", [16, 1], "float32")
+            loss = ((net(x) - y) ** 2).mean()
+        exe = static.Executor()
+        rng = np.random.default_rng(0)
+        W = rng.normal(size=(8, 1)).astype(np.float32)
+        xb = rng.normal(size=(16, 8)).astype(np.float32)
+        feed = {"x": xb, "y": xb @ W}
+        exe.run(prog, feed=feed, fetch_list=[loss], use_jit=True)
+        with static.program_guard(prog):
+            opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                       parameters=net.parameters())
+            opt.minimize(loss)
+        scope = static.Scope()
+        first = last = None
+        for _ in range(40):
+            (l,) = exe.run(prog, feed=feed, fetch_list=[loss],
+                           use_jit=True, scope=scope)
+            first = first if first is not None else float(l)
+            last = float(l)
+        assert last < 0.2 * first, (first, last)
+
+    def test_default_scope_is_per_program(self):
+        """Two programs must not alias each other's training state through
+        a process-global scope (CPython id reuse hazard)."""
+        net1, prog1, loss1, _ = self._build(paddle.optimizer.SGD,
+                                            learning_rate=0.1)
+        self._train(prog1, loss1, steps=20, scope=None)  # default scope
+        net2, prog2, loss2, _ = self._build(paddle.optimizer.SGD,
+                                            learning_rate=0.1)
+        first2, _ = self._train(prog2, loss2, steps=1, scope=None)
+        assert first2 > 1.0  # starts from init, not prog1's trained state
+        assert getattr(prog1, "_scope", None) is not getattr(
+            prog2, "_scope", None)
+
+    def test_incubate_optimizer_refuses_static(self):
+        from paddle_tpu.incubate.optimizer import LookAhead
+
+        paddle.seed(0)
+        net = nn.Linear(4, 1)
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [4, 4], "float32")
+            loss = net(x).mean()
+            inner = paddle.optimizer.SGD(learning_rate=0.1,
+                                         parameters=net.parameters())
+            with pytest.raises(NotImplementedError, match="static"):
+                LookAhead(inner).minimize(loss)

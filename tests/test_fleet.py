@@ -37,6 +37,7 @@ def test_fleet_init_builds_mesh():
     assert fleet.is_first_worker()
 
 
+@pytest.mark.slow
 def test_fleet_pipeline_train_batch_llama():
     s = fleet.DistributedStrategy()
     s.hybrid_configs = {"pp_degree": 2,

@@ -88,6 +88,7 @@ class TestLlamaArchitecture:
         assert attn.q_proj.weight.shape == [cfg.hidden_size, 4 * cfg.head_dim]
         assert attn.k_proj.weight.shape == [cfg.hidden_size, 2 * cfg.head_dim]
 
+    @pytest.mark.slow
     def test_all_params_get_grads(self):
         cfg = LlamaConfig.tiny(num_hidden_layers=2)
         m = LlamaForCausalLM(cfg)
@@ -170,6 +171,7 @@ class TestLlamaParallel:
         assert losses[-1] < losses[0]
         assert all(np.isfinite(l) for l in losses)
 
+    @pytest.mark.slow
     def test_forward_only_jit_sees_weight_updates(self, hybrid_mesh):
         """Params touched only inside the shard_map pipeline must still be
         threaded as jit state — not baked in as constants (regression:
@@ -190,6 +192,7 @@ class TestLlamaParallel:
         after = fwd(ids).numpy()
         assert np.abs(before - after).max() > 1e-6
 
+    @pytest.mark.slow
     def test_pipeline_matches_sequential(self, hybrid_mesh):
         """pp=2 pipeline forward == plain layer loop on the same weights."""
         cfg = LlamaConfig.tiny()
@@ -216,6 +219,7 @@ class TestGraftEntry:
         out = jax.jit(fn)(*args)
         assert out.shape == (2, 32, 256)
 
+    @pytest.mark.slow
     def test_dryrun_multichip(self):
         import importlib.util
 

@@ -69,6 +69,7 @@ class TestBert:
         s, e = m(_ids(cfg))
         assert s.shape == [2, 16] and e.shape == [2, 16]
 
+    @pytest.mark.slow
     def test_finetune_step_learns(self):
         cfg = BertConfig.tiny(hidden_dropout_prob=0.0,
                               attention_probs_dropout_prob=0.0)
@@ -106,6 +107,7 @@ class TestMoELlama:
         w = m.llama.layers[0].mlp.moe.experts.w_in
         assert param_spec(w)[0] == "sep"
 
+    @pytest.mark.slow
     def test_aux_loss_present_and_grads(self):
         cfg = LlamaConfig.tiny_moe()
         m = LlamaForCausalLM(cfg)
@@ -151,6 +153,7 @@ class TestMoELlama:
 
 
 class TestVisionModels:
+    @pytest.mark.slow
     def test_mobilenet_v2_forward_backward(self):
         import paddle_tpu as paddle
         from paddle_tpu.vision.models import mobilenet_v2
@@ -164,6 +167,7 @@ class TestVisionModels:
         convs = [p for n, p in m.named_parameters() if "conv" in n.lower() or "weight" in n]
         assert any(p.grad is not None for p in convs)
 
+    @pytest.mark.slow
     def test_vit_forward_backward(self):
         from paddle_tpu.vision.models import VisionTransformer
 
@@ -177,6 +181,7 @@ class TestVisionModels:
         assert m.pos_embed.grad is not None
         assert m.cls_token.grad is not None
 
+    @pytest.mark.slow
     def test_vgg_forward(self):
         from paddle_tpu.vision.models import vgg11
 

@@ -132,6 +132,7 @@ class TestSequenceParallel:
 
 
 class TestMoE:
+    @pytest.mark.slow
     def test_fused_moe_forward_and_grads(self, mesh_sep4):
         B, S, H = 2, 16, 8
         experts = pl.FusedMoEMLP(num_experts=4, d_model=H, d_hidden=16,
@@ -196,6 +197,7 @@ class TestRingAttention:
         ref = _reference_attention(q._value, k._value, v._value, causal=False)
         np.testing.assert_allclose(out.numpy(), np.asarray(ref), rtol=2e-5, atol=2e-5)
 
+    @pytest.mark.slow
     def test_grads_flow(self, mesh_sep4):
         B, S, NH, D = 1, 8, 1, 4
         q = paddle.to_tensor(np.random.randn(B, S, NH, D).astype("float32"),
@@ -225,6 +227,7 @@ class TestPipeline:
             ref = np.tanh(ref @ Ws[s])
         np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-5, atol=2e-5)
 
+    @pytest.mark.slow
     def test_pipeline_spmd_grads_match(self, mesh_pp4):
         H, B, M = 4, 4, 2
         Ws = jnp.asarray(np.random.randn(4, H, H).astype("float32") * 0.3)
@@ -255,6 +258,7 @@ class TestPipeline:
         out = pipe(x)  # sequential forward (pp=1 semantics)
         assert out.shape == [2, 8]
 
+    @pytest.mark.slow
     def test_pipeline_forward_tensor_api(self, mesh_pp4):
         descs = [pl.LayerDesc(nn.Linear, 8, 8) for _ in range(4)]
         pipe = pl.PipelineLayer(descs, num_stages=4)
@@ -330,6 +334,7 @@ class TestInterleavedPipeline:
         np.testing.assert_allclose(out.numpy(), ref.numpy(),
                                    rtol=2e-4, atol=2e-4)
 
+    @pytest.mark.slow
     def test_vpp_grads_flow_to_all_chunks(self, mesh_pp4):
         paddle.seed(1)
         layers = [nn.Linear(4, 4) for _ in range(8)]

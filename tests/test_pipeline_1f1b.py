@@ -107,6 +107,7 @@ def _toy_setup(n_stages, v, hidden=8, B=8, seed=0):
 
 
 @pytest.mark.parametrize("v,n_micro", [(1, 4), (1, 8), (2, 4)])
+@pytest.mark.slow
 def test_loss_and_grads_match_sequential(mesh_pp4, v, n_micro):
     n = 4
     Ws, bs, head_w, x, tgt, stage_fn, head_fn, reference = _toy_setup(n, v)
@@ -154,6 +155,7 @@ def test_pp2_alignment(mesh_pp2):
                                rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_pp_x_dp_composition():
     # pp=2 × dp=2: grads must equal the single-device full-batch grads
     mesh = topology.init_mesh(dp=2, pp=2)
@@ -181,6 +183,7 @@ def test_pp_x_dp_composition():
 # Tensor-level op + Llama integration
 # --------------------------------------------------------------------------
 
+@pytest.mark.slow
 def test_llama_1f1b_matches_unpipelined():
     import paddle_tpu as paddle
     from paddle_tpu.models import (LlamaConfig, LlamaForCausalLM,
@@ -216,6 +219,7 @@ def test_llama_1f1b_matches_unpipelined():
                                    rtol=2e-4, atol=2e-5, err_msg=n)
 
 
+@pytest.mark.slow
 def test_llama_1f1b_optimizer_step_decreases_loss():
     import paddle_tpu as paddle
     from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
@@ -239,6 +243,7 @@ def test_llama_1f1b_optimizer_step_decreases_loss():
     assert losses[-1] < losses[0], losses
 
 
+@pytest.mark.slow
 def test_vpp_micro_exceeds_buffer_regression(mesh_pp4):
     # regression (r2 review): v=2 with n_micro > pp used to overflow the
     # m % pp ring buffer and silently corrupt gradients
@@ -264,6 +269,7 @@ def test_vpp_micro_exceeds_buffer_regression(mesh_pp4):
                                        rtol=1e-4, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_1f1b_large_micro_count(mesh_pp2):
     # n_micro >> pp exercises ring-buffer slot reuse in the plain schedule
     n, v, n_micro = 2, 1, 12
@@ -281,6 +287,7 @@ def test_1f1b_large_micro_count(mesh_pp2):
                                rtol=1e-4, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_llama_moe_1f1b_aux_loss_matches():
     # MoE aux losses must join the pipelined loss exactly like unpipelined
     import paddle_tpu as paddle
@@ -370,6 +377,7 @@ class TestRecomputeChoice:
 
         return step, stacked, x, tgt, ref, (Ws, bs, hw)
 
+    @pytest.mark.slow
     def test_modes_numerically_aligned(self, mesh_pp4):
         step_r, stacked, x, tgt, ref, (Ws, bs, hw) = self._build(
             mesh_pp4, recompute=True)
@@ -481,6 +489,7 @@ class TestRecomputeChoice:
         # activation residuals (microbatch-sized vectors) are far smaller
         assert extra < sched_depth * w_bytes, (extra, sched_depth * w_bytes)
 
+    @pytest.mark.slow
     def test_store_mode_bf16_aux(self, mesh_pp4):
         """review r3: a non-f32 aux scalar must work in store mode (the aux
         ring buffer keeps the stage's native aux dtype)."""

@@ -12,6 +12,7 @@ from paddle_tpu.ops.paged_attention import BlockKVCache, paged_attention
 
 
 class TestGenerate:
+    @pytest.mark.slow
     def test_greedy_matches_full_forward(self):
         cfg = LlamaConfig.tiny()
         paddle.seed(0)
@@ -26,6 +27,7 @@ class TestGenerate:
         np.testing.assert_array_equal(out.numpy()[:, 12],
                                       full[:, -1].argmax(-1))
 
+    @pytest.mark.slow
     def test_cache_decode_consistent_with_teacher_forcing(self):
         """Feeding generated tokens back through the FULL model must produce
         the same next-token choices the cached decode made."""
@@ -39,6 +41,7 @@ class TestGenerate:
             logits = m(paddle.to_tensor(out[:, :t])).numpy()
             assert logits[0, -1].argmax() == out[0, t]
 
+    @pytest.mark.slow
     def test_sampling_respects_top_k(self):
         cfg = LlamaConfig.tiny(num_hidden_layers=1)
         paddle.seed(0)
@@ -153,6 +156,7 @@ class TestLLMPredictor:
         paddle.seed(0)
         return LlamaForCausalLM(LlamaConfig.tiny())
 
+    @pytest.mark.slow
     def test_paged_generate_matches_dense(self):
         from paddle_tpu.inference import LLMPredictor
 
@@ -164,6 +168,7 @@ class TestLLMPredictor:
         got = pred.generate(0, ids, max_new_tokens=5)
         assert ref.tolist() == got
 
+    @pytest.mark.slow
     def test_continuous_batching_isolation(self):
         """A request joining mid-stream must not perturb running requests,
         and each must match its single-request output."""

@@ -275,6 +275,7 @@ class TestSparseConv:
             jsparse.BCOO((jnp.asarray(vals), jnp.asarray(idx)),
                          shape=(1, 3, 3, 3, 3)))
 
+    @pytest.mark.slow
     def test_conv3d_matches_dense(self):
         x = self._point_cloud()
         conv = sp.nn.Conv3D(3, 5, 3, padding=1)
@@ -449,6 +450,7 @@ class TestSparseConvOnnz:
         p = sp.nn.functional.max_pool3d(x, 2)
         assert p.bcoo.data.shape[0] <= nnz
 
+    @pytest.mark.slow
     def test_subm_conv3d_matches_dense_on_active_sites(self):
         """Gathered-GEMM result equals the dense conv at every active site."""
         import jax
@@ -518,6 +520,7 @@ class TestSparseConvOnnz:
             with pytest.raises(ValueError, match="int32"):
                 _key_dtype(2048 ** 3)
 
+    @pytest.mark.slow
     def test_grouped_conv3d(self):
         """groups>1 via the grouped einsum matches the dense grouped conv."""
         import jax

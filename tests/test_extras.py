@@ -442,3 +442,82 @@ class TestInitializerAndParityPaths:
         from paddle_tpu.parallel.moe import MoELayer
 
         assert moe.MoELayer is MoELayer
+
+
+class TestDeviceSurface:
+    """N13 device abstraction (``phi/backends/device_manager.h:134``):
+    enumeration, plugin registration hook, memory stats, streams/events."""
+
+    def test_enumeration_and_selection(self):
+        import jax
+
+        from paddle_tpu import device as D
+
+        plat = jax.default_backend()
+        devs = D.get_available_device()
+        assert len(devs) == jax.device_count()
+        assert all(d.startswith(plat + ":") for d in devs)
+        assert D.device_count(plat) == jax.device_count()
+        assert D.device_count("nonexistent_backend") == 0
+        D.set_device(f"{plat}:{len(devs) - 1}")
+        try:
+            assert D.get_device() == f"{plat}:{len(devs) - 1}"
+            # the default-device APIs honor set_device (not device 0)
+            assert D._resolve(None).id == jax.devices()[-1].id
+        finally:
+            D.set_device(f"{plat}:0")
+
+    def test_custom_device_queries(self):
+        import jax
+
+        from paddle_tpu import device as D
+
+        plat = jax.default_backend()
+        if plat in ("cpu", "tpu", "gpu"):
+            assert f"{plat}:0" not in D.get_available_custom_device()
+        assert D.is_compiled_with_custom_device(plat)
+        assert not D.is_compiled_with_custom_device("vendor_npu")
+        assert callable(D.register_custom_device)
+
+    def test_memory_stats_contract(self):
+        import jax
+
+        from paddle_tpu import device as D
+
+        stats = D.memory_stats(f"{jax.default_backend()}:0")
+        if jax.default_backend() == "cpu":
+            # CPU PJRT reports no stats: loud absence (empty dict/zeros),
+            # never fabricated numbers
+            assert stats == {}
+            assert D.memory_allocated() == 0
+            assert D.max_memory_allocated() == 0
+            assert D.max_memory_reserved() == 0
+        else:  # live PJRT stats on accelerators
+            assert D.memory_allocated() >= 0
+            assert D.max_memory_allocated() >= D.memory_allocated()
+
+    def test_stream_event_order_semantics(self):
+        import numpy as np
+
+        import paddle_tpu as paddle
+        from paddle_tpu import device as D
+
+        import jax
+
+        x = paddle.to_tensor(np.ones((64, 64), np.float32))
+        y = (x @ x).sum()
+        s = D.current_stream(f"{jax.default_backend()}:0")
+        e = s.record_event()
+        e.synchronize()           # everything enqueued before is done
+        assert e.query()
+        assert float(y) == 64 * 64 * 64
+        s.wait_event(e)
+        s.synchronize()
+        D.synchronize()
+        # unavailable platform strings map to the default backend (the
+        # set_device contract) instead of probing foreign plugins
+        D.synchronize("gpu:0")
+        import pytest as _pytest
+
+        with _pytest.raises(NotImplementedError):
+            D.Event(enable_timing=True)

@@ -18,20 +18,57 @@ from ..core.dispatch import run_op
 from ..core.tensor import Tensor, to_tensor, wrap_result
 
 
+_saved_tensor_hooks: List = []  # active (pack, unpack) pairs, innermost last
+
+
+class saved_tensors_hooks:
+    """(``autograd/saved_tensors_hooks`` analog) context manager installing
+    a ``pack(tensor) -> obj`` / ``unpack(obj) -> tensor`` pair around
+    tensors saved for backward.
+
+    TPU-first scope: applies to tensors saved through
+    ``PyLayerContext.save_for_backward`` — the user-facing save point on
+    this substrate (the built-in ops' residuals live inside XLA's fused
+    program where host-side packing would force device→host syncs; use
+    ``paddle.distributed.recompute``/``jax.checkpoint`` to trade their
+    memory instead)."""
+
+    def __init__(self, pack_hook: Callable, unpack_hook: Callable):
+        self.pair = (pack_hook, unpack_hook)
+
+    def __enter__(self):
+        _saved_tensor_hooks.append(self.pair)
+        return self
+
+    def __exit__(self, *exc):
+        _saved_tensor_hooks.remove(self.pair)
+        return False
+
+
 class PyLayerContext:
     """Context passed to PyLayer.forward/backward (paddle.autograd.PyLayerContext)."""
 
     def __init__(self):
         self._saved = ()
+        self._packed = None
         self.not_inplace_tensors = ()
 
     def save_for_backward(self, *tensors):
-        self._saved = tensors
+        if _saved_tensor_hooks:
+            pack, unpack = _saved_tensor_hooks[-1]
+            self._packed = ([pack(t) for t in tensors], unpack)
+            self._saved = ()
+        else:
+            self._packed = None
+            self._saved = tensors
 
     def saved_tensor(self):
+        if self._packed is not None:
+            objs, unpack = self._packed
+            return tuple(unpack(o) for o in objs)
         return self._saved
 
-    saved_tensors = property(lambda self: self._saved)
+    saved_tensors = property(lambda self: self.saved_tensor())
 
 
 class PyLayerMeta(type):

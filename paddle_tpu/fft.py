@@ -66,3 +66,54 @@ def fftfreq(n, d=1.0, dtype=None, name=None):
 
 def rfftfreq(n, d=1.0, dtype=None, name=None):
     return Tensor(jnp.fft.rfftfreq(n, d))
+
+
+def _split_axes(v, s, axes, two_d):
+    """Resolve (s, axes) for the hermitian N-D pair: axes defaults to the
+    last two dims (``*2``) or every dim; the LAST axis is the hermitian
+    one, the rest are plain (i)fftn axes."""
+    if axes is None:
+        axes = (-2, -1) if two_d else tuple(range(v.ndim))
+    axes = tuple(axes)
+    if s is None:
+        rest_s, last_n = None, None
+    else:
+        s = tuple(s)
+        rest_s, last_n = (s[:-1] or None), s[-1]
+    return rest_s, last_n, axes
+
+
+def _hfftn_impl(two_d):
+    def op(x, s=None, axes=None, norm="backward", name=None):
+        def f(v):
+            rest_s, last_n, ax = _split_axes(v, s, axes, two_d)
+            if len(ax) > 1:
+                v = jnp.fft.fftn(v, s=rest_s, axes=ax[:-1], norm=norm)
+            return jnp.fft.hfft(v, n=last_n, axis=ax[-1], norm=norm)
+
+        return run_op("hfft2" if two_d else "hfftn", f, _ensure(x))
+
+    return op
+
+
+def _ihfftn_impl(two_d):
+    def op(x, s=None, axes=None, norm="backward", name=None):
+        def f(v):
+            rest_s, last_n, ax = _split_axes(v, s, axes, two_d)
+            out = jnp.fft.ihfft(v, n=last_n, axis=ax[-1], norm=norm)
+            if len(ax) > 1:
+                out = jnp.fft.ifftn(out, s=rest_s, axes=ax[:-1], norm=norm)
+            return out
+
+        return run_op("ihfft2" if two_d else "ihfftn", f, _ensure(x))
+
+    return op
+
+
+# Hermitian N-D pair (``fft.py:762`` hfftn / ``fft.py:811`` ihfftn and the
+# 2-D shorthands): fftn over the leading axes composed with the 1-D
+# hermitian transform on the last axis — ihfftn(hfftn(x)) == x.
+hfftn = _hfftn_impl(False)
+hfft2 = _hfftn_impl(True)
+ihfftn = _ihfftn_impl(False)
+ihfft2 = _ihfftn_impl(True)

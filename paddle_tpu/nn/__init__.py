@@ -36,6 +36,11 @@ from .norm import (  # noqa: F401
     SyncBatchNorm,
 )
 from .pooling import *  # noqa: F401,F403
+from .decode import (  # noqa: F401
+    BeamSearchDecoder,
+    Decoder,
+    dynamic_decode,
+)
 from .rnn import (  # noqa: F401
     GRU,
     LSTM,
@@ -43,6 +48,7 @@ from .rnn import (  # noqa: F401
     GRUCell,
     LSTMCell,
     RNN,
+    RNNCellBase,
     SimpleRNN,
     SimpleRNNCell,
 )

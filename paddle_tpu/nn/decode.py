@@ -1,0 +1,165 @@
+"""Seq2seq decoding (``python/paddle/nn/decode.py`` capability):
+``Decoder`` contract, ``BeamSearchDecoder`` over an RNN cell, and
+``dynamic_decode`` — the reference's while-loop decoding driver.
+
+TPU-first notes: the step math (cell forward, log-softmax, top-k over
+beam·vocab, state reindexing) is jnp through the dispatch layer, so each
+step is XLA-compiled; the outer loop is host-driven with early exit on
+all-finished (the reference's dygraph ``while`` semantics).  The final
+``gather_tree`` backtrace over parent pointers mirrors the reference op
+of the same name.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from .functional.common import gather_tree
+
+__all__ = ["Decoder", "BeamSearchDecoder", "dynamic_decode", "gather_tree"]
+
+
+class Decoder:
+    """(``nn/decode.py`` Decoder) initialize/step/finalize contract."""
+
+    def initialize(self, inits):
+        raise NotImplementedError
+
+    def step(self, time, inputs, states, **kwargs):
+        raise NotImplementedError
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        raise NotImplementedError
+
+
+class BeamSearchDecoder(Decoder):
+    """(``nn/decode.py`` BeamSearchDecoder) beam search over a step cell.
+
+    ``cell(inputs, states) -> (outputs, new_states)`` is any RNN-style
+    cell; ``embedding_fn`` maps token ids → cell inputs; ``output_fn``
+    maps cell outputs → vocab logits (identity if the cell already emits
+    logits)."""
+
+    def __init__(self, cell, start_token: int, end_token: int,
+                 beam_size: int, embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    def initialize(self, inits):
+        """``inits``: initial cell states with leading batch dim.  Tiles
+        them to (batch·beam) and scores beam 0 at 0, the rest at -inf (the
+        reference's kInfinity init so all beams start as copies)."""
+        states = [inits] if isinstance(inits, Tensor) else list(inits)
+        batch = states[0].shape[0]
+        K = self.beam_size
+
+        def tile(s):
+            v = s._value if isinstance(s, Tensor) else jnp.asarray(s)
+            return Tensor(jnp.repeat(v[:, None], K, axis=1).reshape(
+                batch * K, *v.shape[1:]))
+
+        tiled = [tile(s) for s in states]
+        log_probs = jnp.where(jnp.arange(K) == 0, 0.0, -1e9)
+        log_probs = jnp.broadcast_to(log_probs, (batch, K))
+        tokens = jnp.full((batch, K), self.start_token, jnp.int32)
+        finished = jnp.zeros((batch, K), bool)
+        return tokens, (tiled, log_probs, finished)
+
+    def step(self, time, inputs, states, **kwargs):
+        tiled, log_probs, finished = states
+        batch, K = log_probs.shape
+
+        x = Tensor(inputs.reshape(-1))
+        if self.embedding_fn is not None:
+            x = self.embedding_fn(x)
+        cell_states = tiled[0] if len(tiled) == 1 else tuple(tiled)
+        out, new_states = self.cell(x, cell_states)
+        if self.output_fn is not None:
+            out = self.output_fn(out)
+        logits = out._value if isinstance(out, Tensor) else jnp.asarray(out)
+        V = logits.shape[-1]
+        import jax
+        from jax import lax
+
+        step_lp = jax.nn.log_softmax(logits, axis=-1).reshape(batch, K, V)
+        # finished beams may only emit end_token at score 0 (reference's
+        # finished-beam masking, so they hold their total score)
+        eos_only = jnp.full((V,), -1e9).at[self.end_token].set(0.0)
+        step_lp = jnp.where(finished[..., None], eos_only, step_lp)
+        total = log_probs[..., None] + step_lp                  # (B, K, V)
+        top_lp, flat_idx = lax.top_k(total.reshape(batch, K * V), K)
+        parent = (flat_idx // V).astype(jnp.int32)              # (B, K)
+        token = (flat_idx % V).astype(jnp.int32)
+
+        # reindex states by chosen parent beams
+        gidx = (jnp.arange(batch)[:, None] * K + parent).reshape(-1)
+        new_states = [new_states] if isinstance(new_states, Tensor) \
+            else list(new_states)
+        retiled = [Tensor(jnp.take((s._value if isinstance(s, Tensor)
+                                    else jnp.asarray(s)), gidx, axis=0))
+                   for s in new_states]
+        new_finished = jnp.take_along_axis(finished, parent, 1) \
+            | (token == self.end_token)
+        return ((token, parent),
+                token,
+                (retiled, top_lp, new_finished),
+                new_finished)
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        ids = np.stack([np.asarray(t) for t, _ in outputs])      # [T, B, K]
+        parents = np.stack([np.asarray(p) for _, p in outputs])
+        seqs = gather_tree(ids, parents).numpy()
+        return seqs, final_states
+
+
+def dynamic_decode(decoder: Decoder, inits=None, max_step_num: int = 100,
+                   output_time_major: bool = False, impute_finished=False,
+                   is_test=False, return_length: bool = False, **kwargs):
+    """(``nn/decode.py`` dynamic_decode) drive ``decoder`` until every
+    sequence finished or ``max_step_num``; returns ``(outputs,
+    final_states)`` (+ ``sequence_lengths`` with ``return_length``),
+    batch-major unless ``output_time_major``.
+
+    ``is_test`` is accepted for API parity (it only affects the
+    reference's static-graph caching); ``impute_finished=True`` is not
+    supported — finished beams are already masked to emit only the end
+    token at score 0 inside the step."""
+    if impute_finished:
+        raise NotImplementedError(
+            "dynamic_decode(impute_finished=True) is not supported: "
+            "finished-beam outputs are masked inside BeamSearchDecoder."
+            "step (end-token-only at score 0), which covers the "
+            "reference's use of the flag")
+    inputs, states = decoder.initialize(inits)
+    outputs = []
+    for t in range(int(max_step_num)):
+        step_out, next_inputs, states, finished = decoder.step(
+            t, inputs, states, **kwargs)
+        outputs.append(step_out)
+        inputs = next_inputs
+        if bool(np.asarray(finished).all()):
+            break
+    seqs, final_states = decoder.finalize(outputs, states, None)
+    # lengths from the BACKTRACED sequences (top-k reorders beam slots
+    # every step, so per-slot counters taken during the loop would label
+    # the wrong beams): first end_token, inclusive, else full length
+    end = getattr(decoder, "end_token", None)
+    T = seqs.shape[0]
+    if end is not None:
+        is_end = seqs == end
+        first = np.where(is_end.any(0), is_end.argmax(0) + 1, T)
+        lengths = first.astype(np.int64)                        # [B, K]
+    else:
+        lengths = np.full(seqs.shape[1:], T, np.int64)
+    if not output_time_major:
+        seqs = np.transpose(seqs, (1, 2, 0))                    # [B, K, T]
+    out = Tensor(jnp.asarray(seqs))
+    if return_length:
+        return out, final_states, Tensor(jnp.asarray(lengths))
+    return out, final_states

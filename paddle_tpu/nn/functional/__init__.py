@@ -32,3 +32,20 @@ from .pooling import *  # noqa: F401,F403
 from .vision import affine_grid, grid_sample, temporal_shift  # noqa: F401
 
 from ...tensor.creation import diag_embed  # noqa: F401  (also exposed here, reference parity)
+
+# In-place activation variants (``nn/functional/activation.py`` *_ set):
+# functional op + rebind, like the generated tensor in-place ops.
+def _act_inplace(fn):
+    def op_(x, *args, **kwargs):
+        return x._rebind(fn(x, *args, **kwargs))
+
+    op_.__name__ = fn.__name__ + "_"
+    op_.__doc__ = f"In-place variant of :func:`{fn.__name__}`."
+    return op_
+
+
+relu_ = _act_inplace(relu)            # noqa: F405
+tanh_ = _act_inplace(tanh)            # noqa: F405
+hardtanh_ = _act_inplace(hardtanh)    # noqa: F405
+leaky_relu_ = _act_inplace(leaky_relu)        # noqa: F405
+thresholded_relu_ = _act_inplace(thresholded_relu)  # noqa: F405

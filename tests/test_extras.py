@@ -521,3 +521,78 @@ class TestDeviceSurface:
 
         with _pytest.raises(NotImplementedError):
             D.Event(enable_timing=True)
+
+
+class TestApiTailRound4:
+    """r4 parity-tail closures: in-place activations, amp capability
+    checks, hermitian N-D FFTs, saved_tensors_hooks."""
+
+    def test_inplace_activations(self):
+        import paddle_tpu.nn.functional as F
+
+        x = paddle.to_tensor(np.array([-1.0, 2.0], np.float32))
+        F.relu_(x)
+        np.testing.assert_array_equal(x.numpy(), [0.0, 2.0])
+        y = paddle.to_tensor(np.array([-3.0, 0.5], np.float32))
+        F.hardtanh_(y)
+        np.testing.assert_array_equal(y.numpy(), [-1.0, 0.5])
+        for name in ("tanh_", "leaky_relu_", "thresholded_relu_"):
+            assert callable(getattr(F, name))
+
+    def test_amp_capability_checks(self):
+        assert paddle.amp.is_bfloat16_supported() is True
+        assert isinstance(paddle.amp.is_float16_supported(), bool)
+
+    def test_hermitian_nd_fft_roundtrip(self):
+        rng = np.random.default_rng(0)
+        a = rng.normal(size=(4, 6)).astype(np.float32)
+        back = paddle.fft.hfft2(paddle.fft.ihfft2(paddle.to_tensor(a)),
+                                s=a.shape)
+        np.testing.assert_allclose(back.numpy(), a, atol=1e-5)
+        # reference docstring example (fft.py:795): 1-D degenerate case
+        x = paddle.to_tensor(np.array([2 + 2j, 2 + 2j, 3 + 3j], np.complex64))
+        np.testing.assert_allclose(
+            paddle.fft.hfftn(x).numpy(), [9.0, 3.0, 1.0, -5.0], atol=1e-5)
+        b = rng.normal(size=(3, 4, 5)).astype(np.float32)
+        back = paddle.fft.hfftn(paddle.fft.ihfftn(paddle.to_tensor(b)),
+                                s=b.shape)
+        np.testing.assert_allclose(back.numpy(), b, atol=1e-4)
+
+    def test_saved_tensors_hooks_pack_unpack(self):
+        from paddle_tpu.autograd import PyLayer, saved_tensors_hooks
+
+        events = []
+
+        class Square(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)
+                return x * x
+
+            @staticmethod
+            def backward(ctx, g):
+                (x,) = ctx.saved_tensor()
+                return g * 2.0 * x
+
+        def pack(t):
+            events.append("pack")
+            return t.numpy()          # e.g. offload to host
+
+        def unpack(obj):
+            events.append("unpack")
+            return paddle.to_tensor(obj)
+
+        x = paddle.to_tensor(np.array([3.0], np.float32))
+        x.stop_gradient = False
+        with saved_tensors_hooks(pack, unpack):
+            y = Square.apply(x)
+        y.backward()
+        assert events == ["pack", "unpack"]
+        np.testing.assert_allclose(x.grad.numpy(), [6.0])
+        # outside the context: no hooks
+        events.clear()
+        x2 = paddle.to_tensor(np.array([2.0], np.float32))
+        x2.stop_gradient = False
+        Square.apply(x2).backward()
+        assert events == []
+        np.testing.assert_allclose(x2.grad.numpy(), [4.0])

@@ -284,3 +284,110 @@ class TestGradClip:
         out = clip([(p, p.grad)])
         total = np.linalg.norm(out[0][1].numpy())
         np.testing.assert_allclose(total, 1.0, rtol=1e-5)
+
+
+class TestBeamSearchDecode:
+    """``nn/decode.py`` BeamSearchDecoder + dynamic_decode (+ gather_tree
+    backtrace): beam search over a step cell with finished-beam masking."""
+
+    V, H, START, EOS = 6, 8, 0, 5
+
+    def _cell_and_state(self):
+        import jax.numpy as jnp
+
+        from paddle_tpu.core.tensor import Tensor
+
+        class TableCell(nn.Layer):
+            def __init__(self, table):
+                super().__init__()
+                self.table = jnp.asarray(table)
+
+            def forward(self, tok, state):
+                t = tok._value if isinstance(tok, Tensor) else jnp.asarray(tok)
+                return Tensor(self.table[t]), state
+
+        # greedy from START picks 1 (p=.5), but 2→3→EOS (p=.4·.99·.99)
+        # beats every 1-prefixed path (≤ .25)
+        table = np.full((self.V, self.V), -10.0, np.float32)
+        table[self.START, 1] = np.log(0.5)
+        table[self.START, 2] = np.log(0.4)
+        table[1, 4] = np.log(0.5)
+        table[1, self.EOS] = np.log(0.5)
+        table[2, 3] = np.log(0.99)
+        table[3, self.EOS] = np.log(0.99)
+        table[4, self.EOS] = np.log(0.9)
+        import jax.numpy as jnp
+
+        from paddle_tpu.core.tensor import Tensor as T
+
+        return TableCell(table), T(jnp.zeros((2, self.H)))
+
+    def test_beam_beats_greedy(self):
+        cell, state = self._cell_and_state()
+        dec = nn.BeamSearchDecoder(cell, start_token=self.START,
+                                   end_token=self.EOS, beam_size=3)
+        out, _, lens = nn.dynamic_decode(dec, inits=state, max_step_num=6,
+                                         return_length=True)
+        seqs = out.numpy()               # [batch, beam, T]
+        assert seqs.shape[:2] == (2, 3)
+        np.testing.assert_array_equal(seqs[0, 0], [2, 3, self.EOS])
+        assert lens.numpy()[0, 0] == 3
+
+    def test_beam1_is_greedy(self):
+        cell, state = self._cell_and_state()
+        dec = nn.BeamSearchDecoder(cell, start_token=self.START,
+                                   end_token=self.EOS, beam_size=1)
+        out, _ = nn.dynamic_decode(dec, inits=state, max_step_num=6)
+        assert out.numpy()[0, 0, 0] == 1  # locally-best first token
+
+    def test_early_stop_and_time_major(self):
+        cell, state = self._cell_and_state()
+        dec = nn.BeamSearchDecoder(cell, start_token=self.START,
+                                   end_token=self.EOS, beam_size=2)
+        out, _ = nn.dynamic_decode(dec, inits=state, max_step_num=50,
+                                   output_time_major=True)
+        assert out.numpy().shape[0] < 50  # stopped when all beams finished
+
+    def test_gather_tree_backtrace(self):
+        from paddle_tpu.nn.decode import gather_tree
+
+        ids = np.array([[[1, 2]], [[3, 4]], [[5, 6]]])       # [T=3, B=1, K=2]
+        parents = np.array([[[0, 0]], [[1, 0]], [[0, 1]]])
+        out = gather_tree(ids, parents)
+        # beam0 at t2 came from parent 0@t1 which came from parent 1@t0
+        np.testing.assert_array_equal(out[:, 0, 0], [2, 3, 5])
+
+    def test_lengths_follow_reordered_beams(self):
+        """Review repro: top-k reorders beam slots across steps; lengths
+        must describe the backtraced sequences, not loop-time slots."""
+        import jax.numpy as jnp
+
+        from paddle_tpu.core.tensor import Tensor
+
+        table = np.full((self.V, self.V), -10.0, np.float32)
+        table[self.START, 1] = np.log(0.5)
+        table[self.START, self.EOS] = np.log(0.4)
+        table[1, 4] = np.log(0.5)
+        table[1, self.EOS] = np.log(0.5)
+        table[4, self.EOS] = np.log(0.9)
+
+        class TableCell(nn.Layer):
+            def __init__(self, t):
+                super().__init__()
+                self.t = jnp.asarray(t)
+
+            def forward(self, tok, state):
+                v = tok._value if isinstance(tok, Tensor) else jnp.asarray(tok)
+                return Tensor(self.t[v]), state
+
+        dec = nn.BeamSearchDecoder(TableCell(table), start_token=self.START,
+                                   end_token=self.EOS, beam_size=2)
+        out, _, lens = nn.dynamic_decode(
+            dec, inits=Tensor(jnp.zeros((1, 4))), max_step_num=6,
+            return_length=True)
+        seqs, ln = out.numpy()[0], lens.numpy()[0]
+        for k in range(2):
+            s = seqs[k]
+            true_len = (np.argmax(s == self.EOS) + 1
+                        if (s == self.EOS).any() else len(s))
+            assert ln[k] == true_len, (k, s, ln)

@@ -5,12 +5,18 @@ Analog of the PaddleNLP/PaddleClas model zoos the reference's configs target
 framework models so the capability rungs are runnable in-repo.
 """
 
-from . import bert, llama  # noqa: F401
+from . import bert, gpt, llama  # noqa: F401
 from .bert import (  # noqa: F401
     BertConfig,
     BertForQuestionAnswering,
     BertForSequenceClassification,
     BertModel,
+)
+from .gpt import (  # noqa: F401
+    GPTConfig,
+    GPTForCausalLM,
+    GPTModel,
+    GPTPretrainingCriterion,
 )
 from .llama import (  # noqa: F401
     LlamaConfig,

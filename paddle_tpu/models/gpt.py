@@ -1,0 +1,197 @@
+"""GPT family (PaddleNLP ``gpt/modeling.py`` capability): the reference's
+other flagship decoder LM — pre-LN transformer, learned position
+embeddings, GELU MLP, tied LM head.
+
+TPU-first exactly like the Llama stack: Column/RowParallelLinear give
+Megatron TP via GSPMD param specs, attention rides the same
+ring/flash/XLA dispatch (no GQA here: kv heads == query heads), and the
+decoder stack routes through the SPMD pipeline schedule when the mesh has
+a ``pp`` axis.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ..core.dispatch import run_op
+from ..nn.initializer import Normal
+from ..nn.container import LayerList
+from ..nn.layers import Layer
+from ..parallel.mp_layers import (
+    ColumnParallelLinear,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+)
+from ..nn.norm import LayerNorm
+from ..parallel.pipeline import PipelineLayer, pipeline_forward
+from ..parallel.recompute import recompute as _recompute
+from ..parallel.ring_attention import ring_flash_attention
+from ..parallel.utils import axis_size, sharding_constraint
+from .llama import LlamaPretrainingCriterion
+
+
+@dataclass
+class GPTConfig:
+    """GPT-2/3 hyperparameters (defaults = GPT-3 6.7B shape)."""
+
+    vocab_size: int = 50304
+    hidden_size: int = 4096
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    intermediate_size: int = 16384
+    max_position_embeddings: int = 2048
+    layer_norm_epsilon: float = 1e-5
+    initializer_range: float = 0.02
+    tie_word_embeddings: bool = True
+    recompute: bool = False
+    dtype: str = "float32"
+    virtual_pp_degree: int = 1
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    @classmethod
+    def tiny(cls, **kw):
+        defaults = dict(vocab_size=256, hidden_size=64,
+                        num_hidden_layers=4, num_attention_heads=4,
+                        intermediate_size=128, max_position_embeddings=128)
+        defaults.update(kw)
+        return cls(**defaults)
+
+
+class GPTAttention(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        h, hd = config.hidden_size, config.head_dim
+        self.num_heads = config.num_attention_heads
+        init = Normal(0.0, config.initializer_range)
+        self.qkv_proj = ColumnParallelLinear(
+            h, 3 * h, has_bias=True, gather_output=False, weight_attr=init)
+        self.o_proj = RowParallelLinear(
+            h, h, has_bias=True, input_is_parallel=True, weight_attr=init)
+
+    def forward(self, x):
+        B, S = x.shape[0], x.shape[1]
+        hd = self.config.head_dim
+        qkv = self.qkv_proj(x)
+
+        def split(a):
+            a = a.reshape(B, S, 3, self.num_heads, hd)
+            return a[:, :, 0], a[:, :, 1], a[:, :, 2]
+
+        q, k, v = run_op("split_qkv", split, qkv)
+        q = sharding_constraint(q, "dp", "sep", "mp", None)
+        k = sharding_constraint(k, "dp", "sep", "mp", None)
+        v = sharding_constraint(v, "dp", "sep", "mp", None)
+        out = ring_flash_attention(q, k, v, causal=True)
+        out = run_op("merge_heads",
+                     lambda a: a.reshape(B, S, self.num_heads * hd), out)
+        out = sharding_constraint(out, "dp", "sep", "mp")
+        return self.o_proj(out)
+
+
+class GPTMLP(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        init = Normal(0.0, config.initializer_range)
+        self.fc_in = ColumnParallelLinear(
+            config.hidden_size, config.intermediate_size, has_bias=True,
+            gather_output=False, weight_attr=init)
+        self.fc_out = RowParallelLinear(
+            config.intermediate_size, config.hidden_size, has_bias=True,
+            input_is_parallel=True, weight_attr=init)
+
+    def forward(self, x):
+        import jax
+
+        h = self.fc_in(x)
+        h = run_op("gelu", lambda v: jax.nn.gelu(v, approximate=True), h)
+        return self.fc_out(h)
+
+
+class GPTDecoderLayer(Layer):
+    """Pre-LN block: x + attn(ln1(x)); x + mlp(ln2(x))."""
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.ln_1 = LayerNorm(config.hidden_size, epsilon=config.layer_norm_epsilon)
+        self.attn = GPTAttention(config)
+        self.ln_2 = LayerNorm(config.hidden_size, epsilon=config.layer_norm_epsilon)
+        self.mlp = GPTMLP(config)
+
+    def forward(self, x):
+        x = x + self.attn(self.ln_1(x))
+        return x + self.mlp(self.ln_2(x))
+
+
+class GPTModel(Layer):
+    """Token + learned-position embeddings, pre-LN stack, final LayerNorm
+    (PaddleNLP ``GPTModel`` analog)."""
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        self.embed_tokens = VocabParallelEmbedding(
+            config.vocab_size, config.hidden_size,
+            weight_attr=Normal(0.0, config.initializer_range))
+        self.position_embeddings = self.create_parameter(
+            [config.max_position_embeddings, config.hidden_size],
+            default_initializer=Normal(0.0, config.initializer_range))
+        self.layers = LayerList(
+            [GPTDecoderLayer(config) for _ in range(config.num_hidden_layers)])
+        self.ln_f = LayerNorm(config.hidden_size, epsilon=config.layer_norm_epsilon)
+        self._pipe: Optional[PipelineLayer] = None
+
+    def _pipeline(self) -> PipelineLayer:
+        if self._pipe is None:
+            self._pipe = PipelineLayer(
+                list(self.layers), num_stages=axis_size("pp"),
+                num_virtual_pipeline_stages=self.config.virtual_pp_degree)
+        return self._pipe
+
+    def forward(self, input_ids, pp_microbatches: Optional[int] = None):
+        S = input_ids.shape[1]
+        h = self.embed_tokens(input_ids)
+        h = run_op("add_pos_embed", lambda a, p: a + p[:S], h,
+                   self.position_embeddings)
+        if pp_microbatches and axis_size("pp") > 1:
+            h = pipeline_forward(self._pipeline(), h, pp_microbatches)
+        else:
+            for layer in self.layers:
+                if self.config.recompute and self.training:
+                    h = _recompute(layer, h)
+                else:
+                    h = layer(h)
+        return self.ln_f(h)
+
+
+class GPTForCausalLM(Layer):
+    """GPT with tied LM head (PaddleNLP ``GPTForCausalLM`` analog)."""
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        self.gpt = GPTModel(config)
+        if config.tie_word_embeddings:
+            self.lm_head = None
+        else:
+            self.lm_head = ColumnParallelLinear(
+                config.hidden_size, config.vocab_size, has_bias=False,
+                gather_output=True,
+                weight_attr=Normal(0.0, config.initializer_range))
+
+    def forward(self, input_ids, pp_microbatches: Optional[int] = None):
+        h = self.gpt(input_ids, pp_microbatches=pp_microbatches)
+        if self.lm_head is None:
+            w = self.gpt.embed_tokens.weight
+            return run_op("tied_head", lambda a, wv: a @ wv.T, h, w)
+        return self.lm_head(h)
+
+
+# shifted-CE pretraining loss: identical semantics to Llama's
+GPTPretrainingCriterion = LlamaPretrainingCriterion

@@ -108,6 +108,32 @@ class LlamaConfig:
         defaults.update(kw)
         return cls.tiny(**defaults)
 
+    @classmethod
+    def deepseek_moe_16b(cls, **kw):
+        """DeepSeekMoE-16B (BASELINE config #5): 64 routed + 2 shared
+        experts, top-6 routing, 0.4B-ish expert FFNs."""
+        defaults = dict(
+            vocab_size=102400, hidden_size=2048, intermediate_size=10944,
+            num_hidden_layers=28, num_attention_heads=16,
+            num_key_value_heads=16, max_position_embeddings=4096,
+            num_experts=64, num_experts_per_tok=6,
+            moe_intermediate_size=1408, num_shared_experts=2)
+        defaults.update(kw)
+        return cls(**defaults)
+
+    @classmethod
+    def qwen2_moe_a14b(cls, **kw):
+        """Qwen2-57B-A14B MoE (BASELINE config #5): 64 routed + shared
+        expert, top-8 routing, GQA 4:1."""
+        defaults = dict(
+            vocab_size=151936, hidden_size=3584, intermediate_size=18944,
+            num_hidden_layers=28, num_attention_heads=28,
+            num_key_value_heads=4, max_position_embeddings=8192,
+            num_experts=64, num_experts_per_tok=8,
+            moe_intermediate_size=2560, num_shared_experts=1)
+        defaults.update(kw)
+        return cls(**defaults)
+
 
 def _rope_tables(head_dim: int, max_pos: int, theta: float):
     # Host-side numpy: sliced at trace time and embedded as jit constants.

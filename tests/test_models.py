@@ -188,3 +188,132 @@ class TestVisionModels:
         m = vgg11(num_classes=7)
         x = paddle.to_tensor(np.random.randn(1, 3, 224, 224).astype("float32"))
         assert m(x).shape == [1, 7]
+
+
+class TestGPT:
+    """GPT family (PaddleNLP gpt/modeling.py analog): pre-LN, learned
+    positions, GELU, tied head, same TP/pipeline substrate as Llama."""
+
+    def _model(self):
+        from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+        paddle.seed(0)
+        cfg = GPTConfig.tiny()
+        return cfg, GPTForCausalLM(cfg)
+
+    def test_forward_shape_and_tied_head(self):
+        cfg, m = self._model()
+        ids = _ids(cfg)
+        out = m(ids)
+        assert tuple(out.shape) == (2, 16, cfg.vocab_size)
+        assert m.lm_head is None  # GPT ties embeddings by default
+        names = [n for n, _ in m.named_parameters()]
+        assert sum("embed_tokens" in n for n in names) == 1
+
+    def test_causality(self):
+        cfg, m = self._model()
+        ids = _ids(cfg)
+        base = m(ids).numpy()
+        pert = ids.numpy().copy()
+        pert[:, 10] = (pert[:, 10] + 1) % cfg.vocab_size
+        got = m(paddle.to_tensor(pert)).numpy()
+        np.testing.assert_allclose(base[:, :10], got[:, :10], rtol=1e-5,
+                                   atol=1e-6)
+        assert not np.allclose(base[:, 10:], got[:, 10:])
+
+    def test_train_step_learns(self):
+        from paddle_tpu.jit import to_static
+        from paddle_tpu.models import GPTPretrainingCriterion
+
+        cfg, m = self._model()
+        crit = GPTPretrainingCriterion(cfg)
+        opt = paddle.optimizer.AdamW(learning_rate=3e-3,
+                                     parameters=m.parameters())
+
+        @to_static
+        def step(x):
+            loss = crit(m(x), x)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        data = paddle.to_tensor(
+            np.tile(np.arange(16, dtype=np.int64) % 7, (4, 1)))
+        first = float(step(data))
+        for _ in range(25):
+            last = float(step(data))
+        assert last < 0.5 * first, (first, last)
+
+    def test_tp_matches_single_device(self):
+        from paddle_tpu.models import GPTForCausalLM
+
+        cfg, m = self._model()
+        ids = _ids(cfg)
+        ref = m(ids).numpy()
+        topology.init_mesh(mp=4)
+        try:
+            paddle.seed(0)
+            m2 = GPTForCausalLM(cfg)
+            apply_param_shardings(m2)
+            np.testing.assert_allclose(m2(ids).numpy(), ref,
+                                       rtol=2e-4, atol=2e-4)
+        finally:
+            topology._global_mesh = None
+            topology._global_hcg = None
+
+
+    def test_recompute_flag_matches_plain_forward(self):
+        from paddle_tpu.jit import to_static
+        from paddle_tpu.models import (
+            GPTConfig,
+            GPTForCausalLM,
+            GPTPretrainingCriterion,
+        )
+
+        paddle.seed(0)
+        cfg = GPTConfig.tiny(recompute=True)
+        m = GPTForCausalLM(cfg)
+        crit = GPTPretrainingCriterion(cfg)
+        ids = _ids(cfg)
+        m.train()
+
+        @to_static
+        def loss_fn(x):
+            loss = crit(m(x), x)
+            loss.backward()
+            g = m.gpt.layers[0].attn.qkv_proj.weight.grad
+            m.clear_gradients()
+            return loss, g
+
+        loss_r, grad_r = loss_fn(ids)
+        m.config.recompute = False
+        loss_p = crit(m(ids), ids)
+        loss_p.backward()
+        grad_p = m.gpt.layers[0].attn.qkv_proj.weight.grad
+        np.testing.assert_allclose(float(loss_r), float(loss_p), rtol=1e-5)
+        np.testing.assert_allclose(grad_r.numpy(), grad_p.numpy(),
+                                   rtol=1e-4, atol=1e-6)
+
+    def test_seed_controls_position_embeddings(self):
+        from paddle_tpu.models import GPTConfig, GPTModel
+
+        paddle.seed(1)
+        a = GPTModel(GPTConfig.tiny()).position_embeddings.numpy()
+        paddle.seed(2)
+        b = GPTModel(GPTConfig.tiny()).position_embeddings.numpy()
+        paddle.seed(1)
+        c = GPTModel(GPTConfig.tiny()).position_embeddings.numpy()
+        assert not np.allclose(a, b)
+        np.testing.assert_array_equal(a, c)
+
+
+class TestNamedMoEConfigs:
+    def test_deepseek_and_qwen2_shapes(self):
+        c = LlamaConfig.deepseek_moe_16b()
+        assert (c.num_experts, c.num_experts_per_tok,
+                c.num_shared_experts) == (64, 6, 2)
+        assert c.hidden_size == 2048 and c.num_hidden_layers == 28
+        q = LlamaConfig.qwen2_moe_a14b()
+        assert (q.num_experts, q.num_experts_per_tok) == (64, 8)
+        assert q.num_attention_heads // q.num_key_value_heads == 7

@@ -5,7 +5,7 @@ sep, mp] replaces NCCL process groups; XLA collectives over named axes
 replace collective kernels; GSPMD shardings replace the reshard lattice.
 """
 
-from . import auto_tuner, checkpoint, collective, env, launch, rpc, topology, watchdog  # noqa: F401
+from . import auto_tuner, checkpoint, collective, env, io, launch, rpc, topology, watchdog  # noqa: F401
 from .auto_tuner import AutoTuner, ModelSpec, TuneConfig  # noqa: F401
 from .checkpoint import load_state_dict, save_state_dict  # noqa: F401
 from .spawn import spawn  # noqa: F401
@@ -32,11 +32,43 @@ from .collective import (  # noqa: F401
     alltoall_single,
     barrier,
     broadcast,
+    irecv,
+    isend,
     new_group,
+    recv,
     reduce,
     reduce_scatter,
     scatter,
+    send,
     wait,
+)
+from .api_tail import (  # noqa: F401
+    CountFilterEntry,
+    DistModel,
+    InMemoryDataset,
+    ParallelEnv,
+    ParallelMode,
+    ProbabilityEntry,
+    QueueDataset,
+    ReduceType,
+    ShowClickEntry,
+    Strategy,
+    all_gather_object,
+    broadcast_object_list,
+    destroy_process_group,
+    gather,
+    get_backend,
+    get_group,
+    gloo_barrier,
+    gloo_init_parallel_env,
+    gloo_release,
+    is_available,
+    scatter_object_list,
+    shard_dataloader,
+    shard_scaler,
+    split,
+    to_static,
+    unshard_dtensor,
 )
 from .env import get_rank, get_world_size, init_parallel_env, is_initialized  # noqa: F401
 from .parallel import DataParallel  # noqa: F401

@@ -1,0 +1,481 @@
+"""Distributed API tail (``python/paddle/distributed/__init__.py``
+surface): environment/introspection classes, object collectives, the
+``split`` sharded-layer op, semi-auto static entry points, and the PS
+dataset/entry configuration carriers.
+
+Multi-process object collectives ride ``multihost_utils.process_allgather``
+over pickled byte buffers (the Gloo path that already carries the tensor
+collectives); in a single process they degrade to local list ops, matching
+the reference's single-card behavior.
+"""
+
+from __future__ import annotations
+
+import pickle
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import jax
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+# --- environment / introspection ------------------------------------------
+
+class ParallelEnv:
+    """(``parallel.py`` ParallelEnv) legacy env facade."""
+
+    @property
+    def rank(self):
+        return jax.process_index()
+
+    @property
+    def world_size(self):
+        return jax.process_count()
+
+    @property
+    def device_id(self):
+        return jax.local_devices()[0].id
+
+    @property
+    def current_endpoint(self):
+        import os
+
+        return os.environ.get("PADDLE_CURRENT_ENDPOINT", "127.0.0.1:0")
+
+    @property
+    def trainer_endpoints(self):
+        import os
+
+        eps = os.environ.get("DISTRIBUTED_TRAINER_ENDPOINTS", "")
+        return eps.split(",") if eps else []
+
+    @property
+    def nranks(self):
+        return self.world_size
+
+    local_rank = rank
+
+
+class ParallelMode:
+    """(``parallel.py`` ParallelMode) constants."""
+
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+
+
+class ReduceType:
+    """(semi-auto ``ReduceType``) partial-tensor reduction kinds."""
+
+    kRedSum = 0
+    kRedMax = 1
+    kRedMin = 2
+    kRedProd = 3
+    kRedAvg = 4
+
+
+def is_available() -> bool:
+    """(``parallel.py`` is_available) collectives are always available on
+    the XLA substrate (mesh axes carry them)."""
+    return True
+
+
+def get_backend(group=None) -> str:
+    """Communication backend carrying the collectives."""
+    return "xla:" + jax.default_backend()
+
+
+_groups: Dict[int, Any] = {}
+
+
+def get_group(id: int = 0):
+    from .collective import new_group
+
+    if id not in _groups:
+        _groups[id] = new_group(list(range(jax.process_count())))
+    return _groups[id]
+
+
+def destroy_process_group(group=None):
+    if jax.process_count() > 1 and jax.distributed.is_initialized():
+        jax.distributed.shutdown()
+    _groups.clear()
+
+
+def gloo_init_parallel_env(rank_id: int, rank_num: int, server_endpoint: str):
+    """(``parallel_with_gloo.py``) CPU rendezvous — Gloo IS the CPU
+    collective backend here, so this is init_parallel_env with the
+    explicit endpoint."""
+    import os
+
+    os.environ.setdefault("PADDLE_TRAINER_ID", str(rank_id))
+    os.environ.setdefault("PADDLE_TRAINERS_NUM", str(rank_num))
+    os.environ.setdefault("PADDLE_MASTER", server_endpoint)
+    from .env import init_parallel_env
+
+    init_parallel_env()
+
+
+def gloo_barrier():
+    from .collective import barrier
+
+    barrier()
+
+
+def gloo_release():
+    destroy_process_group()
+
+
+# --- object collectives ----------------------------------------------------
+
+_OBJ_BUF = 1 << 16  # fixed lane so every process contributes equal shapes
+
+
+def _obj_to_buf(obj) -> np.ndarray:
+    raw = pickle.dumps(obj)
+    if len(raw) + 8 > _OBJ_BUF:
+        raise ValueError(
+            f"object too large for object-collective buffer "
+            f"({len(raw)} > {_OBJ_BUF - 8} bytes); send tensors instead")
+    buf = np.zeros(_OBJ_BUF, np.uint8)
+    buf[:8] = np.frombuffer(np.int64(len(raw)).tobytes(), np.uint8)
+    buf[8:8 + len(raw)] = np.frombuffer(raw, np.uint8)
+    return buf
+
+
+def _buf_to_obj(buf: np.ndarray):
+    n = int(np.frombuffer(np.asarray(buf[:8], np.uint8).tobytes(), np.int64)[0])
+    return pickle.loads(np.asarray(buf[8:8 + n], np.uint8).tobytes())
+
+
+def _allgather_bufs(buf: np.ndarray) -> List[np.ndarray]:
+    if jax.process_count() == 1:
+        return [buf]
+    from jax.experimental import multihost_utils
+
+    out = multihost_utils.process_allgather(buf)  # (P, _OBJ_BUF)
+    return [np.asarray(out[i]) for i in range(out.shape[0])]
+
+
+def all_gather_object(object_list: List, obj, group=None):
+    """(``communication/all_gather.py`` all_gather_object)."""
+    object_list.clear()
+    object_list.extend(_buf_to_obj(b) for b in _allgather_bufs(_obj_to_buf(obj)))
+
+
+def broadcast_object_list(object_list: List, src: int = 0, group=None):
+    """(``communication/broadcast.py`` broadcast_object_list): every
+    process ends with src's list contents."""
+    payload = list(object_list)
+    gathered = _allgather_bufs(_obj_to_buf(payload))
+    object_list[:] = _buf_to_obj(gathered[src if len(gathered) > src else 0])
+
+
+def scatter_object_list(out_object_list: List, in_object_list=None,
+                        src: int = 0, group=None):
+    """(``communication/scatter.py`` scatter_object_list): process i takes
+    entry i of src's list."""
+    gathered = _allgather_bufs(_obj_to_buf(list(in_object_list or [])))
+    full = _buf_to_obj(gathered[src if len(gathered) > src else 0])
+    rank = jax.process_index()
+    out_object_list[:] = [full[rank]] if rank < len(full) else []
+
+
+def gather(tensor, gather_list=None, dst: int = 0, group=None, sync_op=True):
+    """(``communication/gather.py``) SPMD gather: every process computes
+    the full stack (all-gather); paddle semantics fill ``gather_list`` on
+    ``dst`` — here every rank observes it (harmless superset)."""
+    v = tensor._value if isinstance(tensor, Tensor) else np.asarray(tensor)
+    if jax.process_count() == 1:
+        parts = [np.asarray(v)]
+    else:
+        from jax.experimental import multihost_utils
+
+        out = multihost_utils.process_allgather(np.asarray(v))
+        parts = [np.asarray(out[i]) for i in range(out.shape[0])]
+    if gather_list is not None:
+        gather_list[:] = [Tensor(p) for p in parts]
+    return gather_list
+
+
+# --- sharded-layer split op ------------------------------------------------
+
+_split_layers: List = []  # keep created params alive (reference parity)
+
+
+def split(x, size, operation: str = "linear", axis: int = 0, num_partitions=None,
+          gather_out: bool = True, weight_attr=None, bias_attr=None, name=None):
+    """(``collective.py`` split) build the mp-sharded version of a linear /
+    embedding op: creates the parallel layer (params live on the mesh) and
+    applies it — Megatron column/row split chosen by ``axis`` exactly like
+    the reference."""
+    from ..parallel.mp_layers import (
+        ColumnParallelLinear,
+        RowParallelLinear,
+        VocabParallelEmbedding,
+    )
+
+    if operation == "linear":
+        in_f, out_f = size
+        if axis == 1:
+            layer = ColumnParallelLinear(
+                in_f, out_f, has_bias=bias_attr is not False,
+                gather_output=gather_out, weight_attr=weight_attr)
+        else:
+            layer = RowParallelLinear(
+                in_f, out_f, has_bias=bias_attr is not False,
+                input_is_parallel=False, weight_attr=weight_attr)
+    elif operation == "embedding":
+        n, d = size
+        layer = VocabParallelEmbedding(n, d, weight_attr=weight_attr)
+    else:
+        raise ValueError(f"split: unknown operation {operation!r}")
+    _split_layers.append(layer)
+    return layer(x)
+
+
+def unshard_dtensor(dist_tensor) -> Tensor:
+    """(``api.py`` unshard_dtensor) replicate a sharded tensor."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from .topology import get_mesh
+
+    v = dist_tensor._value if isinstance(dist_tensor, Tensor) else dist_tensor
+    mesh = get_mesh()
+    if mesh is not None and isinstance(v, jax.Array):
+        v = jax.device_put(v, NamedSharding(mesh, PartitionSpec()))
+    out = Tensor(v)
+    out.stop_gradient = getattr(dist_tensor, "stop_gradient", True)
+    return out
+
+
+def shard_dataloader(dataloader, meshes=None, shard_dims="dp",
+                     input_keys=None):
+    """(``auto_parallel/api.py`` shard_dataloader) wrap a dataloader so
+    every yielded tensor is sharded over the ``dp`` mesh axis."""
+    from .auto_parallel import Replicate, Shard, shard_tensor
+    from .topology import get_mesh
+
+    class _Sharded:
+        def __init__(self, dl):
+            self._dl = dl
+
+        def _place(self, t, mesh, axes):
+            placements = [Shard(0) if a == shard_dims else Replicate()
+                          for a in axes]
+            return shard_tensor(t, mesh, placements)
+
+        def __iter__(self):
+            mesh = get_mesh()
+            axes = mesh.axis_names if mesh is not None else ()
+            for batch in self._dl:
+                if mesh is None:
+                    yield batch
+                    continue
+                if isinstance(batch, dict):
+                    keys = input_keys or list(batch)
+                    yield {k: (self._place(v, mesh, axes) if k in keys else v)
+                           for k, v in batch.items()}
+                elif isinstance(batch, (list, tuple)):
+                    yield type(batch)(self._place(t, mesh, axes)
+                                      for t in batch)
+                else:
+                    yield self._place(batch, mesh, axes)
+
+        def __len__(self):
+            return len(self._dl)
+
+    return _Sharded(dataloader)
+
+
+def shard_scaler(scaler):
+    """(``auto_parallel/api.py`` shard_scaler) under GSPMD the scaler's
+    found-inf check already sees GLOBAL gradients (they are one sharded
+    array), so no cross-rank sync wrapper is needed — returned as-is."""
+    return scaler
+
+
+# --- semi-auto static entry points ----------------------------------------
+
+@dataclass
+class Strategy:
+    """(``auto_parallel/strategy.py`` Strategy) config carrier for
+    :func:`to_static`."""
+
+    sharding: Any = None
+    fused_passes: Any = None
+    gradient_merge: Any = None
+    pipeline: Any = None
+    amp: Any = None
+
+
+class DistModel:
+    """(``auto_parallel/api.py`` DistModel) the semi-auto static trainer:
+    wraps (layer, loss, optimizer) into ONE compiled train/eval step via
+    ``to_static`` — the engine role of the reference's
+    ``Engine.fit/evaluate/predict`` triple."""
+
+    def __init__(self, layer, loader=None, loss=None, optimizer=None,
+                 strategy: Optional[Strategy] = None, metrics=None):
+        from ..jit import to_static
+
+        self.network = layer
+        self._loss = loss
+        self._opt = optimizer
+        self._mode = "train"
+        self.strategy = strategy or Strategy()
+
+        def _train_step(*inputs):
+            *xs, label = inputs
+            out = self.network(*xs)
+            loss_v = self._loss(out, label)
+            loss_v.backward()
+            self._opt.step()
+            self._opt.clear_grad()
+            return loss_v
+
+        def _eval_step(*inputs):
+            *xs, label = inputs
+            return self._loss(self.network(*xs), label)
+
+        self._train = to_static(_train_step)
+        self._eval = to_static(_eval_step)
+        self._predict = to_static(lambda *xs: self.network(*xs))
+
+    def train(self):
+        self._mode = "train"
+        self.network.train()
+
+    def eval(self):
+        self._mode = "eval"
+        self.network.eval()
+
+    def predict(self):
+        self._mode = "predict"
+        self.network.eval()
+
+    def __call__(self, *args):
+        if self._mode == "train":
+            return self._train(*args)
+        if self._mode == "eval":
+            return self._eval(*args)
+        return self._predict(*args)
+
+    def state_dict(self, *a, **k):
+        return self.network.state_dict(*a, **k)
+
+    def set_state_dict(self, sd):
+        return self.network.set_state_dict(sd)
+
+    dist_main_program = property(lambda self: None)
+
+
+def to_static(layer, loader=None, loss=None, optimizer=None, strategy=None):
+    """(``auto_parallel/api.py`` dist.to_static) → :class:`DistModel` (+
+    the sharded loader when one is given, like the reference)."""
+    model = DistModel(layer, loader, loss, optimizer, strategy)
+    if loader is not None:
+        return model, shard_dataloader(loader)
+    return model
+
+
+# --- PS dataset / entry configs -------------------------------------------
+
+@dataclass
+class CountFilterEntry:
+    """(``entry_attr.py``) admit a sparse feature after ``count_filter``
+    shows; consumed by the PS sparse table as admission policy metadata."""
+
+    count_filter: int = 10
+
+    def _to_attr(self):
+        return f"count_filter_entry:{self.count_filter}"
+
+
+@dataclass
+class ProbabilityEntry:
+    """(``entry_attr.py``) admit with probability."""
+
+    probability: float = 1.0
+
+    def _to_attr(self):
+        return f"probability_entry:{self.probability}"
+
+
+@dataclass
+class ShowClickEntry:
+    """(``entry_attr.py``) show/click-weighted entry."""
+
+    show_name: str = "show"
+    click_name: str = "click"
+
+    def _to_attr(self):
+        return f"show_click_entry:{self.show_name}:{self.click_name}"
+
+
+class InMemoryDataset:
+    """(``distributed/fleet/dataset`` InMemoryDataset) minimal host-memory
+    dataset for PS training: file list in, shuffled line batches out."""
+
+    def __init__(self):
+        self._files: List[str] = []
+        self._lines: List[str] = []
+        self._batch = 1
+        self._parser = None
+
+    def init(self, batch_size=1, thread_num=1, pipe_command=None,
+             use_var=None, **kw):
+        self._batch = batch_size
+        return self
+
+    set_batch_size = init
+
+    def set_filelist(self, files):
+        self._files = list(files)
+
+    def set_parse_func(self, fn):
+        self._parser = fn
+
+    def load_into_memory(self):
+        self._lines = []
+        for f in self._files:
+            with open(f) as fh:
+                self._lines.extend(ln.rstrip("\n") for ln in fh)
+
+    def local_shuffle(self, seed=0):
+        rng = np.random.default_rng(seed)
+        rng.shuffle(self._lines)
+
+    global_shuffle = local_shuffle
+
+    def release_memory(self):
+        self._lines = []
+
+    def get_memory_data_size(self):
+        return len(self._lines)
+
+    def __iter__(self):
+        parse = self._parser or (lambda s: s)
+        for i in range(0, len(self._lines), self._batch):
+            yield [parse(s) for s in self._lines[i:i + self._batch]]
+
+
+class QueueDataset(InMemoryDataset):
+    """(``dataset`` QueueDataset) streaming variant: iterates files
+    directly without the in-memory stage."""
+
+    def __iter__(self):
+        parse = self._parser or (lambda s: s)
+        batch = []
+        for f in self._files:
+            with open(f) as fh:
+                for ln in fh:
+                    batch.append(parse(ln.rstrip("\n")))
+                    if len(batch) == self._batch:
+                        yield batch
+                        batch = []
+        if batch:
+            yield batch

@@ -219,3 +219,11 @@ def new_group(ranks=None, backend=None, timeout=None):
 def wait(tensor, group=None, use_calc_stream=True):
     if isinstance(tensor, Tensor) and not isinstance(tensor._value, jax.core.Tracer):
         tensor._value.block_until_ready()
+
+
+def isend(tensor: Tensor, dst: int = 0, group=None):
+    send(tensor, dst, group)  # raises with the p2p guidance
+
+
+def irecv(tensor: Tensor, src: int = 0, group=None):
+    recv(tensor, src, group)  # raises with the p2p guidance

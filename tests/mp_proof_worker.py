@@ -111,6 +111,17 @@ def main():
     got = model2(x).numpy()
     assert np.array_equal(ref, got), float(np.abs(ref - got).max())
 
+    # ---- object collectives across REAL processes ----------------------
+    objs = []
+    dist.all_gather_object(objs, {"rank": rank, "tag": "hello"})
+    assert [o["rank"] for o in objs] == [0, 1], objs
+    blist = [f"from-{rank}"] if rank == 0 else ["stale"]
+    dist.broadcast_object_list(blist, src=0)
+    assert blist == ["from-0"], blist
+    sc = []
+    dist.scatter_object_list(sc, ["part0", "part1"], src=0)
+    assert sc == [f"part{rank}"], sc
+
     print("MP_PROOF_OK " + json.dumps({
         "rank": rank,
         "dp_rank": hcg.get_data_parallel_rank(),

@@ -369,3 +369,47 @@ class TestSaveLoad:
         loaded = paddle.jit.load(path)
         out = loaded(x).numpy()
         np.testing.assert_allclose(out, expected, rtol=1e-5)
+
+
+class TestIgnoreModule:
+    """jit.ignore_module: registered modules never trace — direct calls run
+    eagerly; nested calls graph-break the OUTER trace (SOT skip-frame)."""
+
+    def test_direct_call_stays_eager(self):
+        import sys
+
+        import paddle_tpu.jit as pjit
+
+        def f(x):
+            return x * 2
+
+        fn = paddle.jit.to_static(f)
+        pjit.ignore_module(sys.modules[__name__])
+        try:
+            out = fn(paddle.to_tensor(np.ones(3, np.float32)))
+            np.testing.assert_allclose(out.numpy(), 2.0)
+            assert len(fn._cache) == 0  # never compiled
+        finally:
+            pjit._ignored_modules.discard(__name__)
+
+    def test_nested_call_breaks_outer_graph(self):
+        import paddle_tpu.jit as pjit
+
+        def inner(x):
+            return x + 1
+
+        inner.__module__ = "fake_vendor_mod"  # only the INNER is ignored
+        inner_s = paddle.jit.to_static(inner)
+
+        def outer(x):
+            return inner_s(x) * 3
+
+        outer_s = paddle.jit.to_static(outer)
+        pjit.ignore_module("fake_vendor_mod")
+        try:
+            with pytest.warns(UserWarning, match="graph break"):
+                out = outer_s(paddle.to_tensor(np.ones(2, np.float32)))
+            np.testing.assert_allclose(out.numpy(), 6.0)
+            assert len(outer_s._cache) == 0
+        finally:
+            pjit._ignored_modules.discard("fake_vendor_mod")

@@ -7,6 +7,10 @@ import sys
 import textwrap
 import time
 
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
 from paddle_tpu.distributed.launch.main import ELASTIC_EXIT_CODE, launch
 
 
@@ -249,3 +253,100 @@ class TestElasticAtomicRegistry:
             rc = lm.launch("noscript.py", elastic=True, max_restarts=0)
         assert rc == 0
         assert calls["n"] == 2  # relaunched once despite max_restarts=0
+
+
+class TestDistributedApiTail:
+    """r4 parity tail for paddle.distributed (env classes, object
+    collectives single-process forms, split, datasets; the cross-process
+    forms run inside tests/mp_proof_worker.py)."""
+
+    def test_env_and_introspection(self):
+        import paddle_tpu.distributed as dist
+
+        env = dist.ParallelEnv()
+        assert env.rank == 0 and env.world_size == 1
+        assert dist.is_available()
+        assert dist.get_backend().startswith("xla:")
+        assert dist.get_group(0).world_size >= 1
+        assert dist.ParallelMode.SHARDING_PARALLEL == 3
+        assert dist.ReduceType.kRedSum == 0
+
+    def test_object_collectives_single_process(self):
+        import paddle_tpu.distributed as dist
+
+        out = []
+        dist.all_gather_object(out, {"a": 1})
+        assert out == [{"a": 1}]
+        lst = [1, 2, 3]
+        dist.broadcast_object_list(lst, src=0)
+        assert lst == [1, 2, 3]
+        res = []
+        dist.scatter_object_list(res, ["only"], src=0)
+        assert res == ["only"]
+        gl = []
+        dist.gather(paddle.to_tensor(np.arange(3.0, dtype=np.float32)), gl)
+        assert len(gl) == 1
+
+    def test_split_linear_and_embedding(self):
+        import paddle_tpu.distributed as dist
+        from paddle_tpu.distributed import topology
+
+        topology.init_mesh(mp=4)
+        try:
+            paddle.seed(0)
+            x = paddle.to_tensor(
+                np.random.default_rng(0).normal(size=(2, 8)).astype("float32"))
+            y = dist.split(x, (8, 12), operation="linear", axis=1)
+            assert tuple(y.shape) == (2, 12)
+            e = dist.split(paddle.to_tensor(np.array([[1, 2]], np.int64)),
+                           (32, 16), operation="embedding")
+            assert tuple(e.shape) == (1, 2, 16)
+            with pytest.raises(ValueError):
+                dist.split(x, (8, 8), operation="conv")
+        finally:
+            topology._global_mesh = None
+            topology._global_hcg = None
+
+    def test_datasets_and_entries(self, tmp_path):
+        import paddle_tpu.distributed as dist
+
+        f = tmp_path / "data.txt"
+        f.write_text("a 1\nb 2\nc 3\n")
+        ds = dist.InMemoryDataset()
+        ds.init(batch_size=2)
+        ds.set_filelist([str(f)])
+        ds.load_into_memory()
+        assert ds.get_memory_data_size() == 3
+        assert [len(b) for b in ds] == [2, 1]
+        ds.local_shuffle(seed=1)
+        ds.release_memory()
+        assert ds.get_memory_data_size() == 0
+        q = dist.QueueDataset()
+        q.init(batch_size=3)
+        q.set_filelist([str(f)])
+        assert [len(b) for b in q] == [3]
+        assert "5" in dist.CountFilterEntry(5)._to_attr()
+        assert "show" in dist.ShowClickEntry()._to_attr()
+
+    def test_dist_model_trains(self):
+        import paddle_tpu.distributed as dist
+        from paddle_tpu import nn
+
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 1))
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=net.parameters())
+        model = dist.to_static(net, loss=nn.MSELoss(), optimizer=opt)
+        model.train()
+        rng = np.random.default_rng(0)
+        W = rng.normal(size=(8, 1)).astype(np.float32)
+        first = last = None
+        for _ in range(40):
+            xb = rng.normal(size=(16, 8)).astype(np.float32)
+            l = model(paddle.to_tensor(xb), paddle.to_tensor(xb @ W))
+            first = first if first is not None else float(l)
+            last = float(l)
+        assert last < 0.1 * first, (first, last)
+        model.eval()
+        assert np.isfinite(float(model(paddle.to_tensor(xb),
+                                       paddle.to_tensor(xb @ W))))

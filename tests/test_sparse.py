@@ -560,3 +560,44 @@ class TestSparseConvOnnz:
         me, ve = stats(x.bcoo.indices, x.bcoo.data, bn_e)
         np.testing.assert_allclose(np.asarray(mj), np.asarray(me), rtol=1e-4)
         np.testing.assert_allclose(np.asarray(vj), np.asarray(ve), rtol=1e-4)
+
+
+class TestSparseTailR4:
+    """r4 parity tail: isnan, slice, pca_lowrank (all O(nnz))."""
+
+    def test_isnan_pattern_preserving(self):
+        d = np.array([[1.0, 0, np.nan], [0, 2.0, 0]], "float32")
+        idx = np.argwhere((d != 0) | np.isnan(d)).astype(np.int32)
+        s = sp.SparseCooTensor(jsparse.BCOO(
+            (jnp.asarray(d[tuple(idx.T)]), jnp.asarray(idx)), shape=d.shape))
+        m = sp.isnan(s)
+        assert m.nnz == s.nnz
+        got = np.asarray(m.bcoo.data)
+        np.testing.assert_array_equal(got, np.isnan(d[tuple(idx.T)]))
+
+    def test_slice_matches_dense(self):
+        d = np.zeros((5, 6), "float32")
+        d[1, 1], d[3, 4], d[4, 5] = 1, 2, 3
+        i = np.argwhere(d != 0).astype(np.int32)
+        s = sp.SparseCooTensor(jsparse.BCOO(
+            (jnp.asarray(d[tuple(i.T)]), jnp.asarray(i)), shape=d.shape))
+        out = sp.slice(s, [0, 1], [1, 1], [4, 5])
+        np.testing.assert_allclose(out.to_dense().numpy(), d[1:4, 1:5])
+        assert out.nnz == 2  # only in-window entries survive
+        neg = sp.slice(s, [1], [-5], [-1])  # negative indexing
+        np.testing.assert_allclose(neg.to_dense().numpy(), d[:, 1:5])
+
+    def test_pca_lowrank_top_components(self):
+        rng = np.random.default_rng(0)
+        base = rng.normal(size=(40, 3)) @ rng.normal(size=(3, 20))
+        dm = np.where(rng.random((40, 20)) < 0.3, base, 0).astype("float32")
+        im = np.argwhere(dm != 0).astype(np.int32)
+        s = sp.SparseCooTensor(jsparse.BCOO(
+            (jnp.asarray(dm[tuple(im.T)]), jnp.asarray(im)), shape=dm.shape))
+        U, S, V = sp.pca_lowrank(s, q=5)
+        assert U.shape == [40, 5] and S.shape == [5] and V.shape == [20, 5]
+        ref = np.linalg.svd(dm - dm.mean(0, keepdims=True),
+                            compute_uv=False)[:3]
+        # leading components are accurate; the tail of a randomized
+        # sketch is approximate by construction
+        np.testing.assert_allclose(np.asarray(S.numpy())[:3], ref, rtol=0.02)

@@ -484,3 +484,119 @@ class TestStaticTraining:
                                          parameters=net.parameters())
             with pytest.raises(NotImplementedError, match="static"):
                 LookAhead(inner).minimize(loss)
+
+
+class TestStaticApiTail:
+    """r4 parity tail for paddle.static (io family, gradients, py_func,
+    metrics, EMA, CompiledProgram, scope_guard, places)."""
+
+    def _forward_prog(self):
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [3, 4], "float32")
+            y = net(x)
+        return net, prog, x, y
+
+    def test_compiled_program_and_inference_roundtrip(self, tmp_path):
+        net, prog, x, y = self._forward_prog()
+        exe = static.Executor()
+        feed = np.random.default_rng(0).normal(size=(3, 4)).astype("float32")
+        (ref,) = exe.run(prog, feed={"x": feed}, fetch_list=[y])
+        (jit_out,) = exe.run(static.CompiledProgram(prog),
+                             feed={"x": feed}, fetch_list=[y])
+        np.testing.assert_allclose(jit_out, ref, rtol=1e-6)
+        prefix = str(tmp_path / "infer")
+        static.save_inference_model(prefix, [x], [y], exe, program=prog)
+        lp, feeds, fetches = static.load_inference_model(prefix, exe)
+        (out2,) = exe.run(lp, feed={feeds[0]: feed}, fetch_list=fetches)
+        np.testing.assert_allclose(out2, ref, rtol=1e-6)
+
+    def test_save_load_state_roundtrip(self, tmp_path):
+        net, prog, x, y = self._forward_prog()
+        path = str(tmp_path / "m")
+        static.save(prog, path)
+        old = net[0].weight.numpy().copy()
+        net[0].weight.set_value(old * 0)
+        static.load(prog, path)
+        np.testing.assert_allclose(net[0].weight.numpy(), old)
+        state = static.load_program_state(path)
+        assert any(v.shape == (4, 8) for v in state.values())
+
+    def test_normalize_program_prunes_dead_ops(self):
+        net, prog, x, y = self._forward_prog()
+        with static.program_guard(prog):
+            dead = x * 123.0  # unused by y
+        pruned = static.normalize_program(prog, [x], [y])
+        assert len(pruned.nodes) < len(prog.nodes)
+        exe = static.Executor()
+        feed = np.random.default_rng(1).normal(size=(3, 4)).astype("float32")
+        (a,) = exe.run(prog, feed={"x": feed}, fetch_list=[y])
+        (b,) = exe.run(pruned, feed={"x": feed}, fetch_list=[y])
+        np.testing.assert_allclose(a, b, rtol=1e-6)
+
+    def test_gradients_matches_manual(self):
+        prog = static.Program()
+        with static.program_guard(prog):
+            a = static.data("a", [4, 3], "float32")
+            w = static.create_parameter([3, 2], "float32")
+            out = (a @ w).sum()
+            (gw,) = static.gradients(out, [w])
+        exe = static.Executor()
+        feed = np.random.default_rng(0).normal(size=(4, 3)).astype("float32")
+        (g,) = exe.run(prog, feed={"a": feed}, fetch_list=[gw])
+        # d(sum(aw))/dw = a^T @ ones
+        np.testing.assert_allclose(g, feed.T @ np.ones((4, 2), "float32"),
+                                   rtol=1e-5)
+
+    def test_py_func_with_backward(self):
+        prog = static.Program()
+        with static.program_guard(prog):
+            b = static.data("b", [2, 2], "float32")
+            out = static.py_func(lambda v: v * 3.0, b, b,
+                                 backward_func=lambda v, g: g * 3.0)
+            s = out.sum()
+            (gb,) = static.gradients(s, [b])
+        exe = static.Executor()
+        feed = np.ones((2, 2), np.float32)
+        o, g = exe.run(prog, feed={"b": feed}, fetch_list=[out, gb])
+        np.testing.assert_allclose(o, 3.0)
+        np.testing.assert_allclose(g, 3.0)
+
+    def test_metrics_and_ema(self):
+        logits = paddle.to_tensor(
+            np.array([[0.1, 0.9], [0.8, 0.2]], "float32"))
+        lab = paddle.to_tensor(np.array([[1], [0]], np.int64))
+        assert float(static.accuracy(logits, lab)) == 1.0
+        assert float(static.auc(logits, lab)) == 1.0
+
+        prog = static.Program()
+        with static.program_guard(prog):
+            static.data("z", [2], "float32")
+            p = static.create_parameter([2], "float32")
+            ema = static.ExponentialMovingAverage(0.9)
+        orig = p.numpy().copy()
+        ema.update()                      # shadow seeds at current value
+        p.set_value(orig + 1.0)
+        ema.update()                      # shadow trails behind the jump
+        with ema.apply():
+            applied = p.numpy().copy()
+        np.testing.assert_allclose(p.numpy(), orig + 1.0)  # restored
+        assert not np.allclose(applied, orig + 1.0)        # EMA < new value
+        assert np.all(applied > orig - 1e-6)               # but moved toward it
+
+    def test_scope_guard_and_places(self):
+        sc = static.Scope()
+        with static.scope_guard(sc):
+            assert static.global_scope() is sc
+        assert static.global_scope() is not sc
+        assert len(static.cpu_places(2)) == 2
+        assert static.cuda_places() == [] and static.xpu_places() == []
+        with static.device_guard("cpu:0"):
+            pass
+        with pytest.raises(NotImplementedError):
+            static.IpuStrategy()
+        with pytest.raises(NotImplementedError):
+            static.WeightNormParamAttr()
+        assert static.Variable is paddle.Tensor

@@ -328,6 +328,7 @@ class TestDistributedApiTail:
         assert "5" in dist.CountFilterEntry(5)._to_attr()
         assert "show" in dist.ShowClickEntry()._to_attr()
 
+    @pytest.mark.slow
     def test_dist_model_trains(self):
         import paddle_tpu.distributed as dist
         from paddle_tpu import nn

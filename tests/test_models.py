@@ -221,6 +221,7 @@ class TestGPT:
                                    atol=1e-6)
         assert not np.allclose(base[:, 10:], got[:, 10:])
 
+    @pytest.mark.slow
     def test_train_step_learns(self):
         from paddle_tpu.jit import to_static
         from paddle_tpu.models import GPTPretrainingCriterion
@@ -245,6 +246,7 @@ class TestGPT:
             last = float(step(data))
         assert last < 0.5 * first, (first, last)
 
+    @pytest.mark.slow
     def test_tp_matches_single_device(self):
         from paddle_tpu.models import GPTForCausalLM
 
@@ -263,6 +265,7 @@ class TestGPT:
             topology._global_hcg = None
 
 
+    @pytest.mark.slow
     def test_recompute_flag_matches_plain_forward(self):
         from paddle_tpu.jit import to_static
         from paddle_tpu.models import (

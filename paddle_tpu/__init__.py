@@ -56,6 +56,8 @@ from . import amp  # noqa: F401
 from . import autograd  # noqa: F401
 from . import device  # noqa: F401
 from . import distributed  # noqa: F401
+from . import hub  # noqa: F401
+from . import regularizer  # noqa: F401
 from . import audio  # noqa: F401
 from . import distribution  # noqa: F401
 from . import fft  # noqa: F401

@@ -6,4 +6,7 @@ from __future__ import annotations
 from . import functional  # noqa: F401
 from .features import LogMelSpectrogram, MFCC, MelSpectrogram, Spectrogram  # noqa: F401
 
+from . import backends  # noqa: F401,E402
+from .backends import info, load, save  # noqa: F401,E402
+
 from . import datasets  # noqa: F401,E402

@@ -1,0 +1,107 @@
+"""``paddle.audio.backends`` (``audio/backends/`` capability): wave IO.
+
+The reference dispatches to soundfile when installed and ships a
+wave-backend fallback; this build implements the wave backend directly
+(stdlib ``wave`` handles PCM WAV — no extra dependency) with the same
+load/save/info surface.
+"""
+
+from __future__ import annotations
+
+import wave
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..core.tensor import Tensor, to_tensor
+
+__all__ = ["list_available_backends", "get_current_backend", "set_backend",
+           "load", "save", "info", "AudioInfo"]
+
+_backend = "wave_backend"
+
+
+def list_available_backends():
+    out = ["wave_backend"]
+    try:
+        import soundfile  # noqa: F401
+
+        out.append("soundfile")
+    except ImportError:
+        pass
+    return out
+
+
+def get_current_backend() -> str:
+    return _backend
+
+
+def set_backend(backend_name: str):
+    global _backend
+    if backend_name not in list_available_backends():
+        raise NotImplementedError(
+            f"audio backend {backend_name!r} not available "
+            f"(have {list_available_backends()})")
+    _backend = backend_name
+
+
+@dataclass
+class AudioInfo:
+    """(``backends/backend.py`` AudioInfo)."""
+
+    sample_rate: int
+    num_samples: int
+    num_channels: int
+    bits_per_sample: int
+    encoding: str = "PCM_S"
+
+
+def info(filepath: str) -> AudioInfo:
+    with wave.open(filepath, "rb") as w:
+        return AudioInfo(sample_rate=w.getframerate(),
+                         num_samples=w.getnframes(),
+                         num_channels=w.getnchannels(),
+                         bits_per_sample=8 * w.getsampwidth())
+
+
+def load(filepath: str, frame_offset: int = 0, num_frames: int = -1,
+         normalize: bool = True, channels_first: bool = True):
+    """Returns ``(waveform Tensor [C, L] (channels_first), sample_rate)``."""
+    with wave.open(filepath, "rb") as w:
+        sr = w.getframerate()
+        n = w.getnframes()
+        w.setpos(min(frame_offset, n))
+        count = n - frame_offset if num_frames < 0 else num_frames
+        raw = w.readframes(count)
+        width = w.getsampwidth()
+        ch = w.getnchannels()
+    dtype = {1: np.uint8, 2: np.int16, 4: np.int32}[width]
+    data = np.frombuffer(raw, dtype=dtype).reshape(-1, ch)
+    if width == 1:
+        x = (data.astype(np.float32) - 128.0) / 128.0
+    else:
+        x = data.astype(np.float32) / float(2 ** (8 * width - 1))
+    if not normalize:
+        x = data.astype(np.float32)
+    x = x.T if channels_first else x
+    return to_tensor(np.ascontiguousarray(x)), sr
+
+
+def save(filepath: str, src, sample_rate: int, channels_first: bool = True,
+         encoding: str = "PCM_16", bits_per_sample: int = 16):
+    v = np.asarray(src.numpy() if isinstance(src, Tensor) else src)
+    if channels_first:
+        v = v.T
+    if v.ndim == 1:
+        v = v[:, None]
+    width = bits_per_sample // 8
+    if v.dtype.kind == "f":
+        scaled = np.clip(v, -1.0, 1.0) * (2 ** (bits_per_sample - 1) - 1)
+        pcm = scaled.astype({2: np.int16, 4: np.int32}[width])
+    else:
+        pcm = v.astype({2: np.int16, 4: np.int32}[width])
+    with wave.open(filepath, "wb") as w:
+        w.setnchannels(v.shape[1])
+        w.setsampwidth(width)
+        w.setframerate(int(sample_rate))
+        w.writeframes(pcm.tobytes())

@@ -1,0 +1,67 @@
+"""``paddle.hub`` (``python/paddle/hapi/hub.py`` capability): list/help/
+load entrypoints from a ``hubconf.py``.
+
+TPU-first scope: ``source='local'`` works fully (a directory with
+hubconf.py, exactly the reference contract); github/gitee sources need
+network egress, which this environment does not have — they raise with
+that reason rather than pretending."""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+__all__ = ["list", "help", "load"]
+
+_HUBCONF = "hubconf.py"
+
+
+def _load_hubconf(repo_dir: str):
+    path = os.path.join(repo_dir, _HUBCONF)
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"no {_HUBCONF} in {repo_dir}")
+    spec = importlib.util.spec_from_file_location("paddle_tpu_hubconf", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.path.insert(0, repo_dir)
+    try:
+        spec.loader.exec_module(mod)
+    finally:
+        sys.path.remove(repo_dir)
+    return mod
+
+
+def _resolve(repo_dir: str, source: str):
+    if source != "local":
+        raise NotImplementedError(
+            f"paddle.hub source={source!r} needs network access (github/"
+            "gitee clone); this environment has no egress — use "
+            "source='local' with a checked-out repo directory")
+    return _load_hubconf(repo_dir)
+
+
+def list(repo_dir: str, source: str = "local", force_reload: bool = False):
+    """Entrypoint names exported by the repo's hubconf."""
+    mod = _resolve(repo_dir, source)
+    return [n for n in dir(mod)
+            if callable(getattr(mod, n)) and not n.startswith("_")]
+
+
+def help(repo_dir: str, model: str, source: str = "local",
+         force_reload: bool = False):
+    """Docstring of one entrypoint."""
+    mod = _resolve(repo_dir, source)
+    fn = getattr(mod, model, None)
+    if fn is None:
+        raise RuntimeError(f"hub entrypoint {model!r} not found")
+    return fn.__doc__
+
+
+def load(repo_dir: str, model: str, source: str = "local",
+         force_reload: bool = False, **kwargs):
+    """Call the entrypoint and return the model it builds."""
+    mod = _resolve(repo_dir, source)
+    fn = getattr(mod, model, None)
+    if fn is None:
+        raise RuntimeError(f"hub entrypoint {model!r} not found")
+    return fn(**kwargs)

@@ -1,0 +1,197 @@
+"""Incubate top-level ops (``python/paddle/incubate/__init__.py``
+surface): segment reductions, fused softmax-mask, graph message passing
+and sampling, identity_loss.
+
+TPU-first: segment/fused/message ops are jnp through the dispatch layer
+(XLA lowers the segment reductions to sorted scatters on TPU); the graph
+SAMPLERS are host ops by nature (data-dependent output sizes — same
+reason the reference runs them on dedicated kernels with dynamic
+outputs) and are documented as eager-only.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import run_op
+from ..core.tensor import Tensor, to_tensor
+
+
+def _ensure(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def _num_segments(ids, n):
+    if n is not None:
+        return int(n)
+    arr = np.asarray(ids._value if isinstance(ids, Tensor) else ids)
+    return int(arr.max()) + 1 if arr.size else 0
+
+
+def segment_sum(data, segment_ids, name=None):
+    """(``incubate/tensor/math.py`` segment_sum)."""
+    n = _num_segments(segment_ids, None)
+    return run_op(
+        "segment_sum",
+        lambda v, i: jax.ops.segment_sum(v, i.astype(jnp.int32),
+                                         num_segments=n),
+        _ensure(data), _ensure(segment_ids))
+
+
+def segment_mean(data, segment_ids, name=None):
+    n = _num_segments(segment_ids, None)
+
+    def f(v, i):
+        i = i.astype(jnp.int32)
+        s = jax.ops.segment_sum(v, i, num_segments=n)
+        cnt = jax.ops.segment_sum(jnp.ones_like(i, v.dtype), i,
+                                  num_segments=n)
+        return s / jnp.maximum(cnt, 1).reshape(
+            (-1,) + (1,) * (v.ndim - 1))
+
+    return run_op("segment_mean", f, _ensure(data), _ensure(segment_ids))
+
+
+def segment_max(data, segment_ids, name=None):
+    n = _num_segments(segment_ids, None)
+
+    def f(v, i):
+        out = jax.ops.segment_max(v, i.astype(jnp.int32), num_segments=n)
+        return jnp.where(jnp.isneginf(out), 0.0, out)  # ref: empty seg = 0
+
+    return run_op("segment_max", f, _ensure(data), _ensure(segment_ids))
+
+
+def segment_min(data, segment_ids, name=None):
+    n = _num_segments(segment_ids, None)
+
+    def f(v, i):
+        out = jax.ops.segment_min(v, i.astype(jnp.int32), num_segments=n)
+        return jnp.where(jnp.isposinf(out), 0.0, out)
+
+    return run_op("segment_min", f, _ensure(data), _ensure(segment_ids))
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """(``incubate/operators/softmax_mask_fuse.py``) softmax(x + mask) in
+    one fused op (XLA fuses it; the reference ships a CUDA kernel)."""
+    return run_op("softmax_mask_fuse",
+                  lambda v, m: jax.nn.softmax(v + m, axis=-1),
+                  _ensure(x), _ensure(mask))
+
+
+def softmax_mask_fuse_upper_triangle(x, name=None):
+    """(``softmax_mask_fuse_upper_triangle``) causal-masked softmax: the
+    upper triangle (future positions) is masked out."""
+
+    def f(v):
+        S = v.shape[-1]
+        causal = jnp.tril(jnp.ones((v.shape[-2], S), bool))
+        return jax.nn.softmax(jnp.where(causal, v, -1e4), axis=-1)
+
+    return run_op("softmax_mask_fuse_upper_triangle", f, _ensure(x))
+
+
+def graph_send_recv(x, src_index, dst_index, pool_type="sum",
+                    out_size=None, name=None):
+    """(``incubate/operators/graph_send_recv.py``) message passing:
+    gather ``x`` rows at ``src_index``, reduce them at ``dst_index``."""
+    n = out_size or (_ensure(x).shape[0])
+    pool = pool_type.lower()
+
+    def f(v, src, dst):
+        msgs = jnp.take(v, src.astype(jnp.int32), axis=0)
+        dst = dst.astype(jnp.int32)
+        if pool == "sum":
+            return jax.ops.segment_sum(msgs, dst, num_segments=n)
+        if pool == "mean":
+            s = jax.ops.segment_sum(msgs, dst, num_segments=n)
+            c = jax.ops.segment_sum(jnp.ones_like(dst, v.dtype), dst,
+                                    num_segments=n)
+            return s / jnp.maximum(c, 1).reshape(
+                (-1,) + (1,) * (v.ndim - 1))
+        if pool == "max":
+            out = jax.ops.segment_max(msgs, dst, num_segments=n)
+            return jnp.where(jnp.isneginf(out), 0.0, out)
+        if pool == "min":
+            out = jax.ops.segment_min(msgs, dst, num_segments=n)
+            return jnp.where(jnp.isposinf(out), 0.0, out)
+        raise ValueError(f"unknown pool_type {pool_type!r}")
+
+    return run_op("graph_send_recv", f, _ensure(x), _ensure(src_index),
+                  _ensure(dst_index))
+
+
+def graph_reindex(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  flag_buffer_hashtable=False, name=None):
+    """(``graph_reindex``) relabel a node subset + its neighbor lists with
+    contiguous ids.  Host op (output size is data-dependent)."""
+    xs = np.asarray(_ensure(x)._value)
+    nb = np.asarray(_ensure(neighbors)._value)
+    cnt = np.asarray(_ensure(count)._value)
+    uniq, order = {}, []
+    for v in list(xs) + list(nb):
+        v = int(v)
+        if v not in uniq:
+            uniq[v] = len(uniq)
+            order.append(v)
+    reindex_src = np.array([uniq[int(v)] for v in nb], np.int64)
+    reindex_dst = np.repeat(np.array([uniq[int(v)] for v in xs], np.int64),
+                            cnt.astype(np.int64))
+    return (Tensor(jnp.asarray(reindex_src)),
+            Tensor(jnp.asarray(reindex_dst)),
+            Tensor(jnp.asarray(np.array(order, np.int64))))
+
+
+def graph_sample_neighbors(row, colptr, input_nodes, sample_size=-1,
+                           eids=None, return_eids=False, perm_buffer=None,
+                           flag_perm_buffer=False, name=None):
+    """(``graph_sample_neighbors``) sample up to ``sample_size`` neighbors
+    of each input node from a CSC graph.  Host op (dynamic output)."""
+    r = np.asarray(_ensure(row)._value)
+    cp = np.asarray(_ensure(colptr)._value)
+    nodes = np.asarray(_ensure(input_nodes)._value)
+    rng = np.random.default_rng(0)
+    out, counts = [], []
+    for v in nodes.astype(np.int64):
+        lo, hi = int(cp[v]), int(cp[v + 1])
+        nbrs = r[lo:hi]
+        if sample_size > 0 and nbrs.size > sample_size:
+            nbrs = rng.choice(nbrs, sample_size, replace=False)
+        out.append(nbrs)
+        counts.append(len(nbrs))
+    flat = np.concatenate(out) if out else np.zeros(0, r.dtype)
+    return (Tensor(jnp.asarray(flat)),
+            Tensor(jnp.asarray(np.array(counts, np.int64))))
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       sorted_eids=None, return_eids=False, name=None):
+    """(``graph_khop_sampler``) multi-hop neighbor sampling: repeated
+    :func:`graph_sample_neighbors` + :func:`graph_reindex`."""
+    cur = _ensure(input_nodes)
+    all_nb, all_cnt = [], []
+    for k in sample_sizes:
+        nb, cnt = graph_sample_neighbors(row, colptr, cur, sample_size=k)
+        all_nb.append(np.asarray(nb._value))
+        all_cnt.append(np.asarray(cnt._value))
+        cur = nb
+    nb_flat = np.concatenate(all_nb) if all_nb else np.zeros(0, np.int64)
+    cnt_flat = np.concatenate(all_cnt) if all_cnt else np.zeros(0, np.int64)
+    src, dst, nodes = graph_reindex(
+        input_nodes, Tensor(jnp.asarray(nb_flat)),
+        Tensor(jnp.asarray(cnt_flat)))
+    return src, dst, nodes, Tensor(jnp.asarray(cnt_flat))
+
+
+def identity_loss(x, reduction="none"):
+    """(``incubate/autograd`` identity_loss) mark a value as the loss:
+    reduce per ``reduction`` and return it."""
+    red = {"none": 2, "sum": 1, "mean": 0}.get(reduction, reduction)
+    if red == 0:
+        return run_op("identity_loss", lambda v: v.mean(), _ensure(x))
+    if red == 1:
+        return run_op("identity_loss", lambda v: v.sum(), _ensure(x))
+    return _ensure(x)

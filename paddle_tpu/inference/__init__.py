@@ -276,3 +276,96 @@ class LLMPredictor:
         toks = self._done[seq_id][:max_new_tokens]
         self.free(seq_id)
         return toks
+
+
+class DataType:
+    """(``inference/wrapper.py`` DataType) tensor dtypes of the predictor
+    API."""
+
+    FLOAT32 = "float32"
+    FLOAT16 = "float16"
+    BFLOAT16 = "bfloat16"
+    INT32 = "int32"
+    INT64 = "int64"
+    INT8 = "int8"
+    UINT8 = "uint8"
+    BOOL = "bool"
+
+
+class PlaceType:
+    CPU = "cpu"
+    GPU = "gpu"
+    XPU = "xpu"
+    CUSTOM = "custom"
+    TPU = "tpu"
+
+
+class PrecisionType:
+    Float32 = "float32"
+    Half = "float16"
+    Bfloat16 = "bfloat16"
+    Int8 = "int8"
+
+
+def get_version() -> str:
+    """(``inference`` get_version) the framework version string."""
+    from ..version import full_version
+
+    return f"paddle_tpu inference {full_version}"
+
+
+def get_num_bytes_of_data_type(dtype) -> int:
+    import numpy as _np
+
+    return _np.dtype("float16" if dtype in ("float16", "bfloat16")
+                     else dtype).itemsize
+
+
+class PredictorPool:
+    """(``inference`` PredictorPool) N predictors over one config — on
+    this substrate they share the compiled executable (XLA caches by
+    program), so the pool is N independent session states."""
+
+    def __init__(self, config: Config, size: int = 1):
+        self._preds = [create_predictor(config) for _ in range(size)]
+
+    def retrieve(self, idx: int) -> Predictor:
+        return self._preds[idx]
+
+    def size(self) -> int:
+        return len(self._preds)
+
+
+def convert_to_mixed_precision(model_file, params_file, mixed_model_file,
+                               mixed_params_file, mixed_precision=None,
+                               backend=None, keep_io_types=True,
+                               black_list=None, **kw):
+    raise NotImplementedError(
+        "convert_to_mixed_precision rewrites serialized fp32 programs; on "
+        "this substrate export the model with paddle.amp.auto_cast/"
+        "decorate applied (bf16 on TPU) and jit.save the result instead")
+
+
+def get_trt_compile_version():
+    raise NotImplementedError("TensorRT is CUDA-only — not in a TPU build")
+
+
+def get_trt_runtime_version():
+    raise NotImplementedError("TensorRT is CUDA-only — not in a TPU build")
+
+
+def _get_phi_kernel_name(op_name: str) -> str:
+    """(internal parity helper) kernels are XLA HLO here; the 'phi kernel
+    name' of an op is its dispatch-layer op name unchanged."""
+    return op_name
+
+
+class XpuConfig:
+    """(``inference`` XpuConfig) Kunlun-XPU device knobs — accepted for
+    config-portability; this build targets TPU, so the knobs carry no
+    behavior (the TPU path needs none of them)."""
+
+    def __init__(self):
+        self.device_id = 0
+        self.l3_size = 0
+        self.conv_autotune_level = 0

@@ -77,6 +77,11 @@ class Profiler:
         self._last_t = None
 
     def start(self):
+        if self._on_trace_ready:
+            # handlers configure the output dir (export_chrome_tracing /
+            # export_protobuf set _log_dir) — must happen BEFORE the trace
+            # starts or they would point at an already-written trace
+            self._on_trace_ready(self)
         if not self._timer_only:
             jax.profiler.start_trace(self._log_dir)
             self._active = True
@@ -86,8 +91,6 @@ class Profiler:
         if self._active:
             jax.profiler.stop_trace()
             self._active = False
-        if self._on_trace_ready:
-            self._on_trace_ready(self)
 
     def step(self, num_samples: Optional[int] = None):
         now = time.perf_counter()
@@ -148,3 +151,44 @@ def benchmark():
     yield
     jax.effects_barrier()
     print(f"benchmark: {time.perf_counter() - t0:.4f}s")
+
+
+class SortedKeys(Enum):
+    """(``profiler/profiler_statistic.py`` SortedKeys) summary sort keys."""
+
+    CPUTotal = 0
+    CPUAvg = 1
+    CPUMax = 2
+    CPUMin = 3
+    GPUTotal = 4
+    GPUAvg = 5
+    GPUMax = 6
+    GPUMin = 7
+
+
+class SummaryView(Enum):
+    """(``profiler/profiler.py`` SummaryView) summary table kinds."""
+
+    DeviceView = 0
+    OverView = 1
+    ModelView = 2
+    DistributedView = 3
+    KernelView = 4
+    OperatorView = 5
+    MemoryView = 6
+    MemoryManipulationView = 7
+    UDFView = 8
+
+
+def export_protobuf(dir_name: str, worker_name=None):
+    """(``profiler.py`` export_protobuf) on-trace-ready handler directing
+    the raw XPlane protobuf output (jax.profiler's native format, the
+    artifact TensorBoard ingests) into ``dir_name``."""
+
+    def handler(prof):
+        import os
+
+        os.makedirs(dir_name, exist_ok=True)
+        prof._log_dir = dir_name
+
+    return handler

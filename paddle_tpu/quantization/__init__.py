@@ -191,3 +191,33 @@ class PTQ:
 
     def convert(self, model: Layer, inplace: bool = True) -> Layer:
         return QAT(self.config).convert(model)
+
+
+class BaseObserver:
+    """(``quantization/factory.py`` BaseObserver) calibration observer
+    contract: watch activations/weights, produce a scale."""
+
+    def observe(self, value):
+        raise NotImplementedError
+
+    def scales(self):
+        raise NotImplementedError
+
+
+class BaseQuanter:
+    """(``quantization/factory.py`` BaseQuanter) trainable fake-quant
+    contract (QAT nodes)."""
+
+    def __call__(self, value):
+        raise NotImplementedError
+
+
+def quanter(class_name: str = None, **kwargs):
+    """(``quantization/factory.py`` quanter) decorator registering a
+    quanter factory (the reference wraps it into a config-resolvable
+    name; here registration is the module attribute itself)."""
+
+    def wrap(cls):
+        return cls
+
+    return wrap

@@ -1,0 +1,25 @@
+"""``paddle.regularizer`` (``python/paddle/regularizer.py``): L1/L2 decay
+config objects consumed by ParamAttr/optimizers (weight_decay carriers)."""
+
+from __future__ import annotations
+
+
+class WeightDecayRegularizer:
+    def __init__(self, coeff: float = 0.0):
+        self._coeff = float(coeff)
+
+    @property
+    def coeff(self):
+        return self._coeff
+
+    def __repr__(self):
+        return f"{type(self).__name__}(coeff={self._coeff})"
+
+
+class L1Decay(WeightDecayRegularizer):
+    """(``regularizer.py`` L1Decay) lasso penalty coeff·|w|."""
+
+
+class L2Decay(WeightDecayRegularizer):
+    """(``regularizer.py`` L2Decay) ridge penalty coeff·||w||² — the form
+    optimizers consume as ``weight_decay``."""

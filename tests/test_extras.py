@@ -596,3 +596,173 @@ class TestApiTailRound4:
         Square.apply(x2).backward()
         assert events == []
         np.testing.assert_allclose(x2.grad.numpy(), [4.0])
+
+
+class TestApiTailRound4b:
+    """Second r4 parity sweep: incubate ops, audio IO, hub, utils,
+    regularizer, inference/quantization/profiler tails."""
+
+    def test_incubate_segment_and_graph_ops(self):
+        import paddle_tpu.incubate as inc
+
+        x = paddle.to_tensor(np.array([[1., 2.], [3., 4.], [5., 6.]],
+                                      np.float32))
+        ids = paddle.to_tensor(np.array([0, 0, 1], np.int32))
+        np.testing.assert_allclose(inc.segment_sum(x, ids).numpy(),
+                                   [[4, 6], [5, 6]])
+        np.testing.assert_allclose(inc.segment_mean(x, ids).numpy(),
+                                   [[2, 3], [5, 6]])
+        np.testing.assert_allclose(inc.segment_max(x, ids).numpy(),
+                                   [[3, 4], [5, 6]])
+        np.testing.assert_allclose(inc.segment_min(x, ids).numpy(),
+                                   [[1, 2], [5, 6]])
+        out = inc.graph_send_recv(
+            x, paddle.to_tensor(np.array([0, 1, 2])),
+            paddle.to_tensor(np.array([1, 1, 0])), "sum")
+        np.testing.assert_allclose(out.numpy(), [[5, 6], [4, 6], [0, 0]])
+        src, dst, nodes = inc.graph_reindex(
+            paddle.to_tensor(np.array([10, 20])),
+            paddle.to_tensor(np.array([20, 30, 10])),
+            paddle.to_tensor(np.array([2, 1])))
+        assert nodes.numpy().tolist() == [10, 20, 30]
+        assert float(inc.identity_loss(x, "mean")) == 3.5
+        sm = inc.softmax_mask_fuse_upper_triangle(
+            paddle.to_tensor(np.zeros((1, 1, 3, 3), np.float32)))
+        assert abs(float(sm.numpy()[0, 0, 0, 0]) - 1.0) < 1e-5
+        assert isinstance(inc.LookAhead, type)
+
+    def test_audio_wave_roundtrip(self, tmp_path):
+        sig = np.sin(np.linspace(0, 20, 1600)).astype(np.float32)[None]
+        f = str(tmp_path / "s.wav")
+        paddle.audio.save(f, paddle.to_tensor(sig), 16000)
+        info = paddle.audio.info(f)
+        assert info.sample_rate == 16000 and info.num_channels == 1
+        wav, sr = paddle.audio.load(f)
+        assert sr == 16000
+        np.testing.assert_allclose(wav.numpy()[0], sig[0], atol=1e-3)
+        assert "wave_backend" in paddle.audio.backends.list_available_backends()
+
+    def test_hub_local_source(self, tmp_path):
+        (tmp_path / "hubconf.py").write_text(
+            "def lenet(**kw):\n"
+            "    '''A LeNet entrypoint.'''\n"
+            "    import paddle_tpu as p\n"
+            "    return p.vision.models.LeNet()\n")
+        d = str(tmp_path)
+        assert "lenet" in paddle.hub.list(d)
+        assert "LeNet" in paddle.hub.help(d, "lenet")
+        assert paddle.hub.load(d, "lenet") is not None
+        with pytest.raises(NotImplementedError):
+            paddle.hub.list("user/repo", source="github")
+
+    def test_utils_and_regularizer(self):
+        assert paddle.utils.require_version("2.0.0")
+        with pytest.raises(Exception):
+            paddle.utils.require_version("99.0.0")
+        assert paddle.regularizer.L2Decay(1e-4).coeff == 1e-4
+
+        @paddle.utils.deprecated(update_to="paddle.new_api", since="2.0")
+        def old():
+            return 42
+
+        with pytest.warns(DeprecationWarning):
+            assert old() == 42
+
+    def test_inference_quantization_profiler_tails(self):
+        assert paddle.inference.DataType.BFLOAT16 == "bfloat16"
+        assert paddle.inference.get_num_bytes_of_data_type("int64") == 8
+        assert "inference" in paddle.inference.get_version()
+        assert paddle.inference.XpuConfig().device_id == 0
+        with pytest.raises(NotImplementedError):
+            paddle.inference.get_trt_compile_version()
+        assert paddle.quantization.BaseQuanter and \
+            paddle.quantization.BaseObserver
+        from paddle_tpu.profiler import SortedKeys, SummaryView
+        assert SortedKeys.CPUTotal is not None
+        assert SummaryView.KernelView is not None
+
+
+class TestVisionTailRound4:
+    def test_new_model_families_forward(self):
+        from paddle_tpu.vision import models as M
+
+        x = paddle.to_tensor(np.random.default_rng(0).normal(
+            size=(1, 3, 64, 64)).astype(np.float32))
+        for fn in (M.mobilenet_v1, M.mobilenet_v3_small,
+                   M.shufflenet_v2_x0_25, M.densenet121,
+                   M.resnext50_32x4d, M.wide_resnet50_2):
+            m = fn(num_classes=5)
+            m.eval()
+            assert tuple(m(x).shape) == (1, 5), fn.__name__
+
+    @pytest.mark.slow
+    def test_heavy_model_families_forward(self):
+        from paddle_tpu.vision import models as M
+
+        big = paddle.to_tensor(np.random.default_rng(0).normal(
+            size=(1, 3, 96, 96)).astype(np.float32))
+        for fn in (M.alexnet, M.squeezenet1_0, M.squeezenet1_1):
+            m = fn(num_classes=5)
+            m.eval()
+            assert tuple(m(big).shape) == (1, 5), fn.__name__
+        g = M.googlenet(num_classes=5)
+        g.eval()
+        out, a1, a2 = g(big)
+        assert tuple(out.shape) == (1, 5) and tuple(a1.shape) == (1, 5)
+        iv = M.inception_v3(num_classes=5)
+        iv.eval()
+        x128 = paddle.to_tensor(np.random.default_rng(0).normal(
+            size=(1, 3, 128, 128)).astype(np.float32))
+        assert tuple(iv(x128).shape) == (1, 5)
+
+    def test_datasets_and_image_io(self, tmp_path):
+        from PIL import Image
+
+        from paddle_tpu.vision import datasets as D
+        from paddle_tpu.vision import ops as V
+
+        f = D.Flowers()
+        img, lab = f[3]
+        assert img.shape == (3, 32, 32) and 0 <= int(lab) < 102
+        v = D.VOC2012(mode="test")
+        img, mask = v[0]
+        assert mask.shape == (64, 64) and mask.max() > 0
+        p = str(tmp_path / "x.jpg")
+        Image.fromarray((np.random.default_rng(0).random((16, 16, 3))
+                         * 255).astype("uint8")).save(p)
+        img = V.decode_jpeg(V.read_file(p), mode="rgb")
+        assert tuple(img.shape) == (3, 16, 16)
+
+    def test_generate_proposals_and_yolo_loss(self):
+        from paddle_tpu.vision import ops as V
+
+        rng = np.random.default_rng(0)
+        N, A, H, W = 1, 3, 4, 4
+        scores = rng.random((N, A, H, W)).astype(np.float32)
+        deltas = (rng.random((N, 4 * A, H, W)).astype(np.float32) - .5) * .1
+        anchors = np.tile(np.array([[0, 0, 15, 15], [0, 0, 31, 31],
+                                    [8, 8, 23, 23]], np.float32), (H * W, 1))
+        rois, probs, num = V.generate_proposals(
+            paddle.to_tensor(scores), paddle.to_tensor(deltas),
+            paddle.to_tensor(np.array([[64., 64.]], np.float32)),
+            paddle.to_tensor(anchors),
+            paddle.to_tensor(np.ones_like(anchors) * .1),
+            return_rois_num=True)
+        assert rois.shape[1] == 4
+        assert int(num.numpy()[0]) == rois.shape[0] > 0
+
+        x = paddle.to_tensor(rng.normal(
+            size=(2, 3 * 9, 4, 4)).astype(np.float32))
+        x.stop_gradient = False
+        gt_box = np.zeros((2, 2, 4), np.float32)
+        gt_box[0, 0] = [0.5, 0.5, 0.3, 0.4]
+        gt_box[1, 0] = [0.25, 0.25, 0.2, 0.2]
+        gt_label = np.zeros((2, 2), np.int64)
+        gt_label[0, 0] = 2
+        loss = V.yolo_loss(
+            x, paddle.to_tensor(gt_box), paddle.to_tensor(gt_label),
+            anchors=[10, 13, 16, 30, 33, 23], anchor_mask=[0, 1, 2],
+            class_num=4, ignore_thresh=0.7, downsample_ratio=32)
+        assert tuple(loss.shape) == (2,) and float(loss.sum()) > 0
+        loss.sum().backward()
+        assert float(np.abs(x.grad.numpy()).sum()) > 0

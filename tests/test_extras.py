@@ -683,12 +683,23 @@ class TestApiTailRound4b:
 
 
 class TestVisionTailRound4:
+    @pytest.mark.slow
+    def test_mobilenet_v1_forward(self):
+        from paddle_tpu.vision import models as M
+
+        x = paddle.to_tensor(np.random.default_rng(0).normal(
+            size=(1, 3, 64, 64)).astype(np.float32))
+        m = M.mobilenet_v1(num_classes=5)
+        m.eval()
+        assert tuple(m(x).shape) == (1, 5)
+
+    @pytest.mark.slow
     def test_new_model_families_forward(self):
         from paddle_tpu.vision import models as M
 
         x = paddle.to_tensor(np.random.default_rng(0).normal(
             size=(1, 3, 64, 64)).astype(np.float32))
-        for fn in (M.mobilenet_v1, M.mobilenet_v3_small,
+        for fn in (M.mobilenet_v3_small,
                    M.shufflenet_v2_x0_25, M.densenet121,
                    M.resnext50_32x4d, M.wide_resnet50_2):
             m = fn(num_classes=5)

@@ -10,9 +10,14 @@ O(S) memory in sequence length, matching FlashAttention-2's structure
 but scheduled by the Mosaic pipeline (grid iteration double-buffers the
 next KV block's DMA behind the current block's einsums automatically).
 
-Layout: [B, S, H, D] (paddle flash-attn convention); no transposes — the
-BlockSpec index maps pick the (batch, head) plane directly.  All softmax
-statistics are kept in fp32 regardless of input dtype.
+Public layout: [B, S, H, D] (paddle flash-attn convention).  Internally the
+kernels run on [B, H, S, D]: Mosaic requires the last two dims of every
+block to be divisible by (8, 128) or equal to the array dims, so the
+blocked dims (seq, head_dim) must be the minor-most two — the wrapper
+transposes at entry/exit (a layout change XLA fuses into neighbouring
+ops).  Softmax statistics (lse, delta) travel as [B, H, S, 1] so their
+(block_q, 1) blocks satisfy the same tiling rule.  All statistics are fp32
+regardless of input dtype.
 """
 
 from __future__ import annotations
@@ -24,6 +29,8 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+
+from .pallas_x32 import no_x64
 
 DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128
@@ -59,9 +66,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
 
     @pl.when(run)
     def _step():
-        q = q_ref[0, :, 0, :]                    # [BQ, D]
-        k = k_ref[0, :, 0, :]                    # [BK, D]
-        v = v_ref[0, :, 0, :]                    # [BK, D]
+        q = q_ref[0, 0, :, :]                    # [BQ, D]
+        k = k_ref[0, 0, :, :]                    # [BK, D]
+        v = v_ref[0, 0, :, :]                    # [BK, D]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale   # [BQ, BK]
@@ -86,8 +93,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
     def _finish():
         l = l_ref[:, :1]
         safe_l = jnp.where(l == 0.0, 1.0, l)
-        o_ref[0, :, 0, :] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
-        lse_ref[0, 0, :] = (m_ref[:, 0] + jnp.log(safe_l[:, 0]))
+        o_ref[0, 0, :, :] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
+        lse_ref[0, 0, :, 0] = (m_ref[:, 0] + jnp.log(safe_l[:, 0]))
 
 
 def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
@@ -99,36 +106,42 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
     # index map — no jnp.repeat, no extra KV HBM traffic
     group = H // k.shape[2]
 
+    # kernels run on [B, H, S, D] (Mosaic tiling: blocked dims minor-most)
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal,
         block_q=block_q, block_k=block_k, n_k=n_k)
 
-    out, lse = pl.pallas_call(
-        kernel,
-        grid=(B, H, n_q, n_k),
-        in_specs=[
-            pl.BlockSpec((1, block_q, 1, D), lambda b, h, i, j: (b, i, h, 0)),
-            pl.BlockSpec((1, block_k, 1, D),
-                         lambda b, h, i, j: (b, j, h // group, 0)),
-            pl.BlockSpec((1, block_k, 1, D),
-                         lambda b, h, i, j: (b, j, h // group, 0)),
-        ],
-        out_specs=[
-            pl.BlockSpec((1, block_q, 1, D), lambda b, h, i, j: (b, i, h, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda b, h, i, j: (b, h, i)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((B, S, H, D), q.dtype),
-            jax.ShapeDtypeStruct((B, H, S), jnp.float32),
-        ],
-        scratch_shapes=[
-            pltpu.VMEM((block_q, D), jnp.float32),
-            pltpu.VMEM((block_q, _LANES), jnp.float32),
-            pltpu.VMEM((block_q, _LANES), jnp.float32),
-        ],
-        interpret=_interpret(),
-    )(q, k, v)
-    return out, lse
+    with no_x64():
+        out, lse = pl.pallas_call(
+            kernel,
+            grid=(B, H, n_q, n_k),
+            in_specs=[
+                pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0)),
+                pl.BlockSpec((1, 1, block_k, D),
+                             lambda b, h, i, j: (b, h // group, j, 0)),
+                pl.BlockSpec((1, 1, block_k, D),
+                             lambda b, h, i, j: (b, h // group, j, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0)),
+                pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, j: (b, h, i, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((B, H, S, D), q.dtype),
+                jax.ShapeDtypeStruct((B, H, S, 1), jnp.float32),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((block_q, D), jnp.float32),
+                pltpu.VMEM((block_q, _LANES), jnp.float32),
+                pltpu.VMEM((block_q, _LANES), jnp.float32),
+            ],
+            interpret=_interpret(),
+        )(qt, kt, vt)
+    return out.transpose(0, 2, 1, 3), lse[..., 0]
 
 
 # ---------------------------------------------------------------------------
@@ -150,12 +163,12 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
     @pl.when(run)
     def _step():
-        q = q_ref[0, :, 0, :]
-        k = k_ref[0, :, 0, :]
-        v = v_ref[0, :, 0, :]
-        do = do_ref[0, :, 0, :]
-        lse = lse_ref[0, 0, :][:, None]          # [BQ, 1]
-        delta = delta_ref[0, 0, :][:, None]      # [BQ, 1]
+        q = q_ref[0, 0, :, :]
+        k = k_ref[0, 0, :, :]
+        v = v_ref[0, 0, :, :]
+        do = do_ref[0, 0, :, :]
+        lse = lse_ref[0, 0, :, :]                # [BQ, 1]
+        delta = delta_ref[0, 0, :, :]            # [BQ, 1]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
@@ -174,7 +187,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
     @pl.when(ki == n_k - 1)
     def _finish():
-        dq_ref[0, :, 0, :] = acc_ref[:].astype(dq_ref.dtype)
+        dq_ref[0, 0, :, :] = acc_ref[:].astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
@@ -195,12 +208,12 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(run)
     def _step():
-        q = q_ref[0, :, 0, :]
-        k = k_ref[0, :, 0, :]
-        v = v_ref[0, :, 0, :]
-        do = do_ref[0, :, 0, :]
-        lse = lse_ref[0, 0, :][:, None]
-        delta = delta_ref[0, 0, :][:, None]
+        q = q_ref[0, 0, :, :]
+        k = k_ref[0, 0, :, :]
+        v = v_ref[0, 0, :, :]
+        do = do_ref[0, 0, :, :]
+        lse = lse_ref[0, 0, :, :]                # [BQ, 1]
+        delta = delta_ref[0, 0, :, :]            # [BQ, 1]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
@@ -222,8 +235,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when((gi == group - 1) & (qi == n_q - 1))
     def _finish():
-        dk_ref[0, :, 0, :] = dk_acc[:].astype(dk_ref.dtype)
-        dv_ref[0, :, 0, :] = dv_acc[:].astype(dv_ref.dtype)
+        dk_ref[0, 0, :, :] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0, 0, :, :] = dv_acc[:].astype(dv_ref.dtype)
 
 
 def _flash_bwd(res, g, *, scale, causal, block_q, block_k):
@@ -240,49 +253,60 @@ def _flash_bwd(res, g, *, scale, causal, block_q, block_k):
     delta = jnp.einsum("bshd,bshd->bhs", do.astype(jnp.float32),
                        out.astype(jnp.float32))
 
-    q_spec = pl.BlockSpec((1, block_q, 1, D), lambda b, h, i, j: (b, i, h, 0))
-    k_spec = pl.BlockSpec((1, block_k, 1, D),
-                          lambda b, h, i, j: (b, j, h // group, 0))
-    r_spec = pl.BlockSpec((1, 1, block_q), lambda b, h, i, j: (b, h, i))
+    # kernels run on [B, H, S, D]; stats as [B, H, S, 1] (legal (bq, 1) tiles)
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    dot = do.transpose(0, 2, 1, 3)
+    lse4 = lse[..., None]
+    delta4 = delta[..., None]
 
-    dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k, n_k=n_k),
-        grid=(B, H, n_q, n_k),
-        in_specs=[q_spec, k_spec, k_spec, q_spec, r_spec, r_spec],
-        out_specs=[q_spec],
-        out_shape=[jax.ShapeDtypeStruct((B, S, H, D), q.dtype)],
-        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
-        interpret=_interpret(),
-    )(q, k, v, do, lse, delta)[0]
+    q_spec = pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0))
+    k_spec = pl.BlockSpec((1, 1, block_k, D),
+                          lambda b, h, i, j: (b, h // group, j, 0))
+    r_spec = pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, j: (b, h, i, 0))
+
+    with no_x64():
+        dq = pl.pallas_call(
+            functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                              block_q=block_q, block_k=block_k, n_k=n_k),
+            grid=(B, H, n_q, n_k),
+            in_specs=[q_spec, k_spec, k_spec, q_spec, r_spec, r_spec],
+            out_specs=[q_spec],
+            out_shape=[jax.ShapeDtypeStruct((B, H, S, D), q.dtype)],
+            scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+            interpret=_interpret(),
+        )(qt, kt, vt, dot, lse4, delta4)[0]
 
     # dk/dv: for each KV block, accumulate across the whole query-head group
     # then the q blocks — grid (B, Hkv, n_k, group, n_q), KV block resident
     # in VMEM for the full (group × n_q) sweep
-    q_spec2 = pl.BlockSpec((1, block_q, 1, D),
-                           lambda b, kh, j, g_, i: (b, i, kh * group + g_, 0))
-    k_spec2 = pl.BlockSpec((1, block_k, 1, D),
-                           lambda b, kh, j, g_, i: (b, j, kh, 0))
-    r_spec2 = pl.BlockSpec((1, 1, block_q),
-                           lambda b, kh, j, g_, i: (b, kh * group + g_, i))
-    dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k, n_q=n_q,
-                          group=group),
-        grid=(B, Hkv, n_k, group, n_q),
-        in_specs=[q_spec2, k_spec2, k_spec2, q_spec2, r_spec2, r_spec2],
-        out_specs=[k_spec2, k_spec2],
-        out_shape=[
-            jax.ShapeDtypeStruct((B, Sk, Hkv, D), k.dtype),
-            jax.ShapeDtypeStruct((B, Sk, Hkv, D), v.dtype),
-        ],
-        scratch_shapes=[
-            pltpu.VMEM((block_k, D), jnp.float32),
-            pltpu.VMEM((block_k, D), jnp.float32),
-        ],
-        interpret=_interpret(),
-    )(q, k, v, do, lse, delta)
-    return dq, dk, dv
+    q_spec2 = pl.BlockSpec((1, 1, block_q, D),
+                           lambda b, kh, j, g_, i: (b, kh * group + g_, i, 0))
+    k_spec2 = pl.BlockSpec((1, 1, block_k, D),
+                           lambda b, kh, j, g_, i: (b, kh, j, 0))
+    r_spec2 = pl.BlockSpec((1, 1, block_q, 1),
+                           lambda b, kh, j, g_, i: (b, kh * group + g_, i, 0))
+    with no_x64():
+        dk, dv = pl.pallas_call(
+            functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                              block_q=block_q, block_k=block_k, n_q=n_q,
+                              group=group),
+            grid=(B, Hkv, n_k, group, n_q),
+            in_specs=[q_spec2, k_spec2, k_spec2, q_spec2, r_spec2, r_spec2],
+            out_specs=[k_spec2, k_spec2],
+            out_shape=[
+                jax.ShapeDtypeStruct((B, Hkv, Sk, D), k.dtype),
+                jax.ShapeDtypeStruct((B, Hkv, Sk, D), v.dtype),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((block_k, D), jnp.float32),
+                pltpu.VMEM((block_k, D), jnp.float32),
+            ],
+            interpret=_interpret(),
+        )(qt, kt, vt, dot, lse4, delta4)
+    return (dq.transpose(0, 2, 1, 3), dk.transpose(0, 2, 1, 3),
+            dv.transpose(0, 2, 1, 3))
 
 
 # ---------------------------------------------------------------------------

@@ -28,6 +28,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from .pallas_x32 import no_x64
+
 _NEG_INF = -1e30
 
 
@@ -55,30 +57,39 @@ def _decode_kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
         q = q_ref[0]                         # [H, D]
         k = k_ref[0]                         # [bs, Hkv, D]
         v = v_ref[0]                         # [bs, Hkv, D]
-        h, d = q.shape
         hkv = k.shape[1]
-        qg = q.reshape(hkv, rep, d)
-        # logits[t, kvh, r] = k[t, kvh, :] · qg[kvh, r, :]
-        s = jax.lax.dot_general(
-            k, qg, (((2,), (2,)), ((1,), (0,))),
-            preferred_element_type=jnp.float32)          # [Hkv, bs, rep]
-        s = jnp.transpose(s, (0, 2, 1)) * scale          # [Hkv, rep, bs]
-        pos = jax.lax.broadcasted_iota(jnp.int32, s.shape, 2) + j * block_size
-        s = jnp.where(pos < seq_len, s, _NEG_INF)
+        # Mosaic's matmul wants plain 2-D dots — unroll the (static, small)
+        # KV-head dimension in Python instead of a 3-D batched dot_general.
+        # logits[kvh*rep + r, t] = q[kvh*rep + r, :] · k[t, kvh, :]
+        parts = []
+        for kvh in range(hkv):
+            qh = q[kvh * rep:(kvh + 1) * rep, :]         # [rep, D]
+            kh = k[:, kvh, :]                            # [bs, D]
+            parts.append(jax.lax.dot_general(
+                qh, kh, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32))     # [rep, bs]
+        s2 = (parts[0] if hkv == 1
+              else jnp.concatenate(parts, axis=0)) * scale   # [H, bs]
+        pos = jax.lax.broadcasted_iota(jnp.int32, s2.shape, 1) + j * block_size
+        s2 = jnp.where(pos < seq_len, s2, _NEG_INF)
 
-        s2 = s.reshape(h, -1)                            # [H, bs]
         m_prev = m_ref[:, 0]                             # [H]
         m_new = jnp.maximum(m_prev, jnp.max(s2, axis=-1))
         alpha = jnp.exp(m_prev - m_new)                  # [H]
         p = jnp.exp(s2 - m_new[:, None])                 # [H, bs]
         l_ref[:, 0] = l_ref[:, 0] * alpha + jnp.sum(p, -1)
         m_ref[:, 0] = m_new
-        # pv[kvh, r, d] = sum_t p[kvh, r, t] v[t, kvh, d]
-        pg = p.reshape(hkv, rep, -1)
-        pv = jax.lax.dot_general(
-            pg, v, (((2,), (0,)), ((0,), (1,))),
-            preferred_element_type=jnp.float32)          # [Hkv, rep, D]
-        acc_ref[:] = acc_ref[:] * alpha[:, None] + pv.reshape(h, d)
+        # pv[kvh*rep + r, d] = sum_t p[kvh*rep + r, t] v[t, kvh, d]
+        pv_parts = []
+        for kvh in range(hkv):
+            ph = p[kvh * rep:(kvh + 1) * rep, :]         # [rep, bs]
+            vh = v[:, kvh, :]                            # [bs, D]
+            pv_parts.append(jax.lax.dot_general(
+                ph.astype(jnp.float32), vh.astype(jnp.float32),
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32))     # [rep, D]
+        pv = pv_parts[0] if hkv == 1 else jnp.concatenate(pv_parts, axis=0)
+        acc_ref[:] = acc_ref[:] * alpha[:, None] + pv
 
     @pl.when(j == n_pages - 1)
     def _finish():
@@ -93,6 +104,9 @@ def paged_attention_decode(q, k_cache, v_cache, block_tables, seq_lens):
     rep = H // Hkv
     n_pages = block_tables.shape[1]
     scale = 1.0 / math.sqrt(D)
+    # Mosaic has no i64: scalar-prefetch operands must be 32-bit
+    block_tables = block_tables.astype(jnp.int32)
+    seq_lens = seq_lens.astype(jnp.int32)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,   # block_tables, seq_lens
@@ -114,9 +128,10 @@ def paged_attention_decode(q, k_cache, v_cache, block_tables, seq_lens):
     )
     kernel = functools.partial(
         _decode_kernel, scale=scale, block_size=bs, n_pages=n_pages, rep=rep)
-    return pl.pallas_call(
-        kernel,
-        grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((B, H, D), q.dtype),
-        interpret=_interpret(),
-    )(block_tables, seq_lens, q, k_cache, v_cache)
+    with no_x64():
+        return pl.pallas_call(
+            kernel,
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((B, H, D), q.dtype),
+            interpret=_interpret(),
+        )(block_tables, seq_lens, q, k_cache, v_cache)

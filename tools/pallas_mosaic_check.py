@@ -1,0 +1,170 @@
+"""First-contact Pallas verdict: do the kernels survive the Mosaic compiler?
+
+Off-TPU the kernels only ever ran in interpret mode; Mosaic routinely
+rejects kernels that interpret fine (VERDICT r3 weak/missing #2).  This
+tool compiles each kernel with the REAL backend, checks numerics against
+the XLA reference path, and micro-benchmarks pallas vs XLA attention.
+
+Writes one JSON line per check to stdout and a summary to
+``PALLAS_VERDICT.json``.  Run on a quiet chip (after bench.py finishes).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _HERE)
+
+jax.config.update("jax_compilation_cache_dir",
+                  os.path.join(_HERE, ".jax_compile_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+os.environ["PADDLE_TPU_STRICT_PALLAS"] = "1"  # raise, don't fall back
+
+
+def _bench(fn, *args, iters=20):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def main() -> None:
+    dev = jax.devices()[0]
+    print(f"# device: {dev.device_kind} backend={jax.default_backend()}",
+          file=sys.stderr)
+    results = {"device": dev.device_kind, "backend": jax.default_backend(),
+               "checks": []}
+
+    from paddle_tpu.ops import pallas_flash, pallas_paged
+
+    rng = np.random.default_rng(0)
+    B, S, H, D = 4, 2048, 8, 128  # [B, S, H, D] — pallas_flash layout
+    q = jnp.asarray(rng.standard_normal((B, S, H, D)), jnp.bfloat16)
+    k = jnp.asarray(rng.standard_normal((B, S, H, D)), jnp.bfloat16)
+    v = jnp.asarray(rng.standard_normal((B, S, H, D)), jnp.bfloat16)
+
+    def xla_attn(q, k, v, causal):
+        scale = 1.0 / np.sqrt(D)
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                       preferred_element_type=jnp.float32) * scale
+        if causal:
+            mask = jnp.tril(jnp.ones((S, S), bool))
+            s = jnp.where(mask, s, -jnp.inf)
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum("bhqk,bkhd->bqhd", p.astype(q.dtype), v)
+
+    for causal in (False, True):
+        name = f"flash_fwd_causal={causal}"
+        try:
+            f_pallas = jax.jit(
+                lambda q, k, v: pallas_flash.flash_attention(
+                    q, k, v, causal=causal))
+            out = f_pallas(q, k, v)
+            jax.block_until_ready(out)
+            ref = jax.jit(lambda q, k, v: xla_attn(q, k, v, causal))(q, k, v)
+            err = float(jnp.max(jnp.abs(out.astype(jnp.float32) -
+                                        ref.astype(jnp.float32))))
+            t_p = _bench(f_pallas, q, k, v)
+            t_x = _bench(jax.jit(lambda q, k, v: xla_attn(q, k, v, causal)),
+                         q, k, v)
+            ok = err < 0.15  # bf16 attention tolerance
+            results["checks"].append(
+                {"name": name, "status": "pass" if ok else "numerics",
+                 "max_err": err, "pallas_ms": round(t_p * 1e3, 3),
+                 "xla_ms": round(t_x * 1e3, 3),
+                 "speedup": round(t_x / t_p, 3)})
+        except Exception as e:  # Mosaic rejection lands here
+            results["checks"].append(
+                {"name": name, "status": "mosaic_fail",
+                 "error": str(e)[-800:]})
+        print(json.dumps(results["checks"][-1]))
+
+    # backward: grad of sum(flash(q,k,v)) vs grad of reference
+    for causal in (False, True):
+        name = f"flash_bwd_causal={causal}"
+        try:
+            g_pallas = jax.jit(jax.grad(
+                lambda q, k, v: pallas_flash.flash_attention(
+                    q, k, v, causal=causal).astype(jnp.float32).sum(),
+                argnums=(0, 1, 2)))
+            gp = g_pallas(q, k, v)
+            jax.block_until_ready(gp)
+            g_ref = jax.jit(jax.grad(
+                lambda q, k, v: xla_attn(
+                    q, k, v, causal).astype(jnp.float32).sum(),
+                argnums=(0, 1, 2)))(q, k, v)
+            err = max(float(jnp.max(jnp.abs(a.astype(jnp.float32) -
+                                            b.astype(jnp.float32))))
+                      for a, b in zip(gp, g_ref))
+            t_p = _bench(g_pallas, q, k, v, iters=10)
+            ok = err < 0.5  # bf16 grads accumulate more error
+            results["checks"].append(
+                {"name": name, "status": "pass" if ok else "numerics",
+                 "max_err": err, "pallas_ms": round(t_p * 1e3, 3)})
+        except Exception as e:
+            results["checks"].append(
+                {"name": name, "status": "mosaic_fail",
+                 "error": str(e)[-800:]})
+        print(json.dumps(results["checks"][-1]))
+
+    # GQA shape (the bench model is MHA; flagship Llama-3 is GQA 4:1)
+    try:
+        kg = jnp.asarray(rng.standard_normal((B, S, 2, D)), jnp.bfloat16)
+        vg = jnp.asarray(rng.standard_normal((B, S, 2, D)), jnp.bfloat16)
+        f = jax.jit(lambda q, k, v: pallas_flash.flash_attention(
+            q, k, v, causal=True))
+        out = f(q, kg, vg)
+        jax.block_until_ready(out)
+        results["checks"].append({"name": "flash_fwd_gqa4", "status": "pass",
+                                  "pallas_ms": round(_bench(f, q, kg, vg) * 1e3, 3)})
+    except Exception as e:
+        results["checks"].append({"name": "flash_fwd_gqa4",
+                                  "status": "mosaic_fail",
+                                  "error": str(e)[-800:]})
+    print(json.dumps(results["checks"][-1]))
+
+    # paged decode
+    try:
+        n_blocks, blk, max_blocks = 64, 16, 8
+        kc = jnp.asarray(rng.standard_normal((n_blocks, blk, 8, D)),
+                         jnp.bfloat16)
+        vc = jnp.asarray(rng.standard_normal((n_blocks, blk, 8, D)),
+                         jnp.bfloat16)
+        qd = jnp.asarray(rng.standard_normal((B, 8, D)), jnp.bfloat16)
+        bt = jnp.asarray(
+            rng.integers(0, n_blocks, (B, max_blocks)), jnp.int32)
+        sl = jnp.asarray([100, 128, 37, 64], jnp.int32)
+        f = jax.jit(lambda q, kc, vc, bt, sl:
+                    pallas_paged.paged_attention_decode(q, kc, vc, bt, sl))
+        out = f(qd, kc, vc, bt, sl)
+        jax.block_until_ready(out)
+        results["checks"].append(
+            {"name": "paged_decode", "status": "pass",
+             "pallas_ms": round(_bench(f, qd, kc, vc, bt, sl) * 1e3, 3)})
+    except Exception as e:
+        results["checks"].append({"name": "paged_decode",
+                                  "status": "mosaic_fail",
+                                  "error": str(e)[-800:]})
+    print(json.dumps(results["checks"][-1]))
+
+    n_fail = sum(1 for c in results["checks"] if c["status"] != "pass")
+    results["verdict"] = "pass" if n_fail == 0 else f"{n_fail} failing"
+    with open(os.path.join(_HERE, "PALLAS_VERDICT.json"), "w") as f:
+        json.dump(results, f, indent=1)
+    print(json.dumps({"verdict": results["verdict"]}))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,26 +1,39 @@
 #!/bin/bash
-# Probe the TPU tunnel persistently; the moment it is up, run bench.py
-# (which warms the persistent XLA compile cache) and record the result.
-# Round-3 standing priority #1 (VERDICT.md): land an on-chip number.
+# Probe the TPU tunnel persistently; the moment it is up, run (in order):
+#   1. tools/pallas_mosaic_check.py — the fast Mosaic pass/fail verdict
+#      (minutes; survives short tunnel windows, writes PALLAS_VERDICT.json)
+#   2. bench.py — the on-chip number (persistent XLA compile cache)
+#   3. tools/profile_train.py — XPlane trace for the MFU gap analysis
+# Round-4 standing priority #1 (VERDICT.md): land an on-chip number.
 cd "$(dirname "$0")/.." || exit 1
-for i in $(seq 1 120); do
+for i in $(seq 1 150); do
   if timeout 300 python -c "import jax; assert jax.default_backend()=='tpu'" 2>/dev/null; then
     echo "[tpu_watch] TPU up at attempt $i ($(date -u +%H:%M:%S))"
+    if [ ! -f PALLAS_VERDICT.json ]; then  # one verdict per watcher run
+      echo "[tpu_watch] pallas mosaic check"
+      timeout 1500 python tools/pallas_mosaic_check.py \
+        >pallas_check.out 2>pallas_check.err
+      echo "[tpu_watch] pallas check rc=$? :"
+      cat pallas_check.out
+    fi
     python bench.py >bench_tpu_attempt.json 2>bench_tpu_attempt.log
     rc=$?
     echo "[tpu_watch] bench rc=$rc"
     cat bench_tpu_attempt.json
     tail -30 bench_tpu_attempt.log
-    # VERDICT r4: after a successful on-chip bench, immediately capture the
-    # profiler trace for the MFU gap analysis (same program, warm cache)
-    if grep -q '"degraded"' bench_tpu_attempt.json; then
-      echo "[tpu_watch] bench degraded; not profiling"
-    else
-      echo "[tpu_watch] capturing XPlane trace"
-      timeout 1800 python tools/profile_train.py prof_trace \
-        >profile_attempt.log 2>&1
-      echo "[tpu_watch] profile rc=$? (prof_trace/, profile_attempt.log)"
+    # after a successful on-chip bench, immediately capture the profiler
+    # trace for the MFU gap analysis (same program, warm cache); any other
+    # outcome (degraded marker, crash, empty JSON) re-probes the tunnel
+    if [ "$rc" -ne 0 ] || [ ! -s bench_tpu_attempt.json ] \
+        || grep -q '"degraded"' bench_tpu_attempt.json; then
+      echo "[tpu_watch] bench not clean (rc=$rc); will re-probe"
+      sleep 120
+      continue
     fi
+    echo "[tpu_watch] capturing XPlane trace"
+    timeout 1800 python tools/profile_train.py prof_trace \
+      >profile_attempt.log 2>&1
+    echo "[tpu_watch] profile rc=$? (prof_trace/, profile_attempt.log)"
     exit 0
   fi
   echo "[tpu_watch] attempt $i: tunnel down ($(date -u +%H:%M:%S))"

@@ -16,6 +16,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 def main(outdir: str = "prof_trace") -> None:
     import jax
 
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # the axon plugin pins the platform at import; env alone is ignored
+        jax.config.update("jax_platforms", "cpu")
     cache = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                          "..", ".jax_compile_cache")
     jax.config.update("jax_compilation_cache_dir", os.path.abspath(cache))
@@ -58,10 +61,34 @@ def main(outdir: str = "prof_trace") -> None:
         opt.clear_grad()
         return loss
 
-    ids = paddle.to_tensor(
-        np.random.default_rng(0).integers(0, cfg.vocab_size, (batch, seq)),
-        dtype="int32")
-    float(train_step(ids))  # compile (cache-warm)
+    # same resilience ladder as bench.py: halve the batch on HBM OOM,
+    # retry the same batch on the XLA path after a Pallas failure
+    ladder = sorted({b for b in (batch, batch // 2, batch // 4, 1) if b >= 1},
+                    reverse=True)
+    bi = 0
+    while True:
+        if bi >= len(ladder):
+            raise RuntimeError("no batch size fits in device memory")
+        batch = ladder[bi]
+        ids = paddle.to_tensor(
+            np.random.default_rng(0).integers(
+                0, cfg.vocab_size, (batch, seq)), dtype="int32")
+        try:
+            float(train_step(ids))  # compile (cache-warm)
+            break
+        except Exception as e:
+            msg = str(e)
+            train_step.concrete_program_cache.clear()
+            if ("RESOURCE_EXHAUSTED" in msg or "Resource exhausted" in msg
+                    or "Out of memory" in msg):
+                bi += 1
+                continue
+            if os.environ.get("PADDLE_TPU_DISABLE_PALLAS") == "1":
+                raise
+            print(f"pallas path failed ({e}); XLA fallback", file=sys.stderr)
+            os.environ["PADDLE_TPU_DISABLE_PALLAS"] = "1"
+            continue
+    print(f"profiling batch={batch} seq={seq}", file=sys.stderr)
     float(train_step(ids))  # settle
 
     jax.profiler.start_trace(outdir)

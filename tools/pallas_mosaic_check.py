@@ -119,18 +119,55 @@ def main() -> None:
                  "error": str(e)[-800:]})
         print(json.dumps(results["checks"][-1]))
 
-    # GQA shape (the bench model is MHA; flagship Llama-3 is GQA 4:1)
+    # GQA 4:1 (the flagship Llama-3 pattern): fwd + bwd numerics vs the
+    # repeat-KV XLA reference
+    def xla_attn_gqa(q, k, v, causal=True):
+        rep = q.shape[2] // k.shape[2]
+        kr = jnp.repeat(k, rep, axis=2)
+        vr = jnp.repeat(v, rep, axis=2)
+        return xla_attn(q, kr, vr, causal)
+
+    kg = jnp.asarray(rng.standard_normal((B, S, 2, D)), jnp.bfloat16)
+    vg = jnp.asarray(rng.standard_normal((B, S, 2, D)), jnp.bfloat16)
     try:
-        kg = jnp.asarray(rng.standard_normal((B, S, 2, D)), jnp.bfloat16)
-        vg = jnp.asarray(rng.standard_normal((B, S, 2, D)), jnp.bfloat16)
         f = jax.jit(lambda q, k, v: pallas_flash.flash_attention(
             q, k, v, causal=True))
         out = f(q, kg, vg)
         jax.block_until_ready(out)
-        results["checks"].append({"name": "flash_fwd_gqa4", "status": "pass",
-                                  "pallas_ms": round(_bench(f, q, kg, vg) * 1e3, 3)})
+        ref = jax.jit(xla_attn_gqa)(q, kg, vg)
+        err = float(jnp.max(jnp.abs(out.astype(jnp.float32) -
+                                    ref.astype(jnp.float32))))
+        results["checks"].append(
+            {"name": "flash_fwd_gqa4",
+             "status": "pass" if err < 0.15 else "numerics", "max_err": err,
+             "pallas_ms": round(_bench(f, q, kg, vg) * 1e3, 3)})
     except Exception as e:
         results["checks"].append({"name": "flash_fwd_gqa4",
+                                  "status": "mosaic_fail",
+                                  "error": str(e)[-800:]})
+    print(json.dumps(results["checks"][-1]))
+
+    try:
+        g_pallas = jax.jit(jax.grad(
+            lambda q, k, v: pallas_flash.flash_attention(
+                q, k, v, causal=True).astype(jnp.float32).sum(),
+            argnums=(0, 1, 2)))
+        gp = g_pallas(q, kg, vg)
+        jax.block_until_ready(gp)
+        g_ref = jax.jit(jax.grad(
+            lambda q, k, v: xla_attn_gqa(
+                q, k, v).astype(jnp.float32).sum(),
+            argnums=(0, 1, 2)))(q, kg, vg)
+        err = max(float(jnp.max(jnp.abs(a.astype(jnp.float32) -
+                                        b.astype(jnp.float32))))
+                  for a, b in zip(gp, g_ref))
+        results["checks"].append(
+            {"name": "flash_bwd_gqa4",
+             "status": "pass" if err < 0.5 else "numerics", "max_err": err,
+             "pallas_ms": round(_bench(g_pallas, q, kg, vg, iters=10) * 1e3,
+                                3)})
+    except Exception as e:
+        results["checks"].append({"name": "flash_bwd_gqa4",
                                   "status": "mosaic_fail",
                                   "error": str(e)[-800:]})
     print(json.dumps(results["checks"][-1]))

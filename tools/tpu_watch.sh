@@ -34,6 +34,10 @@ for i in $(seq 1 150); do
     timeout 1800 python tools/profile_train.py prof_trace \
       >profile_attempt.log 2>&1
     echo "[tpu_watch] profile rc=$? (prof_trace/, profile_attempt.log)"
+    echo "[tpu_watch] autotune sweep"
+    timeout 1800 python tools/autotune_onchip.py \
+      >autotune_attempt.log 2>&1
+    echo "[tpu_watch] autotune rc=$? (AUTOTUNE_ONCHIP.json)"
     exit 0
   fi
   echo "[tpu_watch] attempt $i: tunnel down ($(date -u +%H:%M:%S))"

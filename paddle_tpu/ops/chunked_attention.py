@@ -1,0 +1,173 @@
+"""Memory-efficient attention in pure XLA (lax.scan over KV chunks).
+
+The O(S²) composite attention path materialises the full score matrix —
+first chip contact showed that OOMs a 16 GB v5e at batch 8 × seq 2048
+(backward keeps S² fp32 scores per layer).  This module is the
+FlashAttention-2 recurrence (online softmax over KV chunks, log-sum-exp
+residual, probability recomputation in the backward) expressed as
+``lax.scan`` so XLA compiles it into a bounded-memory loop on ANY backend
+— the fallback when Mosaic rejects the Pallas kernel, the CPU/long-context
+testing path, and the per-shard compute of ring attention.
+
+Peak live memory is O(S·block_k) per (batch, head) instead of O(S²):
+the scan carry holds only the running (m, l, acc) statistics.
+
+Public layout matches ``pallas_flash.flash_attention``: q ``[B, S, H, D]``,
+k/v ``[B, Sk, Hkv, D]`` (GQA native — query heads grouped per KV head, KV
+is never repeated).  Reference analog: memory-efficient attention in
+``phi/kernels/fusion/cutlass/memory_efficient_attention`` (same role for
+the CUDA build).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+DEFAULT_BLOCK_K = 512
+_NEG_INF = -1e30
+
+
+def _grouped(q, k):
+    """[B,S,H,D] q → [B,Hkv,rep,Sq,D]; [B,Sk,Hkv,D] k → [B,Hkv,Sk,D]."""
+    B, Sq, H, D = q.shape
+    Hkv = k.shape[2]
+    rep = H // Hkv
+    qg = q.transpose(0, 2, 1, 3).reshape(B, Hkv, rep, Sq, D)
+    return qg
+
+
+def _pad_kv(k, block_k):
+    Sk = k.shape[1]
+    pad = (-Sk) % block_k
+    if pad:
+        k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
+    return k, Sk + pad
+
+
+def _scan_fwd(q, k, v, scale, causal, block_k):
+    """Returns (out [B,Sq,H,D], lse [B,H,Sq] fp32)."""
+    B, Sq, H, D = q.shape
+    Hkv = k.shape[2]
+    rep = H // Hkv
+    Sk = k.shape[1]
+    k, Skp = _pad_kv(k, block_k)
+    v, _ = _pad_kv(v, block_k)
+    n_chunks = Skp // block_k
+
+    qg = _grouped(q, k)                                   # [B,Hkv,rep,Sq,D]
+    kc = k.transpose(0, 2, 1, 3).reshape(B, Hkv, n_chunks, block_k, D)
+    vc = v.transpose(0, 2, 1, 3).reshape(B, Hkv, n_chunks, block_k, D)
+    kc = jnp.moveaxis(kc, 2, 0)                           # [n,B,Hkv,bk,D]
+    vc = jnp.moveaxis(vc, 2, 0)
+
+    q_pos = jnp.arange(Sq)[:, None]                       # [Sq, 1]
+
+    def step(carry, xs):
+        m, l, acc = carry
+        ci, kb, vb = xs
+        s = jnp.einsum("bgrqd,bgkd->bgrqk", qg, kb,
+                       preferred_element_type=jnp.float32) * scale
+        k_pos = ci * block_k + jnp.arange(block_k)[None, :]
+        valid = k_pos < Sk                                # mask KV padding
+        if causal:
+            valid = valid & (k_pos <= q_pos + (Sk - Sq))
+        s = jnp.where(valid[None, None, None], s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[..., None])
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=-1)
+        acc_new = acc * corr[..., None] + jnp.einsum(
+            "bgrqk,bgkd->bgrqd", p.astype(vb.dtype), vb,
+            preferred_element_type=jnp.float32)
+        return (m_new, l_new, acc_new), None
+
+    m0 = jnp.full((B, Hkv, rep, Sq), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, Hkv, rep, Sq), jnp.float32)
+    a0 = jnp.zeros((B, Hkv, rep, Sq, D), jnp.float32)
+    (m, l, acc), _ = jax.lax.scan(
+        step, (m0, l0, a0), (jnp.arange(n_chunks), kc, vc))
+    safe_l = jnp.where(l == 0.0, 1.0, l)
+    out = (acc / safe_l[..., None]).astype(q.dtype)
+    out = out.reshape(B, H, Sq, D).transpose(0, 2, 1, 3)  # [B,Sq,H,D]
+    lse = (m + jnp.log(safe_l)).reshape(B, H, Sq)
+    return out, lse
+
+
+def _scan_bwd(res, g, *, scale, causal, block_k):
+    q, k, v, out, lse = res
+    B, Sq, H, D = q.shape
+    Hkv = k.shape[2]
+    rep = H // Hkv
+    Sk = k.shape[1]
+    kp, Skp = _pad_kv(k, block_k)
+    vp, _ = _pad_kv(v, block_k)
+    n_chunks = Skp // block_k
+
+    qg = _grouped(q, kp)                                  # [B,Hkv,rep,Sq,D]
+    dog = _grouped(g, kp)
+    kc = jnp.moveaxis(
+        kp.transpose(0, 2, 1, 3).reshape(B, Hkv, n_chunks, block_k, D), 2, 0)
+    vc = jnp.moveaxis(
+        vp.transpose(0, 2, 1, 3).reshape(B, Hkv, n_chunks, block_k, D), 2, 0)
+    lse_g = lse.reshape(B, Hkv, rep, Sq)
+    delta = jnp.einsum("bshd,bshd->bhs", g.astype(jnp.float32),
+                       out.astype(jnp.float32)).reshape(B, Hkv, rep, Sq)
+    q_pos = jnp.arange(Sq)[:, None]
+
+    def step(dq_acc, xs):
+        ci, kb, vb = xs
+        s = jnp.einsum("bgrqd,bgkd->bgrqk", qg, kb,
+                       preferred_element_type=jnp.float32) * scale
+        k_pos = ci * block_k + jnp.arange(block_k)[None, :]
+        valid = k_pos < Sk
+        if causal:
+            valid = valid & (k_pos <= q_pos + (Sk - Sq))
+        s = jnp.where(valid[None, None, None], s, _NEG_INF)
+        p = jnp.exp(s - lse_g[..., None])                 # [B,g,r,Sq,bk]
+        dv_c = jnp.einsum("bgrqk,bgrqd->bgkd", p.astype(jnp.float32),
+                          dog.astype(jnp.float32))
+        dp = jnp.einsum("bgrqd,bgkd->bgrqk", dog, vb,
+                        preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[..., None]) * scale
+        dk_c = jnp.einsum("bgrqk,bgrqd->bgkd", ds, qg.astype(jnp.float32))
+        dq_acc = dq_acc + jnp.einsum("bgrqk,bgkd->bgrqd",
+                                     ds.astype(kb.dtype), kb,
+                                     preferred_element_type=jnp.float32)
+        return dq_acc, (dk_c, dv_c)
+
+    dq0 = jnp.zeros((B, Hkv, rep, Sq, D), jnp.float32)
+    dq, (dk_c, dv_c) = jax.lax.scan(
+        step, dq0, (jnp.arange(n_chunks), kc, vc))
+    dq = dq.reshape(B, H, Sq, D).transpose(0, 2, 1, 3).astype(q.dtype)
+    dk = jnp.moveaxis(dk_c, 0, 2).reshape(B, Hkv, Skp, D)
+    dv = jnp.moveaxis(dv_c, 0, 2).reshape(B, Hkv, Skp, D)
+    dk = dk[:, :, :Sk].transpose(0, 2, 1, 3).astype(k.dtype)
+    dv = dv[:, :, :Sk].transpose(0, 2, 1, 3).astype(v.dtype)
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def chunked_attention(q, k, v, causal=False, block_k=DEFAULT_BLOCK_K):
+    """O(S·block_k)-memory attention over [B,S,H,D] q / [B,Sk,Hkv,D] k,v."""
+    assert q.shape[2] % k.shape[2] == 0
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    out, _ = _scan_fwd(q, k, v, scale, causal, block_k)
+    return out
+
+
+def _vjp_fwd(q, k, v, causal, block_k):
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    out, lse = _scan_fwd(q, k, v, scale, causal, block_k)
+    return out, (q, k, v, out, lse)
+
+
+def _vjp_bwd(causal, block_k, res, g):
+    scale = 1.0 / math.sqrt(res[0].shape[-1])
+    return _scan_bwd(res, g, scale=scale, causal=causal, block_k=block_k)
+
+
+chunked_attention.defvjp(_vjp_fwd, _vjp_bwd)

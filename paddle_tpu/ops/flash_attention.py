@@ -17,10 +17,13 @@ import jax
 import jax.numpy as jnp
 
 _FLASH_MIN_SEQ = 1024  # below this, XLA's fused softmax path is already fast
+_CHUNKED_MIN_AREA = 1024 * 1024  # Sq*Sk at which S^2 scores become the
+                                 # memory bottleneck -> scan recurrence
 
-# Which path the most recent dispatch took: "pallas" | "xla".  Benchmarks and
-# tests read this so a kernel regression shows up as a loud signal, not a
-# silent perf cliff (VERDICT r1 weak #5).
+# Which path the most recent dispatch took: "pallas" | "xla_chunked"
+# (lax.scan flash recurrence, long sequences) | "xla" (composite).
+# Benchmarks and tests read this so a kernel regression shows up as a loud
+# signal, not a silent perf cliff (VERDICT r1 weak #5).
 last_path: str | None = None
 
 
@@ -98,5 +101,14 @@ def flash_attention_fwd(q, k, v, causal: bool = False):
                 f"pallas flash attention failed, falling back to XLA "
                 f"composite path (set PADDLE_TPU_STRICT_PALLAS=1 to raise): "
                 f"{type(e).__name__}: {e}", RuntimeWarning, stacklevel=2)
+    # XLA path: beyond this area the composite S^2 score matrix dominates
+    # memory (first contact: it OOMs a 16 GB v5e at batch 8 x seq 2048
+    # backward), so long sequences take the lax.scan flash recurrence
+    # (O(S*block_k) live memory) instead
+    if q.shape[1] * k.shape[1] >= _CHUNKED_MIN_AREA:
+        from .chunked_attention import chunked_attention
+
+        last_path = "xla_chunked"
+        return chunked_attention(q, k, v, causal)
     last_path = "xla"
     return _reference_attention(q, k, v, causal)

@@ -28,17 +28,20 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
     from ...ops.flash_attention import flash_attention_fwd, use_flash
 
     q, k, v = _ensure(query), _ensure(key), _ensure(value)
-    if use_flash(q.shape, attn_mask):
-        return flash_attention(q, k, v, dropout=dropout_p, causal=is_causal)[0]
     if attn_mask is None and (dropout_p == 0.0 or not training):
-        # no mask/dropout: let the op-level dispatcher pick the path —
-        # pallas on TPU, the O(S·block) scan recurrence for long sequences
-        # (any head_dim), composite otherwise.  Keeps e.g. head_dim-64
-        # long-context off the S^2 composite the v5e can't hold.
+        # no mask/dropout (the hot path): one dispatch decision, made by
+        # the op-level dispatcher — pallas on TPU, the O(S·block) scan
+        # recurrence for long sequences (any head_dim), composite
+        # otherwise.  Keeps e.g. head_dim-64 long-context off the S^2
+        # composite the v5e can't hold.
         def g(qv, kv, vv):
             return flash_attention_fwd(qv, kv, vv, causal=is_causal)
 
         return run_op("attention", g, q, k, v)
+    if use_flash(q.shape, attn_mask):
+        # flash-eligible but with attention dropout: the flash wrapper
+        # handles the dropout contract
+        return flash_attention(q, k, v, dropout=dropout_p, causal=is_causal)[0]
 
     def f(qv, kv, vv, *m):
         B, Sq, H, D = qv.shape

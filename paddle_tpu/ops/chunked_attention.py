@@ -77,7 +77,12 @@ def _scan_fwd(q, k, v, scale, causal, block_k):
             valid = valid & (k_pos <= q_pos + (Sk - Sq))
         s = jnp.where(valid[None, None, None], s, _NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))
-        p = jnp.exp(s - m_new[..., None])
+        # mask p explicitly: a row with NO valid key has m_new == _NEG_INF,
+        # where exp(s - m_new) == 1 would silently average V — such rows
+        # must stay at l == 0 so the epilogue returns zeros (the documented
+        # finite-masked-row contract)
+        p = jnp.where(valid[None, None, None],
+                      jnp.exp(s - m_new[..., None]), 0.0)
         corr = jnp.exp(m - m_new)
         l_new = l * corr + jnp.sum(p, axis=-1)
         acc_new = acc * corr[..., None] + jnp.einsum(
@@ -127,7 +132,10 @@ def _scan_bwd(res, g, *, scale, causal, block_k):
         if causal:
             valid = valid & (k_pos <= q_pos + (Sk - Sq))
         s = jnp.where(valid[None, None, None], s, _NEG_INF)
-        p = jnp.exp(s - lse_g[..., None])                 # [B,g,r,Sq,bk]
+        # same explicit mask as the forward: rows with no valid key have
+        # lse == _NEG_INF and exp(s - lse) == 1 — their p must be 0
+        p = jnp.where(valid[None, None, None],
+                      jnp.exp(s - lse_g[..., None]), 0.0)  # [B,g,r,Sq,bk]
         dv_c = jnp.einsum("bgrqk,bgrqd->bgkd", p.astype(jnp.float32),
                           dog.astype(jnp.float32))
         dp = jnp.einsum("bgrqd,bgkd->bgrqk", dog, vb,
@@ -152,7 +160,12 @@ def _scan_bwd(res, g, *, scale, causal, block_k):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def chunked_attention(q, k, v, causal=False, block_k=DEFAULT_BLOCK_K):
-    """O(S·block_k)-memory attention over [B,S,H,D] q / [B,Sk,Hkv,D] k,v."""
+    """O(S·block_k)-memory attention over [B,S,H,D] q / [B,Sk,Hkv,D] k,v.
+
+    Fully-masked query rows (only possible with ``causal=True`` and
+    Sq > Sk, an invalid decode shape) return zeros with zero gradients —
+    the same finite-masked-row contract as the Pallas kernel — where the
+    composite reference produces NaN."""
     assert q.shape[2] % k.shape[2] == 0
     scale = 1.0 / math.sqrt(q.shape[-1])
     out, _ = _scan_fwd(q, k, v, scale, causal, block_k)

@@ -40,29 +40,35 @@ def main(outdir: str = "prof_trace") -> None:
             vocab_size=32000, hidden_size=1024, intermediate_size=2816,
             num_hidden_layers=6, num_attention_heads=8,
             num_key_value_heads=8, max_position_embeddings=2048,
-            rope_theta=10000.0, dtype="bfloat16")
+            rope_theta=10000.0, dtype="bfloat16", scan_layers=True)
         batch, seq = 8, 2048
         paddle.set_default_dtype("bfloat16")
     else:
         cfg = LlamaConfig.tiny()
         batch, seq = 4, 64
 
-    paddle.seed(0)
-    model = LlamaForCausalLM(cfg)
-    criterion = LlamaPretrainingCriterion(cfg)
-    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
-                                 parameters=model.parameters())
+    def build(cfg):
+        paddle.seed(0)
+        model = LlamaForCausalLM(cfg)
+        criterion = LlamaPretrainingCriterion(cfg)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                     parameters=model.parameters())
 
-    @to_static
-    def train_step(ids):
-        loss = criterion(model(ids), ids)
-        loss.backward()
-        opt.step()
-        opt.clear_grad()
-        return loss
+        @to_static
+        def train_step(ids):
+            loss = criterion(model(ids), ids)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
 
-    # same resilience ladder as bench.py: halve the batch on HBM OOM,
-    # retry the same batch on the XLA path after a Pallas failure
+        return train_step
+
+    train_step = build(cfg)
+
+    # same resilience ladder as bench.py: halve the batch on HBM OOM, XLA
+    # attention after a Pallas/Mosaic failure, unrolled stack after a scan
+    # failure — so the profiled program matches whatever bench.py measured
     ladder = sorted({b for b in (batch, batch // 2, batch // 4, 1) if b >= 1},
                     reverse=True)
     bi = 0
@@ -83,11 +89,25 @@ def main(outdir: str = "prof_trace") -> None:
                     or "Out of memory" in msg):
                 bi += 1
                 continue
-            if os.environ.get("PADDLE_TPU_DISABLE_PALLAS") == "1":
-                raise
-            print(f"pallas path failed ({e}); XLA fallback", file=sys.stderr)
-            os.environ["PADDLE_TPU_DISABLE_PALLAS"] = "1"
-            continue
+            pallas_on = os.environ.get("PADDLE_TPU_DISABLE_PALLAS") != "1"
+            pallas_fail = ("pallas" in msg.lower() or "mosaic" in msg.lower())
+            if pallas_fail and pallas_on:
+                print(f"pallas path failed ({e}); XLA fallback",
+                      file=sys.stderr)
+                os.environ["PADDLE_TPU_DISABLE_PALLAS"] = "1"
+                continue
+            if cfg.scan_layers:
+                print(f"scan stack failed ({e}); unrolled fallback",
+                      file=sys.stderr)
+                cfg.scan_layers = False
+                train_step = build(cfg)
+                continue
+            if pallas_on:
+                print(f"unrecognized failure ({e}); trying XLA path",
+                      file=sys.stderr)
+                os.environ["PADDLE_TPU_DISABLE_PALLAS"] = "1"
+                continue
+            raise
     print(f"profiling batch={batch} seq={seq}", file=sys.stderr)
     float(train_step(ids))  # settle
 

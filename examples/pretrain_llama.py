@@ -35,6 +35,9 @@ def main():
     p.add_argument("--lr", type=float, default=3e-4)
     p.add_argument("--sequence_parallel", action="store_true")
     p.add_argument("--recompute", action="store_true")
+    p.add_argument("--scan_layers", action="store_true",
+                   help="compile the decoder stack as ONE lax.scan body "
+                        "(L-times faster cold compile, same math)")
     p.add_argument("--auto", action="store_true",
                    help="pick dp/mp/pp/sharding with the cost-model planner")
     p.add_argument("--save_dir", default=None)
@@ -66,7 +69,7 @@ def main():
 
     mk = (LlamaConfig.tiny if args.model == "tiny" else LlamaConfig.llama3_8b)
     cfg = mk(sequence_parallel=args.sequence_parallel,
-             recompute=args.recompute)
+             recompute=args.recompute, scan_layers=args.scan_layers)
 
     # fleet API end to end (fleet/fleet.py:167 usage pattern): one strategy
     # object wires mesh + placements + pipeline schedule + sharded optimizer

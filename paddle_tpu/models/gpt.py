@@ -47,6 +47,7 @@ class GPTConfig:
     initializer_range: float = 0.02
     tie_word_embeddings: bool = True
     recompute: bool = False
+    scan_layers: bool = False           # lax.scan over the decoder stack
     dtype: str = "float32"
     virtual_pp_degree: int = 1
 
@@ -161,6 +162,8 @@ class GPTModel(Layer):
                    self.position_embeddings)
         if pp_microbatches and axis_size("pp") > 1:
             h = pipeline_forward(self._pipeline(), h, pp_microbatches)
+        elif self.config.scan_layers and axis_size("sep") == 1:
+            h = self._scan_stack(h)
         else:
             for layer in self.layers:
                 if self.config.recompute and self.training:
@@ -168,6 +171,99 @@ class GPTModel(Layer):
                 else:
                     h = layer(h)
         return self.ln_f(h)
+
+    def _scan_stack(self, h):
+        """``lax.scan`` over the homogeneous GPT stack — one compiled
+        layer body instead of L inlined copies (see
+        ``LlamaModel._scan_stack`` for the design; same structure with
+        GPT's LayerNorm / fused-QKV-with-bias / GELU math)."""
+        import jax
+
+        from ..distributed.topology import get_mesh
+        from ..ops.flash_attention import flash_attention_fwd
+        from ..parallel.utils import _fit_spec, in_manual_mode, param_spec
+
+        cfg = self.config
+        if getattr(self, "_scan_prep", None) is None:
+            roles = [
+                "ln_1.weight", "ln_1.bias",
+                "attn.qkv_proj.weight", "attn.qkv_proj.bias",
+                "attn.o_proj.weight", "attn.o_proj.bias",
+                "ln_2.weight", "ln_2.bias",
+                "mlp.fc_in.weight", "mlp.fc_in.bias",
+                "mlp.fc_out.weight", "mlp.fc_out.bias",
+            ]
+            per_layer = []
+            for layer in self.layers:
+                named = dict(layer.named_parameters())
+                if set(named) != set(roles):
+                    raise ValueError(
+                        f"scan_layers needs a homogeneous stack; layer "
+                        f"params {sorted(named)} != {sorted(roles)}")
+                per_layer.append([named[r] for r in roles])
+            specs = [param_spec(per_layer[0][i]) for i in range(len(roles))]
+            self._scan_prep = (roles, per_layer, specs)
+        roles, per_layer, specs = self._scan_prep
+        n_layers = len(per_layer)
+
+        nh, hd = cfg.num_attention_heads, cfg.head_dim
+        eps = cfg.layer_norm_epsilon
+        remat = cfg.recompute and self.training
+
+        from jax.sharding import NamedSharding
+
+        def f(hv, *flat_params):
+            mesh = get_mesh()
+            manual = in_manual_mode()
+
+            def pin(v, *spec):
+                if mesh is None or manual:
+                    return v
+                sh = NamedSharding(mesh, _fit_spec(spec, jnp.shape(v), mesh))
+                return jax.lax.with_sharding_constraint(v, sh)
+
+            B, S = hv.shape[0], hv.shape[1]
+
+            def ln(x, w, b):
+                xf = x.astype(jnp.float32)
+                mean = jnp.mean(xf, axis=-1, keepdims=True)
+                var = jnp.var(xf, axis=-1, keepdims=True)
+                out = ((xf - mean) * jax.lax.rsqrt(var + eps)).astype(x.dtype)
+                return out * w + b
+
+            def body(carry, xs):
+                (w_ln1, b_ln1, w_qkv, b_qkv, w_o, b_o,
+                 w_ln2, b_ln2, w_fi, b_fi, w_fo, b_fo) = xs
+                x = carry
+                h1 = ln(x, w_ln1, b_ln1)
+                qkv = pin(h1 @ w_qkv + b_qkv, "dp", None, "mp")
+                qkv = qkv.reshape(B, S, 3, nh, hd)
+                q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+                q = pin(q, "dp", "sep", "mp", None)
+                k = pin(k, "dp", "sep", "mp", None)
+                v = pin(v, "dp", "sep", "mp", None)
+                out = flash_attention_fwd(q, k, v, causal=True)
+                out = pin(out.reshape(B, S, nh * hd), "dp", "sep", "mp")
+                out = pin(out, "dp", None, "mp")
+                x = x + (pin(out @ w_o, "dp") + b_o)
+                h2 = ln(x, w_ln2, b_ln2)
+                ff = pin(h2 @ w_fi + b_fi, "dp", None, "mp")
+                ff = jax.nn.gelu(ff, approximate=True)
+                ff = pin(ff, "dp", None, "mp")
+                x = x + (pin(ff @ w_fo, "dp") + b_fo)
+                return x, None
+
+            xs = tuple(
+                pin(jnp.stack(flat_params[i * n_layers:(i + 1) * n_layers]),
+                    None, *specs[i])
+                for i in range(len(roles)))
+            step = jax.checkpoint(body) if remat else body
+            out, _ = jax.lax.scan(step, hv, xs)
+            return out
+
+        flat = [per_layer[j][i] for i in range(len(roles))
+                for j in range(n_layers)]
+        return run_op("gpt_scan_stack", f, h, *flat)
 
 
 class GPTForCausalLM(Layer):

@@ -162,3 +162,37 @@ def test_program_is_smaller_than_unrolled():
         return step.lowered_text(ids).count("\n")
 
     assert hlo_lines(m_scan) < hlo_lines(m_loop)
+
+
+def _gpt_tiny(**kw):
+    from paddle_tpu.models.gpt import GPTConfig
+
+    return GPTConfig(vocab_size=256, hidden_size=64, num_hidden_layers=3,
+                     num_attention_heads=4, intermediate_size=128,
+                     max_position_embeddings=64, **kw)
+
+
+def test_gpt_scan_equivalence():
+    from paddle_tpu.models.gpt import GPTForCausalLM
+
+    paddle.seed(0)
+    m_loop = GPTForCausalLM(_gpt_tiny())
+    paddle.seed(0)
+    m_scan = GPTForCausalLM(_gpt_tiny(scan_layers=True))
+    ids = paddle.to_tensor(
+        np.random.default_rng(0).integers(0, 256, (2, 32)), dtype="int64")
+    o1 = m_loop(ids)
+    o2 = m_scan(ids)
+    np.testing.assert_allclose(np.asarray(o1._value, np.float32),
+                               np.asarray(o2._value, np.float32),
+                               rtol=1e-5, atol=1e-5)
+    (o1 ** 2).mean().backward()
+    (o2 ** 2).mean().backward()
+    g1 = {n: np.asarray(p.grad._value, np.float32)
+          for n, p in m_loop.named_parameters() if p.grad is not None}
+    g2 = {n: np.asarray(p.grad._value, np.float32)
+          for n, p in m_scan.named_parameters() if p.grad is not None}
+    assert set(g1) == set(g2) and len(g1) >= 3 * 12
+    for n in g1:
+        np.testing.assert_allclose(g1[n], g2[n], rtol=1e-4, atol=1e-6,
+                                   err_msg=n)

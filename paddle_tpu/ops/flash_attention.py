@@ -42,7 +42,11 @@ def use_flash(q_shape, attn_mask) -> bool:
     seq, head_dim = q_shape[1], q_shape[3]
     if seq < _FLASH_MIN_SEQ or seq % 128 != 0:
         return False
-    if head_dim % 128 != 0:
+    # Mosaic tiling: the head_dim block must be lane-aligned (divisible by
+    # 128) OR equal to the full array dim with sublane alignment — so 64
+    # (BERT/GPT-2 head size; half-wide vregs, still beats the composite)
+    # is legal alongside multiples of 128
+    if head_dim % 128 != 0 and head_dim != 64:
         return False
     return jax.default_backend() == "tpu"
 

@@ -139,3 +139,37 @@ class TestAutotuneCache:
         monkeypatch.setattr(at, "_loaded", False)
         assert at.cached_flash_blocks(q.shape, k.shape, str(q.dtype),
                                       False) == blocks
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_head_dim_64(causal):
+    # BERT/GPT-2 head size: Mosaic-legal because the D block equals the
+    # full array dim (use_flash admits 64 alongside multiples of 128)
+    q, k, v = _qkv(D=64)
+    out = flash_attention(q, k, v, causal)
+    ref = _reference_attention(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+    g = jax.grad(lambda q, k, v: (flash_attention(q, k, v, causal)
+                                  .astype(jnp.float32) ** 2).sum(),
+                 argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda q, k, v: (_reference_attention(q, k, v, causal)
+                                   .astype(jnp.float32) ** 2).sum(),
+                  argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_use_flash_head_dim_gate():
+    from paddle_tpu.ops.flash_attention import use_flash
+
+    # gate decisions are backend-independent except the final tpu check;
+    # assert the head_dim arm directly
+    shapes = {64: True, 128: True, 256: True, 96: False, 192: False}
+    for hd, legal in shapes.items():
+        got = use_flash((2, 2048, 4, hd), None)
+        # on CPU use_flash is always False; test the documented rule by
+        # checking which shapes short-circuit BEFORE the backend check
+        if not legal:
+            assert got is False

@@ -172,6 +172,37 @@ def main() -> None:
                                   "error": str(e)[-800:]})
     print(json.dumps(results["checks"][-1]))
 
+    # head_dim 64 (BERT/GPT-2 size; D block == full dim — the other legal
+    # tiling arm)
+    try:
+        q64 = jnp.asarray(rng.standard_normal((B, S, H, 64)), jnp.bfloat16)
+        k64 = jnp.asarray(rng.standard_normal((B, S, H, 64)), jnp.bfloat16)
+        v64 = jnp.asarray(rng.standard_normal((B, S, H, 64)), jnp.bfloat16)
+
+        def xla64(q, k, v):
+            s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                           preferred_element_type=jnp.float32) / 8.0
+            mask = jnp.tril(jnp.ones((S, S), bool))
+            p = jax.nn.softmax(jnp.where(mask, s, -jnp.inf), axis=-1)
+            return jnp.einsum("bhqk,bkhd->bqhd", p.astype(q.dtype), v)
+
+        f = jax.jit(lambda q, k, v: pallas_flash.flash_attention(
+            q, k, v, causal=True))
+        out = f(q64, k64, v64)
+        jax.block_until_ready(out)
+        ref = jax.jit(xla64)(q64, k64, v64)
+        err = float(jnp.max(jnp.abs(out.astype(jnp.float32) -
+                                    ref.astype(jnp.float32))))
+        results["checks"].append(
+            {"name": "flash_fwd_d64",
+             "status": "pass" if err < 0.15 else "numerics", "max_err": err,
+             "pallas_ms": round(_bench(f, q64, k64, v64) * 1e3, 3)})
+    except Exception as e:
+        results["checks"].append({"name": "flash_fwd_d64",
+                                  "status": "mosaic_fail",
+                                  "error": str(e)[-800:]})
+    print(json.dumps(results["checks"][-1]))
+
     # paged decode
     try:
         n_blocks, blk, max_blocks = 64, 16, 8

@@ -15,6 +15,7 @@ two registration paths are:
 
 from . import cpp_extension, extension  # noqa: F401
 from .extension import get_custom_op, register_custom_op  # noqa: F401
+from .host_build import host_build  # noqa: F401
 
 
 def try_import(name):

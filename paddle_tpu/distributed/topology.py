@@ -49,10 +49,10 @@ def get_mesh() -> Optional[Mesh]:
     return _global_mesh
 
 
-def set_mesh(mesh: Mesh):
+def set_mesh(mesh: Optional[Mesh]):
     global _global_mesh, _global_hcg
     _global_mesh = mesh
-    _global_hcg = HybridCommunicateGroup(mesh)
+    _global_hcg = HybridCommunicateGroup(mesh) if mesh is not None else None
 
 
 def get_hybrid_communicate_group() -> Optional["HybridCommunicateGroup"]:

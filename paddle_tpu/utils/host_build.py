@@ -47,11 +47,35 @@ def host_build(build_fn: Callable[[], Any], log=None) -> Any:
         out = build_fn()
 
     items = out if isinstance(out, (tuple, list)) else (out,)
+    layers = [item for item in items if isinstance(item, Layer)]
+
+    from ..distributed import topology
+    from ..parallel.utils import param_spec
+
     tensors = []
-    for item in items:
-        if isinstance(item, Layer):
-            tensors.extend(item.parameters())
-            tensors.extend(item.buffers())
+    for layer in layers:
+        tensors.extend(layer.parameters())
+        tensors.extend(layer.buffers())
+
+    from jax.sharding import NamedSharding
+
+    mesh = topology.get_mesh()
+    if mesh is not None and tensors:
+        # active device mesh: place every tensor by its PartitionSpec
+        # annotation (replicated default) — host init then shard-to-mesh,
+        # the multi-chip init story (single-device placement would commit
+        # tensors to one device and conflict with GSPMD constraints).
+        # Still ONE batched device_put: per-tensor puts would reintroduce
+        # the per-dispatch tunnel overhead this module exists to avoid.
+        if log:
+            log(f"host_build: built on cpu ({len(tensors)} tensors); "
+                f"sharding onto mesh "
+                f"{dict(zip(mesh.axis_names, mesh.devices.shape))}")
+        shardings = [NamedSharding(mesh, param_spec(t)) for t in tensors]
+        values = jax.device_put([t._value for t in tensors], shardings)
+        for t, v in zip(tensors, values):
+            t._value = v
+        return out
     if tensors:
         dev = jax.devices()[0]
         if log:

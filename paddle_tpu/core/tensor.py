@@ -55,7 +55,11 @@ class Tensor:
                     ".to_dense() before converting to a dense Tensor")
             value = value._value
         elif not isinstance(value, (jax.Array, jax.core.Tracer)):
-            value = jnp.asarray(value)
+            # jnp.array (copy) not jnp.asarray: jax's CPU backend zero-copy
+            # aliases contiguous numpy buffers, but paddle ingestion
+            # semantics are copy — a caller mutating its buffer (or torch
+            # updating a shared-storage param in place) must not mutate us
+            value = jnp.array(value)
         self._value = value
         self.stop_gradient = stop_gradient
         self.grad = None
@@ -235,7 +239,8 @@ class Tensor:
         """paddle Tensor.set_value — raw data replacement, no grad recording."""
         if isinstance(value, Tensor):
             value = value._value
-        value = jnp.asarray(value)
+        value = (value if isinstance(value, (jax.Array, jax.core.Tracer))
+                 else jnp.array(value))  # copy external buffers (see __init__)
         if tuple(value.shape) != tuple(self._value.shape):
             raise ValueError(
                 f"set_value shape mismatch: {value.shape} vs {self._value.shape}"
@@ -380,7 +385,7 @@ def to_tensor(data, dtype=None, place=None, stop_gradient=True) -> Tensor:
         v = np.asarray(v)
         if d is None and v.dtype == np.float64:
             d = dtype_mod.get_default_dtype()
-        v = jnp.asarray(v, dtype=d)
+        v = jnp.array(v, dtype=d)  # copy external buffers (see __init__)
     elif d is not None:
         v = v.astype(d)
     return Tensor(v, stop_gradient=stop_gradient)

@@ -221,7 +221,10 @@ class Layer:
         for k, v in state_dict.items():
             if k in own:
                 target = own[k]
-                val = v._value if isinstance(v, Tensor) else jnp.asarray(np.asarray(v))
+                # jnp.array (copy): external numpy buffers (e.g. torch
+                # params sharing storage) may be zero-copy aliased by the
+                # CPU backend; paddle load semantics are copy
+                val = v._value if isinstance(v, Tensor) else jnp.array(np.asarray(v))
                 if tuple(val.shape) != tuple(target._value.shape):
                     raise ValueError(
                         f"shape mismatch for {k}: {val.shape} vs {target._value.shape}"

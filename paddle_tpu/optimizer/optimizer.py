@@ -192,7 +192,8 @@ class Optimizer:
             key = names.get(pname)
             if key is None:
                 continue
-            val = v._value if isinstance(v, Tensor) else jnp.asarray(v)
+            # jnp.array (copy): don't alias caller-owned numpy buffers
+            val = v._value if isinstance(v, Tensor) else jnp.array(v)
             self._state.setdefault(key, {})[sname] = Tensor(val)
         if "LR_Scheduler" in state and isinstance(self._lr, LRScheduler):
             self._lr.set_state_dict(state["LR_Scheduler"])

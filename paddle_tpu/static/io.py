@@ -73,7 +73,8 @@ def set_program_state(program, state_dict: Dict[str, Any]):
     params = _named_params(program)
     for k, v in state_dict.items():
         if k in params:
-            params[k]._value = jnp.asarray(v)
+            # jnp.array (copy): don't alias caller-owned numpy buffers
+            params[k]._value = jnp.array(v)
 
 
 # --- inference export (``save_inference_model`` family) --------------------

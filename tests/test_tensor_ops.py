@@ -323,3 +323,43 @@ class TestInplaceTensorMethods:
         with _pytest.raises(TypeError):
             paddle.check_shape(
                 paddle.to_tensor(np.array([2.0], np.float32)))
+
+
+class TestIngestionCopies:
+    """paddle ingestion semantics are copy: jax's CPU backend zero-copy
+    aliases contiguous numpy buffers, so to_tensor/Tensor()/set_value must
+    force a copy — a caller mutating its buffer afterwards (or torch
+    updating a shared-storage param in place) must not mutate the Tensor.
+    Found via the HF-alignment test: aliased embeddings silently tracked
+    torch's SGD updates (test_torch_alignment.py)."""
+
+    def test_to_tensor_copies_numpy(self):
+        import numpy as np
+
+        import paddle_tpu as paddle
+
+        buf = np.ones((4, 4), np.float32)
+        t = paddle.to_tensor(buf)
+        buf[...] = 7.0
+        np.testing.assert_allclose(t.numpy(), np.ones((4, 4), np.float32))
+
+    def test_tensor_ctor_copies_numpy(self):
+        import numpy as np
+
+        from paddle_tpu.core.tensor import Tensor
+
+        buf = np.arange(6, dtype=np.float32)
+        t = Tensor(buf)
+        buf += 100.0
+        np.testing.assert_allclose(t.numpy(), np.arange(6, dtype=np.float32))
+
+    def test_set_value_copies_numpy(self):
+        import numpy as np
+
+        import paddle_tpu as paddle
+
+        t = paddle.to_tensor(np.zeros(3, np.float32))
+        buf = np.full(3, 5.0, np.float32)
+        t.set_value(buf)
+        buf[...] = -1.0
+        np.testing.assert_allclose(t.numpy(), np.full(3, 5.0, np.float32))

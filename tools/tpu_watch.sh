@@ -34,6 +34,12 @@ for i in $(seq 1 150); do
     timeout 1800 python tools/profile_train.py prof_trace \
       >profile_attempt.log 2>&1
     echo "[tpu_watch] profile rc=$? (prof_trace/, profile_attempt.log)"
+    # trace analysis is pure host-side stdlib — run it in the window so
+    # the MFU category breakdown lands even if the session isn't watching
+    timeout 300 python tools/analyze_trace.py prof_trace \
+      >TRACE_BREAKDOWN.txt 2>&1
+    echo "[tpu_watch] analyze rc=$? (TRACE_BREAKDOWN.txt):"
+    cat TRACE_BREAKDOWN.txt
     echo "[tpu_watch] autotune sweep"
     timeout 1800 python tools/autotune_onchip.py \
       >autotune_attempt.log 2>&1

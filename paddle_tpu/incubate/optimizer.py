@@ -94,7 +94,7 @@ class LookAhead(Optimizer):
         slows = state.pop("@lookahead_slow", [])
         params = self.__dict__["inner_optimizer"]._parameter_list or []
         self.__dict__["_slow"] = {
-            id(p): (p, jnp.asarray(s))
+            id(p): (p, jnp.array(s))  # copy: don't alias caller buffers
             for p, s in zip(params, slows) if s is not None}
         return self.__dict__["inner_optimizer"].set_state_dict(state)
 

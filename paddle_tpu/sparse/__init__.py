@@ -148,16 +148,17 @@ def _to_coo(x):
 
 
 def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None, stop_gradient=True):
-    idx = indices._value if isinstance(indices, Tensor) else jnp.asarray(indices)
-    val = values._value if isinstance(values, Tensor) else jnp.asarray(values)
+    # jnp.array (copy) for external buffers: ingestion semantics are copy
+    idx = indices._value if isinstance(indices, Tensor) else jnp.array(indices)
+    val = values._value if isinstance(values, Tensor) else jnp.array(values)
     bcoo = jsparse.BCOO((val, idx.T.astype(jnp.int32)), shape=tuple(shape))
     return SparseCooTensor(bcoo, stop_gradient)
 
 
 def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None, stop_gradient=True):
-    cr = crows._value if isinstance(crows, Tensor) else jnp.asarray(crows)
-    cc = cols._value if isinstance(cols, Tensor) else jnp.asarray(cols)
-    val = values._value if isinstance(values, Tensor) else jnp.asarray(values)
+    cr = crows._value if isinstance(crows, Tensor) else jnp.array(crows)
+    cc = cols._value if isinstance(cols, Tensor) else jnp.array(cols)
+    val = values._value if isinstance(values, Tensor) else jnp.array(values)
     bcsr = jsparse.BCSR((val, cc.astype(jnp.int32), cr.astype(jnp.int32)), shape=tuple(shape))
     return SparseCsrTensor(bcsr, stop_gradient)
 

@@ -73,8 +73,15 @@ def set_program_state(program, state_dict: Dict[str, Any]):
     params = _named_params(program)
     for k, v in state_dict.items():
         if k in params:
-            # jnp.array (copy): don't alias caller-owned numpy buffers
-            params[k]._value = jnp.array(v)
+            # jnp.array (copy): don't alias caller-owned numpy buffers;
+            # validate like Tensor.set_value (loud shape check, keep dtype)
+            cur = params[k]._value
+            val = jnp.array(v)
+            if tuple(val.shape) != tuple(cur.shape):
+                raise ValueError(
+                    f"set_program_state shape mismatch for {k}: "
+                    f"{val.shape} vs {cur.shape}")
+            params[k]._value = val.astype(cur.dtype)
 
 
 # --- inference export (``save_inference_model`` family) --------------------

@@ -120,3 +120,120 @@ class TestTorchLlamaAlignment:
         # (fp32 round-off across 6 full fwd+bwd+update steps)
         np.testing.assert_allclose(got_losses, ref_losses, rtol=2e-4)
         assert got_losses[-1] < got_losses[0]
+
+    def test_greedy_generation_matches_hf(self):
+        # KV-cached decode path (static cache, one compiled decode step)
+        # must produce the same greedy continuation as HF's generate —
+        # serving-path numerics, not just the teacher-forced forward
+        hf = _hf_model()
+        ours = _ours_from_hf(hf)
+        prompt = np.random.default_rng(2).integers(0, VOCAB, (2, 8))
+        new = 12
+        with torch.no_grad():
+            ref = hf.generate(
+                torch.tensor(prompt), max_new_tokens=new,
+                do_sample=False, use_cache=True,
+                eos_token_id=None,  # random weights can emit the default
+                pad_token_id=0).numpy()  # eos (2); compare full lengths
+        got = np.asarray(ours.generate(
+            paddle.to_tensor(prompt, dtype="int64"),
+            max_new_tokens=new, temperature=0.0))
+        np.testing.assert_array_equal(got[:, prompt.shape[1]:],
+                                      ref[:, prompt.shape[1]:])
+
+
+def _hf_gpt2():
+    cfg = transformers.GPT2Config(
+        vocab_size=256, n_embd=64, n_layer=2, n_head=4, n_inner=128,
+        n_positions=64, layer_norm_epsilon=1e-5,
+        activation_function="gelu_new", attn_pdrop=0.0, embd_pdrop=0.0,
+        resid_pdrop=0.0, attn_implementation="eager")
+    torch.manual_seed(11)
+    return transformers.GPT2LMHeadModel(cfg).eval()
+
+
+def _our_gpt_from_hf(hf):
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+    cfg = GPTConfig(
+        vocab_size=256, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=128,
+        max_position_embeddings=64, layer_norm_epsilon=1e-5,
+        tie_word_embeddings=True)
+    ours = GPTForCausalLM(cfg)
+
+    def put(tensor, arr):
+        arr = np.array(arr.detach().numpy(), dtype=np.float32, copy=True)
+        assert tuple(tensor.shape) == arr.shape, (tensor.shape, arr.shape)
+        tensor.set_value(arr)
+
+    tr = hf.transformer
+    put(ours.gpt.embed_tokens.weight, tr.wte.weight)
+    put(ours.gpt.position_embeddings, tr.wpe.weight)
+    for i, hl in enumerate(tr.h):
+        ol = ours.gpt.layers[i]
+        put(ol.ln_1.weight, hl.ln_1.weight)
+        put(ol.ln_1.bias, hl.ln_1.bias)
+        put(ol.ln_2.weight, hl.ln_2.weight)
+        put(ol.ln_2.bias, hl.ln_2.bias)
+        # HF GPT2 Conv1D stores [in, out] — same layout as ours, no
+        # transpose; the fused QKV split order (q|k|v on the last dim)
+        # also matches
+        put(ol.attn.qkv_proj.weight, hl.attn.c_attn.weight)
+        put(ol.attn.qkv_proj.bias, hl.attn.c_attn.bias)
+        put(ol.attn.o_proj.weight, hl.attn.c_proj.weight)
+        put(ol.attn.o_proj.bias, hl.attn.c_proj.bias)
+        put(ol.mlp.fc_in.weight, hl.mlp.c_fc.weight)
+        put(ol.mlp.fc_in.bias, hl.mlp.c_fc.bias)
+        put(ol.mlp.fc_out.weight, hl.mlp.c_proj.weight)
+        put(ol.mlp.fc_out.bias, hl.mlp.c_proj.bias)
+    put(ours.gpt.ln_f.weight, tr.ln_f.weight)
+    put(ours.gpt.ln_f.bias, tr.ln_f.bias)
+    return ours
+
+
+class TestTorchGPT2Alignment:
+    """Second decoder family vs HF's torch GPT-2 (learned positions,
+    pre-LN LayerNorm with bias, fused QKV, gelu_new, tied head)."""
+
+    def test_logits_match_hf(self):
+        hf = _hf_gpt2()
+        ours = _our_gpt_from_hf(hf)
+        ids = np.random.default_rng(3).integers(0, 256, (2, 20))
+        with torch.no_grad():
+            ref = hf(torch.tensor(ids)).logits.numpy()
+        with paddle.no_grad():
+            got = ours(paddle.to_tensor(ids, dtype="int64")).numpy()
+        np.testing.assert_allclose(got, ref, atol=2e-4, rtol=2e-4)
+
+    def test_loss_curve_matches_hf_sgd(self):
+        hf = _hf_gpt2().train()
+        ours = _our_gpt_from_hf(hf)
+        ids_np = np.random.default_rng(4).integers(0, 256, (2, 20))
+
+        ref_losses = []
+        opt_t = torch.optim.SGD(hf.parameters(), lr=0.1)
+        t_ids = torch.tensor(ids_np)
+        for _ in range(6):
+            out = hf(t_ids, labels=t_ids)
+            opt_t.zero_grad()
+            out.loss.backward()
+            opt_t.step()
+            ref_losses.append(float(out.loss))
+
+        crit = LlamaPretrainingCriterion()  # same shifted-CE semantics
+        opt_p = paddle.optimizer.SGD(learning_rate=0.1,
+                                     parameters=ours.parameters())
+
+        @to_static
+        def step(ids):
+            loss = crit(ours(ids), ids)
+            loss.backward()
+            opt_p.step()
+            opt_p.clear_grad()
+            return loss
+
+        p_ids = paddle.to_tensor(ids_np, dtype="int64")
+        got_losses = [float(step(p_ids)) for _ in range(6)]
+        np.testing.assert_allclose(got_losses, ref_losses, rtol=2e-4)
+        assert got_losses[-1] < got_losses[0]

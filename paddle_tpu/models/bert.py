@@ -72,7 +72,11 @@ class BertEmbeddings(Layer):
         S = input_ids.shape[1]
         pos = ops.arange(0, S, dtype="int64")
         x = self.word_embeddings(input_ids) + self.position_embeddings(pos)
-        if token_type_ids is not None:
+        if token_type_ids is None:
+            # reference semantics: default token_type_ids = zeros, so
+            # segment-0 embeddings are ALWAYS added (not skipped)
+            x = x + self.token_type_embeddings.weight[0]
+        else:
             x = x + self.token_type_embeddings(token_type_ids)
         return self.dropout(self.layer_norm(x))
 

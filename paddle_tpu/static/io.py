@@ -73,15 +73,11 @@ def set_program_state(program, state_dict: Dict[str, Any]):
     params = _named_params(program)
     for k, v in state_dict.items():
         if k in params:
-            # jnp.array (copy): don't alias caller-owned numpy buffers;
-            # validate like Tensor.set_value (loud shape check, keep dtype)
-            cur = params[k]._value
-            val = jnp.array(v)
-            if tuple(val.shape) != tuple(cur.shape):
-                raise ValueError(
-                    f"set_program_state shape mismatch for {k}: "
-                    f"{val.shape} vs {cur.shape}")
-            params[k]._value = val.astype(cur.dtype)
+            # set_value: copy-on-ingest + loud shape check + dtype keep
+            try:
+                params[k].set_value(v)
+            except ValueError as e:
+                raise ValueError(f"set_program_state: {k}: {e}") from None
 
 
 # --- inference export (``save_inference_model`` family) --------------------

@@ -28,6 +28,15 @@ from paddle_tpu.models import (  # noqa: E402
 VOCAB, HIDDEN, INTER, LAYERS, HEADS, KV = 256, 64, 128, 2, 4, 2
 SEQ = 24
 
+def _put(tensor, arr):
+    """Copy a torch parameter into ours (copy=True: jax's CPU backend
+    zero-copy-aliases contiguous numpy arrays and torch updates params in
+    place — an aliased weight would silently track torch's training)."""
+    arr = np.array(arr.detach().numpy(), dtype=np.float32, copy=True)
+    assert tuple(tensor.shape) == arr.shape, (tensor.shape, arr.shape)
+    tensor.set_value(arr)
+
+
 
 def _hf_model():
     hf_cfg = transformers.LlamaConfig(
@@ -49,29 +58,22 @@ def _ours_from_hf(hf):
         rope_theta=10000.0, rms_norm_eps=1e-5)
     ours = LlamaForCausalLM(cfg)
 
-    def put(tensor, arr):
-        # copy=True: jax's CPU backend zero-copy-aliases contiguous numpy
-        # arrays, and torch's optimizer updates params IN PLACE — an
-        # aliased weight would silently track torch's training
-        arr = np.array(arr.detach().numpy(), dtype=np.float32, copy=True)
-        assert tuple(tensor.shape) == arr.shape, (tensor.shape, arr.shape)
-        tensor.set_value(arr)
 
     hfm = hf.model
-    put(ours.llama.embed_tokens.weight, hfm.embed_tokens.weight)
+    _put(ours.llama.embed_tokens.weight, hfm.embed_tokens.weight)
     for i, hl in enumerate(hfm.layers):
         ol = ours.llama.layers[i]
-        put(ol.input_layernorm.weight, hl.input_layernorm.weight)
-        put(ol.post_attention_layernorm.weight,
+        _put(ol.input_layernorm.weight, hl.input_layernorm.weight)
+        _put(ol.post_attention_layernorm.weight,
             hl.post_attention_layernorm.weight)
         for name in ("q_proj", "k_proj", "v_proj", "o_proj"):
-            put(getattr(ol.self_attn, name).weight,
+            _put(getattr(ol.self_attn, name).weight,
                 getattr(hl.self_attn, name).weight.T)
         for name in ("gate_proj", "up_proj", "down_proj"):
-            put(getattr(ol.mlp, name).weight,
+            _put(getattr(ol.mlp, name).weight,
                 getattr(hl.mlp, name).weight.T)
-    put(ours.llama.norm.weight, hfm.norm.weight)
-    put(ours.lm_head.weight, hf.lm_head.weight.T)
+    _put(ours.llama.norm.weight, hfm.norm.weight)
+    _put(ours.lm_head.weight, hf.lm_head.weight.T)
     return ours
 
 
@@ -162,33 +164,29 @@ def _our_gpt_from_hf(hf):
         tie_word_embeddings=True)
     ours = GPTForCausalLM(cfg)
 
-    def put(tensor, arr):
-        arr = np.array(arr.detach().numpy(), dtype=np.float32, copy=True)
-        assert tuple(tensor.shape) == arr.shape, (tensor.shape, arr.shape)
-        tensor.set_value(arr)
 
     tr = hf.transformer
-    put(ours.gpt.embed_tokens.weight, tr.wte.weight)
-    put(ours.gpt.position_embeddings, tr.wpe.weight)
+    _put(ours.gpt.embed_tokens.weight, tr.wte.weight)
+    _put(ours.gpt.position_embeddings, tr.wpe.weight)
     for i, hl in enumerate(tr.h):
         ol = ours.gpt.layers[i]
-        put(ol.ln_1.weight, hl.ln_1.weight)
-        put(ol.ln_1.bias, hl.ln_1.bias)
-        put(ol.ln_2.weight, hl.ln_2.weight)
-        put(ol.ln_2.bias, hl.ln_2.bias)
+        _put(ol.ln_1.weight, hl.ln_1.weight)
+        _put(ol.ln_1.bias, hl.ln_1.bias)
+        _put(ol.ln_2.weight, hl.ln_2.weight)
+        _put(ol.ln_2.bias, hl.ln_2.bias)
         # HF GPT2 Conv1D stores [in, out] — same layout as ours, no
         # transpose; the fused QKV split order (q|k|v on the last dim)
         # also matches
-        put(ol.attn.qkv_proj.weight, hl.attn.c_attn.weight)
-        put(ol.attn.qkv_proj.bias, hl.attn.c_attn.bias)
-        put(ol.attn.o_proj.weight, hl.attn.c_proj.weight)
-        put(ol.attn.o_proj.bias, hl.attn.c_proj.bias)
-        put(ol.mlp.fc_in.weight, hl.mlp.c_fc.weight)
-        put(ol.mlp.fc_in.bias, hl.mlp.c_fc.bias)
-        put(ol.mlp.fc_out.weight, hl.mlp.c_proj.weight)
-        put(ol.mlp.fc_out.bias, hl.mlp.c_proj.bias)
-    put(ours.gpt.ln_f.weight, tr.ln_f.weight)
-    put(ours.gpt.ln_f.bias, tr.ln_f.bias)
+        _put(ol.attn.qkv_proj.weight, hl.attn.c_attn.weight)
+        _put(ol.attn.qkv_proj.bias, hl.attn.c_attn.bias)
+        _put(ol.attn.o_proj.weight, hl.attn.c_proj.weight)
+        _put(ol.attn.o_proj.bias, hl.attn.c_proj.bias)
+        _put(ol.mlp.fc_in.weight, hl.mlp.c_fc.weight)
+        _put(ol.mlp.fc_in.bias, hl.mlp.c_fc.bias)
+        _put(ol.mlp.fc_out.weight, hl.mlp.c_proj.weight)
+        _put(ol.mlp.fc_out.bias, hl.mlp.c_proj.bias)
+    _put(ours.gpt.ln_f.weight, tr.ln_f.weight)
+    _put(ours.gpt.ln_f.bias, tr.ln_f.bias)
     return ours
 
 
@@ -235,5 +233,144 @@ class TestTorchGPT2Alignment:
 
         p_ids = paddle.to_tensor(ids_np, dtype="int64")
         got_losses = [float(step(p_ids)) for _ in range(6)]
+        np.testing.assert_allclose(got_losses, ref_losses, rtol=2e-4)
+        assert got_losses[-1] < got_losses[0]
+
+
+def _hf_bert():
+    cfg = transformers.BertConfig(
+        vocab_size=128, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=2, intermediate_size=64,
+        max_position_embeddings=64, type_vocab_size=2,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+        layer_norm_eps=1e-12, attn_implementation="eager")
+    torch.manual_seed(21)
+    return cfg
+
+
+def _our_bert_from_hf(hf_bert):
+    from paddle_tpu.models import BertConfig, BertModel
+
+    cfg = BertConfig.tiny(hidden_dropout_prob=0.0,
+                          attention_probs_dropout_prob=0.0)
+    ours = BertModel(cfg)
+    _map_bert_encoder(ours, hf_bert)
+    return ours
+
+
+def _map_bert_encoder(ours, hf_bert):
+    """hf_bert: transformers BertModel (possibly .bert of a head model)."""
+
+
+    emb = hf_bert.embeddings
+    _put(ours.embeddings.word_embeddings.weight, emb.word_embeddings.weight)
+    _put(ours.embeddings.position_embeddings.weight,
+        emb.position_embeddings.weight)
+    _put(ours.embeddings.token_type_embeddings.weight,
+        emb.token_type_embeddings.weight)
+    _put(ours.embeddings.layer_norm.weight, emb.LayerNorm.weight)
+    _put(ours.embeddings.layer_norm.bias, emb.LayerNorm.bias)
+    for i, hl in enumerate(hf_bert.encoder.layer):
+        ol = ours.encoder[i]
+        pairs = [
+            (ol.attention.q_proj, hl.attention.self.query),
+            (ol.attention.k_proj, hl.attention.self.key),
+            (ol.attention.v_proj, hl.attention.self.value),
+            (ol.attention.out_proj, hl.attention.output.dense),
+            (ol.linear1, hl.intermediate.dense),
+            (ol.linear2, hl.output.dense),
+        ]
+        for o, h in pairs:
+            _put(o.weight, h.weight.T)
+            _put(o.bias, h.bias)
+        _put(ol.attn_norm.weight, hl.attention.output.LayerNorm.weight)
+        _put(ol.attn_norm.bias, hl.attention.output.LayerNorm.bias)
+        _put(ol.ffn_norm.weight, hl.output.LayerNorm.weight)
+        _put(ol.ffn_norm.bias, hl.output.LayerNorm.bias)
+    if hf_bert.pooler is not None:
+        _put(ours.pooler.dense.weight, hf_bert.pooler.dense.weight.T)
+        _put(ours.pooler.dense.bias, hf_bert.pooler.dense.bias)
+
+
+class TestTorchBertAlignment:
+    """Third family — the bidirectional encoder (post-LN, exact gelu,
+    additive padding mask, pooler) vs HF's torch BertModel, plus the
+    BASELINE config-#3 capability: the SQuAD span head fine-tune curve."""
+
+    def test_encoder_and_pooler_match_hf(self):
+        hf = transformers.BertModel(_hf_bert()).eval()
+        ours = _our_bert_from_hf(hf)
+        rng = np.random.default_rng(5)
+        ids = rng.integers(0, 128, (2, 16))
+        mask = np.ones((2, 16), np.int64)
+        mask[1, 10:] = 0  # padding on row 1 exercises the mask convention
+        tt = rng.integers(0, 2, (2, 16))
+        with torch.no_grad():
+            ref = hf(torch.tensor(ids), attention_mask=torch.tensor(mask),
+                     token_type_ids=torch.tensor(tt))
+        with paddle.no_grad():
+            seq, pooled = ours(
+                paddle.to_tensor(ids, dtype="int64"),
+                token_type_ids=paddle.to_tensor(tt, dtype="int64"),
+                attention_mask=paddle.to_tensor(mask, dtype="int64"))
+        np.testing.assert_allclose(
+            seq.numpy()[0], ref.last_hidden_state.numpy()[0],
+            atol=2e-4, rtol=2e-4)
+        # padded positions of row 1 are unspecified; compare valid prefix
+        np.testing.assert_allclose(
+            seq.numpy()[1, :10], ref.last_hidden_state.numpy()[1, :10],
+            atol=2e-4, rtol=2e-4)
+        np.testing.assert_allclose(pooled.numpy(), ref.pooler_output.numpy(),
+                                   atol=2e-4, rtol=2e-4)
+
+    def test_squad_finetune_curve_matches_hf(self):
+        from paddle_tpu.models import BertConfig, BertForQuestionAnswering
+        from paddle_tpu.nn import functional as F
+
+        hf = transformers.BertForQuestionAnswering(_hf_bert()).train()
+        cfg = BertConfig.tiny(hidden_dropout_prob=0.0,
+                              attention_probs_dropout_prob=0.0)
+        ours = BertForQuestionAnswering(cfg)
+        _map_bert_encoder(ours.bert, hf.bert)
+
+
+        _put(ours.qa_outputs.weight, hf.qa_outputs.weight.T)
+        _put(ours.qa_outputs.bias, hf.qa_outputs.bias)
+
+        rng = np.random.default_rng(6)
+        # ids from [1, 128): id 0 is pad — our BertModel masks pads by
+        # default (PaddleNLP reference semantics) while HF attends to them
+        ids_np = rng.integers(1, 128, (4, 16))
+        start_np = rng.integers(0, 16, (4,))
+        end_np = rng.integers(0, 16, (4,))
+
+        ref_losses = []
+        opt_t = torch.optim.SGD(hf.parameters(), lr=0.05)
+        for _ in range(5):
+            out = hf(torch.tensor(ids_np),
+                     start_positions=torch.tensor(start_np),
+                     end_positions=torch.tensor(end_np))
+            opt_t.zero_grad()
+            out.loss.backward()
+            opt_t.step()
+            ref_losses.append(float(out.loss))
+
+        opt_p = paddle.optimizer.SGD(learning_rate=0.05,
+                                     parameters=ours.parameters())
+
+        @to_static
+        def step(ids, start, end):
+            s_logits, e_logits = ours(ids)
+            loss = (F.cross_entropy(s_logits, start)
+                    + F.cross_entropy(e_logits, end)) / 2.0
+            loss.backward()
+            opt_p.step()
+            opt_p.clear_grad()
+            return loss
+
+        p = (paddle.to_tensor(ids_np, dtype="int64"),
+             paddle.to_tensor(start_np, dtype="int64"),
+             paddle.to_tensor(end_np, dtype="int64"))
+        got_losses = [float(step(*p)) for _ in range(5)]
         np.testing.assert_allclose(got_losses, ref_losses, rtol=2e-4)
         assert got_losses[-1] < got_losses[0]

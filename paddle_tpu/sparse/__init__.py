@@ -153,8 +153,12 @@ def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None, stop_
     val = values._value if isinstance(values, Tensor) else jnp.array(values)
     if shape is None:
         # reference semantics: infer the dense shape from the indices
-        # (max coordinate + 1 per sparse dim, plus any dense value dims)
-        sparse_shape = tuple(int(d) + 1 for d in jnp.max(idx, axis=1))
+        # (max coordinate + 1 per sparse dim, plus any dense value dims);
+        # nnz == 0 means size-0 sparse dims, like torch/paddle
+        if idx.shape[1] == 0:
+            sparse_shape = (0,) * idx.shape[0]
+        else:
+            sparse_shape = tuple(int(d) + 1 for d in jnp.max(idx, axis=1))
         shape = sparse_shape + tuple(val.shape[1:])
     bcoo = jsparse.BCOO((val, idx.T.astype(jnp.int32)), shape=tuple(shape))
     return SparseCooTensor(bcoo, stop_gradient)

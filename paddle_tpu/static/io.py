@@ -68,8 +68,6 @@ def load_program_state(model_path: str, var_list=None) -> Dict[str, Any]:
 
 
 def set_program_state(program, state_dict: Dict[str, Any]):
-    import jax.numpy as jnp
-
     params = _named_params(program)
     for k, v in state_dict.items():
         if k in params:

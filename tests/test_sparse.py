@@ -609,29 +609,17 @@ class TestCooShapeInference:
     appended, and size-0 sparse dims for empty indices."""
 
     def test_inferred_shape(self):
-        import numpy as np
-
-        import paddle_tpu as paddle
-
         t = paddle.sparse.sparse_coo_tensor([[0, 2], [1, 3]], [1.0, 2.0])
         assert t.shape == [3, 4]
         d = t.to_dense().numpy()
         assert d[2, 3] == 2.0 and d[0, 1] == 1.0
 
     def test_inferred_shape_with_dense_dims(self):
-        import numpy as np
-
-        import paddle_tpu as paddle
-
         vals = np.ones((2, 5), np.float32)  # nnz=2, dense dim 5
         t = paddle.sparse.sparse_coo_tensor([[1, 3]], vals)
         assert t.shape == [4, 5]
 
     def test_empty_indices(self):
-        import numpy as np
-
-        import paddle_tpu as paddle
-
         t = paddle.sparse.sparse_coo_tensor(
             np.zeros((2, 0), np.int64), np.zeros((0,), np.float32))
         assert t.shape == [0, 0]

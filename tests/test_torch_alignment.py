@@ -527,3 +527,56 @@ class TestTorchResNetAlignment:
         np.testing.assert_allclose(got_losses, ref_losses,
                                    rtol=5e-3, atol=1e-4)
         assert got_losses[-1] < got_losses[0]
+
+
+class TestShardedTrainingMatchesTorch:
+    """The capstone claim, stated directly: hybrid-parallel GSPMD training
+    on an 8-device mesh (dp2 x mp4, host_build shard-to-mesh init)
+    reproduces torch's single-device loss curve on the same weights/data.
+    Distributed execution is a layout choice, not a numerics choice."""
+
+    @pytest.mark.slow
+    def test_dp2mp4_curve_matches_torch(self):
+        from paddle_tpu.distributed import topology
+
+        hf = _hf_model().train()
+        ids_np = np.random.default_rng(12).integers(0, VOCAB, (2, SEQ))
+
+        prev = topology.get_mesh()
+        topology.init_mesh(dp=2, mp=4)
+        try:
+            from paddle_tpu.utils import host_build
+
+            # map weights BEFORE torch trains (it updates in place)
+            ours = host_build(lambda: _ours_from_hf(hf))
+
+            ref = []
+            opt_t = torch.optim.SGD(hf.parameters(), lr=0.1)
+            t_ids = torch.tensor(ids_np)
+            for _ in range(5):
+                out = hf(t_ids, labels=t_ids)
+                opt_t.zero_grad()
+                out.loss.backward()
+                opt_t.step()
+                ref.append(float(out.loss))
+
+            n_dev = len(next(iter(
+                ours.parameters()))._value.sharding.device_set)
+            assert n_dev == 8
+            crit = LlamaPretrainingCriterion()
+            opt_p = paddle.optimizer.SGD(learning_rate=0.1,
+                                         parameters=ours.parameters())
+
+            @to_static
+            def step(ids):
+                loss = crit(ours(ids), ids)
+                loss.backward()
+                opt_p.step()
+                opt_p.clear_grad()
+                return loss
+
+            p_ids = paddle.to_tensor(ids_np, dtype="int64")
+            got = [float(step(p_ids)) for _ in range(5)]
+        finally:
+            topology.set_mesh(prev)
+        np.testing.assert_allclose(got, ref, rtol=2e-4)

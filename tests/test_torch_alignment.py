@@ -59,22 +59,32 @@ def _ours_from_hf(hf):
     ours = LlamaForCausalLM(cfg)
 
 
+    _map_llama_body(ours, hf, _map_dense_mlp)
+    return ours
+
+
+def _map_dense_mlp(ol, hl):
+    for name in ("gate_proj", "up_proj", "down_proj"):
+        _put(getattr(ol.mlp, name).weight,
+             getattr(hl.mlp, name).weight.T)
+
+
+def _map_llama_body(ours, hf, map_mlp):
+    """Shared Llama-body mapping (embed/norms/attention/final norm/head);
+    ``map_mlp(our_layer, hf_layer)`` handles the dense-vs-MoE FFN."""
     hfm = hf.model
     _put(ours.llama.embed_tokens.weight, hfm.embed_tokens.weight)
     for i, hl in enumerate(hfm.layers):
         ol = ours.llama.layers[i]
         _put(ol.input_layernorm.weight, hl.input_layernorm.weight)
         _put(ol.post_attention_layernorm.weight,
-            hl.post_attention_layernorm.weight)
+             hl.post_attention_layernorm.weight)
         for name in ("q_proj", "k_proj", "v_proj", "o_proj"):
             _put(getattr(ol.self_attn, name).weight,
-                getattr(hl.self_attn, name).weight.T)
-        for name in ("gate_proj", "up_proj", "down_proj"):
-            _put(getattr(ol.mlp, name).weight,
-                getattr(hl.mlp, name).weight.T)
+                 getattr(hl.self_attn, name).weight.T)
+        map_mlp(ol, hl)
     _put(ours.llama.norm.weight, hfm.norm.weight)
     _put(ours.lm_head.weight, hf.lm_head.weight.T)
-    return ours
 
 
 class TestTorchLlamaAlignment:
@@ -580,3 +590,58 @@ class TestShardedTrainingMatchesTorch:
         finally:
             topology.set_mesh(prev)
         np.testing.assert_allclose(got, ref, rtol=2e-4)
+
+
+class TestTorchMixtralAlignment:
+    """Fifth family — sparse MoE vs HF's torch Mixtral. With ample
+    capacity (no token drops) our GShard top-2 renormalization
+    (g1/(g1+g2)) is exactly Mixtral's norm_topk_prob routing, and the
+    fused stacked-expert SwiGLU einsums must match the per-expert
+    Linear loop."""
+
+    def test_moe_logits_match_mixtral(self):
+        E = 4
+        hf_cfg = transformers.MixtralConfig(
+            vocab_size=VOCAB, hidden_size=HIDDEN, intermediate_size=INTER,
+            num_hidden_layers=2, num_attention_heads=HEADS,
+            num_key_value_heads=KV, max_position_embeddings=64,
+            rope_theta=10000.0, rms_norm_eps=1e-5,
+            num_local_experts=E, num_experts_per_tok=2,
+            attention_dropout=0.0, use_cache=False,
+            attn_implementation="eager")
+        torch.manual_seed(41)
+        hf = transformers.MixtralForCausalLM(hf_cfg).eval()
+
+        cfg = LlamaConfig(
+            vocab_size=VOCAB, hidden_size=HIDDEN, intermediate_size=INTER,
+            num_hidden_layers=2, num_attention_heads=HEADS,
+            num_key_value_heads=KV, max_position_embeddings=64,
+            rope_theta=10000.0, rms_norm_eps=1e-5,
+            num_experts=E, num_experts_per_tok=2,
+            moe_intermediate_size=INTER, num_shared_experts=0)
+        ours = LlamaForCausalLM(cfg)
+
+        def map_moe_mlp(ol, hl):
+            moe = ol.mlp.moe
+            _put(moe.gate.weight, hl.block_sparse_moe.gate.weight.T)
+            ex = hl.block_sparse_moe.experts
+            # Mixtral w1=gate, w3=up, w2=down (each torch [out, in]);
+            # ours: stacked [E, h, ff] w_gate/w_in and [E, ff, h] w_out
+            _put(moe.experts.w_gate,
+                 torch.stack([e.w1.weight.T for e in ex]))
+            _put(moe.experts.w_in,
+                 torch.stack([e.w3.weight.T for e in ex]))
+            _put(moe.experts.w_out,
+                 torch.stack([e.w2.weight.T for e in ex]))
+            # capacity >= all tokens routed to one expert: parity requires
+            # the no-drop regime (Mixtral is dropless token-choice)
+            moe.capacity_factor = float(E)
+
+        _map_llama_body(ours, hf, map_moe_mlp)
+
+        ids = np.random.default_rng(13).integers(0, VOCAB, (2, SEQ))
+        with torch.no_grad():
+            ref = hf(torch.tensor(ids)).logits.numpy()
+        with paddle.no_grad():
+            got = ours(paddle.to_tensor(ids, dtype="int64")).numpy()
+        np.testing.assert_allclose(got, ref, atol=3e-4, rtol=3e-4)

@@ -79,11 +79,17 @@ class LlamaConfig:
                                         # compile; same math, same params)
     dtype: str = "float32"
     virtual_pp_degree: int = 1          # interleaved VPP chunks per device
+    attention_bias: bool = False        # q/k/v biases (Qwen2 family)
     # MoE knobs (0 experts = dense; DeepSeek/Qwen2-MoE style otherwise)
     num_experts: int = 0
     num_experts_per_tok: int = 2
     moe_intermediate_size: int = 0      # per-expert FFN width
     num_shared_experts: int = 0         # always-on experts (DeepSeek-MoE)
+    moe_norm_topk_prob: bool = True     # renormalize top-k gate weights
+                                        # (GShard/Mixtral); False = raw
+                                        # softmax probs (DeepSeek/Qwen2-MoE)
+    moe_shared_expert_gated: bool = False  # sigmoid-gate the shared
+                                        # expert output (Qwen2-MoE)
     aux_loss_weight: float = 0.01
 
     @property
@@ -121,7 +127,8 @@ class LlamaConfig:
             num_hidden_layers=28, num_attention_heads=16,
             num_key_value_heads=16, max_position_embeddings=4096,
             num_experts=64, num_experts_per_tok=6,
-            moe_intermediate_size=1408, num_shared_experts=2)
+            moe_intermediate_size=1408, num_shared_experts=2,
+            moe_norm_topk_prob=False)  # DeepSeek-MoE: raw softmax gates
         defaults.update(kw)
         return cls(**defaults)
 
@@ -134,7 +141,12 @@ class LlamaConfig:
             num_hidden_layers=28, num_attention_heads=28,
             num_key_value_heads=4, max_position_embeddings=8192,
             num_experts=64, num_experts_per_tok=8,
-            moe_intermediate_size=2560, num_shared_experts=1)
+            # shared_expert_intermediate_size 20480 = 8 x 2560 (ONE gated
+            # shared MLP of that width; our sizing is ff x n_shared)
+            moe_intermediate_size=2560, num_shared_experts=8,
+            moe_norm_topk_prob=False,      # Qwen2-MoE raw softmax gates
+            moe_shared_expert_gated=True,  # sigmoid-gated shared expert
+            attention_bias=True)           # Qwen2 q/k/v biases
         defaults.update(kw)
         return cls(**defaults)
 
@@ -180,14 +192,15 @@ class LlamaAttention(Layer):
         self.num_heads = config.num_attention_heads
         self.num_kv_heads = config.num_key_value_heads
         init = Normal(0.0, config.initializer_range)
+        bias = config.attention_bias
         self.q_proj = ColumnParallelLinear(h, self.num_heads * hd,
-                                           has_bias=False, gather_output=False,
+                                           has_bias=bias, gather_output=False,
                                            weight_attr=init)
         self.k_proj = ColumnParallelLinear(h, self.num_kv_heads * hd,
-                                           has_bias=False, gather_output=False,
+                                           has_bias=bias, gather_output=False,
                                            weight_attr=init)
         self.v_proj = ColumnParallelLinear(h, self.num_kv_heads * hd,
-                                           has_bias=False, gather_output=False,
+                                           has_bias=bias, gather_output=False,
                                            weight_attr=init)
         self.o_proj = RowParallelLinear(self.num_heads * hd, h, has_bias=False,
                                         input_is_parallel=True, weight_attr=init)
@@ -337,21 +350,31 @@ class LlamaMoEBlock(Layer):
 
     def __init__(self, config: LlamaConfig):
         super().__init__()
-        from ..parallel.moe import FusedMoEMLP, GShardGate, MoELayer, SwitchGate
+        from ..nn.common import Linear
+        from ..parallel.moe import FusedMoEMLP, MoELayer, TopKGate
 
         ff = config.moe_intermediate_size or config.intermediate_size
-        gate_cls = SwitchGate if config.num_experts_per_tok == 1 else GShardGate
         self.moe = MoELayer(
             config.hidden_size,
             FusedMoEMLP(config.num_experts, config.hidden_size, ff,
                         activation="swiglu"),
-            gate=gate_cls(config.hidden_size, config.num_experts))
+            # k=1 keeps Switch semantics (raw prob) regardless of the
+            # flag — _topk_gating never renormalizes a single gate
+            gate=TopKGate(config.hidden_size, config.num_experts,
+                          k=config.num_experts_per_tok,
+                          normalize=config.moe_norm_topk_prob))
         if config.num_shared_experts > 0:
             shared_cfg = LlamaConfig(**{**config.__dict__})
             shared_cfg.intermediate_size = ff * config.num_shared_experts
             self.shared_experts = LlamaMLP(shared_cfg)
+            # Qwen2-MoE: shared-expert output scaled by a learned sigmoid
+            # gate (modeling_qwen2_moe shared_expert_gate)
+            self.shared_expert_gate = (
+                Linear(config.hidden_size, 1, bias_attr=False)
+                if config.moe_shared_expert_gated else None)
         else:
             self.shared_experts = None
+            self.shared_expert_gate = None
 
     @property
     def aux_loss(self):
@@ -360,7 +383,15 @@ class LlamaMoEBlock(Layer):
     def forward(self, x):
         out = self.moe(x)
         if self.shared_experts is not None:
-            out = out + self.shared_experts(x)
+            shared = self.shared_experts(x)
+            if self.shared_expert_gate is not None:
+                gate = self.shared_expert_gate(x)
+                shared = run_op(
+                    "shared_expert_gate",
+                    lambda s, g: s * jax.nn.sigmoid(
+                        g.astype(jnp.float32)).astype(s.dtype),
+                    shared, gate)
+            out = out + shared
         return out
 
 
@@ -426,7 +457,10 @@ class LlamaModel(Layer):
         elif pp_microbatches and axis_size("pp") > 1:
             h = pipeline_forward(self._pipeline(), h, pp_microbatches)
         elif (self.config.scan_layers and self.config.num_experts == 0
+                and not self.config.attention_bias
                 and axis_size("sep") == 1):
+            # biased attention (Qwen2-style) keeps the module loop: the
+            # scan body's stacked-weight roles are the bias-free dense set
             h = self._scan_stack(h)
         else:
             for layer in self.layers:

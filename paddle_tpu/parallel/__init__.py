@@ -15,7 +15,7 @@ way of steering GSPMD/shard_map over the global 5-axis mesh
 """
 
 from . import moe, mp_layers, pipeline, random, recompute, ring_attention, sequence_parallel, sharding, utils  # noqa: F401
-from .moe import FusedMoEMLP, GShardGate, MoELayer, NaiveGate, SwitchGate, global_gather, global_scatter  # noqa: F401
+from .moe import FusedMoEMLP, GShardGate, MoELayer, NaiveGate, SwitchGate, TopKGate, global_gather, global_scatter  # noqa: F401
 from .mp_layers import ColumnParallelLinear, ParallelCrossEntropy, RowParallelLinear, VocabParallelEmbedding  # noqa: F401
 from .pipeline import LayerDesc, PipelineLayer, SharedLayerDesc, pipeline_forward, pipeline_spmd  # noqa: F401
 from .random import RNGStatesTracker, get_rng_state_tracker, model_parallel_random_seed  # noqa: F401

@@ -36,66 +36,59 @@ def _one_hot(idx, n):
     return jax.nn.one_hot(idx, n, dtype=jnp.float32)
 
 
-def _top2_gating(logits, capacity):
-    """GShard top-2 gating with capacity pruning and load-balance aux loss
-    (moe/gate/gshard_gate.py analog). logits: [T, E] float32."""
+def _topk_gating(logits, capacity, k, normalize=True):
+    """Generic top-k gating with GShard capacity semantics (generalizes
+    ``moe/gate/gshard_gate.py``): every token routes to its k highest-prob
+    experts, all j-th choices take capacity slots before any (j+1)-th
+    choice, and tokens beyond an expert's capacity are dropped.
+
+    ``normalize=True`` renormalizes the surviving gate weights to sum 1
+    (GShard / Mixtral ``norm_topk_prob``); ``normalize=False`` keeps the
+    raw softmax probabilities (Switch top-1, DeepSeek-MoE, Qwen2-MoE).
+    k=1 never renormalizes — a single surviving gate would be pinned to
+    exactly 1.0, erasing the learned gate magnitude.
+    logits: [T, E] float32."""
+    normalize = normalize and k > 1
     T, E = logits.shape
     probs = jax.nn.softmax(logits, axis=-1)
 
-    g1_idx = jnp.argmax(probs, axis=-1)
-    m1 = _one_hot(g1_idx, E)
-    g1 = jnp.sum(probs * m1, axis=-1)
+    # choice masks in priority order: j-th mask = each token's j-th pick
+    remaining = probs
+    masks, gates = [], []
+    for _ in range(k):
+        idx = jnp.argmax(remaining, axis=-1)
+        m = _one_hot(idx, E)
+        masks.append(m)
+        gates.append(jnp.sum(probs * m, axis=-1))
+        remaining = remaining * (1.0 - m)
 
-    probs2 = probs * (1.0 - m1)
-    g2_idx = jnp.argmax(probs2, axis=-1)
-    m2 = _one_hot(g2_idx, E)
-    g2 = jnp.sum(probs2 * m2, axis=-1)
-
-    # aux loss: mean(prob per expert) * mean(tokens-routed per expert) * E
-    density = jnp.mean(m1, axis=0)
+    # aux loss: mean(prob per expert) * mean(tokens top-1-routed) * E
+    density = jnp.mean(masks[0], axis=0)
     density_proxy = jnp.mean(probs, axis=0)
     aux = jnp.sum(density * density_proxy) * E
 
-    # capacity positions by cumulative count (tokens beyond capacity dropped)
-    pos1 = jnp.cumsum(m1, axis=0) * m1 - 1.0
-    m1 = m1 * (pos1 < capacity)
-    pos2 = (jnp.cumsum(m2, axis=0) + jnp.sum(m1, axis=0, keepdims=True)) * m2 - 1.0
-    m2 = m2 * (pos2 < capacity)
+    # capacity positions by cumulative count; offsets carry KEPT slots of
+    # all higher-priority choices (tokens beyond capacity dropped)
+    offset = jnp.zeros((1, E), probs.dtype)
+    kept, pos = [], []
+    for m in masks:
+        p = (jnp.cumsum(m, axis=0) + offset) * m - 1.0
+        m = m * (p < capacity)
+        offset = offset + jnp.sum(m, axis=0, keepdims=True)
+        kept.append(m)
+        pos.append(p)
 
-    # renormalize the two gates over surviving assignments
-    g1 = g1 * jnp.sum(m1, axis=-1)
-    g2 = g2 * jnp.sum(m2, axis=-1)
-    denom = g1 + g2
-    denom = jnp.where(denom > 0, denom, 1.0)
-    g1, g2 = g1 / denom, g2 / denom
+    gates = [g * jnp.sum(m, axis=-1) for g, m in zip(gates, kept)]
+    if normalize:
+        denom = sum(gates)
+        denom = jnp.where(denom > 0, denom, 1.0)
+        gates = [g / denom for g in gates]
 
-    p1 = jnp.sum(pos1 * m1, axis=-1).astype(jnp.int32)
-    p2 = jnp.sum(pos2 * m2, axis=-1).astype(jnp.int32)
-    # combine[t, e, c]
-    combine = (
-        g1[:, None, None] * m1[:, :, None] * _one_hot(p1, capacity)[:, None, :]
-        + g2[:, None, None] * m2[:, :, None] * _one_hot(p2, capacity)[:, None, :]
-    )
-    dispatch = combine > 0.0
-    return combine, dispatch, aux
-
-
-def _top1_gating(logits, capacity):
-    """Switch-transformer top-1 gating (moe/gate/switch_gate.py analog)."""
-    T, E = logits.shape
-    probs = jax.nn.softmax(logits, axis=-1)
-    idx = jnp.argmax(probs, axis=-1)
-    m1 = _one_hot(idx, E)
-    g1 = jnp.sum(probs * m1, axis=-1)
-
-    density = jnp.mean(m1, axis=0)
-    density_proxy = jnp.mean(probs, axis=0)
-    aux = jnp.sum(density * density_proxy) * E
-
-    pos1 = jnp.cumsum(m1, axis=0) * m1 - 1.0
-    m1 = m1 * (pos1 < capacity)
-    p1 = jnp.sum(pos1 * m1, axis=-1).astype(jnp.int32)
-    combine = g1[:, None, None] * m1[:, :, None] * _one_hot(p1, capacity)[:, None, :]
+    combine = jnp.zeros((T, E, capacity), probs.dtype)
+    for g, m, p in zip(gates, kept, pos):
+        pi = jnp.sum(p * m, axis=-1).astype(jnp.int32)
+        combine = combine + (g[:, None, None] * m[:, :, None]
+                             * _one_hot(pi, capacity)[:, None, :])
     dispatch = combine > 0.0
     return combine, dispatch, aux
 
@@ -117,18 +110,29 @@ class BaseGate(Layer):
             x, self.weight)
 
 
-class GShardGate(BaseGate):
-    top_k = 2
+class TopKGate(BaseGate):
+    """Generic top-k gate: k routed experts per token with GShard capacity
+    semantics; ``normalize=False`` keeps raw softmax weights (DeepSeek-MoE
+    / Qwen2-MoE ``norm_topk_prob=False``)."""
+
+    def __init__(self, d_model: int, num_experts: int, k: int = 2,
+                 normalize: bool = True):
+        super().__init__(d_model, num_experts)
+        self.top_k = k
+        self.normalize = normalize
 
     def gating(self, logits_val, capacity):
-        return _top2_gating(logits_val, capacity)
+        return _topk_gating(logits_val, capacity, self.top_k, self.normalize)
 
 
-class SwitchGate(BaseGate):
-    top_k = 1
+class GShardGate(TopKGate):
+    def __init__(self, d_model: int, num_experts: int):
+        super().__init__(d_model, num_experts, k=2, normalize=True)
 
-    def gating(self, logits_val, capacity):
-        return _top1_gating(logits_val, capacity)
+
+class SwitchGate(TopKGate):
+    def __init__(self, d_model: int, num_experts: int):
+        super().__init__(d_model, num_experts, k=1, normalize=False)
 
 
 class NaiveGate(GShardGate):

@@ -145,9 +145,19 @@ class TestMoELlama:
     def test_switch_top1_variant(self):
         cfg = LlamaConfig.tiny_moe(num_experts_per_tok=1)
         m = LlamaForCausalLM(cfg)
-        from paddle_tpu.parallel.moe import SwitchGate
+        gate = m.llama.layers[0].mlp.moe.gate
+        assert gate.top_k == 1
+        # Switch semantics: raw softmax prob as the gate weight —
+        # _topk_gating never renormalizes k=1 (a single surviving gate
+        # would be pinned to exactly 1.0)
+        import jax.numpy as jnp
 
-        assert isinstance(m.llama.layers[0].mlp.moe.gate, SwitchGate)
+        from paddle_tpu.parallel.moe import _topk_gating
+
+        logits = jnp.array([[2.0, 0.0, -1.0, 0.5]], jnp.float32)
+        combine, _, _ = _topk_gating(logits, capacity=4, k=1, normalize=True)
+        w = float(jnp.sum(combine))
+        assert 0.0 < w < 0.999  # raw prob, not renormalized to 1.0
         ids = _ids(cfg, low=0)
         assert m(ids).shape == [2, 16, cfg.vocab_size]
 

@@ -196,3 +196,31 @@ def test_gpt_scan_equivalence():
     for n in g1:
         np.testing.assert_allclose(g1[n], g2[n], rtol=1e-4, atol=1e-6,
                                    err_msg=n)
+
+
+class TestScanBiasExclusion:
+    def test_attention_bias_falls_back_to_module_loop(self):
+        """Qwen2-style biased attention keeps the module loop (the scan
+        body's stacked roles are the bias-free dense set) — the config
+        combination must run, not raise."""
+        import numpy as np
+
+        import paddle_tpu as paddle
+        from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+        cfg = LlamaConfig.tiny(attention_bias=True, scan_layers=True,
+                               num_hidden_layers=2)
+        paddle.seed(0)
+        m = LlamaForCausalLM(cfg)
+        ids = paddle.to_tensor(
+            np.random.default_rng(0).integers(0, cfg.vocab_size, (2, 8)),
+            dtype="int64")
+        out = m(ids)
+        assert out.shape == [2, 8, cfg.vocab_size]
+
+        cfg2 = LlamaConfig.tiny(attention_bias=True, scan_layers=False,
+                                num_hidden_layers=2)
+        paddle.seed(0)
+        m2 = LlamaForCausalLM(cfg2)
+        np.testing.assert_allclose(out.numpy(), m2(ids).numpy(),
+                                   rtol=1e-6, atol=1e-6)

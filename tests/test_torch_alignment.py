@@ -645,3 +645,78 @@ class TestTorchMixtralAlignment:
         with paddle.no_grad():
             got = ours(paddle.to_tensor(ids, dtype="int64")).numpy()
         np.testing.assert_allclose(got, ref, atol=3e-4, rtol=3e-4)
+
+
+class TestTorchQwen2MoeAlignment:
+    """Sixth family — Qwen2-MoE vs HF torch: generic top-k routing
+    (k=3 here, exercising the k>2 gate), norm_topk_prob=False (raw
+    softmax gate weights), q/k/v biases, and the sigmoid-gated shared
+    expert. This is BASELINE config #5's other namesake."""
+
+    def test_logits_match_qwen2_moe(self):
+        E, K = 4, 3
+        hf_cfg = transformers.Qwen2MoeConfig(
+            vocab_size=VOCAB, hidden_size=HIDDEN, intermediate_size=INTER,
+            num_hidden_layers=2, num_attention_heads=HEADS,
+            num_key_value_heads=KV, max_position_embeddings=64,
+            rope_theta=10000.0, rms_norm_eps=1e-5,
+            num_experts=E, num_experts_per_tok=K, norm_topk_prob=False,
+            moe_intermediate_size=48, shared_expert_intermediate_size=96,
+            decoder_sparse_step=1, mlp_only_layers=[],
+            attention_dropout=0.0, use_cache=False, tie_word_embeddings=False,
+            attn_implementation="eager")
+        torch.manual_seed(43)
+        hf = transformers.Qwen2MoeForCausalLM(hf_cfg).eval()
+
+        cfg = LlamaConfig(
+            vocab_size=VOCAB, hidden_size=HIDDEN, intermediate_size=INTER,
+            num_hidden_layers=2, num_attention_heads=HEADS,
+            num_key_value_heads=KV, max_position_embeddings=64,
+            rope_theta=10000.0, rms_norm_eps=1e-5,
+            num_experts=E, num_experts_per_tok=K, moe_norm_topk_prob=False,
+            moe_intermediate_size=48, num_shared_experts=2,  # 2 x 48 = 96
+            moe_shared_expert_gated=True, attention_bias=True)
+        ours = LlamaForCausalLM(cfg)
+
+        def map_qwen_moe_mlp(ol, hl):
+            moe = ol.mlp.moe
+            blk = hl.mlp
+            _put(moe.gate.weight, blk.gate.weight.T)
+            ex = blk.experts
+            _put(moe.experts.w_gate,
+                 torch.stack([e.gate_proj.weight.T for e in ex]))
+            _put(moe.experts.w_in,
+                 torch.stack([e.up_proj.weight.T for e in ex]))
+            _put(moe.experts.w_out,
+                 torch.stack([e.down_proj.weight.T for e in ex]))
+            sh = blk.shared_expert
+            _put(ol.mlp.shared_experts.gate_proj.weight, sh.gate_proj.weight.T)
+            _put(ol.mlp.shared_experts.up_proj.weight, sh.up_proj.weight.T)
+            _put(ol.mlp.shared_experts.down_proj.weight, sh.down_proj.weight.T)
+            _put(ol.mlp.shared_expert_gate.weight,
+                 blk.shared_expert_gate.weight.T)
+            moe.capacity_factor = float(E)  # no-drop regime for parity
+
+        hfm = hf.model
+        _put(ours.llama.embed_tokens.weight, hfm.embed_tokens.weight)
+        for i, hl in enumerate(hfm.layers):
+            ol = ours.llama.layers[i]
+            _put(ol.input_layernorm.weight, hl.input_layernorm.weight)
+            _put(ol.post_attention_layernorm.weight,
+                 hl.post_attention_layernorm.weight)
+            for name in ("q_proj", "k_proj", "v_proj", "o_proj"):
+                _put(getattr(ol.self_attn, name).weight,
+                     getattr(hl.self_attn, name).weight.T)
+            for name in ("q_proj", "k_proj", "v_proj"):  # Qwen2 qkv biases
+                _put(getattr(ol.self_attn, name).bias,
+                     getattr(hl.self_attn, name).bias)
+            map_qwen_moe_mlp(ol, hl)
+        _put(ours.llama.norm.weight, hfm.norm.weight)
+        _put(ours.lm_head.weight, hf.lm_head.weight.T)
+
+        ids = np.random.default_rng(14).integers(0, VOCAB, (2, SEQ))
+        with torch.no_grad():
+            ref = hf(torch.tensor(ids)).logits.numpy()
+        with paddle.no_grad():
+            got = ours(paddle.to_tensor(ids, dtype="int64")).numpy()
+        np.testing.assert_allclose(got, ref, atol=3e-4, rtol=3e-4)

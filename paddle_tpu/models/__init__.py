@@ -12,6 +12,11 @@ from .bert import (  # noqa: F401
     BertForSequenceClassification,
     BertModel,
 )
+from .ernie import (  # noqa: F401
+    ErnieConfig,
+    ErnieForSequenceClassification,
+    ErnieModel,
+)
 from .gpt import (  # noqa: F401
     GPTConfig,
     GPTForCausalLM,

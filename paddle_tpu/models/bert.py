@@ -168,14 +168,20 @@ class BertModel(Layer):
             [BertLayer(config) for _ in range(config.num_hidden_layers)])
         self.pooler = BertPooler(config)
 
+    @staticmethod
+    def _pad_default_mask(input_ids, pad_token_id):
+        """Reference default mask: pad_token_id positions are masked out
+        (PaddleNLP semantics; HF defaults to all-ones instead)."""
+        from .. import tensor as ops
+
+        return ops.not_equal(
+            input_ids, ops.full_like(input_ids, pad_token_id)
+        ).astype("float32")
+
     def forward(self, input_ids, token_type_ids=None, attention_mask=None):
         if attention_mask is None:
-            from .. import tensor as ops
-
-            attention_mask = ops.not_equal(
-                input_ids,
-                ops.full_like(input_ids, self.config.pad_token_id),
-            ).astype("float32")
+            attention_mask = self._pad_default_mask(
+                input_ids, self.config.pad_token_id)
         h = self.embeddings(input_ids, token_type_ids)
         for layer in self.encoder:
             h = layer(h, attention_mask)

@@ -1,0 +1,124 @@
+"""ERNIE family — the reference ecosystem's hallmark NLP encoder.
+
+PaddleNLP's ``ErnieModel`` (ERNIE 1.0/3.0) is architecturally a post-LN
+BERT encoder whose embeddings additionally carry a *task-type* embedding
+(multi-task pretraining, ERNIE 3.0 ``use_task_id``).  Built on the same
+blocks as :mod:`paddle_tpu.models.bert` (BertLayer/BertPooler); parity vs
+HF transformers' torch ``ErnieModel`` is pinned in
+``tests/test_torch_alignment.py``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..nn.common import Dropout, Embedding, Linear
+from ..nn.initializer import Normal
+from ..nn.layers import Layer
+from ..nn.norm import LayerNorm
+from .bert import BertModel
+
+
+@dataclass
+class ErnieConfig:
+    """ERNIE-3.0-base defaults (PaddleNLP ``ernie-3.0-base-zh`` shape)."""
+
+    vocab_size: int = 40000
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 2048
+    type_vocab_size: int = 4
+    task_type_vocab_size: int = 3
+    use_task_id: bool = True
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    layer_norm_eps: float = 1e-12
+    initializer_range: float = 0.02
+    pad_token_id: int = 0
+
+    @classmethod
+    def tiny(cls, **kw):
+        defaults = dict(vocab_size=128, hidden_size=32, num_hidden_layers=2,
+                        num_attention_heads=2, intermediate_size=64,
+                        max_position_embeddings=64, type_vocab_size=2,
+                        task_type_vocab_size=3)
+        defaults.update(kw)
+        return cls(**defaults)
+
+
+class ErnieEmbeddings(Layer):
+    """word + position + token-type (+ task-type) embeddings, LayerNorm.
+
+    Task-type follows the reference default-zeros rule: when
+    ``task_type_ids`` is None, task-0 embeddings are still added."""
+
+    def __init__(self, config: ErnieConfig):
+        super().__init__()
+        init = Normal(0.0, config.initializer_range)
+        h = config.hidden_size
+        self.word_embeddings = Embedding(config.vocab_size, h,
+                                         weight_attr=init)
+        self.position_embeddings = Embedding(config.max_position_embeddings,
+                                             h, weight_attr=init)
+        self.token_type_embeddings = Embedding(config.type_vocab_size, h,
+                                               weight_attr=init)
+        self.task_type_embeddings = (
+            Embedding(config.task_type_vocab_size, h, weight_attr=init)
+            if config.use_task_id else None)
+        self.layer_norm = LayerNorm(h, epsilon=config.layer_norm_eps)
+        self.dropout = Dropout(config.hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None, task_type_ids=None):
+        from .. import tensor as ops
+
+        S = input_ids.shape[1]
+        pos = ops.arange(0, S, dtype="int64")
+        x = self.word_embeddings(input_ids) + self.position_embeddings(pos)
+        if token_type_ids is None:
+            x = x + self.token_type_embeddings.weight[0]
+        else:
+            x = x + self.token_type_embeddings(token_type_ids)
+        if self.task_type_embeddings is not None:
+            if task_type_ids is None:
+                x = x + self.task_type_embeddings.weight[0]
+            else:
+                x = x + self.task_type_embeddings(task_type_ids)
+        return self.dropout(self.layer_norm(x))
+
+
+class ErnieModel(BertModel):
+    """Embeddings + post-LN encoder stack + pooler (PaddleNLP
+    ``ErnieModel`` analog).  Subclasses :class:`BertModel` — only the
+    embeddings module and the ``task_type_ids`` threading differ, so
+    encoder/mask/pooler semantics stay shared by construction."""
+
+    def __init__(self, config: ErnieConfig):
+        super().__init__(config)
+        self.embeddings = ErnieEmbeddings(config)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                task_type_ids=None):
+        if attention_mask is None:
+            attention_mask = self._pad_default_mask(
+                input_ids, self.config.pad_token_id)
+        h = self.embeddings(input_ids, token_type_ids, task_type_ids)
+        for layer in self.encoder:
+            h = layer(h, attention_mask)
+        return h, self.pooler(h)
+
+
+class ErnieForSequenceClassification(Layer):
+    def __init__(self, config: ErnieConfig, num_classes: int = 2):
+        super().__init__()
+        self.ernie = ErnieModel(config)
+        self.dropout = Dropout(config.hidden_dropout_prob)
+        self.classifier = Linear(config.hidden_size, num_classes,
+                                 weight_attr=Normal(0.0, config.initializer_range))
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                task_type_ids=None):
+        _, pooled = self.ernie(input_ids, token_type_ids, attention_mask,
+                               task_type_ids)
+        return self.classifier(self.dropout(pooled))

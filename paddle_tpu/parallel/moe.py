@@ -118,6 +118,11 @@ class TopKGate(BaseGate):
     def __init__(self, d_model: int, num_experts: int, k: int = 2,
                  normalize: bool = True):
         super().__init__(d_model, num_experts)
+        if not 1 <= k <= num_experts:
+            # k > E would silently re-select expert 0 once all experts
+            # are masked out of the argmax loop
+            raise ValueError(
+                f"top-k {k} must be in [1, num_experts={num_experts}]")
         self.top_k = k
         self.normalize = normalize
 

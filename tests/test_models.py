@@ -330,3 +330,47 @@ class TestNamedMoEConfigs:
         q = LlamaConfig.qwen2_moe_a14b()
         assert (q.num_experts, q.num_experts_per_tok) == (64, 8)
         assert q.num_attention_heads // q.num_key_value_heads == 7
+
+
+class TestErnie:
+    def test_classification_learns(self):
+        from paddle_tpu.jit import to_static
+        from paddle_tpu.models import ErnieConfig, ErnieForSequenceClassification
+        from paddle_tpu.nn import functional as F
+
+        cfg = ErnieConfig.tiny()
+        paddle.seed(0)
+        m = ErnieForSequenceClassification(cfg, num_classes=2)
+        rng = np.random.default_rng(0)
+        ids = paddle.to_tensor(rng.integers(1, cfg.vocab_size, (4, 12)),
+                               dtype="int64")
+        labels = paddle.to_tensor(rng.integers(0, 2, (4,)), dtype="int64")
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=m.parameters())
+
+        @to_static
+        def step(x, y):
+            loss = F.cross_entropy(m(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        losses = [float(step(ids, labels)) for _ in range(5)]
+        assert losses[-1] < losses[0]
+
+    def test_task_type_default_zero_added(self):
+        from paddle_tpu.models import ErnieConfig, ErnieModel
+
+        cfg = ErnieConfig.tiny()
+        paddle.seed(0)
+        m = ErnieModel(cfg)
+        m.eval()
+        ids = paddle.to_tensor(
+            np.random.default_rng(1).integers(1, cfg.vocab_size, (1, 8)),
+            dtype="int64")
+        seq_none, _ = m(ids)
+        task0 = paddle.to_tensor(np.zeros((1, 8), np.int64))
+        seq_zero, _ = m(ids, task_type_ids=task0)
+        np.testing.assert_allclose(seq_none.numpy(), seq_zero.numpy(),
+                                   rtol=1e-6, atol=1e-6)

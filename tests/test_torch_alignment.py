@@ -720,3 +720,76 @@ class TestTorchQwen2MoeAlignment:
         with paddle.no_grad():
             got = ours(paddle.to_tensor(ids, dtype="int64")).numpy()
         np.testing.assert_allclose(got, ref, atol=3e-4, rtol=3e-4)
+
+
+class TestTorchErnieAlignment:
+    """Seventh family — ERNIE, the reference ecosystem's hallmark NLP
+    encoder (BERT blocks + task-type embeddings) vs HF's torch
+    ErnieModel, with use_task_id=True and explicit task_type_ids."""
+
+    def test_encoder_and_pooler_match_hf(self):
+        hf_cfg = transformers.ErnieConfig(
+            vocab_size=128, hidden_size=32, num_hidden_layers=2,
+            num_attention_heads=2, intermediate_size=64,
+            max_position_embeddings=64, type_vocab_size=2,
+            task_type_vocab_size=3, use_task_id=True,
+            hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+            layer_norm_eps=1e-12, attn_implementation="eager")
+        torch.manual_seed(51)
+        hf = transformers.ErnieModel(hf_cfg).eval()
+
+        from paddle_tpu.models import ErnieConfig, ErnieModel
+
+        cfg = ErnieConfig.tiny(hidden_dropout_prob=0.0,
+                               attention_probs_dropout_prob=0.0,
+                               use_task_id=True)
+        ours = ErnieModel(cfg)
+
+        emb = hf.embeddings
+        _put(ours.embeddings.word_embeddings.weight,
+             emb.word_embeddings.weight)
+        _put(ours.embeddings.position_embeddings.weight,
+             emb.position_embeddings.weight)
+        _put(ours.embeddings.token_type_embeddings.weight,
+             emb.token_type_embeddings.weight)
+        _put(ours.embeddings.task_type_embeddings.weight,
+             emb.task_type_embeddings.weight)
+        _put(ours.embeddings.layer_norm.weight, emb.LayerNorm.weight)
+        _put(ours.embeddings.layer_norm.bias, emb.LayerNorm.bias)
+        for i, hl in enumerate(hf.encoder.layer):
+            ol = ours.encoder[i]
+            pairs = [
+                (ol.attention.q_proj, hl.attention.self.query),
+                (ol.attention.k_proj, hl.attention.self.key),
+                (ol.attention.v_proj, hl.attention.self.value),
+                (ol.attention.out_proj, hl.attention.output.dense),
+                (ol.linear1, hl.intermediate.dense),
+                (ol.linear2, hl.output.dense),
+            ]
+            for o, h in pairs:
+                _put(o.weight, h.weight.T)
+                _put(o.bias, h.bias)
+            _put(ol.attn_norm.weight, hl.attention.output.LayerNorm.weight)
+            _put(ol.attn_norm.bias, hl.attention.output.LayerNorm.bias)
+            _put(ol.ffn_norm.weight, hl.output.LayerNorm.weight)
+            _put(ol.ffn_norm.bias, hl.output.LayerNorm.bias)
+        _put(ours.pooler.dense.weight, hf.pooler.dense.weight.T)
+        _put(ours.pooler.dense.bias, hf.pooler.dense.bias)
+
+        rng = np.random.default_rng(15)
+        ids = rng.integers(1, 128, (2, 16))
+        tt = rng.integers(0, 2, (2, 16))
+        task = rng.integers(0, 3, (2, 16))
+        with torch.no_grad():
+            ref = hf(torch.tensor(ids), token_type_ids=torch.tensor(tt),
+                     task_type_ids=torch.tensor(task))
+        with paddle.no_grad():
+            seq, pooled = ours(
+                paddle.to_tensor(ids, dtype="int64"),
+                token_type_ids=paddle.to_tensor(tt, dtype="int64"),
+                task_type_ids=paddle.to_tensor(task, dtype="int64"))
+        np.testing.assert_allclose(seq.numpy(),
+                                   ref.last_hidden_state.numpy(),
+                                   atol=2e-4, rtol=2e-4)
+        np.testing.assert_allclose(pooled.numpy(), ref.pooler_output.numpy(),
+                                   atol=2e-4, rtol=2e-4)

@@ -163,10 +163,14 @@ class BertModel(Layer):
     def __init__(self, config: BertConfig):
         super().__init__()
         self.config = config
-        self.embeddings = BertEmbeddings(config)
+        self.embeddings = self._build_embeddings(config)
         self.encoder = LayerList(
             [BertLayer(config) for _ in range(config.num_hidden_layers)])
         self.pooler = BertPooler(config)
+
+    def _build_embeddings(self, config):
+        """Overridable factory (ERNIE swaps in task-type embeddings)."""
+        return BertEmbeddings(config)
 
     @staticmethod
     def _pad_default_mask(input_ids, pad_token_id):

@@ -16,27 +16,20 @@ from ..nn.common import Dropout, Embedding, Linear
 from ..nn.initializer import Normal
 from ..nn.layers import Layer
 from ..nn.norm import LayerNorm
-from .bert import BertModel
+from .bert import BertConfig, BertModel
 
 
 @dataclass
-class ErnieConfig:
-    """ERNIE-3.0-base defaults (PaddleNLP ``ernie-3.0-base-zh`` shape)."""
+class ErnieConfig(BertConfig):
+    """ERNIE-3.0-base defaults (PaddleNLP ``ernie-3.0-base-zh`` shape).
+    Extends :class:`BertConfig` (one source of truth for the shared
+    encoder fields) with the task-type embedding knobs."""
 
     vocab_size: int = 40000
-    hidden_size: int = 768
-    num_hidden_layers: int = 12
-    num_attention_heads: int = 12
-    intermediate_size: int = 3072
     max_position_embeddings: int = 2048
     type_vocab_size: int = 4
     task_type_vocab_size: int = 3
     use_task_id: bool = True
-    hidden_dropout_prob: float = 0.1
-    attention_probs_dropout_prob: float = 0.1
-    layer_norm_eps: float = 1e-12
-    initializer_range: float = 0.02
-    pad_token_id: int = 0
 
     @classmethod
     def tiny(cls, **kw):
@@ -94,9 +87,8 @@ class ErnieModel(BertModel):
     embeddings module and the ``task_type_ids`` threading differ, so
     encoder/mask/pooler semantics stay shared by construction."""
 
-    def __init__(self, config: ErnieConfig):
-        super().__init__(config)
-        self.embeddings = ErnieEmbeddings(config)
+    def _build_embeddings(self, config):
+        return ErnieEmbeddings(config)
 
     def forward(self, input_ids, token_type_ids=None, attention_mask=None,
                 task_type_ids=None):

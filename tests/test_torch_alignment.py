@@ -793,3 +793,85 @@ class TestTorchErnieAlignment:
                                    atol=2e-4, rtol=2e-4)
         np.testing.assert_allclose(pooled.numpy(), ref.pooler_output.numpy(),
                                    atol=2e-4, rtol=2e-4)
+
+
+class TestTorchNNCoreAlignment:
+    """Core paddle.nn modules vs their torch.nn counterparts — not model
+    zoo, the framework API itself: LSTM/GRU (same gate order and packed
+    [4h/3h, in] weight layout) and TransformerEncoder (post-LN, packed
+    in_proj split into our separate q/k/v projections)."""
+
+    def _match_rnn(self, our_cls, torch_cls):
+        IN, H, B, S = 6, 8, 2, 10
+        torch.manual_seed(61)
+        ref = torch_cls(IN, H, num_layers=2, bidirectional=True,
+                        batch_first=True)
+        ours = our_cls(IN, H, num_layers=2, direction="bidirect")
+        for name, p in ref.named_parameters():
+            _put(getattr(ours, name), p)  # identical naming convention
+
+        x = np.random.default_rng(16).standard_normal(
+            (B, S, IN)).astype(np.float32)
+        with torch.no_grad():
+            out_t = ref(torch.tensor(x))
+        out_p = ours(paddle.to_tensor(x))
+        np.testing.assert_allclose(out_p[0].numpy(), out_t[0].numpy(),
+                                   atol=1e-5, rtol=1e-5)
+        # final states: paddle/torch both [num_layers*dirs, B, H]
+        ref_state = out_t[1]
+        our_state = out_p[1]
+        if isinstance(ref_state, tuple):
+            for rs, os_ in zip(ref_state, our_state):
+                np.testing.assert_allclose(os_.numpy(), rs.numpy(),
+                                           atol=1e-5, rtol=1e-5)
+        else:
+            np.testing.assert_allclose(our_state.numpy(), ref_state.numpy(),
+                                       atol=1e-5, rtol=1e-5)
+
+    def test_lstm_matches_torch(self):
+        self._match_rnn(paddle.nn.LSTM, torch.nn.LSTM)
+
+    def test_gru_matches_torch(self):
+        self._match_rnn(paddle.nn.GRU, torch.nn.GRU)
+
+    def test_transformer_encoder_matches_torch(self):
+        D, NH, FF, B, S = 16, 4, 32, 2, 12
+        torch.manual_seed(62)
+        t_layer = torch.nn.TransformerEncoderLayer(
+            D, NH, dim_feedforward=FF, dropout=0.0, activation="relu",
+            batch_first=True, norm_first=False)
+        ref = torch.nn.TransformerEncoder(t_layer, num_layers=2).eval()
+
+        p_layer = paddle.nn.TransformerEncoderLayer(
+            D, NH, FF, dropout=0.0, activation="relu",
+            normalize_before=False)
+        ours = paddle.nn.TransformerEncoder(p_layer, num_layers=2)
+        ours.eval()
+
+        for i, tl in enumerate(ref.layers):
+            ol = ours.layers[i]
+            # torch packs q|k|v rows in in_proj_weight [3D, D]
+            w = tl.self_attn.in_proj_weight
+            b = tl.self_attn.in_proj_bias
+            for j, name in enumerate(("q_proj", "k_proj", "v_proj")):
+                _put(getattr(ol.self_attn, name).weight,
+                     w[j * D:(j + 1) * D].T)
+                _put(getattr(ol.self_attn, name).bias, b[j * D:(j + 1) * D])
+            _put(ol.self_attn.out_proj.weight, tl.self_attn.out_proj.weight.T)
+            _put(ol.self_attn.out_proj.bias, tl.self_attn.out_proj.bias)
+            _put(ol.linear1.weight, tl.linear1.weight.T)
+            _put(ol.linear1.bias, tl.linear1.bias)
+            _put(ol.linear2.weight, tl.linear2.weight.T)
+            _put(ol.linear2.bias, tl.linear2.bias)
+            _put(ol.norm1.weight, tl.norm1.weight)
+            _put(ol.norm1.bias, tl.norm1.bias)
+            _put(ol.norm2.weight, tl.norm2.weight)
+            _put(ol.norm2.bias, tl.norm2.bias)
+
+        x = np.random.default_rng(17).standard_normal(
+            (B, S, D)).astype(np.float32)
+        with torch.no_grad():
+            out_t = ref(torch.tensor(x)).numpy()
+        with paddle.no_grad():
+            out_p = ours(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(out_p, out_t, atol=1e-5, rtol=1e-5)

@@ -98,6 +98,7 @@ class TestTorchLlamaAlignment:
             got = ours(paddle.to_tensor(ids, dtype="int64")).numpy()
         np.testing.assert_allclose(got, ref, atol=2e-4, rtol=2e-4)
 
+    @pytest.mark.slow
     def test_loss_curve_matches_hf_sgd(self):
         hf = _hf_model().train()
         ours = _ours_from_hf(hf)
@@ -214,6 +215,7 @@ class TestTorchGPT2Alignment:
             got = ours(paddle.to_tensor(ids, dtype="int64")).numpy()
         np.testing.assert_allclose(got, ref, atol=2e-4, rtol=2e-4)
 
+    @pytest.mark.slow
     def test_loss_curve_matches_hf_sgd(self):
         hf = _hf_gpt2().train()
         ours = _our_gpt_from_hf(hf)
@@ -333,6 +335,7 @@ class TestTorchBertAlignment:
         np.testing.assert_allclose(pooled.numpy(), ref.pooler_output.numpy(),
                                    atol=2e-4, rtol=2e-4)
 
+    @pytest.mark.slow
     def test_squad_finetune_curve_matches_hf(self):
         from paddle_tpu.models import BertConfig, BertForQuestionAnswering
         from paddle_tpu.nn import functional as F
@@ -421,6 +424,7 @@ class TestTorchOptimizerAlignment:
         got = [float(step(p_ids)) for _ in range(steps)]
         return got, ref
 
+    @pytest.mark.slow
     def test_adamw_matches_torch(self):
         got, ref = self._curves(
             lambda ps: torch.optim.AdamW(ps, lr=1e-3, betas=(0.9, 0.999),

@@ -879,3 +879,63 @@ class TestTorchNNCoreAlignment:
         with paddle.no_grad():
             out_p = ours(paddle.to_tensor(x)).numpy()
         np.testing.assert_allclose(out_p, out_t, atol=1e-5, rtol=1e-5)
+
+
+class TestTorchViTAlignment:
+    """Eighth family — Vision Transformer vs HF's torch ViT (patch-conv
+    embedding, CLS token, learned positions, pre-LN blocks, CLS head)."""
+
+    def test_logits_match_hf(self):
+        D, DEPTH, NH, IMG, P = 32, 2, 2, 32, 8
+        hf_cfg = transformers.ViTConfig(
+            image_size=IMG, patch_size=P, num_channels=3, hidden_size=D,
+            num_hidden_layers=DEPTH, num_attention_heads=NH,
+            intermediate_size=4 * D, hidden_act="gelu",
+            hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+            layer_norm_eps=1e-6, num_labels=10,
+            attn_implementation="eager")
+        torch.manual_seed(71)
+        hf = transformers.ViTForImageClassification(hf_cfg).eval()
+
+        from paddle_tpu.vision.models import VisionTransformer
+
+        ours = VisionTransformer(img_size=IMG, patch_size=P, class_num=10,
+                                 embed_dim=D, depth=DEPTH, num_heads=NH,
+                                 epsilon=1e-6)
+        ours.eval()
+
+        emb = hf.vit.embeddings
+        _put(ours.cls_token, emb.cls_token)
+        _put(ours.pos_embed, emb.position_embeddings)
+        _put(ours.patch_embed.proj.weight,
+             emb.patch_embeddings.projection.weight)
+        _put(ours.patch_embed.proj.bias, emb.patch_embeddings.projection.bias)
+        for i, hl in enumerate(hf.vit.encoder.layer):
+            ob = ours.blocks[i]
+            att = hl.attention.attention
+            pairs = [
+                (ob.attn.q_proj, att.query), (ob.attn.k_proj, att.key),
+                (ob.attn.v_proj, att.value),
+                (ob.attn.out_proj, hl.attention.output.dense),
+                (ob.mlp[0], hl.intermediate.dense),
+                (ob.mlp[3], hl.output.dense),
+            ]
+            for o, h in pairs:
+                _put(o.weight, h.weight.T)
+                _put(o.bias, h.bias)
+            _put(ob.norm1.weight, hl.layernorm_before.weight)
+            _put(ob.norm1.bias, hl.layernorm_before.bias)
+            _put(ob.norm2.weight, hl.layernorm_after.weight)
+            _put(ob.norm2.bias, hl.layernorm_after.bias)
+        _put(ours.norm.weight, hf.vit.layernorm.weight)
+        _put(ours.norm.bias, hf.vit.layernorm.bias)
+        _put(ours.head.weight, hf.classifier.weight.T)
+        _put(ours.head.bias, hf.classifier.bias)
+
+        imgs = np.random.default_rng(18).standard_normal(
+            (2, 3, IMG, IMG)).astype(np.float32)
+        with torch.no_grad():
+            ref = hf(torch.tensor(imgs)).logits.numpy()
+        with paddle.no_grad():
+            got = ours(paddle.to_tensor(imgs)).numpy()
+        np.testing.assert_allclose(got, ref, atol=2e-4, rtol=2e-4)

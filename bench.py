@@ -27,6 +27,11 @@ _TPU_BENCH_TIMEOUT = 5400  # cold XLA compile through the tunnel is SLOW
                            # (second contact: 2700 s was not enough)
 _CPU_BENCH_TIMEOUT = 600
 _COMPILE_CACHE = os.path.join(_HERE, ".jax_compile_cache")
+# The TPU inner writes each completed phase here IMMEDIATELY, so a tunnel
+# drop (or the 5400-s kill) mid-window still leaves every finished number
+# on disk for the outer process to report (third-contact design: round 4
+# lost a 54-minute window to one monolithic compile with zero output).
+_PHASE_PATH = os.path.join(_HERE, "BENCH_PHASE.json")
 
 # Pinned CPU-smoke reference (VERDICT r3 weak #1): the degraded path must
 # not hide real regressions behind "degraded anyway".  r2 measured 19,868
@@ -107,22 +112,55 @@ def _run_inner(platform: str, timeout: int):
         # the inner bench asserts AFTER printing its JSON line (e.g. a
         # non-finite loss) — a nonzero exit must not masquerade as success
         raise RuntimeError(f"inner bench rc={proc.returncode}")
+    result = None
     for line in proc.stdout.splitlines():
         line = line.strip()
         if line.startswith("{"):
-            return json.loads(line)
-    raise RuntimeError("inner bench produced no JSON line")
+            result = json.loads(line)  # last JSON line = final/best phase
+    if result is None:
+        raise RuntimeError("inner bench produced no JSON line")
+    return result
+
+
+def _phase_file_result():
+    """Salvage: the best phase the killed/crashed TPU inner completed."""
+    try:
+        with open(_PHASE_PATH) as f:
+            phases = json.load(f)
+    except (OSError, ValueError):
+        return None
+    done = [p for p in phases if p.get("value")]
+    if not done:
+        return None
+    # headline pins to the flagship config when it completed (cross-round
+    # comparability of the tokens/s value); otherwise best-MFU phase
+    best = next((p for p in done if p.get("phase") == "B_flagship"),
+                max(done, key=lambda p: p.get("vs_baseline", 0.0)))
+    best = dict(best)
+    best["partial"] = "window_ended_early"  # watcher retries later windows
+    best["note"] = "phases completed: " + ",".join(
+        p.get("phase", "?") for p in done)
+    best["phases"] = phases
+    return best
 
 
 def main() -> None:
     degraded = None
     result = None
     if _probe_tpu():
+        if os.path.exists(_PHASE_PATH):
+            os.remove(_PHASE_PATH)  # never salvage a stale run's phases
         try:
             result = _run_inner("tpu", _TPU_BENCH_TIMEOUT)
         except Exception as e:
             sys.stderr.write(f"[bench] tpu bench failed: {e}\n")
-            degraded = "tpu_bench_failed"
+            result = _phase_file_result()
+            if result is not None:
+                sys.stderr.write(
+                    "[bench] salvaged completed phase(s) from "
+                    f"{os.path.basename(_PHASE_PATH)}: {result['note']}\n")
+            else:
+                degraded = "tpu_bench_failed"
     else:
         degraded = "tpu_unavailable"
     if result is None:
@@ -193,20 +231,7 @@ def inner(platform: str) -> None:
     if on_tpu:
         sys.stderr.write(
             f"[bench] device: {jax.devices()[0].device_kind}\n")
-        # scan_layers: the decoder stack is ONE lax.scan body, so the cold
-        # compile through the tunnel pays for one layer, not six (round-2
-        # first contact timed out compiling 12 unrolled layers); the
-        # persistent cache makes every later run fast
-        cfg = LlamaConfig(
-            vocab_size=32000, hidden_size=1024, intermediate_size=2816,
-            num_hidden_layers=6, num_attention_heads=8,  # head_dim 128 → pallas flash
-            num_key_value_heads=8, max_position_embeddings=2048,
-            rope_theta=10000.0, dtype="bfloat16", scan_layers=True)
-        batch, seq, iters = 8, 2048, 10
         paddle.set_default_dtype("bfloat16")
-    else:  # CPU smoke mode so the script always produces a number
-        cfg = LlamaConfig.tiny()
-        batch, seq, iters = 4, 64, 3
 
     def build(cfg):
         paddle.seed(0)
@@ -234,93 +259,165 @@ def inner(platform: str) -> None:
         # contact: init alone exhausted the 45-min window)
         return host_build(lambda: build(cfg), log=_log)
 
-    _log("building model")
-    model, train_step = (build_off_device if on_tpu else build)(cfg)
-    _log("model ready")
+    def run_phase(name, cfg, batch, seq, iters):
+        """Build + compile + time one config; returns the result dict."""
+        _log(f"[{name}] building model")
+        model, train_step = (build_off_device if on_tpu else build)(cfg)
+        _log(f"[{name}] model ready")
 
-    # Resilience ladder (first contact found both rungs): a Pallas compile
-    # failure falls back to the XLA attention path, and an HBM OOM (the XLA
-    # path materialises S^2 scores for backward — 16 GB v5e can't hold
-    # batch 8) halves the batch.  tokens/s is per token, so the number
-    # stays comparable; the chosen batch is logged for the record.
-    ladder = [b for b in (batch, batch // 2, batch // 4, 1) if b >= 1]
-    ladder = sorted(set(ladder), reverse=True)
-    bi = 0
-    while True:
-        if bi >= len(ladder):
-            raise RuntimeError("no batch size fits in device memory")
-        b = ladder[bi]
-        ids = paddle.to_tensor(
-            np.random.default_rng(0).integers(
-                0, cfg.vocab_size, (b, seq)), dtype="int32")
-        try:
-            _log(f"compiling+running first step (batch {b})")
-            float(train_step(ids))  # first call compiles (pallas on TPU)
-            _log("first step done")
-            batch = b
+        # Resilience ladder (first contact found both rungs): a Pallas
+        # compile failure falls back to the XLA attention path, and an HBM
+        # OOM (the XLA path materialises S^2 scores for backward — 16 GB
+        # v5e can't hold batch 8) halves the batch.  tokens/s is per token,
+        # so the number stays comparable; the chosen batch is logged.
+        ladder = [b for b in (batch, batch // 2, batch // 4, 1) if b >= 1]
+        ladder = sorted(set(ladder), reverse=True)
+        bi = 0
+        while True:
+            if bi >= len(ladder):
+                raise RuntimeError("no batch size fits in device memory")
+            b = ladder[bi]
+            ids = paddle.to_tensor(
+                np.random.default_rng(0).integers(
+                    0, cfg.vocab_size, (b, seq)), dtype="int32")
+            try:
+                _log(f"[{name}] compiling+running first step (batch {b})")
+                float(train_step(ids))  # first call compiles
+                _log(f"[{name}] first step done")
+                batch = b
+                break
+            except Exception as e:
+                msg = str(e)
+                train_step.concrete_program_cache.clear()
+                if ("RESOURCE_EXHAUSTED" in msg or "Resource exhausted" in msg
+                        or "Out of memory" in msg):
+                    sys.stderr.write(f"[bench] batch {b} OOM; halving\n")
+                    bi += 1
+                    continue
+                pallas_on = (os.environ.get("PADDLE_TPU_DISABLE_PALLAS")
+                             != "1")
+                pallas_fail = ("pallas" in msg.lower()
+                               or "mosaic" in msg.lower())
+                if pallas_fail and pallas_on:
+                    # Mosaic rejected the kernel: XLA path, same batch
+                    sys.stderr.write(f"[bench] pallas path failed ({e}); "
+                                     f"XLA fallback\n")
+                    os.environ["PADDLE_TPU_DISABLE_PALLAS"] = "1"
+                    continue
+                if cfg.scan_layers:
+                    # scan-of-layers failure: rebuild with the unrolled
+                    # stack (same math) before giving up
+                    sys.stderr.write(f"[bench] scan stack failed ({e}); "
+                                     f"unrolled fallback\n")
+                    cfg.scan_layers = False
+                    model, train_step = (build_off_device if on_tpu
+                                         else build)(cfg)
+                    continue
+                if pallas_on:
+                    # last resort: some kernel failures don't name pallas
+                    # in the message — disabling it must stay guaranteed
+                    sys.stderr.write(f"[bench] unrecognized failure ({e}); "
+                                     f"trying XLA attention path\n")
+                    os.environ["PADDLE_TPU_DISABLE_PALLAS"] = "1"
+                    continue
+                raise  # out of fallbacks — a real failure
+        sys.stderr.write(f"[bench] [{name}] batch={batch} seq={seq}\n")
+        from paddle_tpu.ops import flash_attention as _fa
+
+        sys.stderr.write(f"[bench] attention path: {_fa.last_path}\n")
+        float(train_step(ids))  # settle
+        _log(f"[{name}] timing {iters} steps")
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            loss = train_step(ids)
+        loss_val = float(loss)  # blocks on the final step
+        dt = (time.perf_counter() - t0) / iters
+        _log(f"[{name}] timed: {dt * 1000:.1f} ms/step")
+        assert np.isfinite(loss_val), f"non-finite loss {loss_val}"
+
+        tok_per_s = batch * seq / dt
+        n_params = sum(p.size for p in model.parameters())
+        # PaLM-style train FLOPs/token: 6N + 12·L·S·hidden (attention term)
+        flops_per_tok = (6 * n_params
+                         + 12 * cfg.num_hidden_layers * seq * cfg.hidden_size)
+        peak = _peak_flops(jax.devices()[0].device_kind) if on_tpu else 0.0
+        mfu = (flops_per_tok * tok_per_s / peak) if peak else 0.0
+        return {"metric": "llama_train_tokens_per_sec_per_chip",
+                "value": round(tok_per_s, 2), "unit": "tokens/s",
+                "vs_baseline": round(mfu / 0.40, 4), "phase": name,
+                "mfu": round(mfu, 4), "batch": batch, "seq": seq,
+                "params": int(n_params),
+                "ms_per_step": round(dt * 1e3, 2)}
+
+    if not on_tpu:  # CPU smoke mode so the script always produces a number
+        res = run_phase("cpu_smoke", LlamaConfig.tiny(), 4, 64, 3)
+        print(json.dumps(res))
+        return
+
+    # TPU: escalating phases, each checkpointed to disk the moment it
+    # completes.  Tunnel windows are 25–54 min and can close at any time;
+    # one monolithic flagship compile burned all of round 4's second window
+    # with nothing to show.  Phase A is sized to produce a real (small) MFU
+    # number within minutes; B is the flagship; C is an MFU-headroom run
+    # attempted only while time remains.  scan_layers everywhere: the
+    # decoder stack is ONE lax.scan body, so the cold compile pays for one
+    # layer regardless of depth; the persistent cache makes re-runs fast.
+    phases = [
+        ("A_small", LlamaConfig(
+            vocab_size=8192, hidden_size=512, intermediate_size=1408,
+            num_hidden_layers=4, num_attention_heads=8,   # head_dim 64
+            num_key_value_heads=8, max_position_embeddings=1024,
+            rope_theta=10000.0, dtype="bfloat16", scan_layers=True),
+         8, 1024, 10),
+        ("B_flagship", LlamaConfig(
+            vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+            num_hidden_layers=6, num_attention_heads=8,   # head_dim 128
+            num_key_value_heads=8, max_position_embeddings=2048,
+            rope_theta=10000.0, dtype="bfloat16", scan_layers=True),
+         8, 2048, 10),
+        ("C_large", LlamaConfig(
+            vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+            num_hidden_layers=10, num_attention_heads=16,  # head_dim 128
+            num_key_value_heads=8, max_position_embeddings=2048,
+            rope_theta=10000.0, dtype="bfloat16", scan_layers=True),
+         4, 2048, 5),
+    ]
+    _C_DEADLINE_S = 3300  # skip C unless A+B left >~35 min of inner budget
+    # an operator-set kill switch (exported before launch, e.g. because
+    # the pallas path hard-hangs the runtime) must survive across phases;
+    # only fallback-set values are phase-local
+    pallas_killed_by_operator = (
+        os.environ.get("PADDLE_TPU_DISABLE_PALLAS") == "1")
+    done = []
+    for name, cfg, batch, seq, iters in phases:
+        if name == "C_large" and time.perf_counter() - t_start > _C_DEADLINE_S:
+            _log(f"[{name}] skipped (out of time budget)")
             break
+        # each phase re-enables pallas: a phase-A fallback (e.g. head_dim
+        # 64 edge) must not condemn later phases to the XLA path
+        if not pallas_killed_by_operator:
+            os.environ.pop("PADDLE_TPU_DISABLE_PALLAS", None)
+        try:
+            res = run_phase(name, cfg, batch, seq, iters)
         except Exception as e:
-            msg = str(e)
-            train_step.concrete_program_cache.clear()
-            if ("RESOURCE_EXHAUSTED" in msg or "Resource exhausted" in msg
-                    or "Out of memory" in msg):
-                sys.stderr.write(f"[bench] batch {b} OOM; halving\n")
-                bi += 1
-                continue
-            pallas_on = os.environ.get("PADDLE_TPU_DISABLE_PALLAS") != "1"
-            pallas_fail = ("pallas" in msg.lower() or "mosaic" in msg.lower())
-            if pallas_fail and pallas_on:
-                # kernel rejected by Mosaic: XLA attention path, same batch
-                sys.stderr.write(f"[bench] pallas path failed ({e}); "
-                                 f"XLA fallback\n")
-                os.environ["PADDLE_TPU_DISABLE_PALLAS"] = "1"
-                continue
-            if cfg.scan_layers:
-                # scan-of-layers failure: rebuild with the unrolled stack
-                # (same math) before giving up
-                sys.stderr.write(f"[bench] scan stack failed ({e}); "
-                                 f"unrolled fallback\n")
-                cfg.scan_layers = False
-                model, train_step = (build_off_device if on_tpu
-                                     else build)(cfg)
-                continue
-            if pallas_on:
-                # last resort: some kernel failures don't name pallas in
-                # the message — disabling it must stay guaranteed
-                sys.stderr.write(f"[bench] unrecognized failure ({e}); "
-                                 f"trying XLA attention path\n")
-                os.environ["PADDLE_TPU_DISABLE_PALLAS"] = "1"
-                continue
-            raise  # out of fallbacks — a real failure
-    sys.stderr.write(f"[bench] batch={batch} seq={seq}\n")
-    from paddle_tpu.ops import flash_attention as _fa
-
-    sys.stderr.write(f"[bench] attention path: {_fa.last_path}\n")
-    float(train_step(ids))  # settle
-    _log(f"timing {iters} steps")
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        loss = train_step(ids)
-    loss_val = float(loss)  # blocks on the final step
-    dt = (time.perf_counter() - t0) / iters
-    _log(f"timed: {dt * 1000:.1f} ms/step")
-
-    tokens = batch * seq
-    tok_per_s = tokens / dt
-
-    n_params = sum(p.size for p in model.parameters())
-    # PaLM-style train FLOPs/token: 6N + 12·L·S·hidden (attention term)
-    flops_per_tok = 6 * n_params + 12 * cfg.num_hidden_layers * seq * cfg.hidden_size
-    peak = _peak_flops(jax.devices()[0].device_kind) if on_tpu else 0.0
-    mfu = (flops_per_tok * tok_per_s / peak) if peak else 0.0
-
-    print(json.dumps({
-        "metric": "llama_train_tokens_per_sec_per_chip",
-        "value": round(tok_per_s, 2),
-        "unit": "tokens/s",
-        "vs_baseline": round(mfu / 0.40, 4),
-    }))
-    assert np.isfinite(loss_val), f"non-finite loss {loss_val}"
+            sys.stderr.write(f"[bench] phase {name} failed: {e}\n")
+            continue
+        done.append(res)
+        with open(_PHASE_PATH, "w") as f:  # checkpoint NOW — window may end
+            json.dump(done, f, indent=1)
+        print(json.dumps(res))
+        sys.stdout.flush()
+    if not done:
+        raise RuntimeError("no bench phase completed")
+    # headline value pins to the flagship config (round-over-round
+    # comparability of tokens/s); best-MFU across phases rides along in
+    # best_vs_baseline + the per-phase table
+    best_mfu = max(done, key=lambda p: p["vs_baseline"])
+    final = dict(next((p for p in done if p["phase"] == "B_flagship"),
+                      best_mfu))
+    final["best_vs_baseline"] = best_mfu["vs_baseline"]
+    final["phases"] = done
+    print(json.dumps(final))  # last JSON line = headline for the outer
 
 
 if __name__ == "__main__":

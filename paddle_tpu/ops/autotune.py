@@ -21,6 +21,11 @@ from typing import Dict, List, Optional, Tuple
 
 _CACHE_PATH = os.path.join(
     os.path.dirname(__file__), "..", "_native", "autotune_cache.json")
+# Committed measured results (tools/autotune_onchip.py writes the winners
+# here; the file is checked in so every later process — including CI and
+# the driver's bench run — starts from on-chip-measured block choices).
+_COMMITTED_PATH = os.path.join(
+    os.path.dirname(__file__), "..", "..", "AUTOTUNE.json")
 
 _memory: Dict[str, Tuple[int, int]] = {}
 _loaded = False
@@ -31,11 +36,13 @@ def _load():
     if _loaded:
         return
     _loaded = True
-    try:
-        with open(_CACHE_PATH) as f:
-            _memory.update({k: tuple(v) for k, v in json.load(f).items()})
-    except (OSError, ValueError):
-        pass
+    for path in (_COMMITTED_PATH, _CACHE_PATH):  # runtime cache wins
+        try:
+            with open(path) as f:
+                _memory.update(
+                    {k: tuple(v) for k, v in json.load(f).items()})
+        except (OSError, ValueError):
+            pass
 
 
 def _save():
@@ -114,3 +121,25 @@ def cached_flash_blocks(q_shape, kv_shape, dtype,
     """Cache lookup only (no tuning) — the hot-path accessor."""
     _load()
     return _memory.get(_key(q_shape, kv_shape, dtype, causal))
+
+
+def record(q_shape, kv_shape, dtype, causal, blocks: Tuple[int, int],
+           committed: bool = False) -> str:
+    """Store a measured winner; ``committed=True`` also writes the
+    repo-root ``AUTOTUNE.json`` (the checked-in results table the sweep
+    tool produces on the live chip).  Returns the cache key."""
+    _load()
+    key = _key(q_shape, kv_shape, dtype, causal)
+    _memory[key] = tuple(blocks)
+    _save()
+    if committed:
+        table = {}
+        try:
+            with open(_COMMITTED_PATH) as f:
+                table = json.load(f)
+        except (OSError, ValueError):
+            pass
+        table[key] = list(blocks)
+        with open(_COMMITTED_PATH, "w") as f:
+            json.dump(table, f, indent=1, sort_keys=True)
+    return key

@@ -25,15 +25,17 @@ def host_build(build_fn: Callable[[], Any], log=None) -> Any:
     """Run ``build_fn`` on the host CPU backend; bulk-move results to device.
 
     ``build_fn`` is a zero-arg callable; every :class:`paddle_tpu.nn.Layer`
-    found in its return value (the value itself, or any element of a
-    tuple/list) has its parameters and buffers transferred.  Returns the
-    ``build_fn`` output unchanged (Tensors are rebound in place).
+    and bare :class:`Tensor` found anywhere in its return value (walked
+    through nested tuples/lists/dicts) has its parameters/buffers/value
+    transferred.  Returns the ``build_fn`` output unchanged (Tensors are
+    rebound in place).
 
     Falls back to a plain ``build_fn()`` call when no host CPU backend
     exists (then there is no tunnel to avoid either).
     """
     import jax
 
+    from ..core.tensor import Tensor
     from ..nn import Layer
 
     try:
@@ -46,8 +48,29 @@ def host_build(build_fn: Callable[[], Any], log=None) -> Any:
     with jax.default_device(cpu):
         out = build_fn()
 
-    items = out if isinstance(out, (tuple, list)) else (out,)
-    layers = [item for item in items if isinstance(item, Layer)]
+    # generic container walk: a Layer nested inside a dict (e.g.
+    # {"model": m, "opt": o}) must not silently keep its parameters on
+    # the host CPU — that would reintroduce the per-dispatch tunnel cost
+    # this utility exists to avoid
+    layers, bare = [], []
+    seen = set()
+
+    def _walk(obj):
+        if id(obj) in seen:
+            return
+        seen.add(id(obj))
+        if isinstance(obj, Layer):
+            layers.append(obj)
+        elif isinstance(obj, Tensor):
+            bare.append(obj)
+        elif isinstance(obj, dict):
+            for v in obj.values():
+                _walk(v)
+        elif isinstance(obj, (tuple, list)):
+            for v in obj:
+                _walk(v)
+
+    _walk(out)
 
     from ..distributed import topology
     from ..parallel.utils import param_spec
@@ -56,6 +79,16 @@ def host_build(build_fn: Callable[[], Any], log=None) -> Any:
     for layer in layers:
         tensors.extend(layer.parameters())
         tensors.extend(layer.buffers())
+    param_ids = {id(t) for t in tensors}
+    tensors.extend(t for t in bare if id(t) not in param_ids)
+    if not tensors:
+        import warnings
+
+        warnings.warn(
+            "host_build: no Layers or Tensors found in build_fn's return "
+            "value — nothing was transferred to the device (did the model "
+            "end up inside an unsupported container?)", RuntimeWarning,
+            stacklevel=2)
 
     from jax.sharding import NamedSharding
 

@@ -75,7 +75,22 @@ class TestHostBuild:
         assert logits.shape == [1, 4, cfg.vocab_size]
 
     def test_non_layer_output_passthrough(self):
-        assert host_build(lambda: 42) == 42
+        with pytest.warns(RuntimeWarning, match="nothing was transferred"):
+            assert host_build(lambda: 42) == 42
+
+    def test_layer_nested_in_dict_is_found(self):
+        # ADVICE r4: a Layer inside a dict (or deeper nesting) must be
+        # transferred, not silently left on the host CPU
+        cfg = LlamaConfig.tiny()
+        logs = []
+        out = host_build(
+            lambda: {"bundle": [LlamaForCausalLM(cfg)],
+                     "extra": paddle.to_tensor(np.ones(3, np.float32))},
+            log=logs.append)
+        assert any("transferring" in m for m in logs)
+        model = out["bundle"][0]
+        ids = paddle.to_tensor(np.zeros((1, 4), dtype="int32"))
+        assert model(ids).shape == [1, 4, cfg.vocab_size]
 
     def test_active_mesh_shards_instead_of_committing(self):
         # with a live mesh, host init must place tensors by PartitionSpec

@@ -1,12 +1,35 @@
 #!/bin/bash
 # Probe the TPU tunnel persistently; the moment it is up, run (in order):
 #   1. tools/pallas_mosaic_check.py — the fast Mosaic pass/fail verdict
-#      (minutes; survives short tunnel windows, writes PALLAS_VERDICT.json)
-#   2. bench.py — the on-chip number (persistent XLA compile cache)
-#   3. tools/profile_train.py — XPlane trace for the MFU gap analysis
-# Round-4 standing priority #1 (VERDICT.md): land an on-chip number.
+#      (skipped once PALLAS_VERDICT.json exists)
+#   2. bench.py — the on-chip number (phased: A_small lands a real MFU
+#      number within minutes, B_flagship/C_large escalate; every finished
+#      phase is checkpointed to BENCH_PHASE.json)
+#   3. tools/autotune_onchip.py — ALWAYS runs once the tunnel answered,
+#      even when bench is not clean (VERDICT r4 item #2: committed
+#      measured block sizes)
+#   4. tools/profile_train.py — XPlane trace for the MFU gap analysis
+# After EVERY stage the artifacts are git-committed: windows close without
+# warning, and evidence that only lives in the working tree is evidence
+# the round can lose (VERDICT r4 item #1: "zero visibility must not
+# happen twice").
 cd "$(dirname "$0")/.." || exit 1
-for i in $(seq 1 150); do
+
+commit_evidence() {
+  # artifacts are mostly gitignored (working files) — force-add the ones
+  # that constitute round evidence.  One add per file: a single add with
+  # every pathspec is all-or-nothing and a missing file (normal before
+  # later stages run) would silently stage NOTHING.
+  for f in BENCH_PHASE.json bench_tpu_attempt.json bench_tpu_attempt.log \
+    bench_inner_tpu.err AUTOTUNE_ONCHIP.json AUTOTUNE.json \
+    PALLAS_VERDICT.json pallas_check.out pallas_check.err \
+    TRACE_BREAKDOWN.txt profile_attempt.log autotune_attempt.log; do
+    [ -e "$f" ] && git add -f "$f" 2>/dev/null
+  done
+  git diff --cached --quiet || git commit -q -m "$1"
+}
+
+for i in $(seq 1 160); do
   if timeout 300 python -c "import jax; assert jax.default_backend()=='tpu'" 2>/dev/null; then
     echo "[tpu_watch] TPU up at attempt $i ($(date -u +%H:%M:%S))"
     if [ ! -f PALLAS_VERDICT.json ]; then  # one verdict per watcher run
@@ -15,17 +38,27 @@ for i in $(seq 1 150); do
         >pallas_check.out 2>pallas_check.err
       echo "[tpu_watch] pallas check rc=$? :"
       cat pallas_check.out
+      commit_evidence "On-chip Pallas Mosaic re-check"
     fi
     python bench.py >bench_tpu_attempt.json 2>bench_tpu_attempt.log
     rc=$?
     echo "[tpu_watch] bench rc=$rc"
     cat bench_tpu_attempt.json
     tail -30 bench_tpu_attempt.log
-    # after a successful on-chip bench, immediately capture the profiler
-    # trace for the MFU gap analysis (same program, warm cache); any other
-    # outcome (degraded marker, crash, empty JSON) re-probes the tunnel
+    commit_evidence "On-chip bench attempt (rc=$rc)"
+    # autotune runs in the SAME window regardless of bench outcome: the
+    # sweep is many small fast compiles and its results feed the flash
+    # call path via the committed AUTOTUNE.json
+    echo "[tpu_watch] autotune sweep"
+    timeout 2400 python tools/autotune_onchip.py \
+      >autotune_attempt.log 2>&1
+    echo "[tpu_watch] autotune rc=$? (AUTOTUNE_ONCHIP.json)"
+    commit_evidence "On-chip autotune sweep"
+    # "partial" = salvaged phases from a window that ended early — a real
+    # on-chip number, but later phases deserve a warm-cache retry, so the
+    # watcher keeps probing rather than exiting
     if [ "$rc" -ne 0 ] || [ ! -s bench_tpu_attempt.json ] \
-        || grep -q '"degraded"' bench_tpu_attempt.json; then
+        || grep -q '"degraded"\|"partial"' bench_tpu_attempt.json; then
       echo "[tpu_watch] bench not clean (rc=$rc); will re-probe"
       sleep 120
       continue
@@ -40,10 +73,8 @@ for i in $(seq 1 150); do
       >TRACE_BREAKDOWN.txt 2>&1
     echo "[tpu_watch] analyze rc=$? (TRACE_BREAKDOWN.txt):"
     cat TRACE_BREAKDOWN.txt
-    echo "[tpu_watch] autotune sweep"
-    timeout 1800 python tools/autotune_onchip.py \
-      >autotune_attempt.log 2>&1
-    echo "[tpu_watch] autotune rc=$? (AUTOTUNE_ONCHIP.json)"
+    commit_evidence "On-chip XPlane trace + step-time breakdown"
+    echo "[tpu_watch] window complete; staying resident for re-runs"
     exit 0
   fi
   echo "[tpu_watch] attempt $i: tunnel down ($(date -u +%H:%M:%S))"

@@ -61,6 +61,16 @@ def _peak_flops(kind: str) -> float:
     return 0.0
 
 
+def train_flops_per_token(n_params: int, num_layers: int, seq: int,
+                          hidden: int) -> float:
+    """PaLM-style training FLOPs per token: 6N for the parameter ops
+    (fwd 2N + bwd 4N) + 12·L·S·H for attention score/context matmuls
+    (2·2S·H per of {QK^T fwd, AV fwd} = 4SH fwd, ×3 with backward,
+    per layer).  The MFU denominator everyone reports against; pinned by
+    tests/test_mfu_accounting.py."""
+    return 6.0 * n_params + 12.0 * num_layers * seq * hidden
+
+
 def _probe_tpu() -> bool:
     """Can a subprocess initialize the TPU backend within the timeout?"""
     code = "import jax; print('BACKEND=' + jax.default_backend())"
@@ -337,9 +347,8 @@ def inner(platform: str) -> None:
 
         tok_per_s = batch * seq / dt
         n_params = sum(p.size for p in model.parameters())
-        # PaLM-style train FLOPs/token: 6N + 12·L·S·hidden (attention term)
-        flops_per_tok = (6 * n_params
-                         + 12 * cfg.num_hidden_layers * seq * cfg.hidden_size)
+        flops_per_tok = train_flops_per_token(
+            n_params, cfg.num_hidden_layers, seq, cfg.hidden_size)
         peak = _peak_flops(jax.devices()[0].device_kind) if on_tpu else 0.0
         mfu = (flops_per_tok * tok_per_s / peak) if peak else 0.0
         return {"metric": "llama_train_tokens_per_sec_per_chip",

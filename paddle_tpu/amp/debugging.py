@@ -69,7 +69,7 @@ def disable_tensor_checker():
 
 def check_numerics(tensor, op_type="", var_name="", debug_mode=DebugMode.CHECK_NAN_INF_AND_ABORT):
     """Scan one tensor for NaN/Inf; returns (num_nan, num_inf, num_zero)."""
-    v = np.asarray(tensor._value)
+    v = tensor._host_read()
     if not np.issubdtype(v.dtype, np.floating):
         return to_tensor(0), to_tensor(0), to_tensor(int((v == 0).sum()))
     n_nan = int(np.isnan(v).sum())

@@ -149,7 +149,12 @@ def run_backward(
     ``capture_tensors``: tensors whose incoming gradient should be captured
     (used by ``paddle.grad``); results land in ``capture`` keyed by id.
     """
+    from .dispatch import notify_backward
     from .tensor import Tensor  # local import to avoid cycle
+
+    # tape closures capture forward-time values: a linear-trace recorder
+    # (jit/partial.py) cannot replay them and must give up
+    notify_backward()
 
     # --- seed gradients ----------------------------------------------------
     node_grads: Dict[Tuple[int, int], Any] = {}  # (id(node), out_idx) -> grad

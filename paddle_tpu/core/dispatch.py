@@ -83,6 +83,33 @@ def notify_rebind(wrapper, source):
         _op_observer.on_rebind(wrapper, source)
 
 
+def notify_sync(tensor, kind: str, value=None):
+    """A concrete host value was pulled out of ``tensor`` (``bool()``/
+    ``int()``/``float()``/``item()``/``numpy()``).  Partial-graph trace
+    recording turns these into segment boundaries + guards."""
+    if _op_observer is not None:
+        cb = getattr(_op_observer, "on_sync", None)
+        if cb is not None:
+            cb(tensor, kind, value)
+
+
+def notify_backward():
+    """The eager autograd engine is about to run (linear-trace recording
+    cannot represent tape closures — the recorder gives up)."""
+    if _op_observer is not None:
+        cb = getattr(_op_observer, "on_backward", None)
+        if cb is not None:
+            cb()
+
+
+def notify_ignored_module(fn_name: str):
+    """An ignore_module()'d function is running under trace recording."""
+    if _op_observer is not None:
+        cb = getattr(_op_observer, "on_ignored_module", None)
+        if cb is not None:
+            cb(fn_name)
+
+
 def _tree_leaves_with_path(out):
     if isinstance(out, (list, tuple)):
         return list(out), type(out)
@@ -127,7 +154,7 @@ def run_op(name: str, fn: Callable, *args, **kwargs):
             outs = result if isinstance(result, (list, tuple)) else [result]
             _capture_recorder.on_outputs([o for o in outs if isinstance(o, Tensor)])
         if _op_observer is not None:
-            _op_observer.on_op(name, fn, args, kwraw, result)
+            _op_observer.on_op(name, fn, args, kwargs, result)
         return result
 
     diff_idx = [i for i in tensor_idx if not args[i].stop_gradient]
@@ -160,7 +187,7 @@ def run_op(name: str, fn: Callable, *args, **kwargs):
         outs = result if isinstance(result, (list, tuple)) else [result]
         _capture_recorder.on_outputs([o for o in outs if isinstance(o, Tensor)])
     if _op_observer is not None:
-        _op_observer.on_op(name, fn, args, kwraw, result)
+        _op_observer.on_op(name, fn, args, kwargs, result)
     return result
 
 

@@ -125,6 +125,10 @@ define_flag("log_compiles", False, "Log every XLA compilation (jax_log_compiles)
             on_set=_jax_config("jax_log_compiles"))
 define_flag("jit_cache_max_entries", 64,
             "Max compiled entries per to_static function before eviction.")
+define_flag("jit_partial_graph", True,
+            "After a to_static graph break, record the eager run as a "
+            "linear trace, compile segments between host sync points, and "
+            "replay them with value guards (SOT partial-graph analog).")
 def _bool_env_mirror(env_key):
     """Mirror a boolean flag into the env var the kernel gates actually
     read ("1"/unset) so spawned workers inherit it."""

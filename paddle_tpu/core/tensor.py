@@ -28,6 +28,13 @@ from . import dtype as dtype_mod
 from .autograd import run_backward
 
 
+# Monotonic Tensor creation counter: partial-graph trace recording
+# (jit/partial.py) uses it to detect tensors created DURING a recorded run
+# outside op dispatch (host-computed values, to_tensor literals) — a linear
+# replay cannot reproduce those, so the trace must be rejected.
+_n_created = 0
+
+
 class Tensor:
     __slots__ = (
         "_value",
@@ -42,6 +49,7 @@ class Tensor:
         "trainable",
         "dist_attr",
         "dist_spec",
+        "_ctr",
         "__weakref__",
     )
 
@@ -70,6 +78,8 @@ class Tensor:
         self._backward_hooks = None
         self._hook_counter = 0
         self.trainable = True
+        global _n_created
+        self._ctr = _n_created = _n_created + 1
 
     # --- basic properties ---------------------------------------------------
     @property
@@ -126,6 +136,28 @@ class Tensor:
         hang the job.  ``item``/``tolist``/``float()``/``print`` route
         through here and share the contract.
         """
+        out = self._to_np()
+        from .dispatch import notify_sync
+
+        notify_sync(self, "numpy")
+        return out
+
+    def _host_read(self):
+        """Read the full value onto the host for host-side computation
+        (dynamic-shape ops like nonzero/masked_select, shape-from-tensor
+        reads, observer statistics).  Reports the escape to an active
+        partial-graph trace recorder — the host result can steer later
+        Python invisibly, so a recorded trace that contains one cannot be
+        replayed soundly."""
+        from .dispatch import notify_sync
+
+        notify_sync(self, "numpy")
+        return self._to_np()
+
+    def _to_np(self):
+        """numpy() without the host-sync notification (internal paths and
+        the scalar dunders, which report their own finer-grained sync
+        kind so partial-graph recording can guard the value)."""
         v = self._value
         if (isinstance(v, jax.Array) and not v.is_fully_addressable
                 and not v.is_fully_replicated):
@@ -135,10 +167,21 @@ class Tensor:
                 multihost_utils.process_allgather(v, tiled=True))
         return np.asarray(v)
 
+    def _sync_scalar(self, kind: str):
+        """Concretize to a host scalar, reporting (kind, value) to an
+        active partial-graph trace recorder as a guardable sync point."""
+        a = self._to_np()
+        value = (bool(a) if kind == "bool" else int(a) if kind == "int"
+                 else float(a) if kind == "float" else a.item())
+        from .dispatch import notify_sync
+
+        notify_sync(self, kind, value)
+        return value
+
     def item(self, *args):
         if args:
             return self._value[args].item() if len(args) > 1 else self.numpy().flat[args[0]].item()
-        return self.numpy().item()
+        return self._sync_scalar("item")
 
     def tolist(self):
         return self.numpy().tolist()
@@ -287,16 +330,16 @@ class Tensor:
             yield self[i]
 
     def __bool__(self):
-        return bool(self.numpy())
+        return self._sync_scalar("bool")
 
     def __float__(self):
-        return float(self.numpy())
+        return self._sync_scalar("float")
 
     def __int__(self):
-        return int(self.numpy())
+        return self._sync_scalar("int")
 
     def __index__(self):
-        return int(self.numpy())
+        return self._sync_scalar("int")
 
     def __hash__(self):
         return id(self)

@@ -23,11 +23,11 @@ def is_persistable(var) -> bool:
 
 def _state_of(obj) -> Dict[str, Any]:
     if hasattr(obj, "state_dict"):
-        return {k: np.asarray(v._value if isinstance(v, Tensor) else v)
+        return {k: (v._host_read() if isinstance(v, Tensor) else np.asarray(v))
                 for k, v in obj.state_dict().items()}
     from ..static.io import _named_params
 
-    return {k: np.asarray(p._value)
+    return {k: p._host_read()
             for k, p in _named_params(obj).items()}
 
 

@@ -43,7 +43,7 @@ class CUDAPinnedPlace(Place):
 
 def _to_saveable(obj: Any) -> Any:
     if isinstance(obj, Tensor):
-        return {"__tensor__": True, "data": np.asarray(obj._value),
+        return {"__tensor__": True, "data": obj._host_read(),
                 "stop_gradient": obj.stop_gradient,
                 "param": isinstance(obj, Parameter)}
     if isinstance(obj, dict):

@@ -26,7 +26,7 @@ def _ensure(x):
 def _num_segments(ids, n):
     if n is not None:
         return int(n)
-    arr = np.asarray(ids._value if isinstance(ids, Tensor) else ids)
+    arr = (ids._host_read() if isinstance(ids, Tensor) else np.asarray(ids))
     return int(arr.max()) + 1 if arr.size else 0
 
 
@@ -128,9 +128,9 @@ def graph_reindex(x, neighbors, count, value_buffer=None, index_buffer=None,
                   flag_buffer_hashtable=False, name=None):
     """(``graph_reindex``) relabel a node subset + its neighbor lists with
     contiguous ids.  Host op (output size is data-dependent)."""
-    xs = np.asarray(_ensure(x)._value)
-    nb = np.asarray(_ensure(neighbors)._value)
-    cnt = np.asarray(_ensure(count)._value)
+    xs = _ensure(x)._host_read()
+    nb = _ensure(neighbors)._host_read()
+    cnt = _ensure(count)._host_read()
     uniq, order = {}, []
     for v in list(xs) + list(nb):
         v = int(v)
@@ -150,9 +150,9 @@ def graph_sample_neighbors(row, colptr, input_nodes, sample_size=-1,
                            flag_perm_buffer=False, name=None):
     """(``graph_sample_neighbors``) sample up to ``sample_size`` neighbors
     of each input node from a CSC graph.  Host op (dynamic output)."""
-    r = np.asarray(_ensure(row)._value)
-    cp = np.asarray(_ensure(colptr)._value)
-    nodes = np.asarray(_ensure(input_nodes)._value)
+    r = _ensure(row)._host_read()
+    cp = _ensure(colptr)._host_read()
+    nodes = _ensure(input_nodes)._host_read()
     rng = np.random.default_rng(0)
     out, counts = [], []
     for v in nodes.astype(np.int64):
@@ -175,8 +175,8 @@ def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
     all_nb, all_cnt = [], []
     for k in sample_sizes:
         nb, cnt = graph_sample_neighbors(row, colptr, cur, sample_size=k)
-        all_nb.append(np.asarray(nb._value))
-        all_cnt.append(np.asarray(cnt._value))
+        all_nb.append(nb._host_read())
+        all_cnt.append(cnt._host_read())
         cur = nb
     nb_flat = np.concatenate(all_nb) if all_nb else np.zeros(0, np.int64)
     cnt_flat = np.concatenate(all_cnt) if all_cnt else np.zeros(0, np.int64)

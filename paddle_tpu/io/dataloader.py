@@ -41,7 +41,7 @@ def default_collate_fn(batch):
     """Stack samples into batched numpy arrays (paddle default_collate_fn)."""
     sample = batch[0]
     if isinstance(sample, Tensor):
-        return np.stack([np.asarray(s._value) for s in batch])
+        return np.stack([s._host_read() for s in batch])
     if isinstance(sample, np.ndarray):
         return np.stack(batch)
     if isinstance(sample, (int, float, np.number)):

@@ -1,0 +1,490 @@
+"""Partial-graph execution after a ``to_static`` graph break.
+
+Capability analog of the reference's SOT tracer
+(``python/paddle/jit/sot/`` + the CPython eval-frame hook
+``paddle/fluid/pybind/eval_frame.c:480``): when a function cannot be
+staged whole (data-dependent Python control flow, host sync), the
+reference keeps compiled subgraphs around the break, guarded, and
+executes only the breaking region eagerly.  Ours previously fell back to
+whole-function eager per signature — a silent perf cliff.
+
+TPU-first design — no bytecode hacking.  The eager fallback run is
+recorded at the op-dispatch layer as a *linear trace*: every ``run_op``
+call, every in-place rebind, and every host **sync point** (a concrete
+scalar pulled into Python via ``bool()``/``int()``/``float()``/
+``item()``).  The trace is split into **segments** at sync points; each
+segment compiles to ONE fused XLA program (``jax.jit`` over a replay of
+its op list).  Later calls replay segments compiled and re-evaluate only
+the host-side decisions:
+
+* every sync value is a **guard** — replay proceeds only while the fresh
+  concrete value equals the recorded one, so any host scalar that could
+  have steered recorded Python control flow (or been baked into a
+  downstream op as a constant) is revalidated by construction.  A
+  mismatch re-records the trace for the new path (bounded; then the
+  signature goes plain-eager, loudly).
+* traces that a linear replay cannot represent are rejected at record
+  time: autograd tape activity (eager backward closures capture
+  record-time values), ``.numpy()`` escapes (untracked host data flow),
+  RNG consumption (keys would be frozen), and ``ignore_module``'d calls.
+
+Python side effects between segments (prints, list appends) run only
+during recording calls — the same contract ``to_static`` already has for
+its discovery pass.
+"""
+
+from __future__ import annotations
+
+import warnings
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+
+from ..core import dispatch as _dispatch
+from ..core import random as rng_mod
+from ..core.tensor import Tensor
+
+_MAX_TRACES = 3  # per signature; guard churn beyond this → plain eager
+
+_recording_depth = 0
+
+
+def in_recording() -> bool:
+    return _recording_depth > 0
+
+
+class GuardMismatch(Exception):
+    """A sync value diverged from the recorded path."""
+
+
+class _Op:
+    __slots__ = ("name", "fn", "arg_ids", "arg_consts", "kw_ids",
+                 "kw_consts", "out_ids")
+
+    def __init__(self, name, fn, arg_ids, arg_consts, kw_ids, kw_consts,
+                 out_ids):
+        self.name = name
+        self.fn = fn
+        self.arg_ids = arg_ids        # per-position tensor id or None
+        self.arg_consts = arg_consts  # per-position constant (when id None)
+        self.kw_ids = kw_ids          # kwarg name -> tensor id
+        self.kw_consts = kw_consts    # kwarg name -> constant value
+        self.out_ids = out_ids
+
+
+class _Alias:
+    __slots__ = ("wrapper_id", "src_id")
+
+    def __init__(self, wrapper_id, src_id):
+        self.wrapper_id = wrapper_id
+        self.src_id = src_id
+
+
+class _Sync:
+    __slots__ = ("tid", "kind", "value")
+
+    def __init__(self, tid, kind, value):
+        self.tid = tid
+        self.kind = kind
+        self.value = value
+
+
+class TraceRecorder:
+    """Dispatch observer recording one eager run as a linear trace."""
+
+    def __init__(self, arg_tensors: List[Tensor]):
+        from ..core import tensor as tensor_mod
+
+        self.events: List[Any] = []
+        self.tensors: Dict[int, Tensor] = {}  # strong refs: id stability
+        self.arg_ids = [id(t) for t in arg_tensors]
+        self.produced = set(self.arg_ids)
+        self.captured: Dict[int, Tensor] = {}  # pre-existing state
+        self.mutated: Dict[int, Tensor] = {}   # alias/rebind targets
+        self.dead: Optional[str] = None
+        # tensors created after this point that did NOT come out of op
+        # dispatch (host-computed results like nonzero/masked_select,
+        # to_tensor literals, np.random data) cannot be replayed soundly
+        self.start_ctr = tensor_mod._n_created
+        for t in arg_tensors:
+            self.tensors[id(t)] = t
+
+    # --- classification ----------------------------------------------------
+    def _touch_input(self, t: Tensor) -> int:
+        tid = id(t)
+        if tid not in self.produced and tid not in self.captured:
+            if t._ctr > self.start_ctr:
+                self._die("a Tensor created outside op dispatch entered "
+                          "the trace (host-computed value or to_tensor "
+                          "literal inside the function)")
+            self.captured[tid] = t
+        self.tensors[tid] = t
+        return tid
+
+    # --- dispatch observer callbacks ---------------------------------------
+    def on_op(self, name, fn, args, kwargs, result):
+        arg_ids, arg_consts = [], []
+        for a in args:
+            if isinstance(a, Tensor):
+                arg_ids.append(self._touch_input(a))
+                arg_consts.append(None)
+            else:
+                arg_ids.append(None)
+                arg_consts.append(a)
+        kw_ids, kw_consts = {}, {}
+        for k, v in kwargs.items():
+            if isinstance(v, Tensor):
+                kw_ids[k] = self._touch_input(v)
+            else:
+                kw_consts[k] = v
+        outs = result if isinstance(result, (list, tuple)) else [result]
+        out_ids = []
+        for o in outs:
+            if isinstance(o, Tensor):
+                tid = id(o)
+                out_ids.append(tid)
+                self.produced.add(tid)
+                self.tensors[tid] = o
+            else:
+                out_ids.append(None)
+        self.events.append(_Op(name, fn, arg_ids, arg_consts, kw_ids,
+                               kw_consts, out_ids))
+
+    def on_rebind(self, wrapper, source):
+        wid, sid = id(wrapper), id(source)
+        if sid not in self.produced and sid not in self.captured:
+            self.captured[sid] = source
+        self.tensors[wid] = wrapper
+        self.tensors[sid] = source
+        self.produced.add(wid)
+        self.mutated[wid] = wrapper
+        self.events.append(_Alias(wid, sid))
+
+    def _die(self, reason: str):
+        if self.dead is None:  # the FIRST reason is the root cause
+            self.dead = reason
+
+    def on_sync(self, tensor, kind, value):
+        if kind in ("numpy",):
+            # a full array escaped to host Python — its downstream use is
+            # untrackable, so a linear replay cannot be validated
+            self._die("a Tensor was converted to numpy "
+                      "(host data escape)")
+            return
+        tid = self._touch_input(tensor)
+        self.events.append(_Sync(tid, kind, value))
+
+    def on_backward(self):
+        self._die("the autograd tape ran (eager backward closures "
+                  "capture record-time values)")
+
+    def on_ignored_module(self, fn_name):
+        self._die(f"ignore_module()'d function {fn_name!r} was called")
+
+
+class _Segment:
+    def __init__(self, nodes, in_ids, out_ids, sync: Optional[_Sync]):
+        self.nodes = nodes
+        self.in_ids = in_ids
+        self.out_ids = out_ids
+        self.sync = sync
+        self._jitted = None
+
+    def run(self, env: Dict[int, Any]):
+        if self.nodes:
+            if self._jitted is None:
+                self._jitted = self._compile()
+            outs = self._jitted(tuple(env[i] for i in self.in_ids))
+            env.update(zip(self.out_ids, outs))
+
+    def _compile(self):
+        nodes, in_ids, out_ids = self.nodes, self.in_ids, self.out_ids
+
+        def replay(in_vals):
+            env = dict(zip(in_ids, in_vals))
+            for ev in nodes:
+                if isinstance(ev, _Alias):
+                    env[ev.wrapper_id] = env[ev.src_id]
+                    continue
+                call = [env[tid] if tid is not None else const
+                        for tid, const in zip(ev.arg_ids, ev.arg_consts)]
+                kw = dict(ev.kw_consts)
+                for k, tid in ev.kw_ids.items():
+                    kw[k] = env[tid]
+                out = ev.fn(*call, **kw)
+                outs = out if isinstance(out, (list, tuple)) else [out]
+                for oid, o in zip(ev.out_ids, outs):
+                    if oid is not None:
+                        env[oid] = o
+            return tuple(env[i] for i in out_ids)
+
+        return jax.jit(replay)
+
+
+class LinearTrace:
+    """A recorded, segmented, guarded trace for one signature + path."""
+
+    def __init__(self, rec: TraceRecorder, result):
+        self.arg_ids = rec.arg_ids
+        self.captured = dict(rec.captured)
+        self.mutated = dict(rec.mutated)
+        # NOTE: rec.tensors (every intermediate touched during recording)
+        # is deliberately NOT retained — replay only needs the captured
+        # state and mutation targets; keeping intermediates would pin one
+        # full run's activations in device memory per cached trace.
+        # Intermediate ids live on only as integer keys inside segments,
+        # where id reuse by later tensors is harmless.
+
+        def _to_template(obj):
+            if isinstance(obj, Tensor):
+                return ("__tensor__", id(obj), obj.stop_gradient)
+            if isinstance(obj, (list, tuple)):
+                return type(obj)(_to_template(o) for o in obj)
+            if isinstance(obj, dict):
+                return {k: _to_template(v) for k, v in obj.items()}
+            return obj
+
+        self.result_template = _to_template(result)
+        self.segments = self._segment(rec.events)
+        self.n_compiled_ops = sum(
+            len([n for n in s.nodes if isinstance(n, _Op)])
+            for s in self.segments)
+
+    # --- segmentation ------------------------------------------------------
+    def _segment(self, events) -> List[_Segment]:
+        # needed ids: walked backwards so each segment exports exactly what
+        # later segments / syncs / writebacks / results consume
+        result_ids = []
+
+        def _collect(obj):
+            if isinstance(obj, tuple) and len(obj) == 3 \
+                    and obj[0] == "__tensor__":
+                result_ids.append(obj[1])
+            elif isinstance(obj, (list, tuple)):
+                for o in obj:
+                    _collect(o)
+            elif isinstance(obj, dict):
+                for o in obj.values():
+                    _collect(o)
+
+        _collect(self.result_template)
+
+        chunks: List[Tuple[List[Any], Optional[_Sync]]] = []
+        cur: List[Any] = []
+        for ev in events:
+            if isinstance(ev, _Sync):
+                chunks.append((cur, ev))
+                cur = []
+            else:
+                cur.append(ev)
+        chunks.append((cur, None))
+
+        always_needed = set(result_ids) | set(self.mutated)
+        segments: List[_Segment] = []
+        needed_after = set(always_needed)
+        # backwards pass: what each chunk must export
+        exports: List[set] = [set() for _ in chunks]
+        for i in range(len(chunks) - 1, -1, -1):
+            nodes, sync = chunks[i]
+            produced = set()
+            consumed = set()
+            for ev in nodes:
+                if isinstance(ev, _Alias):
+                    consumed.add(ev.src_id)
+                    produced.add(ev.wrapper_id)
+                else:
+                    consumed.update(t for t in ev.arg_ids if t is not None)
+                    consumed.update(ev.kw_ids.values())
+                    produced.update(t for t in ev.out_ids if t is not None)
+            need_here = set(needed_after)
+            if sync is not None:
+                need_here.add(sync.tid)
+            exports[i] = produced & need_here
+            needed_after = (need_here - produced) | consumed
+        # forwards pass: inputs = ids consumed but not produced earlier in
+        # the same chunk
+        avail = set(self.arg_ids) | set(self.captured)
+        for (nodes, sync), outs in zip(chunks, exports):
+            produced = set()
+            in_ids = set()
+            for ev in nodes:
+                if isinstance(ev, _Alias):
+                    if ev.src_id not in produced:
+                        in_ids.add(ev.src_id)
+                    produced.add(ev.wrapper_id)
+                else:
+                    for tid in list(ev.arg_ids) + list(ev.kw_ids.values()):
+                        if tid is not None and tid not in produced:
+                            in_ids.add(tid)
+                    produced.update(t for t in ev.out_ids if t is not None)
+            seg_in = sorted(in_ids & avail)
+            segments.append(_Segment(nodes, seg_in, sorted(outs), sync))
+            avail |= outs
+        return segments
+
+    # --- replay ------------------------------------------------------------
+    def replay(self, current_args: List[Tensor]):
+        env: Dict[int, Any] = {}
+        for tid, t in self.captured.items():
+            env[tid] = t._value
+        for tid, t in zip(self.arg_ids, current_args):
+            env[tid] = t._value
+        for seg in self.segments:
+            seg.run(env)
+            if seg.sync is not None:
+                s = seg.sync
+                fresh = _concretize(env[s.tid], s.kind)
+                if fresh != s.value:
+                    raise GuardMismatch(
+                        f"{s.kind}() sync: recorded {s.value!r}, "
+                        f"got {fresh!r}")
+        # write back mutations (deferred until every guard passed, so a
+        # mismatch mid-replay leaves no visible side effects)
+        arg_pos = {tid: i for i, tid in enumerate(self.arg_ids)}
+        for wid, wrapper in self.mutated.items():
+            if wid in env:
+                target = (current_args[arg_pos[wid]] if wid in arg_pos
+                          else wrapper)
+                target._value = env[wid]
+
+        def _rebuild(obj):
+            if isinstance(obj, tuple) and len(obj) == 3 \
+                    and obj[0] == "__tensor__":
+                return Tensor(env[obj[1]], stop_gradient=obj[2])
+            if isinstance(obj, (list, tuple)):
+                return type(obj)(_rebuild(o) for o in obj)
+            if isinstance(obj, dict):
+                return {k: _rebuild(v) for k, v in obj.items()}
+            return obj
+
+        return _rebuild(self.result_template)
+
+
+def _concretize(value, kind: str):
+    import numpy as np
+
+    a = np.asarray(value)
+    if kind == "bool":
+        return bool(a)
+    if kind == "int":
+        return int(a)
+    if kind == "float":
+        return float(a)
+    return a.item()  # "item"
+
+
+def _walk_tensors(obj, acc: List[Tensor]):
+    if isinstance(obj, Tensor):
+        acc.append(obj)
+    elif isinstance(obj, (list, tuple)):
+        for o in obj:
+            _walk_tensors(o, acc)
+    elif isinstance(obj, dict):
+        for o in obj.values():
+            _walk_tensors(o, acc)
+
+
+def _rng_state_equal(a, b) -> bool:
+    import numpy as np
+
+    if set(a) != set(b):
+        return False
+    return all(np.array_equal(np.asarray(a[k]), np.asarray(b[k]))
+               for k in a)
+
+
+def record_call(fn, args, kwargs, arg_tensors):
+    """Run ``fn`` eagerly under the trace recorder.
+
+    Returns ``(result, LinearTrace | None, dead_reason | None)``.
+    """
+    global _recording_depth
+    rec = TraceRecorder(arg_tensors)
+    rng_before = rng_mod.get_rng_state()
+    _dispatch._set_op_observer(rec)
+    _recording_depth += 1
+    try:
+        result = fn(*args, **kwargs)
+    finally:
+        _recording_depth -= 1
+        _dispatch._set_op_observer(None)
+    if rec.dead is None and not _rng_state_equal(rng_mod.get_rng_state(),
+                                                 rng_before):
+        rec.dead = ("RNG state advanced (replay would freeze the keys "
+                    "— e.g. dropout in train mode)")
+    if rec.dead is None:
+        # a host-computed tensor RETURNED without being consumed by any op
+        # never hit _touch_input — reject it here
+        res_tensors: List[Tensor] = []
+        _walk_tensors(result, res_tensors)
+        for t in res_tensors:
+            if id(t) not in rec.produced and t._ctr > rec.start_ctr:
+                rec.dead = ("a Tensor created outside op dispatch is "
+                            "returned from the function")
+                break
+    if rec.dead is not None:
+        return result, None, rec.dead
+    try:
+        trace = LinearTrace(rec, result)
+    except Exception as e:  # defensive: never break the eager result
+        return result, None, f"trace build failed: {e}"
+    return result, trace, None
+
+
+class TraceStore:
+    """Per-signature store: recorded traces (one per guard path).
+
+    ``announce`` is an optional zero-arg callable consulted before the
+    informational "compiled a partial graph" warning — the owning
+    StaticFunction uses it to emit that message once per function rather
+    than once per signature."""
+
+    def __init__(self, fn_name: str, announce=None):
+        self.fn_name = fn_name
+        self.announce = announce
+        self.traces: List[LinearTrace] = []
+        self.dead: Optional[str] = None
+
+    def call(self, fn, args, kwargs, arg_tensors):
+        if self.dead is not None:
+            return fn(*args, **kwargs)
+        for trace in self.traces:
+            try:
+                return trace.replay(arg_tensors)
+            except GuardMismatch:
+                continue
+            except Exception as e:
+                # a trace that cannot replay (e.g. a host-only op inside a
+                # segment jit) permanently disqualifies partial mode here
+                self.dead = f"segment replay failed: {type(e).__name__}: {e}"
+                warnings.warn(
+                    f"to_static[{self.fn_name}]: partial-graph replay "
+                    f"failed ({self.dead}); this signature now runs "
+                    "fully eagerly.", RuntimeWarning, stacklevel=3)
+                return fn(*args, **kwargs)
+        if len(self.traces) >= _MAX_TRACES:
+            self.dead = (f"guards diverged on {_MAX_TRACES} recorded "
+                         "paths (an unstable host scalar steers this "
+                         "function, e.g. float(loss) compared each step)")
+            warnings.warn(
+                f"to_static[{self.fn_name}]: PERFORMANCE — {self.dead}; "
+                "this signature now runs fully eagerly.",
+                RuntimeWarning, stacklevel=3)
+            return fn(*args, **kwargs)
+        result, trace, dead = record_call(fn, args, kwargs, arg_tensors)
+        if trace is not None:
+            self.traces.append(trace)
+            if self.announce is None or self.announce():
+                warnings.warn(
+                    f"to_static[{self.fn_name}]: compiled a partial graph "
+                    f"around the break: {len(trace.segments)} segment(s), "
+                    f"{trace.n_compiled_ops} ops staged; host sync points "
+                    "re-evaluated per call with value guards.",
+                    RuntimeWarning, stacklevel=3)
+        else:
+            self.dead = dead
+            warnings.warn(
+                f"to_static[{self.fn_name}]: cannot build a partial "
+                f"graph: {dead}; this signature runs fully eagerly.",
+                RuntimeWarning, stacklevel=3)
+        return result

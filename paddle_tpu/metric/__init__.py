@@ -36,8 +36,8 @@ class Accuracy(Metric):
         self.count = [0] * len(self.topk)
 
     def compute(self, pred, label, *args):
-        p = np.asarray(pred._value if isinstance(pred, Tensor) else pred)
-        l = np.asarray(label._value if isinstance(label, Tensor) else label)
+        p = (pred._host_read() if isinstance(pred, Tensor) else np.asarray(pred))
+        l = (label._host_read() if isinstance(label, Tensor) else np.asarray(label))
         if l.ndim == p.ndim and l.shape[-1] == 1:
             l = l.squeeze(-1)
         idx = np.argsort(-p, axis=-1)[..., : self.maxk]
@@ -45,7 +45,7 @@ class Accuracy(Metric):
         return to_tensor(correct.astype(np.float32))
 
     def update(self, correct, *args):
-        c = np.asarray(correct._value if isinstance(correct, Tensor) else correct)
+        c = (correct._host_read() if isinstance(correct, Tensor) else np.asarray(correct))
         for i, k in enumerate(self.topk):
             hit = c[..., :k].sum(-1).mean()
             self.total[i] += float(c[..., :k].sum())
@@ -72,8 +72,8 @@ class Precision(Metric):
         self.fp = 0
 
     def update(self, preds, labels):
-        p = np.asarray(preds._value if isinstance(preds, Tensor) else preds)
-        l = np.asarray(labels._value if isinstance(labels, Tensor) else labels)
+        p = (preds._host_read() if isinstance(preds, Tensor) else np.asarray(preds))
+        l = (labels._host_read() if isinstance(labels, Tensor) else np.asarray(labels))
         pred_pos = (p > 0.5).astype(np.int64).reshape(-1)
         l = l.reshape(-1)
         self.tp += int(((pred_pos == 1) & (l == 1)).sum())
@@ -97,8 +97,8 @@ class Recall(Metric):
         self.fn = 0
 
     def update(self, preds, labels):
-        p = np.asarray(preds._value if isinstance(preds, Tensor) else preds)
-        l = np.asarray(labels._value if isinstance(labels, Tensor) else labels)
+        p = (preds._host_read() if isinstance(preds, Tensor) else np.asarray(preds))
+        l = (labels._host_read() if isinstance(labels, Tensor) else np.asarray(labels))
         pred_pos = (p > 0.5).astype(np.int64).reshape(-1)
         l = l.reshape(-1)
         self.tp += int(((pred_pos == 1) & (l == 1)).sum())
@@ -123,8 +123,8 @@ class Auc(Metric):
         self._stat_neg = np.zeros(self.num_thresholds + 1)
 
     def update(self, preds, labels):
-        p = np.asarray(preds._value if isinstance(preds, Tensor) else preds)
-        l = np.asarray(labels._value if isinstance(labels, Tensor) else labels).reshape(-1)
+        p = (preds._host_read() if isinstance(preds, Tensor) else np.asarray(preds))
+        l = (labels._host_read() if isinstance(labels, Tensor) else np.asarray(labels)).reshape(-1)
         if p.ndim == 2:
             p = p[:, 1]
         bins = np.clip((p * self.num_thresholds).astype(int), 0, self.num_thresholds)
@@ -152,8 +152,8 @@ class Auc(Metric):
 
 
 def accuracy(input, label, k=1, correct=None, total=None, name=None):
-    p = np.asarray(input._value)
-    l = np.asarray(label._value)
+    p = input._host_read()
+    l = label._host_read()
     if l.ndim == 2 and l.shape[-1] == 1:
         l = l.squeeze(-1)
     idx = np.argsort(-p, axis=-1)[..., :k]

@@ -90,6 +90,8 @@ class LlamaConfig:
                                         # softmax probs (DeepSeek/Qwen2-MoE)
     moe_shared_expert_gated: bool = False  # sigmoid-gate the shared
                                         # expert output (Qwen2-MoE)
+    first_k_dense_replace: int = 0      # first k layers use a DENSE MLP
+                                        # (DeepSeek-MoE: layer 0 is dense)
     aux_loss_weight: float = 0.01
 
     @property
@@ -128,7 +130,8 @@ class LlamaConfig:
             num_key_value_heads=16, max_position_embeddings=4096,
             num_experts=64, num_experts_per_tok=6,
             moe_intermediate_size=1408, num_shared_experts=2,
-            moe_norm_topk_prob=False)  # DeepSeek-MoE: raw softmax gates
+            moe_norm_topk_prob=False,   # DeepSeek-MoE: raw softmax gates
+            first_k_dense_replace=1)    # layer 0 is a dense MLP
         defaults.update(kw)
         return cls(**defaults)
 
@@ -139,7 +142,7 @@ class LlamaConfig:
         defaults = dict(
             vocab_size=151936, hidden_size=3584, intermediate_size=18944,
             num_hidden_layers=28, num_attention_heads=28,
-            num_key_value_heads=4, max_position_embeddings=8192,
+            num_key_value_heads=4, max_position_embeddings=32768,
             num_experts=64, num_experts_per_tok=8,
             # shared_expert_intermediate_size 20480 = 8 x 2560 (ONE gated
             # shared MLP of that width; our sizing is ff x n_shared)
@@ -399,14 +402,14 @@ class LlamaDecoderLayer(Layer):
     """Pre-norm decoder block; single-input forward so the stack is
     pipeline-homogeneous (drops into PipelineLayer unchanged)."""
 
-    def __init__(self, config: LlamaConfig):
+    def __init__(self, config: LlamaConfig, layer_idx: int = 0):
         super().__init__()
         self.config = config
         self.input_layernorm = RMSNorm(config.hidden_size, config.rms_norm_eps)
         self.self_attn = LlamaAttention(config)
         self.post_attention_layernorm = RMSNorm(config.hidden_size,
                                                 config.rms_norm_eps)
-        if config.num_experts > 0:
+        if config.num_experts > 0 and layer_idx >= config.first_k_dense_replace:
             self.mlp = LlamaMoEBlock(config)
         else:
             self.mlp = LlamaMLP(config)
@@ -436,7 +439,8 @@ class LlamaModel(Layer):
             config.vocab_size, config.hidden_size,
             weight_attr=Normal(0.0, config.initializer_range))
         self.layers = LayerList(
-            [LlamaDecoderLayer(config) for _ in range(config.num_hidden_layers)])
+            [LlamaDecoderLayer(config, layer_idx=i)
+             for i in range(config.num_hidden_layers)])
         self.norm = RMSNorm(config.hidden_size, config.rms_norm_eps)
         self._pipe: Optional[PipelineLayer] = None
         self._scan_prep = None              # lazy (roles, per_layer, specs)

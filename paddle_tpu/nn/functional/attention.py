@@ -115,8 +115,8 @@ def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
 
     q = _ensure(query)
     B, H, L, _ = q.shape
-    offs = np.asarray(_ensure(sparse_csr_offset)._value)
-    cols = np.asarray(_ensure(sparse_csr_columns)._value)
+    offs = _ensure(sparse_csr_offset)._host_read()
+    cols = _ensure(sparse_csr_columns)._host_read()
     vals = np.ones(cols.reshape(B * H, -1).shape, np.float32)
     mask = sparse_csr_tensor(offs.reshape(B * H, L + 1),
                              cols.reshape(B * H, -1), vals,
@@ -137,8 +137,8 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
     import numpy as np
 
     q, k, v = _ensure(query), _ensure(key), _ensure(value)
-    cq = np.asarray(_ensure(cu_seqlens_q)._value).astype(np.int64)
-    ck = np.asarray(_ensure(cu_seqlens_k)._value).astype(np.int64)
+    cq = _ensure(cu_seqlens_q)._host_read().astype(np.int64)
+    ck = _ensure(cu_seqlens_k)._host_read().astype(np.int64)
     seg_q = np.repeat(np.arange(len(cq) - 1), np.diff(cq))
     seg_k = np.repeat(np.arange(len(ck) - 1), np.diff(ck))
     pos_q = np.concatenate([np.arange(n) for n in np.diff(cq)]) if len(cq) > 1 \

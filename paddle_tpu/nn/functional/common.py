@@ -81,7 +81,7 @@ def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", pad_from_left_ax
     """paddle.nn.functional.pad: pad is [left,right,...] per trailing dims or
     full ndim*2 list; also accepts per-axis pairs for constant mode."""
     if isinstance(pad, Tensor):
-        pad = [int(v) for v in np.asarray(pad._value)]
+        pad = [int(v) for v in pad._host_read()]
     pad = list(pad)
     x = _ensure(x)
     nd = x.ndim
@@ -185,7 +185,7 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest", align_corners=F
 
     if size is not None:
         if isinstance(size, Tensor):
-            size = [int(v) for v in np.asarray(size._value)]
+            size = [int(v) for v in size._host_read()]
         out_spatial = [int(s._value) if isinstance(s, Tensor) else int(s) for s in (size if isinstance(size, (list, tuple)) else [size])]
     else:
         sf = scale_factor if isinstance(scale_factor, (list, tuple)) else [scale_factor] * spatial
@@ -433,11 +433,11 @@ def edit_distance(input, label, normalized=True, ignored_tokens=None,
     """Levenshtein distance per batch row over padded int sequences
     (``nn/functional/loss.py`` edit_distance; host DP like the reference's
     CPU kernel).  Returns (distance [B, 1], sequence_num [1])."""
-    a = np.asarray(_ensure(input)._value)
-    b = np.asarray(_ensure(label)._value)
-    la = (np.asarray(_ensure(input_length)._value) if input_length is not None
+    a = _ensure(input)._host_read()
+    b = _ensure(label)._host_read()
+    la = (_ensure(input_length)._host_read() if input_length is not None
           else np.full(a.shape[0], a.shape[1]))
-    lb = (np.asarray(_ensure(label_length)._value) if label_length is not None
+    lb = (_ensure(label_length)._host_read() if label_length is not None
           else np.full(b.shape[0], b.shape[1]))
     ignored = set(ignored_tokens or [])
     out = np.zeros((a.shape[0], 1), np.float32)

@@ -499,8 +499,8 @@ def hsigmoid_loss(input, label, num_classes, weight, bias=None,
         # softplus(z) - code*z summed twice is wrong, so mask instead
         valid = np.arange(D)[None, :] < lens[:, None]
     else:
-        table = np.asarray(_ensure(path_table)._value)
-        code = np.asarray(_ensure(path_code)._value).astype(np.float32)
+        table = _ensure(path_table)._host_read()
+        code = _ensure(path_code)._host_read().astype(np.float32)
         valid = np.ones(table.shape, bool)
 
     def f(x, y, wv, *maybe_b):
@@ -601,7 +601,7 @@ def class_center_sample(label, num_classes, num_samples, group=None):
     sampled_class_index)."""
     from ...core import random as rng_mod
 
-    y = np.asarray(_ensure(label)._value).reshape(-1).astype(np.int64)
+    y = _ensure(label)._host_read().reshape(-1).astype(np.int64)
     pos = np.unique(y)
     need = max(0, num_samples - len(pos))
     rest = np.setdiff1d(np.arange(num_classes, dtype=np.int64), pos,

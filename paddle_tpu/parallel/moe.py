@@ -47,48 +47,45 @@ def _topk_gating(logits, capacity, k, normalize=True):
     raw softmax probabilities (Switch top-1, DeepSeek-MoE, Qwen2-MoE).
     k=1 never renormalizes — a single surviving gate would be pinned to
     exactly 1.0, erasing the learned gate magnitude.
-    logits: [T, E] float32."""
+    logits: [T, E] float32.
+
+    Fully vectorized over k (one ``lax.top_k`` + one cumsum over the
+    [T, k, E] choice tensor — graph size constant in k; the k-unrolled
+    argmax/cumsum formulation grew linearly and k=8 presets paid for it).
+    The sequential "offset carries KEPT slots of higher-priority choices"
+    rule has the closed form ``offset_j(e) = min(capacity,
+    Σ_{j'<j} count_{j'}(e))``: round-j positions are contiguous from the
+    running offset, so the kept count is ``min(capacity, offset+count) -
+    offset`` and the recursion telescopes."""
     normalize = normalize and k > 1
     T, E = logits.shape
     probs = jax.nn.softmax(logits, axis=-1)
 
-    # choice masks in priority order: j-th mask = each token's j-th pick
-    remaining = probs
-    masks, gates = [], []
-    for _ in range(k):
-        idx = jnp.argmax(remaining, axis=-1)
-        m = _one_hot(idx, E)
-        masks.append(m)
-        gates.append(jnp.sum(probs * m, axis=-1))
-        remaining = remaining * (1.0 - m)
+    # priority-ordered choices: idx[t, j] = token t's j-th expert
+    vals, idx = jax.lax.top_k(probs, k)           # [T, k] each
+    M = _one_hot(idx, E)                          # [T, k, E]
 
     # aux loss: mean(prob per expert) * mean(tokens top-1-routed) * E
-    density = jnp.mean(masks[0], axis=0)
+    density = jnp.mean(M[:, 0, :], axis=0)
     density_proxy = jnp.mean(probs, axis=0)
     aux = jnp.sum(density * density_proxy) * E
 
-    # capacity positions by cumulative count; offsets carry KEPT slots of
-    # all higher-priority choices (tokens beyond capacity dropped)
-    offset = jnp.zeros((1, E), probs.dtype)
-    kept, pos = [], []
-    for m in masks:
-        p = (jnp.cumsum(m, axis=0) + offset) * m - 1.0
-        m = m * (p < capacity)
-        offset = offset + jnp.sum(m, axis=0, keepdims=True)
-        kept.append(m)
-        pos.append(p)
+    # capacity accounting (see closed form above): all j-th choices take
+    # slots before any (j+1)-th choice; within a round, token order
+    counts = jnp.sum(M, axis=0)                   # [k, E] per-round totals
+    before = jnp.cumsum(counts, axis=0) - counts  # exclusive prefix
+    offset = jnp.minimum(capacity, before)        # [k, E] kept-slot offset
+    p = (jnp.cumsum(M, axis=0) + offset[None]) * M - 1.0
+    kept = M * (p < capacity)                     # [T, k, E]
 
-    gates = [g * jnp.sum(m, axis=-1) for g, m in zip(gates, kept)]
+    gates = vals * jnp.sum(kept, axis=-1)         # [T, k]; dropped -> 0
     if normalize:
-        denom = sum(gates)
-        denom = jnp.where(denom > 0, denom, 1.0)
-        gates = [g / denom for g in gates]
+        denom = jnp.sum(gates, axis=-1, keepdims=True)
+        gates = gates / jnp.where(denom > 0, denom, 1.0)
 
-    combine = jnp.zeros((T, E, capacity), probs.dtype)
-    for g, m, p in zip(gates, kept, pos):
-        pi = jnp.sum(p * m, axis=-1).astype(jnp.int32)
-        combine = combine + (g[:, None, None] * m[:, :, None]
-                             * _one_hot(pi, capacity)[:, None, :])
+    pi = jnp.sum(p * kept, axis=-1).astype(jnp.int32)   # [T, k] slot index
+    slot = _one_hot(pi, capacity)                       # [T, k, C]
+    combine = jnp.einsum("tk,tke,tkc->tec", gates, kept, slot)
     dispatch = combine > 0.0
     return combine, dispatch, aux
 

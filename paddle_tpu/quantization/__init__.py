@@ -76,7 +76,7 @@ class AbsmaxObserver:
     def observe(self, x: Tensor):
         import numpy as np
 
-        v = float(np.max(np.abs(np.asarray(x._value))))
+        v = float(np.max(np.abs(x._host_read())))
         self.scale = max(self.scale, v)
 
 
@@ -90,7 +90,7 @@ class EMAObserver(AbsmaxObserver):
     def observe(self, x: Tensor):
         import numpy as np
 
-        v = float(np.max(np.abs(np.asarray(x._value))))
+        v = float(np.max(np.abs(x._host_read())))
         self.scale = v if self.scale == 0.0 else (
             self.momentum * self.scale + (1 - self.momentum) * v)
 

@@ -82,7 +82,11 @@ class Program:
         self.state_ids: List[int] = []
 
     # --- observer callbacks (dispatch hook) -------------------------------
-    def on_op(self, name, fn, args, kwraw, result):
+    def on_op(self, name, fn, args, kwargs, result):
+        # kwarg tensors are frozen at record time (Program replay rebinds
+        # positional args only — the documented static-graph contract)
+        kwraw = {k: (v._value if isinstance(v, Tensor) else v)
+                 for k, v in kwargs.items()}
         arg_ids, arg_snaps = [], []
         for a in args:
             if isinstance(a, Tensor):

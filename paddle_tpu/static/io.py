@@ -45,7 +45,7 @@ def _named_params(program) -> Dict[str, Parameter]:
 
 def save(program, path: str, protocol: int = 4):
     """(``static/io.py`` save) persist every parameter of ``program``."""
-    state = {k: np.asarray(p._value) for k, p in _named_params(program).items()}
+    state = {k: p._host_read() for k, p in _named_params(program).items()}
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     with open(path + _PARAMS_SUFFIX if not path.endswith(_PARAMS_SUFFIX)
               else path, "wb") as f:
@@ -159,7 +159,7 @@ def deserialize_program(data: bytes):
 def serialize_persistables(feed_vars, fetch_vars, executor=None,
                            program=None, **kwargs) -> bytes:
     program = program or _program()
-    state = {k: np.asarray(p._value) for k, p in _named_params(program).items()}
+    state = {k: p._host_read() for k, p in _named_params(program).items()}
     return pickle.dumps(state)
 
 

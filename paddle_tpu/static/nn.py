@@ -53,7 +53,7 @@ def cond(pred, true_fn: Callable = None, false_fn: Callable = None,
          name=None, return_names=None):
     p = _ensure(pred)
     if not _is_traced(p):
-        taken = bool(np.asarray(p._value).reshape(()))
+        taken = bool(p._host_read().reshape(()))
         return true_fn() if taken else false_fn()
     out = jax.lax.cond(p._value.reshape(()).astype(bool),
                        lambda: _unwrap(true_fn()),
@@ -90,7 +90,7 @@ def switch_case(branch_index, branch_fns, default: Callable = None,
     if default is None:
         default = pairs[-1][1]
     if not _is_traced(idx):
-        i = int(np.asarray(idx._value).reshape(()))
+        i = int(idx._host_read().reshape(()))
         for k, fn in pairs:
             if k == i:
                 return fn()
@@ -139,8 +139,8 @@ def Assert(cond_t, data=None, summarize=20, name=None):
     c = _ensure(cond_t)
     if _is_traced(c):
         return  # compiled programs: checks run via debug_nans/checkify
-    if not bool(np.asarray(c._value).all()):
-        vals = [np.asarray(_ensure(d)._value).reshape(-1)[:summarize]
+    if not bool(c._host_read().all()):
+        vals = [_ensure(d)._host_read().reshape(-1)[:summarize]
                 for d in (data or [])]
         raise AssertionError(f"paddle.static.nn.Assert failed; data={vals}")
 
@@ -155,7 +155,7 @@ def Print(input, first_n=-1, message=None, summarize=20,
     if _is_traced(t):
         jax.debug.print((message or "Print") + ": {x}", x=t._value)
         return t
-    v = np.asarray(t._value).reshape(-1)[:summarize]
+    v = t._host_read().reshape(-1)[:summarize]
     print(f"{message or 'Print'}: shape={list(t.shape)} values={v}")
     return t
 
@@ -207,7 +207,7 @@ def sequence_softmax(x, length, name=None):
 
 def sequence_pad(x, pad_value, maxlen=None, length=None, name=None):
     """Pad the valid prefix with ``pad_value`` beyond ``length``."""
-    pv = float(np.asarray(_ensure(pad_value)._value).reshape(-1)[0]) \
+    pv = float(_ensure(pad_value)._host_read().reshape(-1)[0]) \
         if isinstance(pad_value, Tensor) else float(pad_value)
 
     def f(v, ln):

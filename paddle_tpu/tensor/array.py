@@ -21,7 +21,7 @@ def _as_index(i) -> int:
     """Indices are host ints (the reference reads ``i.item()`` in dygraph);
     a traced index would make list length data-dependent."""
     if isinstance(i, Tensor):
-        arr = np.asarray(i._value)
+        arr = i._host_read()
         return int(arr.reshape(-1)[0])
     return int(i)
 

@@ -20,7 +20,7 @@ def _d(dtype, default_float=True):
 
 def _shape(shape):
     if isinstance(shape, Tensor):
-        return tuple(int(s) for s in np.asarray(shape._value))
+        return tuple(int(s) for s in shape._host_read())
     if isinstance(shape, (int, np.integer)):
         return (int(shape),)
     return tuple(int(s._value) if isinstance(s, Tensor) else int(s) for s in shape)

@@ -236,7 +236,7 @@ def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
 def eig(x, name=None):
     # XLA has no nonsymmetric eig on device; compute on host (same capability
     # position as the reference's LAPACK-backed CPU eig kernel).
-    xv = np.asarray(_ensure(x)._value)
+    xv = _ensure(x)._host_read()
     w, v = np.linalg.eig(xv)
     return to_tensor(w), to_tensor(v)
 
@@ -246,7 +246,7 @@ def eigh(x, UPLO="L", name=None):
 
 
 def eigvals(x, name=None):
-    xv = np.asarray(_ensure(x)._value)
+    xv = _ensure(x)._host_read()
     return to_tensor(np.linalg.eigvals(xv))
 
 

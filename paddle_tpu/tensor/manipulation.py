@@ -21,7 +21,7 @@ def _ensure(x):
 
 def _ints(seq):
     if isinstance(seq, Tensor):
-        return tuple(int(v) for v in np.asarray(seq._value))
+        return tuple(int(v) for v in seq._host_read())
     if isinstance(seq, (int, np.integer)):
         return (int(seq),)
     return tuple(int(s._value) if isinstance(s, Tensor) else int(s) for s in seq)
@@ -319,8 +319,8 @@ def index_put(x, indices, value, accumulate=False, name=None):
 def masked_select(x, mask, name=None):
     # Dynamic-shape op: must materialize on host (same caveat as reference's
     # masked_select which is shape-dynamic; do not call under jit).
-    xv = np.asarray(_ensure(x)._value)
-    mv = np.asarray(_ensure(mask)._value)
+    xv = _ensure(x)._host_read()
+    mv = _ensure(mask)._host_read()
     return to_tensor(xv[np.broadcast_to(mv, xv.shape)])
 
 
@@ -357,7 +357,7 @@ def put_along_axis(arr, indices, values, axis, reduce="assign", name=None):
 
 def repeat_interleave(x, repeats, axis=None, name=None):
     if isinstance(repeats, Tensor):
-        repeats = np.asarray(repeats._value)
+        repeats = repeats._host_read()
 
     def f(v):
         return jnp.repeat(v, repeats, axis=axis)
@@ -418,7 +418,7 @@ def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
 
 
 def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None, dtype="int64", name=None):
-    xv = np.asarray(_ensure(x)._value)
+    xv = _ensure(x)._host_read()
     res = np.unique(xv, return_index=return_index, return_inverse=return_inverse,
                     return_counts=return_counts, axis=axis)
     if not isinstance(res, tuple):
@@ -427,7 +427,7 @@ def unique(x, return_index=False, return_inverse=False, return_counts=False, axi
 
 
 def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None, dtype="int64", name=None):
-    xv = np.asarray(_ensure(x)._value)
+    xv = _ensure(x)._host_read()
     if axis is None:
         xv = xv.reshape(-1)
         change = np.concatenate([[True], xv[1:] != xv[:-1]])
@@ -445,7 +445,7 @@ def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None, 
 
 
 def as_strided(x, shape, stride, offset=0, name=None):
-    xv = np.asarray(_ensure(x)._value)
+    xv = _ensure(x)._host_read()
     itemsize = xv.itemsize
     out = np.lib.stride_tricks.as_strided(
         xv.reshape(-1)[offset:], shape=_ints(shape), strides=[s * itemsize for s in _ints(stride)]
@@ -456,7 +456,7 @@ def as_strided(x, shape, stride, offset=0, name=None):
 def tensordot(x, y, axes=2, name=None):
     ax = axes
     if isinstance(ax, Tensor):
-        ax = np.asarray(ax._value).tolist()
+        ax = ax._host_read().tolist()
     return run_op("tensordot", lambda a, b: jnp.tensordot(a, b, axes=ax), _ensure(x), _ensure(y))
 
 
@@ -555,7 +555,7 @@ def as_real(x, name=None):
 
 
 def tolist(x):
-    return np.asarray(_ensure(x)._value).tolist()
+    return _ensure(x)._host_read().tolist()
 
 
 def column_stack(x, name=None):

@@ -22,7 +22,7 @@ def _axis(axis):
     if axis is None:
         return None
     if isinstance(axis, Tensor):
-        a = np.asarray(axis._value)
+        a = axis._host_read()
         return tuple(int(v) for v in np.atleast_1d(a))
     if isinstance(axis, (list, tuple)):
         return tuple(int(a) for a in axis)

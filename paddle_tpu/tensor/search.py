@@ -115,7 +115,7 @@ def mode(x, axis=-1, keepdim=False, name=None):
 
 
 def nonzero(x, as_tuple=False):
-    xv = np.asarray(_ensure(x)._value)
+    xv = _ensure(x)._host_read()
     nz = np.nonzero(xv)
     if as_tuple:
         return tuple(to_tensor(n.astype(np.int64)) for n in nz)
@@ -227,23 +227,23 @@ def nanquantile(x, q, axis=None, keepdim=False, interpolation="linear", name=Non
 
 
 def histogram(input, bins=100, min=0, max=0, weight=None, density=False, name=None):
-    xv = np.asarray(_ensure(input)._value)
+    xv = _ensure(input)._host_read()
     lo, hi = (min, max) if (min != 0 or max != 0) else (xv.min(), xv.max())
-    wv = np.asarray(weight._value) if isinstance(weight, Tensor) else weight
+    wv = weight._host_read() if isinstance(weight, Tensor) else weight
     h, _ = np.histogram(xv.reshape(-1), bins=bins, range=(lo, hi), weights=wv, density=density)
     return to_tensor(h if density or weight is not None else h.astype(np.int64))
 
 
 def histogramdd(x, bins=10, ranges=None, density=False, weights=None, name=None):
-    xv = np.asarray(_ensure(x)._value)
-    wv = np.asarray(weights._value) if isinstance(weights, Tensor) else weights
+    xv = _ensure(x)._host_read()
+    wv = weights._host_read() if isinstance(weights, Tensor) else weights
     h, edges = np.histogramdd(xv, bins=bins, range=ranges, density=density, weights=wv)
     return to_tensor(h), [to_tensor(e) for e in edges]
 
 
 def bincount(x, weights=None, minlength=0, name=None):
-    xv = np.asarray(_ensure(x)._value)
-    wv = np.asarray(weights._value) if isinstance(weights, Tensor) else weights
+    xv = _ensure(x)._host_read()
+    wv = weights._host_read() if isinstance(weights, Tensor) else weights
     return to_tensor(np.bincount(xv, weights=wv, minlength=minlength))
 
 

@@ -27,7 +27,7 @@ def _ensure(x):
 
 
 def _np(x):
-    return np.asarray(_ensure(x)._value)
+    return _ensure(x)._host_read()
 
 
 # --------------------------------------------------------------------------
@@ -176,7 +176,7 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
         else:
             # reference adaptive grid: ceil(roi_size / pooled_size), shared
             # across RoIs here (static shapes) via the largest RoI
-            bv_np = np.asarray(b._value)
+            bv_np = b._host_read()
             max_side = max(float(np.max(bv_np[:, 2] - bv_np[:, 0])),
                            float(np.max(bv_np[:, 3] - bv_np[:, 1])), 1.0)
             sr = max(1, int(np.ceil(max_side * spatial_scale

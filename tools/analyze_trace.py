@@ -40,7 +40,11 @@ def _bucket(name: str) -> str:
     return "other"
 
 
-def main(trace_dir: str = "prof_trace", n_steps: int = 3) -> None:
+def analyze(trace_dir: str = "prof_trace", n_steps: int = 3) -> dict:
+    """Parse the newest chrome trace under ``trace_dir`` into the category
+    breakdown.  Returns {run, pids, device_pids, by_cat, by_name, wall,
+    busy} (durations in trace microseconds) — the testable core
+    (tests/test_mfu_accounting.py pins it against a hand-built fixture)."""
     runs = sorted(glob.glob(os.path.join(
         trace_dir, "plugins", "profile", "*")))
     if not runs:
@@ -76,8 +80,16 @@ def main(trace_dir: str = "prof_trace", n_steps: int = 3) -> None:
         by_name[e.get("name", "?")] += d
     t0 = min(e["ts"] for e in dev)
     t1 = max(e["ts"] + e.get("dur", 0) for e in dev)
-    wall = t1 - t0
-    busy = sum(by_cat.values())
+    return {"run": run, "pids": pids, "device_pids": device_pids,
+            "by_cat": by_cat, "by_name": by_name,
+            "wall": t1 - t0, "busy": sum(by_cat.values())}
+
+
+def main(trace_dir: str = "prof_trace", n_steps: int = 3) -> None:
+    res = analyze(trace_dir, n_steps)
+    run, pids, device_pids = res["run"], res["pids"], res["device_pids"]
+    by_cat, by_name = res["by_cat"], res["by_name"]
+    wall, busy = res["wall"], res["busy"]
 
     print(f"run: {run}")
     print(f"devices: {sorted(pids[p] for p in device_pids)}")

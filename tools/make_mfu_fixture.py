@@ -1,0 +1,58 @@
+"""Generate the committed MFU-accounting trace fixture.
+
+Builds a minimal chrome trace (the format ``jax.profiler`` emits and
+``tools/analyze_trace.py`` parses) with hand-chosen event names/durations
+covering every category bucket, one device lane and one host lane.  The
+expected breakdown is hand-computed in ``tests/test_mfu_accounting.py``;
+regenerating the fixture must keep the two in sync.
+"""
+
+from __future__ import annotations
+
+import gzip
+import json
+import os
+
+EVENTS = [
+    # (name, ts, dur) on the device lane (pid 1) — sequential, no overlap:
+    # wall == busy == 875 us
+    ("dot_general.7", 1000, 300),        # matmul/conv (MXU)
+    ("fusion.12", 1300, 200),            # fusion (mixed)
+    ("pallas_call_flash_fwd", 1500, 125),  # pallas
+    ("custom-call.4", 1625, 25),         # pallas (custom-call)
+    ("copy.3", 1650, 50),                # copy/transpose
+    ("all-reduce.1", 1700, 75),          # collectives
+    ("dynamic-update-slice.2", 1775, 60),  # dynamic-update/scatter
+    ("add.5", 1835, 40),                 # other
+]
+
+
+def build() -> dict:
+    trace = [
+        {"ph": "M", "name": "process_name", "pid": 1,
+         "args": {"name": "/device:TPU:0"}},
+        {"ph": "M", "name": "process_name", "pid": 2,
+         "args": {"name": "python host"}},
+        # host-lane event: must be EXCLUDED from the device breakdown
+        {"ph": "X", "name": "python_dispatch", "pid": 2, "tid": 1,
+         "ts": 900, "dur": 5000},
+    ]
+    for name, ts, dur in EVENTS:
+        trace.append({"ph": "X", "name": name, "pid": 1, "tid": 1,
+                      "ts": ts, "dur": dur})
+    return {"traceEvents": trace}
+
+
+def main() -> None:
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    run_dir = os.path.join(here, "tests", "fixtures", "mfu_trace",
+                           "plugins", "profile", "fixture_run")
+    os.makedirs(run_dir, exist_ok=True)
+    path = os.path.join(run_dir, "device.trace.json.gz")
+    with gzip.open(path, "wt") as f:
+        json.dump(build(), f)
+    print(path)
+
+
+if __name__ == "__main__":
+    main()

@@ -15,18 +15,27 @@
 # happen twice").
 cd "$(dirname "$0")/.." || exit 1
 
+EVIDENCE="BENCH_PHASE.json bench_tpu_attempt.json bench_tpu_attempt.log
+bench_inner_tpu.err AUTOTUNE_ONCHIP.json AUTOTUNE.json
+PALLAS_VERDICT.json pallas_check.out pallas_check.err
+TRACE_BREAKDOWN.txt profile_attempt.log autotune_attempt.log"
+
 commit_evidence() {
   # artifacts are mostly gitignored (working files) — force-add the ones
   # that constitute round evidence.  One add per file: a single add with
   # every pathspec is all-or-nothing and a missing file (normal before
-  # later stages run) would silently stage NOTHING.
-  for f in BENCH_PHASE.json bench_tpu_attempt.json bench_tpu_attempt.log \
-    bench_inner_tpu.err AUTOTUNE_ONCHIP.json AUTOTUNE.json \
-    PALLAS_VERDICT.json pallas_check.out pallas_check.err \
-    TRACE_BREAKDOWN.txt profile_attempt.log autotune_attempt.log; do
-    [ -e "$f" ] && git add -f "$f" 2>/dev/null
+  # later stages run) would silently stage NOTHING.  The commit is
+  # restricted to the evidence pathspecs so unrelated changes someone
+  # staged in this shared checkout are never swept into it.
+  present=""
+  for f in $EVIDENCE; do
+    if [ -e "$f" ]; then
+      git add -f "$f" 2>/dev/null
+      present="$present $f"
+    fi
   done
-  git diff --cached --quiet || git commit -q -m "$1"
+  [ -n "$present" ] || return 0
+  git diff --cached --quiet -- $present || git commit -q -m "$1" -- $present
 }
 
 for i in $(seq 1 160); do
@@ -74,8 +83,11 @@ for i in $(seq 1 160); do
     echo "[tpu_watch] analyze rc=$? (TRACE_BREAKDOWN.txt):"
     cat TRACE_BREAKDOWN.txt
     commit_evidence "On-chip XPlane trace + step-time breakdown"
+    # stay resident: a later window re-runs bench against the warm compile
+    # cache (cheap) — more phases may complete, numbers may improve
     echo "[tpu_watch] window complete; staying resident for re-runs"
-    exit 0
+    sleep 1200
+    continue
   fi
   echo "[tpu_watch] attempt $i: tunnel down ($(date -u +%H:%M:%S))"
   sleep 240

@@ -19,7 +19,20 @@
 //   int   sparse_table_load(void* t, const long long* keys, const float* rows,
 //                           const float* g2, long long n);  // REPLACES rows
 //   void  sparse_table_clear(void* t);
+//
+// Eviction / TTL (the reference's Shrink() + bounded-memory capability,
+// memory_sparse_table.h — ours is the in-memory tier; SSD spill is a
+// documented non-goal):
+//   void  sparse_table_set_max_rows(void* t, long long max_rows);
+//       // 0 = unbounded.  When an insert would exceed max_rows, the
+//       // coldest ~12.5% of rows (smallest last-touch tick) are evicted
+//       // in one O(n) sweep — amortized O(1) per insert, RSS bounded.
+//   void  sparse_table_tick(void* t);      // advance the pass counter
+//       // (call once per epoch/interval; pulls/pushes stamp rows with it)
+//   long long sparse_table_shrink(void* t, long long ttl_ticks);
+//       // evict rows untouched for >= ttl_ticks passes; returns #evicted
 
+#include <algorithm>
 #include <cmath>
 #include <cstdint>
 #include <cstring>
@@ -32,6 +45,7 @@ namespace {
 struct Row {
   std::vector<float> value;
   std::vector<float> g2;  // adagrad accumulator (lazily sized)
+  int64_t last_touch = 0;  // pass-counter stamp (eviction/TTL)
 };
 
 struct Table {
@@ -40,8 +54,44 @@ struct Table {
   int optimizer;  // 0 = sgd, 1 = adagrad
   float init_scale;
   uint64_t seed;
+  int64_t tick = 0;          // pass counter (sparse_table_tick)
+  int64_t max_rows = 0;      // 0 = unbounded
   std::mutex mu;
   std::unordered_map<int64_t, Row> rows;
+
+  // Bounded-memory eviction: one O(n) sweep removing the coldest ~1/8 of
+  // rows once the budget is hit (amortized O(1) per insert).  Must be
+  // called with mu held.  ``protect_key`` (the row just inserted) is
+  // never evicted — with a uniform tick every stamp ties the cutoff and
+  // the fresh row could otherwise evict itself, invalidating the
+  // caller's iterator.
+  void evict_coldest_locked(int64_t protect_key) {
+    if (max_rows <= 0 || static_cast<int64_t>(rows.size()) <= max_rows)
+      return;
+    // selection threshold: nth-smallest last_touch via a copy of stamps
+    std::vector<int64_t> stamps;
+    stamps.reserve(rows.size());
+    for (const auto& kv : rows) stamps.push_back(kv.second.last_touch);
+    // trim to the budget plus ~1/8 of the BUDGET as slack (amortizes
+    // the sweep); sizing slack off the current row count would wipe the
+    // table on a large budget shrink (set_max_rows(500) on 5000 rows)
+    size_t n_evict = (rows.size() - max_rows)
+                     + static_cast<size_t>(max_rows / 8);
+    if (n_evict >= stamps.size()) n_evict = stamps.size() - 1;
+    if (n_evict == 0) return;
+    std::nth_element(stamps.begin(), stamps.begin() + n_evict - 1,
+                     stamps.end());
+    int64_t cutoff = stamps[n_evict - 1];
+    size_t removed = 0;
+    for (auto it = rows.begin(); it != rows.end() && removed < n_evict;) {
+      if (it->second.last_touch <= cutoff && it->first != protect_key) {
+        it = rows.erase(it);
+        ++removed;
+      } else {
+        ++it;
+      }
+    }
+  }
 
   // deterministic per-key init: splitmix64 -> uniform(-scale, scale)
   void init_row(int64_t key, std::vector<float>* out) const {
@@ -88,9 +138,13 @@ int sparse_table_pull(void* handle, const long long* keys, int n,
     auto it = t->rows.find(keys[i]);
     if (it == t->rows.end()) {
       Row row;
+      row.last_touch = t->tick;
       t->init_row(keys[i], &row.value);
-      it = t->rows.emplace(keys[i], std::move(row)).first;
+      t->rows.emplace(keys[i], std::move(row));
+      t->evict_coldest_locked(keys[i]);
+      it = t->rows.find(keys[i]);  // eviction may rehash; key is protected
     }
+    it->second.last_touch = t->tick;
     std::memcpy(out + static_cast<size_t>(i) * t->dim,
                 it->second.value.data(), sizeof(float) * t->dim);
   }
@@ -106,10 +160,14 @@ int sparse_table_push(void* handle, const long long* keys, int n,
     auto it = t->rows.find(keys[i]);
     if (it == t->rows.end()) {
       Row row;
+      row.last_touch = t->tick;
       t->init_row(keys[i], &row.value);
-      it = t->rows.emplace(keys[i], std::move(row)).first;
+      t->rows.emplace(keys[i], std::move(row));
+      t->evict_coldest_locked(keys[i]);
+      it = t->rows.find(keys[i]);  // eviction may rehash; key is protected
     }
     Row& row = it->second;
+    row.last_touch = t->tick;
     const float* g = grads + static_cast<size_t>(i) * t->dim;
     if (t->optimizer == 1) {  // adagrad
       if (row.g2.empty()) row.g2.assign(t->dim, 0.0f);
@@ -185,6 +243,37 @@ int sparse_table_load(void* handle, const long long* keys, const float* rows,
     t->rows[keys[i]] = std::move(row);
   }
   return 0;
+}
+
+void sparse_table_set_max_rows(void* handle, long long max_rows) {
+  Table* t = static_cast<Table*>(handle);
+  if (!t) return;
+  std::lock_guard<std::mutex> lock(t->mu);
+  t->max_rows = max_rows;
+  t->evict_coldest_locked(-1);  // no insert in flight: nothing protected
+}
+
+void sparse_table_tick(void* handle) {
+  Table* t = static_cast<Table*>(handle);
+  if (!t) return;
+  std::lock_guard<std::mutex> lock(t->mu);
+  ++t->tick;
+}
+
+long long sparse_table_shrink(void* handle, long long ttl_ticks) {
+  Table* t = static_cast<Table*>(handle);
+  if (!t || ttl_ticks <= 0) return -1;
+  std::lock_guard<std::mutex> lock(t->mu);
+  long long removed = 0;
+  for (auto it = t->rows.begin(); it != t->rows.end();) {
+    if (t->tick - it->second.last_touch >= ttl_ticks) {
+      it = t->rows.erase(it);
+      ++removed;
+    } else {
+      ++it;
+    }
+  }
+  return removed;
 }
 
 }  // extern "C"

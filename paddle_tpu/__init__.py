@@ -75,6 +75,7 @@ from . import optimizer  # noqa: F401
 from . import profiler  # noqa: F401
 from . import sparse  # noqa: F401
 from . import static  # noqa: F401
+from . import strings  # noqa: F401
 from . import utils  # noqa: F401
 from . import vision  # noqa: F401
 from .device import get_device, set_device  # noqa: F401

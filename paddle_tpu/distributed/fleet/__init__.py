@@ -243,10 +243,52 @@ def distributed_model(model: Layer):
 
 def distributed_optimizer(optimizer, strategy: Optional[DistributedStrategy] = None):
     """Wrap the optimizer per the strategy (``fleet.distributed_optimizer``
-    analog): ZeRO stage 1/2 shard the optimizer states over the ``sharding``
-    axis; everything else (comm fusion, overlap) is XLA's job."""
+    analog): LARS/LAMB meta-optimizers swap the update rule, gradient
+    merge accumulates k micro-steps inside the jitted step, ZeRO stage
+    1/2 shard the optimizer states over the ``sharding`` axis; everything
+    else (comm fusion, overlap) is XLA's job."""
+    from ...optimizer import (GradientMergeOptimizer, Lamb, LarsMomentum,
+                              Momentum)
+
     _require_init()
     strategy = strategy or _state["strategy"]
+    def _params_of(opt):
+        # keep param GROUPS (per-group lr/decay attrs) across the rebuild
+        return (opt._param_groups if opt._param_groups is not None
+                else opt._parameter_list)
+
+    if strategy.lars and isinstance(optimizer, Momentum) \
+            and not isinstance(optimizer, LarsMomentum):
+        # LarsOptimizer meta-optimizer (meta_optimizers/lars_optimizer.py):
+        # rebuild the Momentum update as LARS with the strategy's knobs
+        c = strategy.lars_configs
+        optimizer = LarsMomentum(
+            learning_rate=optimizer._lr, momentum=optimizer._momentum,
+            parameters=_params_of(optimizer),
+            lars_coeff=c["lars_coeff"],
+            lars_weight_decay=c["lars_weight_decay"],
+            exclude_from_weight_decay=c["exclude_from_weight_decay"],
+            epsilon=c["epsilon"], grad_clip=optimizer._grad_clip)
+    if strategy.lamb and not isinstance(optimizer, Lamb):
+        c = strategy.lamb_configs
+        exclude_keys = tuple(c["exclude_from_weight_decay"])
+
+        def _lamb_exclude(p, _keys=exclude_keys):
+            name = getattr(p, "name", None) or ""
+            return any(k in name for k in _keys)
+
+        optimizer = Lamb(
+            learning_rate=optimizer._lr,
+            parameters=_params_of(optimizer),
+            lamb_weight_decay=c["lamb_weight_decay"],
+            exclude_from_weight_decay_fn=(_lamb_exclude if exclude_keys
+                                          else None),
+            grad_clip=optimizer._grad_clip)
+    if strategy.gradient_merge:
+        k = int(strategy.gradient_merge_configs["k_steps"])
+        if k > 1:
+            optimizer = GradientMergeOptimizer(
+                optimizer, k, avg=bool(strategy.gradient_merge_configs["avg"]))
     if strategy.sharding and strategy.sharding_configs["stage"] in (1, 2):
         from ...parallel.sharding import GroupShardedOptimizerStage2
 

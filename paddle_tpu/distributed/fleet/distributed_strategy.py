@@ -59,6 +59,11 @@ _PIPELINE_DEFAULTS: Dict[str, Any] = {
 }
 
 _GRADIENT_MERGE_DEFAULTS: Dict[str, Any] = {"k_steps": 1, "avg": True}
+_LARS_DEFAULTS: Dict[str, Any] = {
+    "lars_coeff": 0.001, "lars_weight_decay": 0.0005,
+    "epsilon": 0.0, "exclude_from_weight_decay": []}
+_LAMB_DEFAULTS: Dict[str, Any] = {
+    "lamb_weight_decay": 0.01, "exclude_from_weight_decay": []}
 
 
 def _merge(defaults: Dict[str, Any], configs: Dict[str, Any],
@@ -104,7 +109,9 @@ class DistributedStrategy:
         self.fuse_all_reduce_ops = True       # parity; XLA fuses collectives
         self.gradient_scale_configs = {"scale_strategy": "avg"}
         self.lamb = False
+        self._lamb_configs = dict(_LAMB_DEFAULTS)
         self.lars = False
+        self._lars_configs = dict(_LARS_DEFAULTS)
 
     # -- hybrid ------------------------------------------------------------
     @property
@@ -169,6 +176,22 @@ class DistributedStrategy:
     def gradient_merge_configs(self, configs):
         self._gradient_merge_configs = _merge(_GRADIENT_MERGE_DEFAULTS,
                                               configs, "gradient_merge")
+
+    @property
+    def lars_configs(self):
+        return self._lars_configs
+
+    @lars_configs.setter
+    def lars_configs(self, configs):
+        self._lars_configs = _merge(_LARS_DEFAULTS, configs, "lars")
+
+    @property
+    def lamb_configs(self):
+        return self._lamb_configs
+
+    @lamb_configs.setter
+    def lamb_configs(self, configs):
+        self._lamb_configs = _merge(_LAMB_DEFAULTS, configs, "lamb")
 
     # -- introspection -----------------------------------------------------
     def __repr__(self):

@@ -37,7 +37,8 @@ class NativeSparseTable:
 
     def __init__(self, dim: int, initializer: str = "uniform",
                  init_scale: float = 0.01, optimizer: str = "sgd",
-                 learning_rate: float = 0.05, seed: int = 0):
+                 learning_rate: float = 0.05, seed: int = 0,
+                 max_rows: int = 0):
         import ctypes
 
         from ...core import native
@@ -65,6 +66,12 @@ class NativeSparseTable:
             ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
             ctypes.c_longlong]
         lib.sparse_table_clear.argtypes = [ctypes.c_void_p]
+        lib.sparse_table_set_max_rows.argtypes = [ctypes.c_void_p,
+                                                  ctypes.c_longlong]
+        lib.sparse_table_tick.argtypes = [ctypes.c_void_p]
+        lib.sparse_table_shrink.restype = ctypes.c_longlong
+        lib.sparse_table_shrink.argtypes = [ctypes.c_void_p,
+                                            ctypes.c_longlong]
         self._lib = lib
         self._ct = ctypes
         self.dim = dim
@@ -74,6 +81,8 @@ class NativeSparseTable:
             scale, seed)
         if not self._h:
             raise RuntimeError("sparse_table_create failed")
+        if max_rows:
+            lib.sparse_table_set_max_rows(self._h, max_rows)
 
     def _keys(self, keys):
         arr = np.ascontiguousarray(np.asarray(keys, np.int64).reshape(-1))
@@ -106,6 +115,24 @@ class NativeSparseTable:
 
     def size(self) -> int:
         return int(self._lib.sparse_table_size(self._h))
+
+    def set_max_rows(self, max_rows: int):
+        """Bound the row budget; the coldest rows are evicted on overflow
+        (the reference's bounded-memory table capability)."""
+        self._lib.sparse_table_set_max_rows(self._h, int(max_rows))
+
+    def tick(self):
+        """Advance the pass counter (call once per epoch/interval);
+        pulls/pushes stamp rows with the current pass for TTL/eviction."""
+        self._lib.sparse_table_tick(self._h)
+
+    def shrink(self, ttl_ticks: int) -> int:
+        """Evict rows untouched for >= ``ttl_ticks`` passes (the
+        reference's ``Table::Shrink`` pass).  Returns rows evicted."""
+        out = int(self._lib.sparse_table_shrink(self._h, int(ttl_ticks)))
+        if out < 0:
+            raise ValueError(f"shrink ttl_ticks must be > 0")
+        return out
 
     def state_dict(self):
         # retry with the fresh size on -2: a concurrent pull may insert a

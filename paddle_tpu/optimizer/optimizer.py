@@ -199,6 +199,78 @@ class Optimizer:
             self._lr.set_state_dict(state["LR_Scheduler"])
 
 
+class GradientMergeOptimizer(Optimizer):
+    """Gradient accumulation over ``k_steps`` micro-steps INSIDE the jitted
+    train step (``distributed/passes/auto_parallel_gradient_merge.py``
+    analog).
+
+    TPU-first: no dynamic control flow — every call accumulates into a
+    per-parameter buffer and computes the inner update unconditionally;
+    ``jnp.where`` on the step-counter boundary selects whether the weight
+    and inner optimizer slots actually advance.  The whole k-cycle stays
+    ONE XLA program (the per-step cost of the discarded inner update is a
+    single optimizer-rule evaluation — noise next to fwd+bwd).  After k
+    calls the applied update equals one large-batch step on the summed
+    (or averaged) gradient — pinned by
+    ``tests/test_fleet.py::TestGradientMerge``."""
+
+    def __init__(self, inner: "Optimizer", k_steps: int, avg: bool = True):
+        if k_steps < 1:
+            raise ValueError(f"k_steps must be >= 1, got {k_steps}")
+        # preserve param GROUPS (per-group lr/decay attrs), not just the
+        # flattened list
+        params = (inner._param_groups if inner._param_groups is not None
+                  else inner._parameter_list)
+        super().__init__(inner._lr, params,
+                         inner._weight_decay, inner._grad_clip)
+        self._inner = inner
+        self._k = k_steps
+        self._avg = avg
+        self._use_master_weights = inner._use_master_weights
+        # instance attr shadows the class tuple: merge slots + inner slots
+        self._slots = ("gm_acc",) + tuple(type(inner)._slots)
+        # ONE shared cycle counter (traced state): a per-param counter
+        # would desynchronize when a parameter misses a micro-step (no
+        # grad on an unused branch), shifting its k-boundary
+        self._gm_counter = Tensor(jnp.zeros((), jnp.int32))
+
+    def step(self):
+        with no_grad():
+            new_c = run_op("gm_cycle_count", lambda c: c + 1,
+                           self._gm_counter)
+        self._gm_counter._value = new_c._value
+        run_op_notify_rebind(self._gm_counter, new_c)
+        super().step()
+
+    def _update(self, w, g, lr, wd, slots, p):
+        acc, *inner_slots = slots
+        acc = acc + g.astype(acc.dtype)
+        # closure over the SAME trace level's counter value (concrete in
+        # eager, a tracer of the enclosing staged program under to_static)
+        boundary = (self._gm_counter._value % self._k) == 0
+        g_eff = (acc / self._k if self._avg else acc).astype(w.dtype)
+        out = self._inner._update(w, g_eff, lr, wd, tuple(inner_slots), p)
+        out = out if isinstance(out, tuple) else (out,)
+        new_w = jnp.where(boundary, out[0], w)
+        new_inner = [jnp.where(boundary, nv, ov)
+                     for nv, ov in zip(out[1:], inner_slots)]
+        acc = jnp.where(boundary, jnp.zeros_like(acc), acc)
+        return (new_w, acc, *new_inner)
+
+    def state_dict(self):
+        out = super().state_dict()
+        out["gm_counter"] = Tensor(self._gm_counter._value)
+        return out
+
+    def set_state_dict(self, state):
+        state = dict(state)
+        c = state.pop("gm_counter", None)
+        if c is not None:
+            v = c._value if isinstance(c, Tensor) else jnp.asarray(c)
+            self._gm_counter = Tensor(jnp.array(v))
+        super().set_state_dict(state)
+
+
 class SGD(Optimizer):
     def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
                  grad_clip=None, name=None):
@@ -227,6 +299,48 @@ class Momentum(Optimizer):
         else:
             new_w = w - lr * v
         return new_w.astype(w.dtype), v
+
+
+class LarsMomentum(Momentum):
+    """Momentum with layer-wise adaptive rate scaling (LARS).
+
+    Capability analog of the reference's lars_momentum kernel
+    (``paddle/phi/kernels/impl/lars_momentum_kernel_impl.h``): the local
+    learning rate is ``lr · lars_coeff · ||w|| / (||g|| + λ·||w|| + ε)``
+    per parameter, with λ applied as coupled decay — the large-batch
+    training rule (You et al.).  ``exclude_from_weight_decay`` disables
+    both decay and rescaling for matching parameter names (the
+    reference's bias/norm convention)."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 lars_coeff=0.001, lars_weight_decay=0.0005,
+                 exclude_from_weight_decay=None, epsilon=0.0,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, momentum, parameters,
+                         use_nesterov=False, weight_decay=None,
+                         grad_clip=grad_clip, name=name)
+        self._lars_coeff = lars_coeff
+        self._lars_wd = lars_weight_decay
+        self._lars_eps = epsilon
+        self._exclude = tuple(exclude_from_weight_decay or ())
+
+    def _update(self, w, g, lr, wd, slots, p):
+        (v,) = slots
+        pname = getattr(p, "name", None) or ""
+        excluded = any(key in pname for key in self._exclude)
+        decay = 0.0 if excluded else self._lars_wd
+        if not excluded:
+            w_norm = jnp.sqrt(jnp.sum((w * w).astype(jnp.float32)))
+            g_norm = jnp.sqrt(jnp.sum((g * g).astype(jnp.float32)))
+            local = jnp.where(
+                (w_norm > 0) & (g_norm > 0),
+                self._lars_coeff * w_norm
+                / (g_norm + decay * w_norm + self._lars_eps),
+                1.0).astype(w.dtype)
+            lr = lr * local
+        g = g + decay * w
+        v = self._momentum * v + lr * g  # reference: lr folded into velocity
+        return (w - v).astype(w.dtype), v
 
 
 class Adam(Optimizer):

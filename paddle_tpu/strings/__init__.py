@@ -1,0 +1,152 @@
+"""``paddle.strings`` — string-tensor ops (N9).
+
+Capability analog of the reference's strings kernels
+(``paddle/phi/kernels/strings/strings_lower_upper_kernel.h`` with the
+unicode case tables in ``strings/unicode.h``, ``strings_empty_kernel.h``,
+``strings_copy_kernel.h``).  TPU-first note: XLA has no string dtype —
+strings are a HOST data type by construction, so the carrier is a numpy
+unicode array on the host (exactly where the reference runs its CPU
+strings kernels; its "GPU" strings kernels round-trip through pinned host
+memory too).  Case mapping uses Python's full unicode tables — the
+analog of the reference's ``unicode.cc`` case-flag tables — rather than
+``np.char``'s byte-wise rules.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+import numpy as np
+
+__all__ = [
+    "StringTensor", "to_string_tensor", "empty", "empty_like", "copy",
+    "lower", "upper", "strip", "lstrip", "rstrip", "split", "join",
+]
+
+
+class StringTensor:
+    """A host tensor of unicode strings (``phi::StringTensor`` analog:
+    dims + pstring payload; here dims + numpy unicode payload)."""
+
+    def __init__(self, data, name: Optional[str] = None):
+        if isinstance(data, StringTensor):
+            data = data._data
+        self._data = np.asarray(data, dtype=np.str_)
+        self.name = name
+
+    @property
+    def shape(self) -> List[int]:
+        return list(self._data.shape)
+
+    @property
+    def size(self) -> int:
+        return int(self._data.size)
+
+    def numpy(self) -> np.ndarray:
+        return self._data.copy()
+
+    def tolist(self):
+        return self._data.tolist()
+
+    def __getitem__(self, idx):
+        out = self._data[idx]
+        if isinstance(out, np.ndarray):
+            return StringTensor(out)
+        return str(out)
+
+    def __len__(self):
+        if self._data.ndim == 0:
+            raise TypeError("len() of a 0-d StringTensor")
+        return self._data.shape[0]
+
+    def __eq__(self, other):
+        other = other._data if isinstance(other, StringTensor) else other
+        return np.asarray(self._data == other)
+
+    def __repr__(self):
+        return (f"StringTensor(shape={self.shape})\n"
+                f"{np.array2string(self._data, threshold=16)}")
+
+
+def _ensure(x) -> StringTensor:
+    return x if isinstance(x, StringTensor) else StringTensor(x)
+
+
+def _map(fn, x: StringTensor) -> StringTensor:
+    # element-wise python-str mapping: full unicode semantics (the
+    # reference's unicode.cc case tables; np.char is byte-rule-bound)
+    flat = [fn(s) for s in x._data.reshape(-1).tolist()]
+    return StringTensor(np.asarray(flat, np.str_).reshape(x._data.shape))
+
+
+def to_string_tensor(data, name: Optional[str] = None) -> StringTensor:
+    """Create a StringTensor from (nested) python strings / numpy."""
+    return StringTensor(data, name=name)
+
+
+def empty(shape: Sequence[int], name: Optional[str] = None) -> StringTensor:
+    """``strings_empty_kernel.h`` analog: empty strings of the shape."""
+    return StringTensor(np.full(tuple(shape), "", np.str_), name=name)
+
+
+def empty_like(x: Union[StringTensor, np.ndarray],
+               name: Optional[str] = None) -> StringTensor:
+    return empty(_ensure(x).shape, name=name)
+
+
+def copy(x: Union[StringTensor, np.ndarray]) -> StringTensor:
+    """``strings_copy_kernel.h`` analog (deep copy)."""
+    return StringTensor(_ensure(x)._data.copy())
+
+
+def lower(x, use_utf8_encoding: bool = True) -> StringTensor:
+    """``StringsLowerKernel``: per-element unicode (or ascii) lowercase."""
+    x = _ensure(x)
+    if use_utf8_encoding:
+        return _map(str.lower, x)
+    return _map(lambda s: "".join(
+        c.lower() if c.isascii() else c for c in s), x)
+
+
+def upper(x, use_utf8_encoding: bool = True) -> StringTensor:
+    """``StringsUpperKernel``: per-element unicode (or ascii) uppercase."""
+    x = _ensure(x)
+    if use_utf8_encoding:
+        return _map(str.upper, x)
+    return _map(lambda s: "".join(
+        c.upper() if c.isascii() else c for c in s), x)
+
+
+def strip(x, chars: Optional[str] = None) -> StringTensor:
+    return _map(lambda s: s.strip(chars), _ensure(x))
+
+
+def lstrip(x, chars: Optional[str] = None) -> StringTensor:
+    return _map(lambda s: s.lstrip(chars), _ensure(x))
+
+
+def rstrip(x, chars: Optional[str] = None) -> StringTensor:
+    return _map(lambda s: s.rstrip(chars), _ensure(x))
+
+
+def split(x, sep: Optional[str] = None,
+          maxsplit: int = -1) -> List[List[str]]:
+    """Per-element split.  Ragged by nature, so the result is nested
+    python lists (shape ``x.shape`` + one ragged axis)."""
+    x = _ensure(x)
+
+    def rec(a):
+        if isinstance(a, list):
+            return [rec(v) for v in a]
+        return a.split(sep) if maxsplit < 0 else a.split(sep, maxsplit)
+
+    return rec(x._data.tolist())
+
+
+def join(x, sep: str = "") -> str:
+    """Join every element of a 1-D StringTensor with ``sep``."""
+    x = _ensure(x)
+    if x._data.ndim != 1:
+        raise ValueError(f"join expects a 1-D StringTensor, got shape "
+                         f"{x.shape}")
+    return sep.join(x._data.tolist())

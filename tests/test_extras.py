@@ -90,6 +90,81 @@ class TestQuantization:
         ptq.convert(net)
         assert hasattr(net[0], "_int8_weight")
 
+    def test_compiled_qat_step_updates_scales(self):
+        """VERDICT r4 item #6: the activation scale is traced state — a
+        to_static-compiled QAT train step must keep calibrating (the old
+        host-side observer silently skipped tracers)."""
+        from paddle_tpu.jit import to_static
+
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+        QAT(QuantConfig()).quantize(net)
+        opt = paddle.optimizer.SGD(learning_rate=1e-2,
+                                   parameters=net.parameters())
+
+        @to_static
+        def step(x, y):
+            loss = ((net(x) - y) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        rng = np.random.default_rng(0)
+        x1 = paddle.to_tensor(rng.standard_normal((4, 8)).astype("float32"))
+        y = paddle.to_tensor(rng.standard_normal((4, 4)).astype("float32"))
+        step(x1, y)
+        assert not step._eager_keys  # whole step stayed one XLA program
+        s1 = net[0].act_observer.scale
+        assert s1 > 0  # compiled step calibrated the range
+        # a hotter batch must move the EMA upward THROUGH the compiled step
+        x2 = paddle.to_tensor(
+            10.0 * rng.standard_normal((4, 8)).astype("float32"))
+        step(x2, y)
+        s2 = net[0].act_observer.scale
+        assert s2 > s1
+        # EMA semantics: s2 = 0.9*s1 + 0.1*absmax(x2)
+        expect = 0.9 * s1 + 0.1 * float(np.abs(x2.numpy()).max())
+        np.testing.assert_allclose(s2, expect, rtol=1e-5)
+
+    def test_qat_wraps_conv2d_and_attention_projections(self):
+        from paddle_tpu.quantization import QuantedConv2D, QuantedLinear
+
+        paddle.seed(0)
+
+        class TinyAttn(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.q_proj = nn.Linear(8, 8)
+                self.out_proj = nn.Linear(8, 8)
+
+            def forward(self, x):
+                return self.out_proj(self.q_proj(x))
+
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.conv = nn.Conv2D(3, 4, 3, padding=1)
+                self.attn = TinyAttn()
+
+            def forward(self, x, h):
+                return self.conv(x).mean() + self.attn(h).mean()
+
+        net = Net()
+        cfg = QuantConfig().add_type_config(nn.Linear)
+        cfg.add_type_config(nn.Conv2D)
+        QAT(cfg).quantize(net)
+        assert isinstance(net.conv, QuantedConv2D)
+        assert isinstance(net.attn.q_proj, QuantedLinear)
+        assert isinstance(net.attn.out_proj, QuantedLinear)
+        x = paddle.to_tensor(np.random.randn(2, 3, 8, 8).astype("float32"))
+        h = paddle.to_tensor(np.random.randn(2, 4, 8).astype("float32"))
+        out = net(x, h)
+        out.backward()
+        assert net.conv.inner.weight.grad is not None
+        assert net.conv.act_observer.scale > 0
+        assert net.attn.q_proj.act_observer.scale > 0
+
 
 class TestIncubateFused:
     def test_fused_rms_norm_matches_layer(self):

@@ -202,3 +202,108 @@ class TestParityPaths:
         assert meta_parallel.ColumnParallelLinear is ColumnParallelLinear
         assert hasattr(meta_parallel, "PipelineLayer")
         assert hasattr(utils, "ScatterOp")
+
+
+class TestGradientMerge:
+    """VERDICT r4 item #7: gradient_merge accumulates k micro-steps inside
+    the jitted step; after a full cycle the applied update equals ONE
+    large-batch step (reference auto_parallel_gradient_merge.py)."""
+
+    def _mlp(self, seed):
+        from paddle_tpu import nn
+
+        paddle.seed(seed)
+        return nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 4))
+
+    def test_k_micro_steps_equal_one_large_batch_step(self):
+        from paddle_tpu.jit import to_static
+        from paddle_tpu.optimizer import GradientMergeOptimizer
+
+        k = 4
+        rng = np.random.default_rng(0)
+        xs = rng.standard_normal((k, 4, 8)).astype("float32")
+        ys = rng.standard_normal((k, 4, 4)).astype("float32")
+
+        # merged: k compiled micro-steps through the wrapper
+        net_a = self._mlp(5)
+        opt_a = GradientMergeOptimizer(
+            paddle.optimizer.AdamW(learning_rate=1e-2,
+                                   parameters=net_a.parameters()), k)
+
+        @to_static
+        def micro_step(x, y):
+            loss = ((net_a(x) - y) ** 2).mean()
+            loss.backward()
+            opt_a.step()
+            opt_a.clear_grad()
+            return loss
+
+        w_before = net_a[0].weight.numpy().copy()
+        for i in range(k - 1):
+            micro_step(paddle.to_tensor(xs[i]), paddle.to_tensor(ys[i]))
+            # not at the boundary: weights must NOT move
+            np.testing.assert_array_equal(net_a[0].weight.numpy(), w_before)
+        micro_step(paddle.to_tensor(xs[-1]), paddle.to_tensor(ys[-1]))
+        assert not np.allclose(net_a[0].weight.numpy(), w_before)
+        assert not micro_step._eager_keys  # stayed one XLA program
+
+        # reference: one large-batch step with the plain inner optimizer
+        net_b = self._mlp(5)  # same seed stream -> identical init
+        opt_b = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                       parameters=net_b.parameters())
+        x_full = paddle.to_tensor(xs.reshape(k * 4, 8))
+        y_full = paddle.to_tensor(ys.reshape(k * 4, 4))
+        loss = ((net_b(x_full) - y_full) ** 2).mean()
+        loss.backward()
+        opt_b.step()
+        opt_b.clear_grad()
+
+        for pa, pb in zip(net_a.parameters(), net_b.parameters()):
+            np.testing.assert_allclose(pa.numpy(), pb.numpy(),
+                                       rtol=2e-5, atol=2e-6)
+
+    def test_distributed_optimizer_wires_strategy_flags(self):
+        from paddle_tpu.optimizer import (GradientMergeOptimizer, Lamb,
+                                          LarsMomentum)
+
+        s = fleet.DistributedStrategy()
+        s.gradient_merge = True
+        s.gradient_merge_configs = {"k_steps": 4}
+        s.lars = True
+        s.lars_configs = {"lars_coeff": 0.002,
+                          "exclude_from_weight_decay": ["bias"]}
+        fleet.init(is_collective=True, strategy=s)
+        net = self._mlp(0)
+        opt = fleet.distributed_optimizer(paddle.optimizer.Momentum(
+            learning_rate=0.1, momentum=0.9, parameters=net.parameters()))
+        assert isinstance(opt, GradientMergeOptimizer)
+        assert isinstance(opt._inner, LarsMomentum)
+        assert opt._inner._lars_coeff == 0.002
+
+        s2 = fleet.DistributedStrategy()
+        s2.lamb = True
+        fleet.init(is_collective=True, strategy=s2)
+        net2 = self._mlp(0)
+        opt2 = fleet.distributed_optimizer(paddle.optimizer.AdamW(
+            learning_rate=1e-3, parameters=net2.parameters()))
+        assert isinstance(opt2, Lamb)
+
+    def test_lars_momentum_trains_and_scales_rate(self):
+        from paddle_tpu.optimizer import LarsMomentum
+
+        net = self._mlp(3)
+        opt = LarsMomentum(learning_rate=0.1, momentum=0.9,
+                           parameters=net.parameters(),
+                           exclude_from_weight_decay=["bias"])
+        x = paddle.to_tensor(
+            np.random.default_rng(1).standard_normal((8, 8)).astype("float32"))
+        y = paddle.to_tensor(
+            np.random.default_rng(2).standard_normal((8, 4)).astype("float32"))
+        losses = []
+        for _ in range(12):
+            loss = ((net(x) - y) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]

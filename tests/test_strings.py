@@ -1,0 +1,62 @@
+"""paddle.strings — string-tensor ops (N9; reference
+paddle/phi/kernels/strings/strings_lower_upper_kernel.h + unicode.cc,
+strings_empty_kernel.h, strings_copy_kernel.h)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import strings
+
+
+class TestStringTensor:
+    def test_create_shape_numpy_roundtrip(self):
+        t = strings.to_string_tensor([["Hello", "World"], ["Foo", "Bar"]])
+        assert t.shape == [2, 2]
+        assert t.size == 4
+        assert t[0, 1] == "World"
+        assert t[1].tolist() == ["Foo", "Bar"]
+        arr = t.numpy()
+        arr[0, 0] = "mutated"  # numpy() is a copy
+        assert t[0, 0] == "Hello"
+
+    def test_empty_and_copy(self):
+        e = strings.empty([2, 3])
+        assert e.shape == [2, 3] and e[0, 0] == ""
+        src = strings.to_string_tensor(["a", "b"])
+        cp = strings.copy(src)
+        cp._data[0] = "z"
+        assert src[0] == "a"
+        assert strings.empty_like(src).shape == [2]
+
+    def test_lower_upper_unicode(self):
+        t = strings.to_string_tensor(["Hello WORLD", "Grüße", "ΣΟΦΙΑ"])
+        low = strings.lower(t)
+        assert low.tolist() == ["hello world", "grüße", "σοφια"]
+        up = strings.upper(strings.to_string_tensor(["straße"]))
+        assert up[0] == "STRASSE"  # full unicode case mapping (unicode.cc)
+        # ascii mode: non-ascii chars pass through untouched
+        a = strings.lower(strings.to_string_tensor(["ÄBC"]),
+                          use_utf8_encoding=False)
+        assert a[0] == "Äbc"
+
+    def test_strip_variants(self):
+        t = strings.to_string_tensor(["  pad  ", "\tx\n", "--y--"])
+        assert strings.strip(t).tolist() == ["pad", "x", "--y--"]
+        assert strings.strip(t, "-").tolist() == ["  pad  ", "\tx\n", "y"]
+        assert strings.lstrip(t).tolist() == ["pad  ", "x\n", "--y--"]
+        assert strings.rstrip(t).tolist() == ["  pad", "\tx", "--y--"]
+
+    def test_split_and_join(self):
+        t = strings.to_string_tensor(["a b  c", "one"])
+        assert strings.split(t) == [["a", "b", "c"], ["one"]]
+        assert strings.split(t, " ", maxsplit=1) == [["a", "b  c"], ["one"]]
+        nested = strings.to_string_tensor([["x,y", "z"]])
+        assert strings.split(nested, ",") == [[["x", "y"], ["z"]]]
+        assert strings.join(strings.to_string_tensor(["a", "b"]), "-") == "a-b"
+        with pytest.raises(ValueError):
+            strings.join(nested)
+
+    def test_namespace_export(self):
+        assert paddle.strings is strings
+        assert isinstance(strings.lower(["A"]), strings.StringTensor)

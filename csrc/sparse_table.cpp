@@ -61,11 +61,12 @@ struct Table {
 
   // Bounded-memory eviction: one O(n) sweep removing the coldest ~1/8 of
   // rows once the budget is hit (amortized O(1) per insert).  Must be
-  // called with mu held.  ``protect_key`` (the row just inserted) is
-  // never evicted — with a uniform tick every stamp ties the cutoff and
-  // the fresh row could otherwise evict itself, invalidating the
-  // caller's iterator.
-  void evict_coldest_locked(int64_t protect_key) {
+  // called with mu held.  When ``has_protect``, ``protect_key`` (the
+  // row just inserted) is never evicted — with a uniform tick every
+  // stamp ties the cutoff and the fresh row could otherwise evict
+  // itself, invalidating the caller's iterator.  (A flag, not a
+  // sentinel key: -1 is a legitimate int64 feature id.)
+  void evict_coldest_locked(int64_t protect_key, bool has_protect) {
     if (max_rows <= 0 || static_cast<int64_t>(rows.size()) <= max_rows)
       return;
     // selection threshold: nth-smallest last_touch via a copy of stamps
@@ -84,7 +85,8 @@ struct Table {
     int64_t cutoff = stamps[n_evict - 1];
     size_t removed = 0;
     for (auto it = rows.begin(); it != rows.end() && removed < n_evict;) {
-      if (it->second.last_touch <= cutoff && it->first != protect_key) {
+      if (it->second.last_touch <= cutoff
+          && !(has_protect && it->first == protect_key)) {
         it = rows.erase(it);
         ++removed;
       } else {
@@ -141,7 +143,7 @@ int sparse_table_pull(void* handle, const long long* keys, int n,
       row.last_touch = t->tick;
       t->init_row(keys[i], &row.value);
       t->rows.emplace(keys[i], std::move(row));
-      t->evict_coldest_locked(keys[i]);
+      t->evict_coldest_locked(keys[i], true);
       it = t->rows.find(keys[i]);  // eviction may rehash; key is protected
     }
     it->second.last_touch = t->tick;
@@ -163,7 +165,7 @@ int sparse_table_push(void* handle, const long long* keys, int n,
       row.last_touch = t->tick;
       t->init_row(keys[i], &row.value);
       t->rows.emplace(keys[i], std::move(row));
-      t->evict_coldest_locked(keys[i]);
+      t->evict_coldest_locked(keys[i], true);
       it = t->rows.find(keys[i]);  // eviction may rehash; key is protected
     }
     Row& row = it->second;
@@ -231,6 +233,10 @@ int sparse_table_load(void* handle, const long long* keys, const float* rows,
   t->rows.clear();
   for (long long i = 0; i < n; ++i) {
     Row row;
+    // restored rows are stamped with the CURRENT tick: a periodic
+    // shrink(ttl) right after a checkpoint restore must not evict the
+    // entire just-loaded table as "maximally cold"
+    row.last_touch = t->tick;
     row.value.assign(rows + static_cast<size_t>(i) * t->dim,
                      rows + static_cast<size_t>(i + 1) * t->dim);
     if (g2) {
@@ -250,7 +256,7 @@ void sparse_table_set_max_rows(void* handle, long long max_rows) {
   if (!t) return;
   std::lock_guard<std::mutex> lock(t->mu);
   t->max_rows = max_rows;
-  t->evict_coldest_locked(-1);  // no insert in flight: nothing protected
+  t->evict_coldest_locked(0, false);  // no insert in flight
 }
 
 void sparse_table_tick(void* handle) {

@@ -257,6 +257,11 @@ def distributed_optimizer(optimizer, strategy: Optional[DistributedStrategy] = N
         return (opt._param_groups if opt._param_groups is not None
                 else opt._parameter_list)
 
+    if strategy.lars and strategy.lamb:
+        raise ValueError(
+            "strategy.lars and strategy.lamb are mutually exclusive — "
+            "both rewrite the update rule (the second would silently "
+            "discard the first)")
     if strategy.lars and isinstance(optimizer, Momentum) \
             and not isinstance(optimizer, LarsMomentum):
         # LarsOptimizer meta-optimizer (meta_optimizers/lars_optimizer.py):

@@ -218,14 +218,16 @@ class GradientMergeOptimizer(Optimizer):
         if k_steps < 1:
             raise ValueError(f"k_steps must be >= 1, got {k_steps}")
         # preserve param GROUPS (per-group lr/decay attrs), not just the
-        # flattened list
+        # flattened list; grad_clip is handled HERE (on the merged
+        # gradient, once per cycle), so the base step must not clip the
+        # raw micro-gradients
         params = (inner._param_groups if inner._param_groups is not None
                   else inner._parameter_list)
-        super().__init__(inner._lr, params,
-                         inner._weight_decay, inner._grad_clip)
+        super().__init__(inner._lr, params, inner._weight_decay, None)
         self._inner = inner
         self._k = k_steps
         self._avg = avg
+        self._merged_clip = inner._grad_clip
         self._use_master_weights = inner._use_master_weights
         # instance attr shadows the class tuple: merge slots + inner slots
         self._slots = ("gm_acc",) + tuple(type(inner)._slots)
@@ -233,6 +235,14 @@ class GradientMergeOptimizer(Optimizer):
         # would desynchronize when a parameter misses a micro-step (no
         # grad on an unused branch), shifting its k-boundary
         self._gm_counter = Tensor(jnp.zeros((), jnp.int32))
+        self._gm_eff = None
+
+    def _init_state(self, ref_value, state):
+        if "gm_acc" not in state:
+            state["gm_acc"] = Tensor(jnp.zeros_like(ref_value))
+        # the inner optimizer's own slot-init rules (Rprop's step_size =
+        # lr, NAdam's scalar mu_prod, Adagrad's initial accumulator...)
+        self._inner._init_state(ref_value, state)
 
     def step(self):
         with no_grad():
@@ -240,6 +250,33 @@ class GradientMergeOptimizer(Optimizer):
                            self._gm_counter)
         self._gm_counter._value = new_c._value
         run_op_notify_rebind(self._gm_counter, new_c)
+        self._gm_eff = None
+        if self._merged_clip is not None:
+            # clip the MERGED (cycle) gradient, matching one large-batch
+            # step — clipping each raw micro-gradient would change the
+            # applied update.  Computed unconditionally every micro-step
+            # (the boundary is traced state, so Python cannot branch on
+            # it); _update selects it only at the boundary.
+            k, avg = self._k, self._avg
+            pairs = []
+            with no_grad():
+                for p, _ in self._params_with_group_attrs():
+                    if p.grad is None or p.stop_gradient:
+                        continue
+                    acc = self._state.get(id(p), {}).get("gm_acc")
+                    if acc is None:
+                        m = run_op("gm_merge",
+                                   lambda g: (g / k if avg else g), p.grad)
+                    else:
+                        m = run_op(
+                            "gm_merge",
+                            lambda a, g: ((a + g.astype(a.dtype)) / k
+                                          if avg
+                                          else a + g.astype(a.dtype)),
+                            acc, p.grad)
+                    pairs.append((p, m))
+                clipped = self._merged_clip(pairs)
+            self._gm_eff = {id(p): g for p, g in clipped}
         super().step()
 
     def _update(self, w, g, lr, wd, slots, p):
@@ -248,7 +285,10 @@ class GradientMergeOptimizer(Optimizer):
         # closure over the SAME trace level's counter value (concrete in
         # eager, a tracer of the enclosing staged program under to_static)
         boundary = (self._gm_counter._value % self._k) == 0
-        g_eff = (acc / self._k if self._avg else acc).astype(w.dtype)
+        if self._gm_eff is not None:
+            g_eff = self._gm_eff[id(p)]._value.astype(w.dtype)
+        else:
+            g_eff = (acc / self._k if self._avg else acc).astype(w.dtype)
         out = self._inner._update(w, g_eff, lr, wd, tuple(inner_slots), p)
         out = out if isinstance(out, tuple) else (out,)
         new_w = jnp.where(boundary, out[0], w)

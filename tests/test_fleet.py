@@ -288,6 +288,55 @@ class TestGradientMerge:
             learning_rate=1e-3, parameters=net2.parameters()))
         assert isinstance(opt2, Lamb)
 
+    def test_merged_clip_matches_large_batch_clip(self):
+        """grad_clip must apply to the MERGED gradient once per cycle,
+        not to each raw micro-gradient (review r5 finding)."""
+        from paddle_tpu.optimizer import GradientMergeOptimizer
+
+        k = 3
+        rng = np.random.default_rng(3)
+        # spiky micro-batches: per-micro clipping would distort the merge
+        xs = (rng.standard_normal((k, 4, 8)) * [[[5.0]], [[0.1]], [[2.0]]]
+              ).astype("float32")
+        ys = rng.standard_normal((k, 4, 4)).astype("float32")
+        clip = paddle.nn.ClipGradByGlobalNorm(0.05)
+
+        net_a = self._mlp(9)
+        opt_a = GradientMergeOptimizer(
+            paddle.optimizer.SGD(learning_rate=0.1,
+                                 parameters=net_a.parameters(),
+                                 grad_clip=clip), k)
+        for i in range(k):
+            loss = ((net_a(paddle.to_tensor(xs[i]))
+                     - paddle.to_tensor(ys[i])) ** 2).mean()
+            loss.backward()
+            opt_a.step()
+            opt_a.clear_grad()
+
+        net_b = self._mlp(9)
+        opt_b = paddle.optimizer.SGD(learning_rate=0.1,
+                                     parameters=net_b.parameters(),
+                                     grad_clip=clip)
+        loss = ((net_b(paddle.to_tensor(xs.reshape(k * 4, 8)))
+                 - paddle.to_tensor(ys.reshape(k * 4, 4))) ** 2).mean()
+        loss.backward()
+        opt_b.step()
+        opt_b.clear_grad()
+
+        for pa, pb in zip(net_a.parameters(), net_b.parameters()):
+            np.testing.assert_allclose(pa.numpy(), pb.numpy(),
+                                       rtol=2e-5, atol=2e-6)
+
+    def test_lars_lamb_mutually_exclusive(self):
+        s = fleet.DistributedStrategy()
+        s.lars = True
+        s.lamb = True
+        fleet.init(is_collective=True, strategy=s)
+        net = self._mlp(0)
+        with pytest.raises(ValueError, match="mutually exclusive"):
+            fleet.distributed_optimizer(paddle.optimizer.Momentum(
+                learning_rate=0.1, parameters=net.parameters()))
+
     def test_lars_momentum_trains_and_scales_rate(self):
         from paddle_tpu.optimizer import LarsMomentum
 

@@ -1,0 +1,397 @@
+"""Op battery 3 — behavioral coverage for the parity-family op set
+(VERDICT r4 item #5 / weak #3).
+
+Every op on the forward/backward path of the 8 torch-parity model
+families (Llama, GPT-2, BERT, ERNIE, ViT, ResNet, Mixtral, Qwen2-MoE)
+gets: a fp32 ``check_output`` against a NumPy reference, a bf16 sweep
+(the TPU training dtype), and a ``check_grad`` (analytic tape vs central
+finite differences) — the reference's OpTest discipline
+(``test/legacy_test/op_test.py:2763,2973``) applied to the long tail of
+``tensor/manipulation.py`` and ``nn/functional``.
+
+Shapes are tiny on purpose: finite differences evaluate the op once per
+element per input.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from op_test import check_grad, check_output, check_output_dtypes
+
+_rng = np.random.default_rng(7)
+
+
+def _f32(*shape, lo=-1.0, hi=1.0):
+    return (lo + (hi - lo) * _rng.random(shape)).astype(np.float32)
+
+
+def _pos(*shape):
+    return (0.2 + _rng.random(shape)).astype(np.float32)
+
+
+# --------------------------------------------------------------------------
+# Group A: pointwise / binary ops on every family's path
+# name -> (op_fn, np_fn, inputs)
+_POINTWISE = {
+    "silu": (F.silu, lambda x: x / (1 + np.exp(-x)), [_f32(3, 4)]),
+    "gelu_tanh": (lambda x: F.gelu(x, approximate=True),
+                  lambda x: 0.5 * x * (1 + np.tanh(
+                      np.sqrt(2 / np.pi) * (x + 0.044715 * x ** 3))),
+                  [_f32(3, 4)]),
+    "gelu_erf": (F.gelu,
+                 lambda x: (0.5 * x * (1 + np.vectorize(__import__("math").erf)(
+                     (x / np.sqrt(2)).astype(np.float64)))).astype(np.float32),
+                 [_f32(3, 4)]),
+    "sigmoid": (F.sigmoid, lambda x: 1 / (1 + np.exp(-x)), [_f32(3, 4)]),
+    "tanh": (paddle.tanh, np.tanh, [_f32(3, 4)]),
+    "relu": (F.relu, lambda x: np.maximum(x, 0), [_f32(3, 4)]),
+    "softplus": (F.softplus, lambda x: np.log1p(np.exp(x)), [_f32(3, 4)]),
+    "exp": (paddle.exp, np.exp, [_f32(3, 4)]),
+    "log": (paddle.log, np.log, [_pos(3, 4)]),
+    "sqrt": (paddle.sqrt, np.sqrt, [_pos(3, 4)]),
+    "rsqrt": (paddle.rsqrt, lambda x: 1 / np.sqrt(x), [_pos(3, 4)]),
+    "square": (paddle.square, np.square, [_f32(3, 4)]),
+    "abs": (paddle.abs, np.abs, [_f32(3, 4) + 0.1]),
+    "add": (paddle.add, np.add, [_f32(3, 4), _f32(3, 4)]),
+    "subtract": (paddle.subtract, np.subtract, [_f32(3, 4), _f32(3, 4)]),
+    "multiply": (paddle.multiply, np.multiply, [_f32(3, 4), _f32(3, 4)]),
+    "divide": (paddle.divide, np.divide, [_f32(3, 4), _pos(3, 4)]),
+    "pow2": (lambda x: paddle.pow(x, 2.0), lambda x: x ** 2, [_f32(3, 4)]),
+    "maximum": (paddle.maximum, np.maximum, [_f32(3, 4), _f32(3, 4)]),
+    "minimum": (paddle.minimum, np.minimum, [_f32(3, 4), _f32(3, 4)]),
+    "clip": (lambda x: paddle.clip(x, -0.5, 0.5),
+             lambda x: np.clip(x, -0.5, 0.5), [_f32(3, 4)]),
+    "scale": (lambda x: paddle.scale(x, 2.5, bias=0.5),
+              lambda x: 2.5 * x + 0.5, [_f32(3, 4)]),
+    "add_bcast": (paddle.add, np.add, [_f32(3, 4), _f32(4)]),
+    "mul_bcast": (paddle.multiply, np.multiply, [_f32(2, 3, 4), _f32(1, 4)]),
+}
+
+
+@pytest.mark.parametrize("name", sorted(_POINTWISE))
+def test_pointwise_output_fp32_bf16(name):
+    op, ref, inputs = _POINTWISE[name]
+    check_output(op, ref, inputs, rtol=2e-5, atol=2e-6)
+    check_output_dtypes(op, ref, inputs)
+
+
+@pytest.mark.parametrize("name", sorted(_POINTWISE))
+def test_pointwise_grad(name):
+    op, _, inputs = _POINTWISE[name]
+    check_grad(op, inputs)
+
+
+# --------------------------------------------------------------------------
+# Group B: reductions + softmax family (every transformer's hot path)
+_REDUCE = {
+    "mean_all": (paddle.mean, lambda x: np.mean(x), [_f32(3, 4)]),
+    "mean_axis": (lambda x: paddle.mean(x, axis=-1, keepdim=True),
+                  lambda x: np.mean(x, -1, keepdims=True), [_f32(3, 4)]),
+    "sum_axis": (lambda x: paddle.sum(x, axis=0),
+                 lambda x: np.sum(x, 0), [_f32(3, 4)]),
+    "max_axis": (lambda x: paddle.max(x, axis=1),
+                 lambda x: np.max(x, 1), [_f32(3, 4)]),
+    "min_axis": (lambda x: paddle.min(x, axis=1),
+                 lambda x: np.min(x, 1), [_f32(3, 4)]),
+    "prod": (lambda x: paddle.prod(x, axis=1),
+             lambda x: np.prod(x, 1), [_pos(3, 4)]),
+    "logsumexp": (lambda x: paddle.logsumexp(x, axis=-1),
+                  lambda x: np.log(np.sum(np.exp(x), -1)), [_f32(3, 4)]),
+    "softmax": (lambda x: F.softmax(x, axis=-1),
+                lambda x: np.exp(x - x.max(-1, keepdims=True))
+                / np.exp(x - x.max(-1, keepdims=True)).sum(-1, keepdims=True),
+                [_f32(3, 5)]),
+    "log_softmax": (lambda x: F.log_softmax(x, axis=-1),
+                    lambda x: x - x.max(-1, keepdims=True)
+                    - np.log(np.exp(x - x.max(-1, keepdims=True))
+                             .sum(-1, keepdims=True)), [_f32(3, 5)]),
+    "cumsum": (lambda x: paddle.cumsum(x, axis=1),
+               lambda x: np.cumsum(x, 1), [_f32(3, 4)]),
+    "cumprod": (lambda x: paddle.cumprod(x, dim=1),
+                lambda x: np.cumprod(x, 1), [_pos(2, 4)]),
+}
+
+
+@pytest.mark.parametrize("name", sorted(_REDUCE))
+def test_reduce_output_fp32_bf16(name):
+    op, ref, inputs = _REDUCE[name]
+    check_output(op, ref, inputs, rtol=2e-5, atol=2e-6)
+    check_output_dtypes(op, ref, inputs)
+
+
+@pytest.mark.parametrize("name", sorted(_REDUCE))
+def test_reduce_grad(name):
+    op, _, inputs = _REDUCE[name]
+    # max/min grads are subgradients at ties — inputs above are generic
+    check_grad(op, inputs)
+
+
+# --------------------------------------------------------------------------
+# Group C: manipulation long tail (tensor/manipulation.py)
+_MANIP = {
+    "transpose": (lambda x: paddle.transpose(x, [1, 0, 2]),
+                  lambda x: np.transpose(x, (1, 0, 2)), [_f32(2, 3, 4)]),
+    "reshape": (lambda x: paddle.reshape(x, [4, 6]),
+                lambda x: np.reshape(x, (4, 6)), [_f32(2, 3, 4)]),
+    "flatten": (lambda x: paddle.flatten(x, start_axis=1),
+                lambda x: x.reshape(x.shape[0], -1), [_f32(2, 3, 4)]),
+    "squeeze": (lambda x: paddle.squeeze(x, axis=1),
+                lambda x: np.squeeze(x, 1), [_f32(3, 1, 4)]),
+    "unsqueeze": (lambda x: paddle.unsqueeze(x, axis=1),
+                  lambda x: np.expand_dims(x, 1), [_f32(3, 4)]),
+    "concat": (lambda a, b: paddle.concat([a, b], axis=1),
+               lambda a, b: np.concatenate([a, b], 1),
+               [_f32(3, 2), _f32(3, 3)]),
+    "stack": (lambda a, b: paddle.stack([a, b], axis=0),
+              lambda a, b: np.stack([a, b], 0), [_f32(3, 4), _f32(3, 4)]),
+    "split0": (lambda x: paddle.split(x, 2, axis=1)[0],
+               lambda x: np.split(x, 2, 1)[0], [_f32(3, 4)]),
+    "chunk1": (lambda x: paddle.chunk(x, 2, axis=0)[1],
+               lambda x: np.array_split(x, 2, 0)[1], [_f32(4, 3)]),
+    "tile": (lambda x: paddle.tile(x, [2, 1]),
+             lambda x: np.tile(x, (2, 1)), [_f32(2, 3)]),
+    "expand": (lambda x: paddle.expand(x, [3, 2, 4]),
+               lambda x: np.broadcast_to(x, (3, 2, 4)), [_f32(2, 4)]),
+    "broadcast_to": (lambda x: paddle.broadcast_to(x, [3, 4]),
+                     lambda x: np.broadcast_to(x, (3, 4)), [_f32(1, 4)]),
+    "flip": (lambda x: paddle.flip(x, axis=[1]),
+             lambda x: np.flip(x, 1), [_f32(3, 4)]),
+    "roll": (lambda x: paddle.roll(x, shifts=2, axis=1),
+             lambda x: np.roll(x, 2, 1), [_f32(3, 4)]),
+    "rot90": (lambda x: paddle.rot90(x, k=1, axes=[0, 1]),
+              lambda x: np.rot90(x, 1, (0, 1)), [_f32(3, 4)]),
+    "moveaxis": (lambda x: paddle.moveaxis(x, 0, 2),
+                 lambda x: np.moveaxis(x, 0, 2), [_f32(2, 3, 4)]),
+    "tril": (paddle.tril, np.tril, [_f32(4, 4)]),
+    "triu": (paddle.triu, np.triu, [_f32(4, 4)]),
+    "diagonal": (lambda x: paddle.diagonal(x, axis1=0, axis2=1),
+                 lambda x: np.diagonal(x, 0, 0, 1).copy(), [_f32(3, 3)]),
+    "trace_op": (paddle.trace, np.trace, [_f32(3, 3)]),
+    "repeat_interleave": (
+        lambda x: paddle.repeat_interleave(x, 2, axis=1),
+        lambda x: np.repeat(x, 2, 1), [_f32(2, 3)]),
+    "unbind0": (lambda x: paddle.unbind(x, axis=0)[0],
+                lambda x: x[0], [_f32(3, 4)]),
+    "pad_2d": (lambda x: paddle.nn.functional.pad(x, [1, 2], value=0.0),
+               lambda x: np.pad(x, ((0, 0), (1, 2))), [_f32(2, 3)]),
+    "kron": (paddle.kron, np.kron, [_f32(2, 2), _f32(2, 2)]),
+}
+
+
+@pytest.mark.parametrize("name", sorted(_MANIP))
+def test_manip_output_fp32_bf16(name):
+    op, ref, inputs = _MANIP[name]
+    check_output(op, ref, inputs, rtol=2e-5, atol=2e-6)
+    check_output_dtypes(op, ref, inputs)
+
+
+@pytest.mark.parametrize("name", sorted(_MANIP))
+def test_manip_grad(name):
+    op, _, inputs = _MANIP[name]
+    check_grad(op, inputs)
+
+
+# --------------------------------------------------------------------------
+# Group D: indexing / gather-scatter (embedding + MoE routing path)
+class TestIndexingOps:
+    def test_gather_output_and_grad(self):
+        idx = np.array([2, 0, 1], np.int64)
+        check_output(lambda x, i: paddle.gather(x, i, axis=0),
+                     lambda x, i: x[i], [_f32(4, 3), idx])
+        check_grad(lambda x, i: paddle.gather(x, i, axis=0),
+                   [_f32(4, 3), idx], grad_inputs=[0])
+
+    def test_index_select_output_and_grad(self):
+        idx = np.array([1, 3], np.int64)
+        check_output(lambda x, i: paddle.index_select(x, i, axis=1),
+                     lambda x, i: x[:, i], [_f32(3, 4), idx])
+        check_grad(lambda x, i: paddle.index_select(x, i, axis=1),
+                   [_f32(3, 4), idx], grad_inputs=[0])
+
+    def test_take_along_axis_output_and_grad(self):
+        idx = np.array([[0, 2], [1, 0]], np.int64)
+        check_output(lambda x, i: paddle.take_along_axis(x, i, axis=1),
+                     lambda x, i: np.take_along_axis(x, i, 1),
+                     [_f32(2, 3), idx])
+        check_grad(lambda x, i: paddle.take_along_axis(x, i, axis=1),
+                   [_f32(2, 3), idx], grad_inputs=[0])
+
+    def test_gather_nd_output_and_grad(self):
+        idx = np.array([[0, 1], [2, 0]], np.int64)
+        check_output(paddle.gather_nd,
+                     lambda x, i: x[tuple(i.T)], [_f32(3, 3), idx])
+        check_grad(paddle.gather_nd, [_f32(3, 3), idx], grad_inputs=[0])
+
+    def test_embedding_grad(self):
+        ids = np.array([[1, 3], [0, 2]], np.int64)
+        w = _f32(5, 4)
+        check_output(lambda i, w: F.embedding(i, w),
+                     lambda i, w: w[i], [ids, w])
+        check_grad(lambda i, w: F.embedding(i, w), [ids, w],
+                   grad_inputs=[1])
+
+    def test_one_hot_output(self):
+        ids = np.array([0, 2, 1], np.int64)
+        check_output(lambda i: F.one_hot(i, 4),
+                     lambda i: np.eye(4, dtype=np.float32)[i], [ids])
+
+    def test_where_output_and_grad(self):
+        c = np.array([[True, False], [False, True]])
+        check_output(paddle.where, np.where,
+                     [c, _f32(2, 2), _f32(2, 2)])
+        check_grad(lambda a, b: paddle.where(paddle.to_tensor(c), a, b),
+                   [_f32(2, 2), _f32(2, 2)])
+
+    def test_masked_fill_grad(self):
+        m = np.array([[True, False], [False, True]])
+        check_output(lambda x: paddle.masked_fill(x, paddle.to_tensor(m), 0.5),
+                     lambda x: np.where(m, 0.5, x), [_f32(2, 2)])
+        check_grad(lambda x: paddle.masked_fill(x, paddle.to_tensor(m), 0.5),
+                   [_f32(2, 2)])
+
+    def test_scatter_output_and_grad(self):
+        idx = np.array([1, 0], np.int64)
+        upd = _f32(2, 3)
+
+        def ref(x, i, u):
+            out = x.copy()
+            out[i] = u
+            return out
+
+        check_output(lambda x, i, u: paddle.scatter(x, i, u), ref,
+                     [_f32(3, 3), idx, upd])
+        check_grad(lambda x, u: paddle.scatter(
+            x, paddle.to_tensor(idx), u), [_f32(3, 3), upd])
+
+
+# --------------------------------------------------------------------------
+# Group E: nn.functional layers on the family path
+class TestNNFunctionalOps:
+    def test_linear_output_and_grad(self):
+        check_output(F.linear, lambda x, w, b: x @ w + b,
+                     [_f32(3, 4), _f32(4, 5), _f32(5)], rtol=2e-5)
+        check_grad(F.linear, [_f32(3, 4), _f32(4, 5), _f32(5)])
+
+    def test_matmul_transpose_flags(self):
+        check_output(lambda a, b: paddle.matmul(a, b, transpose_y=True),
+                     lambda a, b: a @ b.T, [_f32(3, 4), _f32(5, 4)],
+                     rtol=2e-5)
+        check_grad(lambda a, b: paddle.matmul(a, b, transpose_y=True),
+                   [_f32(3, 4), _f32(5, 4)])
+
+    def test_bmm_output_and_grad(self):
+        check_output(paddle.bmm, lambda a, b: a @ b,
+                     [_f32(2, 3, 4), _f32(2, 4, 2)], rtol=2e-5)
+        check_grad(paddle.bmm, [_f32(2, 3, 4), _f32(2, 4, 2)])
+
+    def test_layer_norm_output_and_grad(self):
+        def ref(x, w, b):
+            mu = x.mean(-1, keepdims=True)
+            var = x.var(-1, keepdims=True)
+            return (x - mu) / np.sqrt(var + 1e-5) * w + b
+
+        op = lambda x, w, b: F.layer_norm(x, [4], weight=w, bias=b)  # noqa: E731
+        check_output(op, ref, [_f32(3, 4), _pos(4), _f32(4)], rtol=2e-5,
+                     atol=2e-5)
+        check_grad(op, [_f32(3, 4), _pos(4), _f32(4)], rtol=3e-2)
+
+    def test_rms_norm_path_grad(self):
+        # the Llama RMSNorm composite: x * rsqrt(mean(x^2)+eps) * w
+        def op(x, w):
+            var = paddle.mean(paddle.square(x), axis=-1, keepdim=True)
+            return x * paddle.rsqrt(var + 1e-6) * w
+
+        def ref(x, w):
+            var = np.mean(x ** 2, -1, keepdims=True)
+            return x / np.sqrt(var + 1e-6) * w
+
+        check_output(op, ref, [_f32(3, 4), _pos(4)], rtol=2e-5)
+        check_grad(op, [_f32(3, 4), _pos(4)], rtol=3e-2)
+
+    def test_cross_entropy_output_and_grad(self):
+        labels = np.array([2, 0, 1], np.int64)
+
+        def ref(x, y):
+            m = x - x.max(-1, keepdims=True)
+            logp = m - np.log(np.exp(m).sum(-1, keepdims=True))
+            return -logp[np.arange(len(y)), y].mean()
+
+        op = lambda x, y: F.cross_entropy(x, y)  # noqa: E731
+        check_output(op, ref, [_f32(3, 5), labels], rtol=2e-5)
+        check_grad(op, [_f32(3, 5), labels], grad_inputs=[0])
+
+    def test_mse_and_l1_loss_grad(self):
+        check_output(F.mse_loss, lambda a, b: np.mean((a - b) ** 2),
+                     [_f32(3, 4), _f32(3, 4)])
+        check_grad(F.mse_loss, [_f32(3, 4), _f32(3, 4)])
+        check_output(F.l1_loss, lambda a, b: np.mean(np.abs(a - b)),
+                     [_f32(3, 4), _f32(3, 4) + 2.0])
+        check_grad(F.l1_loss, [_f32(3, 4), _f32(3, 4) + 2.0])
+
+    def test_conv2d_output_and_grad(self):
+        x, w, b = _f32(1, 2, 5, 5), _f32(3, 2, 3, 3), _f32(3)
+
+        def ref(x, w, b):
+            B, C, H, W = x.shape
+            O, _, K, _ = w.shape
+            out = np.zeros((B, O, H - K + 1, W - K + 1), np.float32)
+            for o in range(O):
+                for i in range(H - K + 1):
+                    for j in range(W - K + 1):
+                        out[:, o, i, j] = np.sum(
+                            x[:, :, i:i + K, j:j + K] * w[o], axis=(1, 2, 3))
+            return out + b[None, :, None, None]
+
+        check_output(F.conv2d, ref, [x, w, b], rtol=2e-5, atol=2e-5)
+        check_grad(F.conv2d, [x, w, b], rtol=3e-2)
+
+    def test_max_pool2d_output_and_grad(self):
+        x = _f32(1, 2, 4, 4)
+
+        def ref(x):
+            return x.reshape(1, 2, 2, 2, 2, 2).max(axis=(3, 5))
+
+        op = lambda x: F.max_pool2d(x, kernel_size=2, stride=2)  # noqa: E731
+        check_output(op, ref, [x])
+        check_grad(op, [x])
+
+    def test_adaptive_avg_pool2d_output_and_grad(self):
+        x = _f32(1, 2, 4, 4)
+        op = lambda x: F.adaptive_avg_pool2d(x, 1)  # noqa: E731
+        check_output(op, lambda x: x.mean(axis=(2, 3), keepdims=True), [x])
+        check_grad(op, [x])
+
+    def test_batch_norm_eval_output(self):
+        x = _f32(3, 4)
+        mean, var = _f32(4) * 0.1, _pos(4)
+        w, b = _pos(4), _f32(4)
+        check_output(
+            lambda x, m, v, w, b: F.batch_norm(x, m, v, weight=w, bias=b,
+                                               training=False),
+            lambda x, m, v, w, b: (x - m) / np.sqrt(v + 1e-5) * w + b,
+            [x, mean, var, w, b], rtol=2e-5, atol=2e-5)
+
+    def test_softmax_with_temperature_chain_grad(self):
+        # GPT/Llama decode head: logits / T -> softmax -> mix
+        def op(x, w):
+            return paddle.matmul(F.softmax(x / 0.7, axis=-1), w)
+
+        def ref(x, w):
+            e = np.exp(x / 0.7 - (x / 0.7).max(-1, keepdims=True))
+            return (e / e.sum(-1, keepdims=True)) @ w
+
+        check_output(op, ref, [_f32(3, 4), _f32(4, 2)], rtol=2e-5)
+        check_grad(op, [_f32(3, 4), _f32(4, 2)])
+
+    def test_dropout_eval_identity_and_train_scale(self):
+        x = _f32(64)
+        out = F.dropout(paddle.to_tensor(x), p=0.5, training=False)
+        np.testing.assert_array_equal(out.numpy(), x)
+        paddle.seed(0)
+        t = F.dropout(paddle.to_tensor(np.ones(4096, np.float32)), p=0.25,
+                      training=True)
+        kept = t.numpy() != 0
+        assert abs(kept.mean() - 0.75) < 0.05
+        np.testing.assert_allclose(t.numpy()[kept], 1 / 0.75, rtol=1e-6)

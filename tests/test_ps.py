@@ -295,6 +295,18 @@ class TestEvictionTTL:
         with pytest.raises(ValueError):
             t.shrink(0)
 
+    def test_ttl_survives_checkpoint_restore(self):
+        # restored rows must be stamped with the CURRENT tick: a periodic
+        # shrink right after load must not evict the whole table
+        t = ps.NativeSparseTable(dim=4)
+        t.pull(list(range(20)))
+        snap = t.state_dict()
+        for _ in range(5):
+            t.tick()
+        t.load_state_dict(snap)
+        assert t.shrink(2) == 0
+        assert t.size() == 20
+
     def test_set_max_rows_after_creation(self):
         t = ps.NativeSparseTable(dim=4)
         t.pull(list(range(5000)))

@@ -110,6 +110,18 @@ def notify_ignored_module(fn_name: str):
             cb(fn_name)
 
 
+# Per-op host timing hook (paddle.profiler summary statistics): called
+# with (op_name, wall_seconds) for every run_op while a Profiler is
+# active.  On an async backend this is dispatch+trace time, not device
+# execution — the host-side operator table of the reference's summary().
+_op_timer = None
+
+
+def _set_op_timer(timer):
+    global _op_timer
+    _op_timer = timer
+
+
 def _tree_leaves_with_path(out):
     if isinstance(out, (list, tuple)):
         return list(out), type(out)
@@ -123,6 +135,18 @@ def run_op(name: str, fn: Callable, *args, **kwargs):
     tensors are unwrapped but always non-differentiable — pass a tensor
     positionally if it needs a gradient.
     """
+    if _op_timer is not None:
+        import time as _time
+
+        t0 = _time.perf_counter()
+        try:
+            return _run_op_impl(name, fn, *args, **kwargs)
+        finally:
+            _op_timer(name, _time.perf_counter() - t0)
+    return _run_op_impl(name, fn, *args, **kwargs)
+
+
+def _run_op_impl(name: str, fn: Callable, *args, **kwargs):
     from .tensor import Tensor, wrap_result
 
     if flags.flag("eager_log_ops"):

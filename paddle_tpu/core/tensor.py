@@ -50,6 +50,8 @@ class Tensor:
         "dist_attr",
         "dist_spec",
         "_ctr",
+        "_view_base",
+        "_view_index",
         "__weakref__",
     )
 
@@ -78,6 +80,8 @@ class Tensor:
         self._backward_hooks = None
         self._hook_counter = 0
         self.trainable = True
+        self._view_base = None
+        self._view_index = None
         global _n_created
         self._ctr = _n_created = _n_created + 1
 
@@ -276,7 +280,26 @@ class Tensor:
         from .dispatch import notify_rebind
 
         notify_rebind(self, other)
+        self._write_back_if_view()
         return self
+
+    def _write_back_if_view(self):
+        """Shared-storage view semantics, write direction (the reference's
+        zero-copy stride views, ``paddle/phi/kernels/stride/``): an
+        in-place mutation of a basic-index view writes through to its
+        base tensor (``a = x[0]; a.add_(1)`` mutates ``x``), chaining
+        through nested views.  Divergence (documented + tested): the READ
+        direction is not aliased — a view materialized before a later
+        base mutation keeps its copy; re-index to observe base updates.
+        XLA arrays are immutable, so true two-way aliasing would need
+        every ``_value`` read to re-slice the base."""
+        base = self._view_base
+        if base is not None:
+            # pass the view ITSELF (differentiable): the base's setitem
+            # then records the mutated value's autograd chain, so
+            # x[0].add_(t); x.sum().backward() flows through the add —
+            # wrapping a raw value would detach the region's gradient
+            base[self._view_index] = self
 
     def set_value(self, value):
         """paddle Tensor.set_value — raw data replacement, no grad recording."""
@@ -289,6 +312,7 @@ class Tensor:
                 f"set_value shape mismatch: {value.shape} vs {self._value.shape}"
             )
         self._value = value.astype(self._value.dtype)
+        self._write_back_if_view()
 
     def copy_(self, other, blocking=True):
         self.set_value(other)
@@ -296,10 +320,12 @@ class Tensor:
 
     def fill_(self, v):
         self._value = jnp.full_like(self._value, v)
+        self._write_back_if_view()
         return self
 
     def zero_(self):
         self._value = jnp.zeros_like(self._value)
+        self._write_back_if_view()
         return self
 
     # --- indexing ------------------------------------------------------------
@@ -307,7 +333,15 @@ class Tensor:
         from .dispatch import run_op
 
         idx = _unwrap_index(idx)
-        return run_op("getitem", lambda x: x[idx], self)
+        out = run_op("getitem", lambda x: x[idx], self)
+        if _is_basic_index(idx):
+            # basic indexing is a VIEW in the reference (stride kernels);
+            # mark it so in-place mutation writes back into this tensor.
+            # Advanced indexing (arrays/bool masks) is a gather COPY in
+            # the reference too — no link.
+            out._view_base = self
+            out._view_index = idx
+        return out
 
     def __setitem__(self, idx, value):
         from .dispatch import run_op
@@ -386,6 +420,18 @@ def _unwrap_index(idx):
     if isinstance(idx, list):
         return jnp.asarray(idx)
     return idx
+
+
+def _is_basic_index(idx) -> bool:
+    """True for int/slice/Ellipsis/None (tuples thereof) — the indexing
+    forms the reference serves as zero-copy stride VIEWS.  Array/bool
+    indices are gather copies there too (bool subclasses int: reject it
+    explicitly)."""
+    if isinstance(idx, tuple):
+        return all(_is_basic_index(i) for i in idx)
+    if isinstance(idx, bool):
+        return False
+    return idx is None or idx is Ellipsis or isinstance(idx, (int, slice))
 
 
 def wrap_result(out, stop_gradient: bool, node=None):

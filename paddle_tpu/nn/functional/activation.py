@@ -13,11 +13,12 @@ def _ensure(x):
     return x if isinstance(x, Tensor) else to_tensor(x)
 
 
-def _unary(name, fn):
+def _unary(opname, fn):
+    # the paddle-API ``name=`` kwarg must not shadow the dispatch name
     def op(x, name=None):
-        return run_op(name, fn, _ensure(x))
+        return run_op(opname, fn, _ensure(x))
 
-    op.__name__ = name
+    op.__name__ = opname
     return op
 
 

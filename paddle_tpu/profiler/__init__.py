@@ -77,20 +77,29 @@ class Profiler:
         self._last_t = None
 
     def start(self):
+        from ..core import dispatch as _dispatch
+        from .statistic import HostOpRecorder
+
         if self._on_trace_ready:
             # handlers configure the output dir (export_chrome_tracing /
             # export_protobuf set _log_dir) — must happen BEFORE the trace
             # starts or they would point at an already-written trace
             self._on_trace_ready(self)
+        self._host_recorder = HostOpRecorder()
+        _dispatch._set_op_timer(self._host_recorder)
         if not self._timer_only:
             jax.profiler.start_trace(self._log_dir)
             self._active = True
         self._last_t = time.perf_counter()
 
     def stop(self):
+        from ..core import dispatch as _dispatch
+
+        _dispatch._set_op_timer(None)
         if self._active:
             jax.profiler.stop_trace()
             self._active = False
+            self._captured = True  # THIS profiler wrote a trace run
 
     def step(self, num_samples: Optional[int] = None):
         now = time.perf_counter()
@@ -105,8 +114,25 @@ class Profiler:
         avg = sum(self._step_times[-10:]) / len(self._step_times[-10:])
         return f"avg step time {avg * 1000:.2f} ms"
 
-    def summary(self, sorted_by=None, op_detail=True, thread_sep=False, time_unit="ms"):
-        print(self.step_info())
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
+                time_unit="ms"):
+        """Sortable per-op statistics tables
+        (``profiler_statistic.py`` analog): host operator dispatch times
+        + device-lane op times from the captured trace.  Prints AND
+        returns the report."""
+        from .statistic import build_summary
+
+        stats = getattr(self, "_host_recorder", None)
+        # only read trace dirs THIS profiler wrote — the shared default
+        # log dir may hold a stale/foreign run's device table
+        log_dir = (self._log_dir if getattr(self, "_captured", False)
+                   else None)
+        report = build_summary(
+            stats.stats if stats else {}, log_dir,
+            self._step_times, sorted_by=sorted_by, op_detail=op_detail,
+            time_unit=time_unit)
+        print(report)
+        return report
 
     def export(self, path: str, format: str = "json"):
         print(f"trace written under {self._log_dir} (XPlane/TensorBoard format)")

@@ -1,0 +1,157 @@
+"""Profiler summary statistics (``profiler/profiler_statistic.py`` analog).
+
+Two sortable per-op tables, mirroring the reference's ``summary()``:
+
+* **host op stats** — wall time of every eager ``run_op`` dispatch while
+  the profiler is active (the reference's CPU-side operator times).  On
+  an async backend this measures dispatch + trace-time, not device
+  execution — the honest host-side number.
+* **device op stats** — per-op durations from the chrome trace the
+  profiler captured (``jax.profiler`` XPlane export), grouped by op name
+  (the reference's GPU kernel table; here XLA/TPU device lanes).
+"""
+
+from __future__ import annotations
+
+import collections
+import glob
+import gzip
+import json
+import os
+from typing import Dict, List, Optional
+
+
+class OpStat:
+    __slots__ = ("name", "calls", "total", "max", "min")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.calls = 0
+        self.total = 0.0
+        self.max = 0.0
+        self.min = float("inf")
+
+    def add(self, dt: float):
+        self.calls += 1
+        self.total += dt
+        self.max = max(self.max, dt)
+        self.min = min(self.min, dt)
+
+    @property
+    def avg(self) -> float:
+        return self.total / self.calls if self.calls else 0.0
+
+
+class HostOpRecorder:
+    """Dispatch timing hook target (installed via dispatch._set_op_timer)."""
+
+    def __init__(self):
+        self.stats: Dict[str, OpStat] = {}
+
+    def __call__(self, name: str, dt: float):
+        name = str(name) if name else "<anonymous>"
+        stat = self.stats.get(name)
+        if stat is None:
+            stat = self.stats[name] = OpStat(name)
+        stat.add(dt)
+
+
+def collect_device_stats(log_dir: Optional[str]) -> Dict[str, OpStat]:
+    """Per-op device-lane durations from the newest captured trace.
+    ``None`` (no trace captured by this profiler) yields no stats."""
+    if log_dir is None:
+        return {}
+    runs = sorted(glob.glob(os.path.join(log_dir, "plugins", "profile",
+                                         "*")))
+    stats: Dict[str, OpStat] = {}
+    if not runs:
+        return stats
+    events, pids = [], {}
+    for path in glob.glob(os.path.join(runs[-1], "*.trace.json.gz")):
+        try:
+            data = json.load(gzip.open(path))
+        except (OSError, ValueError):
+            continue
+        for e in data.get("traceEvents", []):
+            if e.get("ph") == "M" and e.get("name") == "process_name":
+                pids[e["pid"]] = e["args"].get("name", str(e["pid"]))
+            elif e.get("ph") == "X":
+                events.append(e)
+    device_pids = {p for p, n in pids.items()
+                   if "TPU" in n.upper() or "/device" in n.lower()}
+    if not device_pids:
+        device_pids = set(pids)
+    for e in events:
+        if e["pid"] not in device_pids:
+            continue
+        name = e.get("name", "?")
+        stat = stats.get(name)
+        if stat is None:
+            stat = stats[name] = OpStat(name)
+        stat.add(e.get("dur", 0) / 1e6)  # trace us -> seconds
+    return stats
+
+
+_UNIT = {"s": 1.0, "ms": 1e3, "us": 1e6}
+
+
+def _sort_key(sorted_by) -> str:
+    name = getattr(sorted_by, "name", str(sorted_by or "CPUTotal"))
+    for suffix in ("Total", "Avg", "Max", "Min"):
+        if name.endswith(suffix):
+            return suffix.lower() if suffix != "Total" else "total"
+    return "total"
+
+
+def summary_table(stats: Dict[str, OpStat], title: str,
+                  sorted_by=None, time_unit: str = "ms",
+                  top: Optional[int] = None) -> str:
+    """Render one sortable stats table (the reference's ``_build_table``)."""
+    scale = _UNIT.get(time_unit, 1e3)
+    key = _sort_key(sorted_by)
+    rows = sorted(stats.values(), key=lambda s: getattr(s, key),
+                  reverse=True)
+    if top:
+        rows = rows[:top]
+    grand = sum(s.total for s in stats.values()) or 1.0
+    name_w = max([len(s.name[:48]) for s in rows] + [len("Name"), 4])
+    header = (f"{'Name':{name_w}s} {'Calls':>7s} "
+              f"{'Total(' + time_unit + ')':>12s} "
+              f"{'Avg(' + time_unit + ')':>12s} "
+              f"{'Max(' + time_unit + ')':>12s} "
+              f"{'Min(' + time_unit + ')':>12s} {'Ratio(%)':>9s}")
+    bar = "-" * len(header)
+    lines = [bar, title, bar, header, bar]
+    for s in rows:
+        lines.append(
+            f"{s.name[:48]:{name_w}s} {s.calls:7d} "
+            f"{s.total * scale:12.4f} {s.avg * scale:12.4f} "
+            f"{s.max * scale:12.4f} "
+            f"{(0.0 if s.min == float('inf') else s.min) * scale:12.4f} "
+            f"{100.0 * s.total / grand:9.2f}")
+    lines.append(bar)
+    return "\n".join(lines)
+
+
+def build_summary(host_stats: Dict[str, OpStat], log_dir: str,
+                  step_times: List[float], sorted_by=None,
+                  op_detail: bool = True, time_unit: str = "ms") -> str:
+    parts = []
+    if step_times:
+        scale = _UNIT.get(time_unit, 1e3)
+        n = len(step_times)
+        parts.append(
+            f"steps: {n}, avg {sum(step_times) / n * scale:.3f} "
+            f"{time_unit}/step, min "
+            f"{min(step_times) * scale:.3f}, max "
+            f"{max(step_times) * scale:.3f}")
+    if op_detail and host_stats:
+        parts.append(summary_table(
+            host_stats, "Host operator summary (eager dispatch wall time)",
+            sorted_by, time_unit))
+    dev = collect_device_stats(log_dir)
+    if op_detail and dev:
+        parts.append(summary_table(
+            dev, "Device operator summary (trace device lanes)",
+            sorted_by, time_unit, top=30))
+    return "\n\n".join(parts) if parts else "no profiling data recorded"

@@ -13,14 +13,14 @@ def _ensure(x):
     return x if isinstance(x, Tensor) else to_tensor(x)
 
 
-def _binary(name, fn):
+def _binary(opname, fn):
     def op(x, y, name=None):
         x = _ensure(x)
         if isinstance(y, Tensor):
-            return run_op(name, fn, x, y)
-        return run_op(name, lambda a: fn(a, y), x)
+            return run_op(opname, fn, x, y)
+        return run_op(opname, lambda a: fn(a, y), x)
 
-    op.__name__ = name
+    op.__name__ = opname
     return op
 
 

@@ -35,22 +35,24 @@ def _ensure(x):
 
 # --- generic builders -----------------------------------------------------
 
-def _unary(name, fn):
+def _unary(opname, fn):
+    # the paddle-API ``name=`` kwarg must not shadow the dispatch name
+    # (it silently made every unary op anonymous in logs/Programs)
     def op(x, name=None):
-        return run_op(name, fn, _ensure(x))
+        return run_op(opname, fn, _ensure(x))
 
-    op.__name__ = name
+    op.__name__ = opname
     return op
 
 
-def _binary(name, fn):
+def _binary(opname, fn):
     def op(x, y, name=None):
         x = _ensure(x)
         if isinstance(y, Tensor):
-            return run_op(name, fn, x, y)
-        return run_op(name, lambda a: fn(a, y), x)
+            return run_op(opname, fn, x, y)
+        return run_op(opname, lambda a: fn(a, y), x)
 
-    op.__name__ = name
+    op.__name__ = opname
     return op
 
 

@@ -93,6 +93,20 @@ def notify_sync(tensor, kind: str, value=None):
             cb(tensor, kind, value)
 
 
+def notify_inplace(tensor, kind: str, recompute_fn=None):
+    """``tensor`` was mutated in place OUTSIDE op dispatch (``set_value``/
+    ``fill_``/``zero_``/``copy_``).  ``recompute_fn`` is a pure
+    ``old_value -> new_value`` function when the mutation is a
+    deterministic function of the tensor itself (``fill_``/``zero_`` —
+    replayable); ``None`` when it depends on untracked host data
+    (``set_value``/``copy_`` — a recorded trace must loudly reject it
+    rather than replay a stale value)."""
+    if _op_observer is not None:
+        cb = getattr(_op_observer, "on_inplace", None)
+        if cb is not None:
+            cb(tensor, kind, recompute_fn)
+
+
 def notify_backward():
     """The eager autograd engine is about to run (linear-trace recording
     cannot represent tape closures — the recorder gives up)."""

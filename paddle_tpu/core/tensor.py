@@ -312,6 +312,12 @@ class Tensor:
                 f"set_value shape mismatch: {value.shape} vs {self._value.shape}"
             )
         self._value = value.astype(self._value.dtype)
+        # rebind-style observer event: the new value came from OUTSIDE op
+        # dispatch, so a partial-graph trace recorder must reject the trace
+        # (a replay would silently reuse this call's data)
+        from .dispatch import notify_inplace
+
+        notify_inplace(self, "set_value", None)
         self._write_back_if_view()
 
     def copy_(self, other, blocking=True):
@@ -320,11 +326,19 @@ class Tensor:
 
     def fill_(self, v):
         self._value = jnp.full_like(self._value, v)
+        from .dispatch import notify_inplace
+
+        # replayable: new value is a pure function of the old (v is a
+        # baked constant, like any non-tensor op argument)
+        notify_inplace(self, "fill_", lambda x: jnp.full_like(x, v))
         self._write_back_if_view()
         return self
 
     def zero_(self):
         self._value = jnp.zeros_like(self._value)
+        from .dispatch import notify_inplace
+
+        notify_inplace(self, "zero_", jnp.zeros_like)
         self._write_back_if_view()
         return self
 
@@ -425,13 +439,16 @@ def _unwrap_index(idx):
 def _is_basic_index(idx) -> bool:
     """True for int/slice/Ellipsis/None (tuples thereof) — the indexing
     forms the reference serves as zero-copy stride VIEWS.  Array/bool
-    indices are gather copies there too (bool subclasses int: reject it
-    explicitly)."""
+    indices are gather copies there too (bool subclasses int in BOTH
+    type systems: reject it explicitly).  ``np.integer`` counts as int
+    so ``x[np.int64(0)]`` is a write-back view like ``x[0]``, not a
+    silent copy."""
     if isinstance(idx, tuple):
         return all(_is_basic_index(i) for i in idx)
-    if isinstance(idx, bool):
+    if isinstance(idx, (bool, np.bool_)):
         return False
-    return idx is None or idx is Ellipsis or isinstance(idx, (int, slice))
+    return (idx is None or idx is Ellipsis
+            or isinstance(idx, (int, np.integer, slice)))
 
 
 def wrap_result(out, stop_gradient: bool, node=None):

@@ -129,12 +129,19 @@ class LLMPredictor:
     (``block_multi_head_attention_kernel.cu``): requests join/leave the
     batch between steps, every sequence's KV lives in shared fixed-size
     pages, and one compiled decode program serves any batch composition
-    (routing arrays are data, not shapes)."""
+    (routing arrays are data, not shapes).
+
+    This is the *caller-scheduled* surface: ``add_request``/``step`` run
+    exactly what they are told.  The machinery underneath — block pool,
+    bucketed fixed-shape jitted prefill/decode programs — is
+    :class:`paddle_tpu.serving.EngineCore`; use that (or
+    ``paddle_tpu.serving.LLM``) directly for engine-scheduled serving
+    with admission control, preemption, and streaming."""
 
     def __init__(self, model, num_blocks: Optional[int] = None,
                  block_size: Optional[int] = None, dtype=jnp.float32,
                  config: Optional[Config] = None):
-        from ..ops.paged_attention import PagedCache
+        from ..serving import EngineCore, SchedulerConfig
 
         # serving knobs resolve Config < explicit args < defaults
         if config is not None:
@@ -146,126 +153,56 @@ class LLMPredictor:
         num_blocks = num_blocks or 256
         block_size = block_size or 16
         self.model = model
-        cfg = model.config
         self.block_size = block_size
         self.num_blocks = num_blocks
-        self._free = list(range(num_blocks - 1, 0, -1))  # 0 = null page
-        self._tables: Dict[int, List[int]] = {}
-        self._lens: Dict[int, int] = {}
-        self._last_tok: Dict[int, int] = {}
-        self._done: Dict[int, List[int]] = {}
-        self.caches = [
-            PagedCache(
-                Tensor(jnp.zeros((num_blocks, block_size,
-                                  cfg.num_key_value_heads, cfg.head_dim),
-                                 dtype)),
-                Tensor(jnp.zeros((num_blocks, block_size,
-                                  cfg.num_key_value_heads, cfg.head_dim),
-                                 dtype)))
-            for _ in range(cfg.num_hidden_layers)
-        ]
-        model.eval()
+        self.engine = EngineCore(
+            model, num_blocks=num_blocks, block_size=block_size,
+            dtype=dtype,
+            scheduler_config=SchedulerConfig(
+                max_num_seqs=self.max_batch_size))
 
-    # --- block bookkeeping --------------------------------------------------
-    def _alloc_slot(self, seq_id: int) -> None:
-        table = self._tables.setdefault(seq_id, [])
-        pos = self._lens.get(seq_id, 0)
-        if pos // self.block_size >= len(table):
-            if not self._free:
-                raise RuntimeError("KV block pool exhausted")
-            table.append(self._free.pop())
+    # --- engine views (predictor-era introspection surface) -----------------
+    @property
+    def _free(self):
+        return self.engine.kv._free
+
+    @property
+    def _tables(self):
+        return self.engine.kv._tables
+
+    @property
+    def _done(self) -> Dict[int, List[int]]:
+        return {rid: r.output_tokens
+                for rid, r in self.engine.requests.items()}
 
     def free(self, seq_id: int):
-        for b in self._tables.pop(seq_id, []):
-            self._free.append(b)
-        self._lens.pop(seq_id, None)
-        self._last_tok.pop(seq_id, None)
-        self._done.pop(seq_id, None)
+        self.engine.release(seq_id)
 
     # --- serving ------------------------------------------------------------
     def add_request(self, seq_id: int, input_ids: np.ndarray):
-        """Prefill one sequence: dense-cache forward (compiled once per
-        prompt length), then migrate its K/V into pages."""
-        from .. import no_grad
+        """Prefill one sequence through the engine's bucketed prefill
+        program and return its first greedy token."""
+        from ..serving import Request, SamplingParams
 
-        ids = np.asarray(input_ids, np.int64).reshape(1, -1)
-        T0 = ids.shape[1]
-        cfg = self.model.config
-        dense = [
-            (Tensor(jnp.zeros((1, T0, cfg.num_key_value_heads, cfg.head_dim),
-                              jnp.float32)),
-             Tensor(jnp.zeros((1, T0, cfg.num_key_value_heads, cfg.head_dim),
-                              jnp.float32)))
-            for _ in range(cfg.num_hidden_layers)
-        ]
-        with no_grad():
-            logits = self.model(to_tensor(ids), caches=dense,
-                                pos=to_tensor(0, dtype="int32"))
-        # migrate each layer's [1, T0, Hkv, D] into this sequence's pages
-        for t in range(T0):
-            self._alloc_slot(seq_id)
-            self._lens[seq_id] = self._lens.get(seq_id, 0) + 1
-        table = self._tables[seq_id]
-        pos = np.arange(T0)
-        blocks = np.asarray([table[p // self.block_size] for p in pos])
-        offs = pos % self.block_size
-        for cache, (kb, vb) in zip(self.caches, dense):
-            cache.k_pool._value = cache.k_pool._value.at[blocks, offs].set(
-                kb._value[0].astype(cache.k_pool._value.dtype))
-            cache.v_pool._value = cache.v_pool._value.at[blocks, offs].set(
-                vb._value[0].astype(cache.v_pool._value.dtype))
-        tok = int(np.asarray(logits.numpy())[0, -1].argmax(-1))
-        self._last_tok[seq_id] = tok
-        self._done[seq_id] = [tok]
-        return tok
+        ids = np.asarray(input_ids, np.int64).reshape(-1)
+        req = Request(prompt_ids=list(ids),
+                      sampling=SamplingParams(max_new_tokens=2 ** 30,
+                                              temperature=0.0),
+                      request_id=seq_id)
+        self.engine.requests[seq_id] = req
+        return self.engine.prefill_now(req)
 
     def step(self, seq_ids: Optional[Sequence[int]] = None) -> Dict[int, int]:
         """One batched greedy decode step for the active sequences."""
-        from .. import no_grad
-
-        active = list(seq_ids if seq_ids is not None else self._tables)
+        active = list(seq_ids if seq_ids is not None
+                      else self.engine.kv._tables)
         if not active:
             return {}
-        if len(active) > self.max_batch_size:
+        result: Dict[int, int] = {}
+        for i in range(0, len(active), self.max_batch_size):
             # decode in max_batch_size chunks (the Config knob's contract)
-            result = {}
-            for i in range(0, len(active), self.max_batch_size):
-                result.update(self.step(active[i:i + self.max_batch_size]))
-            return result
-        B = len(active)
-        # allocate this step's slot per sequence + build routing arrays
-        max_blocks = 0
-        slot_blocks, slot_offsets, lens, toks, poss = [], [], [], [], []
-        for s in active:
-            self._alloc_slot(s)
-            p = self._lens[s]
-            t = self._tables[s]
-            slot_blocks.append(t[p // self.block_size])
-            slot_offsets.append(p % self.block_size)
-            lens.append(p + 1)            # cache length AFTER this token
-            poss.append(p)                # rope position of this token
-            toks.append(self._last_tok[s])
-            max_blocks = max(max_blocks, len(t))
-        tables = np.zeros((B, max_blocks), np.int32)
-        for i, s in enumerate(active):
-            t = self._tables[s]
-            tables[i, :len(t)] = t
-        for cache in self.caches:
-            cache.route(tables, np.asarray(lens, np.int32),
-                        np.asarray(slot_blocks, np.int32),
-                        np.asarray(slot_offsets, np.int32))
-        ids = np.asarray(toks, np.int64).reshape(B, 1)
-        with no_grad():
-            logits = self.model(to_tensor(ids), caches=self.caches,
-                                pos=to_tensor(np.asarray(poss, np.int32)))
-        out = np.asarray(logits.numpy())[:, -1].argmax(-1)
-        result = {}
-        for i, s in enumerate(active):
-            self._lens[s] += 1
-            tok = int(out[i])
-            self._last_tok[s] = tok
-            self._done[s].append(tok)
-            result[s] = tok
+            result.update(
+                self.engine.decode_ids(active[i:i + self.max_batch_size]))
         return result
 
     def generate(self, seq_id: int, input_ids, max_new_tokens: int = 16):

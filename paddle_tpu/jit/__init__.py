@@ -14,6 +14,8 @@ import pickle
 from typing import Any, List, Optional
 
 import jax
+import jax.export  # binds the submodule: jax<0.6 gates the attr behind a
+                   # deprecation __getattr__ that raises at access time
 import numpy as np
 
 from ..core.tensor import Tensor

@@ -106,6 +106,7 @@ class TraceRecorder:
         # dispatch (host-computed results like nonzero/masked_select,
         # to_tensor literals, np.random data) cannot be replayed soundly
         self.start_ctr = tensor_mod._n_created
+        self._syn_id = -1  # synthetic ids for in-place recompute results
         for t in arg_tensors:
             self.tensors[id(t)] = t
 
@@ -173,6 +174,28 @@ class TraceRecorder:
             return
         tid = self._touch_input(tensor)
         self.events.append(_Sync(tid, kind, value))
+
+    def on_inplace(self, tensor, kind, recompute_fn):
+        """An in-place mutation that bypassed op dispatch (set_value/
+        fill_/zero_/copy_, ``dispatch.notify_inplace``).  Replayable
+        mutations (``recompute_fn`` is a pure old->new function) are
+        recorded as an op + alias pair, exactly like a rebind; untracked
+        ones (host data in set_value/copy_) kill the trace LOUDLY instead
+        of replaying a silently stale value."""
+        if recompute_fn is None:
+            self._die(f"{kind}() mutated a Tensor with untracked host "
+                      "data during recording (a replay would reuse this "
+                      "call's value)")
+            return
+        tid = self._touch_input(tensor)
+        sid = self._syn_id
+        self._syn_id -= 1
+        self.events.append(_Op(kind, recompute_fn, [tid], [None],
+                               {}, {}, [sid]))
+        self.produced.add(sid)
+        self.produced.add(tid)
+        self.mutated[tid] = tensor
+        self.events.append(_Alias(tid, sid))
 
     def on_backward(self):
         self._die("the autograd tape ran (eager backward closures "
@@ -350,7 +373,10 @@ class LinearTrace:
         def _rebuild(obj):
             if isinstance(obj, tuple) and len(obj) == 3 \
                     and obj[0] == "__tensor__":
-                return Tensor(env[obj[1]], stop_gradient=obj[2])
+                # stop_gradient=True unconditionally (belt to record_call's
+                # differentiable-return rejection): a replayed tensor has
+                # no grad node, and the flag must say so
+                return Tensor(env[obj[1]], stop_gradient=True)
             if isinstance(obj, (list, tuple)):
                 return type(obj)(_rebuild(o) for o in obj)
             if isinstance(obj, dict):
@@ -421,6 +447,17 @@ def record_call(fn, args, kwargs, arg_tensors):
             if id(t) not in rec.produced and t._ctr > rec.start_ctr:
                 rec.dead = ("a Tensor created outside op dispatch is "
                             "returned from the function")
+                break
+            if not t.stop_gradient:
+                # a replayed result has no grad node — handing it to a
+                # later backward() would silently train nothing.  Reject
+                # at record time so the function stays eager (and
+                # differentiable) instead of silently killing training.
+                rec.dead = ("the function returns a differentiable Tensor "
+                            "(stop_gradient=False); replayed results "
+                            "detach from the autograd tape, which would "
+                            "silently break a later backward() — run "
+                            "eagerly, or wrap the call in no_grad()")
                 break
     if rec.dead is not None:
         return result, None, rec.dead

@@ -23,9 +23,101 @@ import jax.numpy as jnp
 import numpy as np
 
 
+class PoolExhausted(RuntimeError):
+    """The shared KV block pool has no free block for the request.
+
+    A *typed* RuntimeError so serving layers can catch it and degrade
+    gracefully (preempt + recompute, ``serving/kv_manager.py``) instead of
+    failing the request."""
+
+
+class BlockPool:
+    """Refcounted block-pool bookkeeping (no device tensors) — the ONE
+    implementation of the free-list / refcount / fork invariants, shared
+    by :class:`BlockKVCache` (op layer) and the serving layer's
+    :class:`~paddle_tpu.serving.KVCacheManager`.  Block 0 is the reserved
+    null page that padding rows of a bucketed batch write into."""
+
+    def __init__(self, num_blocks: int, block_size: int):
+        if num_blocks < 2:
+            raise ValueError("need at least 2 blocks (block 0 is the null page)")
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        self._free: list = list(range(num_blocks - 1, 0, -1))
+        self._ref: dict = {}     # block -> owner count (shared prefixes)
+        self._tables: dict = {}  # seq_id -> list[int]
+        self._lens: dict = {}    # seq_id -> int
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    def blocks_for(self, num_tokens: int) -> int:
+        return -(-num_tokens // self.block_size)
+
+    def blocks_needed(self, seq_id, num_tokens: int) -> int:
+        cur = self._lens.get(seq_id, 0)
+        held = len(self._tables.get(seq_id, ()))
+        return max(0, self.blocks_for(cur + num_tokens) - held)
+
+    def can_allocate(self, seq_id, num_tokens: int) -> bool:
+        return self.blocks_needed(seq_id, num_tokens) <= len(self._free)
+
+    def allocate(self, seq_id, num_tokens: int) -> bool:
+        """All-or-nothing reservation of blocks for ``num_tokens`` more
+        tokens; returns False (taking nothing) when the pool can't cover
+        it, so the state stays clean for the caller's preemption/retry."""
+        need = self.blocks_needed(seq_id, num_tokens)
+        if need > len(self._free):
+            return False
+        table = self._tables.setdefault(seq_id, [])
+        for _ in range(need):
+            b = self._free.pop()
+            self._ref[b] = 1
+            table.append(b)
+        return True
+
+    def fork(self, src_seq, dst_seq) -> int:
+        """Share ``src_seq``'s FULL blocks with ``dst_seq`` (refcount++, no
+        copy).  Only whole blocks are shared — appends always land in
+        blocks the destination owns alone, so no copy-on-write is ever
+        needed.  Returns the number of tokens ``dst_seq`` starts with."""
+        if dst_seq in self._tables:
+            raise ValueError(f"fork target seq {dst_seq!r} already exists")
+        n_full = self._lens.get(src_seq, 0) // self.block_size
+        shared = self._tables.get(src_seq, [])[:n_full]
+        for b in shared:
+            self._ref[b] = self._ref.get(b, 1) + 1
+        self._tables[dst_seq] = list(shared)
+        self._lens[dst_seq] = n_full * self.block_size
+        return n_full * self.block_size
+
+    def free(self, seq_id) -> int:
+        """Release the sequence; returns how many blocks went back to the
+        pool (shared blocks stay out until their last owner frees)."""
+        returned = 0
+        for b in self._tables.pop(seq_id, []):
+            n = self._ref.get(b, 1) - 1
+            if n <= 0:
+                self._ref.pop(b, None)
+                self._free.append(b)
+                returned += 1
+            else:
+                self._ref[b] = n
+        self._lens.pop(seq_id, None)
+        return returned
+
+
 class BlockKVCache:
     """Host-side block-pool manager (BlockTable bookkeeping is python; the
-    cache tensors live on device)."""
+    cache tensors live on device).
+
+    Blocks are reference-counted so sequences can share a prefix without
+    copying (``fork``): a shared block returns to the free list only when
+    its last owner frees it — the copy-on-write-free reuse hook the
+    serving layer's :class:`~paddle_tpu.serving.KVCacheManager` builds on.
+    Bookkeeping is delegated to one shared :class:`BlockPool`; the public
+    ``block_tables``/``seq_lens``/``_free`` attributes alias its state."""
 
     def __init__(self, num_blocks: int, block_size: int, num_heads: int,
                  head_dim: int, dtype=jnp.bfloat16):
@@ -33,25 +125,44 @@ class BlockKVCache:
         self.block_size = block_size
         self.k_cache = jnp.zeros((num_blocks, block_size, num_heads, head_dim), dtype)
         self.v_cache = jnp.zeros((num_blocks, block_size, num_heads, head_dim), dtype)
-        self._free = list(range(num_blocks - 1, 0, -1))  # block 0 = null page
-        self.block_tables = {}  # seq_id -> list[int]
-        self.seq_lens = {}      # seq_id -> int
+        self._pool = BlockPool(num_blocks, block_size)
+        self._free = self._pool._free        # same objects, mutated in place
+        self._ref = self._pool._ref
+        self.block_tables = self._pool._tables
+        self.seq_lens = self._pool._lens
+
+    def blocks_needed(self, seq_id: int, num_tokens: int) -> int:
+        return self._pool.blocks_needed(seq_id, num_tokens)
+
+    def can_allocate(self, seq_id: int, num_tokens: int) -> bool:
+        return self._pool.can_allocate(seq_id, num_tokens)
 
     def allocate(self, seq_id: int, num_tokens: int):
-        """Reserve enough blocks for ``num_tokens`` more tokens."""
-        table = self.block_tables.setdefault(seq_id, [])
-        cur = self.seq_lens.get(seq_id, 0)
-        need = -(-(cur + num_tokens) // self.block_size) - len(table)
-        for _ in range(need):
-            if not self._free:
-                raise RuntimeError("KV cache pool exhausted")
-            table.append(self._free.pop())
-        return table
+        """Reserve enough blocks for ``num_tokens`` more tokens.
+
+        All-or-nothing: on exhaustion raises :class:`PoolExhausted`
+        WITHOUT having taken any block, so the pool state stays clean for
+        the caller's preemption/retry policy (``try_allocate`` is the
+        non-raising form)."""
+        if not self._pool.allocate(seq_id, num_tokens):
+            raise PoolExhausted(
+                f"KV cache pool exhausted: seq {seq_id} needs "
+                f"{self._pool.blocks_needed(seq_id, num_tokens)} block(s), "
+                f"{len(self._free)} free — free or preempt a sequence and "
+                "retry")
+        return self.block_tables[seq_id]
+
+    def try_allocate(self, seq_id: int, num_tokens: int):
+        """``allocate`` returning ``None`` instead of raising on exhaustion."""
+        if not self._pool.allocate(seq_id, num_tokens):
+            return None
+        return self.block_tables[seq_id]
+
+    def fork(self, src_seq: int, dst_seq: int) -> int:
+        return self._pool.fork(src_seq, dst_seq)
 
     def free(self, seq_id: int):
-        for b in self.block_tables.pop(seq_id, []):
-            self._free.append(b)
-        self.seq_lens.pop(seq_id, None)
+        self._pool.free(seq_id)
 
     def write(self, seq_id: int, k: jax.Array, v: jax.Array):
         """Append [T, H, D] keys/values for one sequence."""

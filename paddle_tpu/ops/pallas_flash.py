@@ -25,6 +25,8 @@ from __future__ import annotations
 import functools
 import math
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
@@ -34,7 +36,7 @@ from .pallas_x32 import no_x64
 
 DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128
-_NEG_INF = -1e30  # large-negative instead of -inf: keeps masked rows finite
+_NEG_INF = np.float32(-1e30)  # large-negative instead of -inf: keeps masked rows finite
 
 _LANES = 128  # stats are kept (BQ, 128) — min f32 tile is (8, 128)
 
@@ -92,7 +94,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
     @pl.when(ki == n_k - 1)
     def _finish():
         l = l_ref[:, :1]
-        safe_l = jnp.where(l == 0.0, 1.0, l)
+        safe_l = jnp.where(l == 0.0, jnp.float32(1.0), l)
         o_ref[0, 0, :, :] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
         lse_ref[0, 0, :, 0] = (m_ref[:, 0] + jnp.log(safe_l[:, 0]))
 
@@ -122,9 +124,9 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
             in_specs=[
                 pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0)),
                 pl.BlockSpec((1, 1, block_k, D),
-                             lambda b, h, i, j: (b, h // group, j, 0)),
+                             lambda b, h, i, j: (b, h // np.int32(group), j, 0)),
                 pl.BlockSpec((1, 1, block_k, D),
-                             lambda b, h, i, j: (b, h // group, j, 0)),
+                             lambda b, h, i, j: (b, h // np.int32(group), j, 0)),
             ],
             out_specs=[
                 pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0)),
@@ -263,7 +265,7 @@ def _flash_bwd(res, g, *, scale, causal, block_q, block_k):
 
     q_spec = pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0))
     k_spec = pl.BlockSpec((1, 1, block_k, D),
-                          lambda b, h, i, j: (b, h // group, j, 0))
+                          lambda b, h, i, j: (b, h // np.int32(group), j, 0))
     r_spec = pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, j: (b, h, i, 0))
 
     with no_x64():
@@ -323,19 +325,19 @@ def flash_attention(q, k, v, causal=False,
     from HBM once per group, never materialised repeated."""
     assert q.shape[2] % k.shape[2] == 0, (
         f"query heads {q.shape[2]} not divisible by kv heads {k.shape[2]}")
-    scale = 1.0 / math.sqrt(q.shape[-1])
+    scale = np.float32(1.0 / math.sqrt(q.shape[-1]))
     out, _ = _flash_fwd(q, k, v, scale, causal, block_q, block_k)
     return out
 
 
 def _vjp_fwd(q, k, v, causal, block_q, block_k):
-    scale = 1.0 / math.sqrt(q.shape[-1])
+    scale = np.float32(1.0 / math.sqrt(q.shape[-1]))
     out, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k)
     return out, (q, k, v, out, lse)
 
 
 def _vjp_bwd(causal, block_q, block_k, res, g):
-    scale = 1.0 / math.sqrt(res[0].shape[-1])
+    scale = np.float32(1.0 / math.sqrt(res[0].shape[-1]))
     return _flash_bwd(res, g, scale=scale, causal=causal,
                       block_q=block_q, block_k=block_k)
 

@@ -1,0 +1,30 @@
+"""jax version-compat shims for SPMD code.
+
+One home for the ``shard_map`` import dance and the ``lax.axis_size``
+polyfill so their users (pipeline, pipeline_1f1b, ring_attention,
+distributed.collective) cannot drift when jax moves the APIs again —
+and so paddle_tpu never monkeypatches the global ``jax`` namespace.
+"""
+
+import jax
+
+try:
+    from jax import shard_map
+except ImportError:  # jax<0.6: experimental namespace + check_rep kwarg
+    from jax.experimental.shard_map import shard_map as _shard_map_legacy
+
+    def shard_map(*args, **kwargs):
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _shard_map_legacy(*args, **kwargs)
+
+
+if hasattr(jax.lax, "axis_size"):
+    lax_axis_size = jax.lax.axis_size
+else:
+    def lax_axis_size(axis_name):
+        # jax<0.6: the classic psum-of-1 idiom (constant-folds to a
+        # static int inside shard_map/pmap bodies)
+        return jax.lax.psum(1, axis_name)
+
+__all__ = ["shard_map", "lax_axis_size"]

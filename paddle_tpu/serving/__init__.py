@@ -1,0 +1,36 @@
+"""``paddle_tpu.serving`` — request-level continuous-batching engine.
+
+The serving subsystem VERDICT N31 asked for, layered over the existing
+paged-attention ops and predictor API:
+
+* :class:`EngineCore` (``engine.py``) — request queue, bucketed
+  fixed-shape jitted prefill/decode programs, streaming, abort.
+* :class:`ContinuousBatchingScheduler` (``scheduler.py``) — admission
+  control + decode-slot reservation with preemption-and-recompute.
+* :class:`KVCacheManager` (``kv_manager.py``) — refcounted paged block
+  pool bookkeeping shared by all layers.
+* :class:`ServingMetrics` (``metrics.py``) — TTFT / inter-token latency,
+  queue/pool gauges, preemption counters, profiler-style ``summary()``.
+* :class:`LLM` / :func:`stream_generate` (``entrypoints.py``) — batch and
+  streaming user surfaces.
+
+Architecture sketch and scheduler invariants: see ``scheduler.py``'s
+module docstring and the README's serving section.
+"""
+
+from .engine import EngineCore  # noqa: F401
+from .entrypoints import LLM, CompletionOutput, stream_generate  # noqa: F401
+from .kv_manager import KVCacheManager, PoolExhausted  # noqa: F401
+from .metrics import ServingMetrics  # noqa: F401
+from .request import (  # noqa: F401
+    FinishReason,
+    Request,
+    RequestState,
+    SamplingParams,
+)
+from .scheduler import (  # noqa: F401
+    ContinuousBatchingScheduler,
+    SchedulerConfig,
+    SchedulerOutput,
+    bucket_size,
+)

@@ -1,0 +1,386 @@
+"""EngineCore: request-level continuous-batching serving engine.
+
+The piece VERDICT N31 called missing: above ``ops/paged_attention.py``
+(block pool) and ``inference.LLMPredictor`` (single-call API) sits an
+engine that owns a request queue, admission control, preemption, and a
+**fixed-shape** jitted step program — the Ragged-Paged-Attention serving
+shape (PAPERS.md) with MPK's compile-once discipline:
+
+* All sequences share ONE paged KV pool per layer
+  (``[num_blocks, block_size, Hkv, D]``); per-step routing arrays (block
+  tables, lengths, slot indices) are DATA, so joining/leaving requests
+  never change a tensor shape.
+* Batch size and block-table width are padded to power-of-two buckets
+  (``scheduler.bucket_size``), so the jitted decode step compiles at most
+  once per (batch-bucket, width-bucket) pair and the jitted prefill at
+  most once per prompt-length bucket — never per request.  ``
+  decode_trace_count``/``prefill_trace_count`` count actual retraces
+  (incremented inside the traced function, so they move only when JAX
+  really traces) and are asserted against the bucket sets in tests.
+* Pool exhaustion preempts (lowest priority, newest arrival first) and
+  recomputes instead of failing the request: the victim's blocks are
+  freed, it re-enqueues at the front of the waiting queue, and its next
+  prefill runs over ``prompt + output_tokens`` — token-identical
+  continuation under greedy decoding (tested).
+* Padding rows of a bucketed batch write into block 0, the reserved null
+  page, and carry ``seq_len = 1`` so every attention path stays finite.
+
+The model runs *functionally* inside the jitted step: parameters and KV
+pools enter as jit arguments (swapped into the eager module for the trace,
+restored after), updated pools return as outputs.  On TPU the pool
+arguments are donated, so the decode step updates KV in place in HBM.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Iterator, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..ops.paged_attention import PagedCache, PoolExhausted
+from .kv_manager import KVCacheManager
+from .metrics import ServingMetrics, StepTimer
+from .request import FinishReason, Request, RequestState, SamplingParams
+from .scheduler import (
+    ContinuousBatchingScheduler,
+    SchedulerConfig,
+    bucket_size,
+)
+
+
+class EngineCore:
+    """Continuous-batching engine over one causal-LM model.
+
+    High-level loop: ``add_request`` enqueues; each ``step()`` asks the
+    scheduler for a plan (decode-slot reservation with preemption, then
+    admission), runs at most one bucketed prefill program and one bucketed
+    decode program, samples on the host with each request's own RNG
+    stream, and retires finished requests.  ``stream()`` exposes a
+    per-request generator that drives ``step()`` on demand.
+    """
+
+    def __init__(self, model, num_blocks: int = 256, block_size: int = 16,
+                 dtype=jnp.float32, scheduler_config: Optional[SchedulerConfig] = None,
+                 profile_ops: bool = False):
+        cfg = model.config
+        self.model = model
+        self.kv = KVCacheManager(num_blocks, block_size)
+        self.block_size = block_size
+        self.num_blocks = num_blocks
+        self.scheduler = ContinuousBatchingScheduler(
+            scheduler_config or SchedulerConfig(), self.kv)
+        self.metrics = ServingMetrics()
+        self.requests: Dict[object, Request] = {}
+        self._pool_dtype = jnp.dtype(dtype)
+        shape = (num_blocks, block_size, cfg.num_key_value_heads, cfg.head_dim)
+        self._k_pools = tuple(jnp.zeros(shape, dtype)
+                              for _ in range(cfg.num_hidden_layers))
+        self._v_pools = tuple(jnp.zeros(shape, dtype)
+                              for _ in range(cfg.num_hidden_layers))
+        self._params = list(model.parameters())
+        # retrace counters: += 1 runs only while JAX traces the function,
+        # so these count COMPILATIONS, not calls (the N31 acceptance hook)
+        self.decode_trace_count = 0
+        self.prefill_trace_count = 0
+        self.decode_buckets = set()
+        self.prefill_buckets = set()
+        donate = (1, 2) if jax.default_backend() == "tpu" else ()
+        self._jit_decode = jax.jit(self._decode_fn, donate_argnums=donate)
+        self._jit_prefill = jax.jit(self._prefill_fn, donate_argnums=donate)
+        self._profile_ops = profile_ops
+        model.eval()
+
+    # --- functional model step (traced) ------------------------------------
+    def _call_model(self, ids_val, caches, pos_val, param_vals):
+        """Run the eager module under the current trace with parameters
+        swapped to the traced ``param_vals`` (and restored after) — the
+        same rebinding trick as ``train_batch_1f1b``'s head_apply, so the
+        jitted step threads weights as arguments instead of baking them
+        in as constants."""
+        from .. import no_grad
+
+        saved = [p._value for p in self._params]
+        for p, v in zip(self._params, param_vals):
+            p._value = v
+        try:
+            with no_grad():
+                out = self.model(Tensor(ids_val), caches=caches,
+                                 pos=Tensor(pos_val))
+            return out._value
+        finally:
+            for p, v in zip(self._params, saved):
+                p._value = v
+
+    def _decode_fn(self, param_vals, k_pools, v_pools, ids, pos,
+                   tables, lens, slot_blocks, slot_offsets):
+        """One batched decode step: write each sequence's token KV into
+        its (block, offset) slot, attend through the block tables, return
+        last-position logits + updated pools.  Shapes fixed per bucket."""
+        self.decode_trace_count += 1
+        caches = []
+        for k, v in zip(k_pools, v_pools):
+            c = PagedCache(Tensor(k), Tensor(v))
+            c.route(tables, lens, slot_blocks, slot_offsets)
+            caches.append(c)
+        logits = self._call_model(ids, caches, pos, param_vals)
+        return (logits[:, -1, :].astype(jnp.float32),
+                tuple(c.k_pool._value for c in caches),
+                tuple(c.v_pool._value for c in caches))
+
+    def _prefill_fn(self, param_vals, k_pools, v_pools, ids, last_pos,
+                    blocks, offs):
+        """Bucketed prefill: dense-cache forward over the (padded) prompt,
+        then scatter every layer's K/V into the sequence's pages.  Pad
+        positions scatter into block 0 (the null page).  Returns the
+        logits row of the LAST REAL token + updated pools."""
+        self.prefill_trace_count += 1
+        cfg = self.model.config
+        Tb = ids.shape[1]
+        dense = [
+            (Tensor(jnp.zeros((1, Tb, cfg.num_key_value_heads, cfg.head_dim),
+                              self._pool_dtype)),
+             Tensor(jnp.zeros((1, Tb, cfg.num_key_value_heads, cfg.head_dim),
+                              self._pool_dtype)))
+            for _ in range(cfg.num_hidden_layers)
+        ]
+        logits = self._call_model(ids, dense, jnp.int32(0), param_vals)
+        last = jnp.take(logits[0], last_pos, axis=0).astype(jnp.float32)
+        new_k = tuple(
+            kp.at[blocks, offs].set(kb._value[0].astype(kp.dtype))
+            for kp, (kb, _) in zip(k_pools, dense))
+        new_v = tuple(
+            vp.at[blocks, offs].set(vb._value[0].astype(vp.dtype))
+            for vp, (_, vb) in zip(v_pools, dense))
+        return last, new_k, new_v
+
+    # --- request lifecycle --------------------------------------------------
+    def add_request(self, prompt_ids, sampling: Optional[SamplingParams] = None,
+                    request_id=None, priority: int = 0) -> Request:
+        """Enqueue a request (admission happens inside ``step``)."""
+        req = Request(prompt_ids=list(np.asarray(prompt_ids).reshape(-1)),
+                      sampling=sampling or SamplingParams(),
+                      request_id=request_id, priority=priority)
+        if req.request_id in self.requests:
+            raise ValueError(f"request id {req.request_id!r} already exists")
+        req.arrival_time = time.perf_counter()
+        self.requests[req.request_id] = req
+        self.scheduler.add(req)
+        self.metrics.count("requests_admitted")
+        return req
+
+    def abort_request(self, request_id) -> bool:
+        """Abort: frees blocks immediately, ends any stream with
+        finish_reason ABORT.  True if the request was still live."""
+        req = self.requests.get(request_id)
+        if req is None or req.finished:
+            return False
+        self.scheduler.remove(req)
+        self.kv.free(req.request_id)
+        self._finish(req, FinishReason.ABORT)
+        self.requests.pop(request_id, None)
+        return True
+
+    def _finish(self, req: Request, reason: FinishReason) -> None:
+        req.state = RequestState.FINISHED
+        req.finish_reason = reason
+        req.finish_time = time.perf_counter()
+        self.metrics.count(f"requests_finished_{reason.value}")
+
+    def _emit(self, req: Request, tok: int) -> None:
+        """Append one sampled token + finish-state bookkeeping."""
+        now = time.perf_counter()
+        if req.first_token_time is None:
+            req.first_token_time = now
+            self.metrics.observe_ttft(now - req.arrival_time)
+        else:
+            self.metrics.observe_inter_token(now - req._last_emit)
+        req._last_emit = now
+        req.append_token(tok)
+        if req.hit_eos(tok):
+            self._finish(req, FinishReason.EOS)
+        elif len(req.output_tokens) >= req.sampling.max_new_tokens:
+            self._finish(req, FinishReason.LENGTH)
+
+    def _retire(self, req: Request) -> None:
+        self.scheduler.remove(req)
+        self.kv.free(req.request_id)
+        # drop the engine's handle so a long-lived server never accumulates
+        # finished Requests; the caller keeps the object from add_request
+        self.requests.pop(req.request_id, None)
+
+    # --- execution ----------------------------------------------------------
+    def _param_vals(self):
+        return tuple(p._value for p in self._params)
+
+    def _prefill(self, req: Request) -> None:
+        """Run the bucketed prefill program for one request (first
+        admission or preemption-recompute) and sample its next token."""
+        rid = req.request_id
+        ids = req.prompt_ids + req.output_tokens
+        if req.output_tokens:
+            self.metrics.count("recompute_prefills")
+        T0 = len(ids)
+        if not self.kv.allocate(rid, T0):
+            raise PoolExhausted(  # scheduler admission guarantees room
+                f"prefill of {T0} tokens for {rid!r} after admission")
+        self.kv.commit(rid, T0)
+        table = self.kv.table(rid)
+        Tb = bucket_size(T0)
+        ids_arr = np.zeros((1, Tb), np.int64)
+        ids_arr[0, :T0] = ids
+        blocks = np.zeros((Tb,), np.int32)  # pads -> null page (block 0)
+        pos = np.arange(T0)
+        blocks[:T0] = [table[p // self.block_size] for p in pos]
+        offs = (np.arange(Tb) % self.block_size).astype(np.int32)
+        self.prefill_buckets.add(("prefill", Tb))
+        with StepTimer(self.metrics, "prefill_step"):
+            last, self._k_pools, self._v_pools = self._jit_prefill(
+                self._param_vals(), self._k_pools, self._v_pools,
+                ids_arr, np.int32(T0 - 1), blocks, offs)
+            logits = np.asarray(last, np.float32)
+        self._emit(req, req.sampling.sample(logits, req._rng))
+
+    def _decode(self, reqs: List[Request]) -> Dict[object, int]:
+        """One bucketed decode step for ``reqs`` (slots already reserved
+        by the scheduler on ``req._slot``)."""
+        B = len(reqs)
+        Bb = bucket_size(B)
+        width = max(len(self.kv.table(r.request_id)) for r in reqs)
+        Wb = bucket_size(width)
+        ids = np.zeros((Bb, 1), np.int64)
+        poss = np.zeros((Bb,), np.int32)
+        tables = np.zeros((Bb, Wb), np.int32)
+        lens = np.ones((Bb,), np.int32)   # pad rows: 1 token of null page
+        slot_blocks = np.zeros((Bb,), np.int32)
+        slot_offsets = np.zeros((Bb,), np.int32)
+        for i, r in enumerate(reqs):
+            rid = r.request_id
+            t = self.kv.table(rid)
+            p = self.kv.seq_len(rid)
+            ids[i, 0] = r.last_token
+            poss[i] = p
+            tables[i, :len(t)] = t
+            lens[i] = p + 1               # cache length AFTER this token
+            slot_blocks[i], slot_offsets[i] = r._slot
+        self.decode_buckets.add(("decode", Bb, Wb))
+        with StepTimer(self.metrics, "decode_step"):
+            out, self._k_pools, self._v_pools = self._jit_decode(
+                self._param_vals(), self._k_pools, self._v_pools,
+                ids, poss, tables, lens, slot_blocks, slot_offsets)
+            out = np.asarray(out, np.float32)
+        result = {}
+        for i, r in enumerate(reqs):
+            self.kv.commit(r.request_id, 1)
+            tok = r.sampling.sample(out[i], r._rng)
+            self._emit(r, tok)
+            result[r.request_id] = tok
+        return result
+
+    def step(self) -> Dict[object, int]:
+        """One engine iteration: schedule → prefill(s) → decode batch →
+        retire.  Returns {request_id: token} emitted this step."""
+        remove_timer = (self.metrics.install_dispatch_timer()
+                        if self._profile_ops else lambda: None)
+        try:
+            plan = self.scheduler.schedule()
+            self.metrics.count("engine_steps")
+            self.metrics.count("preemptions", len(plan.preempted))
+            for req in plan.aborted:
+                # unservable at admission: scheduler set state/reason, the
+                # engine owns finish bookkeeping (timestamp + counter)
+                self._finish(req, FinishReason.ABORT)
+                self.requests.pop(req.request_id, None)
+            emitted: Dict[object, int] = {}
+            for req in plan.prefills:
+                self._prefill(req)
+                emitted[req.request_id] = req.output_tokens[-1]
+            decodes = [r for r in plan.decodes
+                       if r.state is RequestState.RUNNING]
+            if decodes:
+                emitted.update(self._decode(decodes))
+            for req in list(self.scheduler.running):
+                if req.finished:
+                    self._retire(req)
+            self.metrics.sample_gauges(self.scheduler.queue_depth,
+                                       self.scheduler.num_running,
+                                       self.kv.occupancy())
+            return emitted
+        finally:
+            remove_timer()
+
+    def run(self, max_steps: Optional[int] = None) -> None:
+        """Drive ``step()`` until every request finishes."""
+        steps = 0
+        while self.scheduler.has_work():
+            self.step()
+            steps += 1
+            if (max_steps is not None and steps >= max_steps
+                    and self.scheduler.has_work()):
+                raise RuntimeError(
+                    f"engine did not drain within {max_steps} steps")
+
+    # --- streaming ----------------------------------------------------------
+    def stream(self, request_id) -> Iterator[int]:
+        """Per-request token generator: yields tokens as they are
+        produced, driving the shared engine loop when it runs dry.  Ends
+        when the request finishes (its ``finish_reason`` says why); an
+        abort mid-stream simply ends the iteration.  The handle is
+        resolved eagerly, so the stream stays valid after the engine
+        retires the finished request from ``self.requests``."""
+        req = self.requests[request_id]
+
+        def _gen():
+            cursor = 0
+            while True:
+                while cursor < len(req.output_tokens):
+                    yield req.output_tokens[cursor]
+                    cursor += 1
+                if req.finished:
+                    return
+                self.step()
+
+        return _gen()
+
+    # --- manual (predictor-compat) mode -------------------------------------
+    def prefill_now(self, req: Request) -> int:
+        """Admission-bypassing immediate prefill (LLMPredictor's
+        ``add_request`` contract: the caller owns scheduling).  Raises
+        :class:`PoolExhausted` when the prompt cannot be covered."""
+        if not self.kv.can_allocate(req.request_id, req.num_computed_tokens):
+            raise PoolExhausted(
+                f"prompt of {req.num_computed_tokens} tokens needs "
+                f"{self.kv.blocks_needed(req.request_id, req.num_computed_tokens)}"
+                f" blocks, {self.kv.num_free} free")
+        if not req.arrival_time:
+            req.arrival_time = time.perf_counter()
+        req.state = RequestState.RUNNING
+        self.scheduler.running.append(req)
+        self._prefill(req)
+        return req.output_tokens[-1]
+
+    def decode_ids(self, request_ids: Sequence[object]) -> Dict[object, int]:
+        """Manual decode for explicit ids (LLMPredictor's ``step``): the
+        caller picked the batch, so exhaustion here raises instead of
+        preempting."""
+        reqs = []
+        for rid in request_ids:
+            req = self.requests[rid]
+            slot = self.kv.append_slot(rid)
+            if slot is None:
+                raise PoolExhausted(
+                    f"no free block for decode slot of {rid!r}")
+            req._slot = slot
+            reqs.append(req)
+        return self._decode(reqs)
+
+    def release(self, request_id) -> None:
+        """Drop a request and free its blocks (no finish bookkeeping —
+        the predictor's ``free``)."""
+        req = self.requests.pop(request_id, None)
+        if req is not None:
+            self.scheduler.remove(req)
+        self.kv.free(request_id)

@@ -1,0 +1,74 @@
+"""Paged KV-cache manager for the serving engine.
+
+Owns the *bookkeeping* of the shared block pool — block tables, sequence
+lengths, reference counts — while the pool tensors themselves (one
+``[num_blocks, block_size, Hkv, D]`` pair per layer) live on the engine as
+:class:`~paddle_tpu.ops.paged_attention.PagedCache` state threaded through
+the jitted step.  This is the Ragged-Paged-Attention shape (PAPERS.md): a
+ragged batch of sequences at different lengths indexes one block pool
+through per-sequence tables, so admission/eviction never reshapes anything
+the compiler sees.
+
+Graceful degradation contract: allocation never partially succeeds, and
+exhaustion is a *scheduling event*, not an error — the engine preempts the
+lowest-priority running request (freeing its blocks for recompute later)
+instead of failing anyone.  Block 0 is the reserved null page that padding
+rows of a bucketed batch write into.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from ..ops.paged_attention import (  # noqa: F401  (PoolExhausted re-export)
+    BlockPool,
+    PoolExhausted,
+)
+
+
+class KVCacheManager(BlockPool):
+    """Refcounted block-pool bookkeeping (no device tensors).
+
+    The free-list / refcount / fork core is
+    :class:`~paddle_tpu.ops.paged_attention.BlockPool` — the same
+    implementation :class:`~paddle_tpu.ops.paged_attention.BlockKVCache`
+    uses, so the invariants cannot drift.  Here one pool is shared across
+    *all* layers: every layer's tensors use the same block index for a
+    given (sequence, position), which is what lets one routing array drive
+    the whole decoder stack.  This subclass adds the serving-loop surface:
+    decode-slot reservation (``append_slot``/``commit``) and gauges.
+    """
+
+    # --- capacity ----------------------------------------------------------
+    def occupancy(self) -> float:
+        """Fraction of the usable pool currently held by sequences."""
+        usable = self.num_blocks - 1
+        return (usable - len(self._free)) / usable if usable else 0.0
+
+    # --- allocation --------------------------------------------------------
+    def append_slot(self, seq_id) -> Optional[Tuple[int, int]]:
+        """(block, offset) slot for the sequence's NEXT token, allocating a
+        fresh block on a boundary.  ``None`` on exhaustion — the caller
+        preempts and retries.  Does not advance the length: ``commit``
+        does, after the model step actually wrote the slot."""
+        if not self.allocate(seq_id, 1):
+            return None
+        pos = self._lens.get(seq_id, 0)
+        table = self._tables[seq_id]
+        return table[pos // self.block_size], pos % self.block_size
+
+    def commit(self, seq_id, num_tokens: int = 1):
+        self._lens[seq_id] = self._lens.get(seq_id, 0) + num_tokens
+
+    # --- views -------------------------------------------------------------
+    def table(self, seq_id) -> List[int]:
+        return self._tables.get(seq_id, [])
+
+    def seq_len(self, seq_id) -> int:
+        return self._lens.get(seq_id, 0)
+
+    def has(self, seq_id) -> bool:
+        return seq_id in self._tables
+
+    def num_owned_blocks(self, seq_id) -> int:
+        return len(self._tables.get(seq_id, ()))

@@ -1,0 +1,172 @@
+"""Serving metrics: request-level latency + scheduler/pool health.
+
+Built on the SAME primitives as the profiler's summary statistics
+(``profiler/statistic.py``): latency distributions are
+:class:`~paddle_tpu.profiler.statistic.OpStat` entries rendered with
+``summary_table``, and the optional per-op host table reuses
+``HostOpRecorder`` through the dispatch ``_set_op_timer`` hook — so a
+serving summary reads exactly like a profiler summary.
+
+Tracked:
+
+* **time-to-first-token** (admission-inclusive: arrival → first emitted
+  token) and **inter-token latency** per request;
+* **prefill / decode step** wall times;
+* **queue depth**, **running-set size**, and **KV-pool occupancy** sampled
+  once per engine step;
+* counters: admitted, finished-by-reason (eos/length/abort), preemptions,
+  recompute prefills, decode/prefill jit traces.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Deque, Dict, Optional
+
+from ..profiler.statistic import HostOpRecorder, OpStat, summary_table
+
+# how many raw per-step gauge samples to retain for inspection; the
+# summary's avg/max/min come from exact streaming aggregates, so a
+# long-lived server's memory stays constant no matter how many steps run
+GAUGE_WINDOW = 4096
+
+
+class ServingMetrics:
+    def __init__(self):
+        self.latency: Dict[str, OpStat] = {}
+        self.counters: Dict[str, int] = {
+            "requests_admitted": 0,
+            "requests_finished_eos": 0,
+            "requests_finished_length": 0,
+            "requests_finished_abort": 0,
+            "preemptions": 0,
+            "recompute_prefills": 0,
+            "engine_steps": 0,
+        }
+        # recent per-step gauge samples (bounded window) + full-history
+        # streaming aggregates [n, sum, max, min] per gauge
+        self.queue_depth: Deque[int] = deque(maxlen=GAUGE_WINDOW)
+        self.num_running: Deque[int] = deque(maxlen=GAUGE_WINDOW)
+        self.kv_occupancy: Deque[float] = deque(maxlen=GAUGE_WINDOW)
+        self._gauge_agg: Dict[str, list] = {}
+        self._host_ops: Optional[HostOpRecorder] = None
+
+    # --- recording ----------------------------------------------------------
+    def _stat(self, name: str) -> OpStat:
+        s = self.latency.get(name)
+        if s is None:
+            s = self.latency[name] = OpStat(name)
+        return s
+
+    def count(self, name: str, n: int = 1) -> None:
+        self.counters[name] = self.counters.get(name, 0) + n
+
+    def observe(self, name: str, seconds: float) -> None:
+        self._stat(name).add(seconds)
+
+    def observe_ttft(self, seconds: float) -> None:
+        self.observe("time_to_first_token", seconds)
+
+    def observe_inter_token(self, seconds: float) -> None:
+        self.observe("inter_token_latency", seconds)
+
+    def sample_gauges(self, queue_depth: int, num_running: int,
+                      kv_occupancy: float) -> None:
+        for name, window, v in (
+                ("queue_depth", self.queue_depth, queue_depth),
+                ("num_running", self.num_running, num_running),
+                ("kv_pool_occupancy", self.kv_occupancy, kv_occupancy)):
+            window.append(v)
+            agg = self._gauge_agg.get(name)
+            if agg is None:
+                self._gauge_agg[name] = [1, v, v, v]
+            else:
+                agg[0] += 1
+                agg[1] += v
+                agg[2] = max(agg[2], v)
+                agg[3] = min(agg[3], v)
+
+    # --- dispatch-hook wiring (profiler integration) ------------------------
+    def install_dispatch_timer(self):
+        """Route per-op dispatch wall times into this metrics object via
+        the profiler's ``_set_op_timer`` hook (no-op if a Profiler already
+        owns the hook).  Returns a zero-arg remover."""
+        from ..core import dispatch as _dispatch
+
+        if _dispatch._op_timer is not None:
+            return lambda: None
+        if self._host_ops is None:
+            self._host_ops = HostOpRecorder()
+        _dispatch._set_op_timer(self._host_ops)
+
+        def remove():
+            if _dispatch._op_timer is self._host_ops:
+                _dispatch._set_op_timer(None)
+
+        return remove
+
+    # --- reporting ----------------------------------------------------------
+    def _gauge_rows(self):
+        rows = []
+        for name in ("queue_depth", "num_running", "kv_pool_occupancy"):
+            agg = self._gauge_agg.get(name)
+            if agg is None:
+                rows.append((name, 0, "-", "-", "-"))
+            else:
+                n, total, mx, mn = agg
+                rows.append((name, n, f"{total / n:.2f}",
+                             f"{mx:.2f}", f"{mn:.2f}"))
+        return rows
+
+    def summary(self, time_unit: str = "ms") -> str:
+        """Render the serving report in ``profiler/statistic.py`` table
+        style (printed AND returned, like ``Profiler.summary``)."""
+        parts = []
+        if self.latency:
+            parts.append(summary_table(
+                self.latency, "Serving latency summary (request-level)",
+                time_unit=time_unit))
+
+        header = f"{'Counter':32s} {'Value':>12s}"
+        bar = "-" * len(header)
+        lines = [bar, "Serving counters", bar, header, bar]
+        for name in sorted(self.counters):
+            lines.append(f"{name:32s} {self.counters[name]:12d}")
+        lines.append(bar)
+        parts.append("\n".join(lines))
+
+        header = (f"{'Gauge':24s} {'Samples':>8s} {'Avg':>10s} "
+                  f"{'Max':>10s} {'Min':>10s}")
+        bar = "-" * len(header)
+        lines = [bar, "Scheduler/pool gauges (per engine step)", bar,
+                 header, bar]
+        for name, n, avg, mx, mn in self._gauge_rows():
+            lines.append(f"{name:24s} {n:8d} {avg:>10s} {mx:>10s} {mn:>10s}")
+        lines.append(bar)
+        parts.append("\n".join(lines))
+
+        if self._host_ops is not None and self._host_ops.stats:
+            parts.append(summary_table(
+                self._host_ops.stats,
+                "Host operator summary (serving dispatch wall time)",
+                time_unit=time_unit))
+        report = "\n\n".join(parts)
+        print(report)
+        return report
+
+
+class StepTimer:
+    """``with StepTimer(metrics, "decode_step"): ...`` convenience."""
+
+    def __init__(self, metrics: ServingMetrics, name: str):
+        self.metrics = metrics
+        self.name = name
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.metrics.observe(self.name, time.perf_counter() - self._t0)
+        return False

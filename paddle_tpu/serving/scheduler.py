@@ -1,0 +1,198 @@
+"""Continuous-batching scheduler.
+
+Request-level scheduling over the ragged paged KV pool (the Ragged Paged
+Attention shape, PAPERS.md): every engine step the scheduler
+
+1. **admits** waiting requests into the running set while (a) the running
+   set is under ``max_num_seqs`` and (b) the pool can cover the request's
+   whole prompt *plus one decode block of headroom* without preempting
+   anyone — admission never steals blocks from running work;
+2. **reserves** this step's decode slot for every running request, and on
+   exhaustion **preempts** — the least-important running request (highest
+   ``(priority, arrival_seq)``) is evicted, its blocks freed, and it is
+   re-enqueued at the FRONT of the waiting queue for prefill-recompute.
+   Exhaustion is a scheduling event, not an error.
+
+Invariants (tested by ``tests/test_serving_engine.py``):
+
+* slot reservation is all-or-nothing per request — a preemption pass never
+  leaves a half-allocated sequence behind;
+* a preempted request keeps its generated tokens, so recompute costs one
+  prefill over ``prompt + output_tokens`` and produces token-identical
+  continuations (greedy);
+* a request whose total footprint can never fit the pool (prompt blocks >
+  usable pool) is finished as ABORT instead of live-locking the queue;
+* batch composition changes NEVER change tensor shapes the compiler sees —
+  the engine pads each batch to a size bucket (``bucket_size``), so the
+  jitted decode step compiles once per bucket (MPK's fixed-shape
+  mega-program argument, PAPERS.md).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Deque, List, Optional, Tuple
+
+from .kv_manager import KVCacheManager
+from .request import FinishReason, Request, RequestState
+
+
+def bucket_size(n: int, cap: Optional[int] = None) -> int:
+    """Next power of two ≥ n (≥1); optionally clamped to ``cap``.  The
+    shape-bucketing that bounds jit trace count: any batch/width in the
+    same bucket replays the same compiled program."""
+    b = 1
+    while b < n:
+        b <<= 1
+    return min(b, cap) if cap is not None else b
+
+
+@dataclass
+class SchedulerConfig:
+    max_num_seqs: int = 8            # running-set cap (decode batch ≤ this)
+    max_prefills_per_step: int = 1   # admission throttle: prefill is the
+                                     # expensive fixed-shape program; decode
+                                     # latency of running requests is
+                                     # protected by not batching many
+                                     # prefills into one engine step
+
+
+@dataclass
+class SchedulerOutput:
+    """One step's plan: prefills to run, the decode set, and who was
+    preempted to make room."""
+
+    prefills: List[Request] = field(default_factory=list)
+    decodes: List[Request] = field(default_factory=list)
+    preempted: List[Request] = field(default_factory=list)
+    aborted: List[Request] = field(default_factory=list)
+
+
+class ContinuousBatchingScheduler:
+    """Owns the waiting queue and the running set; pure bookkeeping — the
+    engine executes the plan this object returns."""
+
+    def __init__(self, config: SchedulerConfig, kv: KVCacheManager):
+        self.config = config
+        self.kv = kv
+        self.waiting: Deque[Request] = deque()
+        self.running: List[Request] = []
+
+    # --- queue ops ----------------------------------------------------------
+    def add(self, req: Request) -> None:
+        req.state = RequestState.WAITING
+        self.waiting.append(req)
+
+    def remove(self, req: Request) -> None:
+        if req in self.running:
+            self.running.remove(req)
+        try:
+            self.waiting.remove(req)
+        except ValueError:
+            pass
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self.waiting)
+
+    @property
+    def num_running(self) -> int:
+        return len(self.running)
+
+    def has_work(self) -> bool:
+        return bool(self.waiting or self.running)
+
+    # --- planning -----------------------------------------------------------
+    def _usable_blocks(self) -> int:
+        return self.kv.num_blocks - 1  # block 0 = null page
+
+    def _admit(self, out: SchedulerOutput) -> None:
+        admitted = 0
+        promised = 0  # blocks pledged to prefills admitted THIS pass: the
+                      # engine allocates them only when it runs the prefill,
+                      # so kv.num_free alone would double-count the pool
+        while (self.waiting
+               and len(self.running) < self.config.max_num_seqs
+               and admitted < self.config.max_prefills_per_step):
+            req = self.waiting[0]
+            prompt_blocks = self.kv.blocks_for(req.num_computed_tokens)
+            if prompt_blocks > self._usable_blocks():
+                # can never fit, even with the whole pool: fail THIS request
+                # honestly rather than live-locking everyone behind it
+                self.waiting.popleft()
+                req.state = RequestState.FINISHED
+                req.finish_reason = FinishReason.ABORT
+                req.error = (f"request needs {prompt_blocks} KV blocks; "
+                             f"pool has {self._usable_blocks()} usable")
+                out.aborted.append(req)
+                continue
+            # +1 decode-slot headroom, but never demand more than the pool
+            # HAS: a prompt filling the pool exactly is still servable when
+            # its decode tokens fit the last block's free slots
+            need = min(prompt_blocks + 1, self._usable_blocks())
+            if need > self.kv.num_free - promised:
+                break  # admission never preempts running work
+            promised += need
+            self.waiting.popleft()
+            req.state = RequestState.RUNNING
+            self.running.append(req)
+            out.prefills.append(req)
+            admitted += 1
+
+    def _preempt(self, victim: Request) -> None:
+        """Evict ``victim``: free its blocks, re-enqueue at the FRONT of
+        the waiting queue (a preempted request outranks new arrivals, so
+        it is re-admitted and recomputed as soon as blocks free up)."""
+        self.running.remove(victim)
+        self.kv.free(victim.request_id)
+        victim.state = RequestState.PREEMPTED
+        victim.num_preemptions += 1
+        self.waiting.appendleft(victim)
+
+    def _pick_victim(self, exclude) -> Optional[Request]:
+        # only block-holding requests relieve pressure, and a request
+        # that already reserved its slot this step (= more important in
+        # the iteration order) is never stolen from
+        candidates = [r for r in self.running if r not in exclude
+                      and self.kv.num_owned_blocks(r.request_id) > 0]
+        if not candidates:
+            return None
+        return max(candidates, key=lambda r: r.preempt_key)
+
+    def _reserve_decode_slots(self, out: SchedulerOutput) -> None:
+        """Reserve one decode slot per running request, preempting the
+        least-important block-holding requests on exhaustion.  Iterates
+        most-important first so preemption pressure lands on the tail."""
+        granted: List[Request] = []
+        for req in sorted(list(self.running), key=lambda r: r.preempt_key):
+            if req.state is not RequestState.RUNNING:
+                continue  # preempted by an earlier iteration
+            while True:
+                slot = self.kv.append_slot(req.request_id)
+                if slot is not None:
+                    req._slot = slot
+                    granted.append(req)
+                    out.decodes.append(req)
+                    break
+                victim = self._pick_victim(exclude=granted + [req])
+                if victim is None:
+                    # nothing evictable below it: this request itself
+                    # yields (it is the least important slot-seeker left)
+                    self._preempt(req)
+                    out.preempted.append(req)
+                    break
+                self._preempt(victim)
+                out.preempted.append(victim)
+
+    def schedule(self) -> SchedulerOutput:
+        """Plan one engine step.  Decode slots are reserved BEFORE
+        admission, so blocks promised to a freshly admitted prefill can
+        never be consumed by this step's decode appends.  Prefilled
+        requests decode their first token within the same step (the
+        prefill's last-position logits ARE that token), so they are not
+        in ``decodes``."""
+        out = SchedulerOutput()
+        self._reserve_decode_slots(out)
+        self._admit(out)
+        return out

@@ -262,6 +262,123 @@ class TestPartialGraphReplay:
             flags.set_flags({"jit_partial_graph": True})
 
 
+class TestInplaceMutationEvents:
+    """set_value/fill_/zero_/copy_ emit rebind-style observer events
+    (dispatch.notify_inplace): deterministic mutations are RECORDED into
+    the trace, host-data mutations loudly reject it — never a replay
+    that silently omits the mutation."""
+
+    def test_fill_zero_are_recorded_and_replayed(self):
+        state = paddle.to_tensor(np.full((3,), 9.0, np.float32))
+
+        def body(x):
+            state.fill_(2.0)          # in-place OUTSIDE op dispatch
+            h = x + state
+            if float(h.sum()) > 0:
+                state.zero_()
+                return h * 2
+            return h
+
+        f, calls = _make_counted(body)
+        fn = paddle.jit.to_static(f)
+        x = paddle.to_tensor(np.ones((3,), np.float32))
+        with pytest.warns(UserWarning, match="graph break"):
+            out1 = fn(x)
+        np.testing.assert_allclose(out1.numpy(), 6.0 * np.ones(3))
+        np.testing.assert_allclose(state.numpy(), np.zeros(3))
+
+        store = next(iter(fn._partial.values()))
+        assert store.dead is None and len(store.traces) == 1
+
+        state.fill_(9.0)              # perturb: replay must re-mutate
+        n = calls["n"]
+        out2 = fn(x)                  # replay — Python must NOT run
+        assert calls["n"] == n
+        np.testing.assert_allclose(out2.numpy(), 6.0 * np.ones(3))
+        np.testing.assert_allclose(state.numpy(), np.zeros(3))
+
+    def test_set_value_rejects_trace_loudly(self):
+        state = paddle.to_tensor(np.zeros((2,), np.float32))
+        feed = {"v": np.ones((2,), np.float32)}
+
+        def f(x):
+            state.set_value(feed["v"])   # untracked host data
+            if float(x.sum()) > 0:
+                return x + state
+            return x
+
+        fn = paddle.jit.to_static(f)
+        x = paddle.to_tensor(np.ones((2,), np.float32))
+        with pytest.warns(RuntimeWarning, match="set_value"):
+            fn(x)
+        store = next(iter(fn._partial.values()))
+        assert store.dead is not None
+        # stays eager and therefore CORRECT when the host data changes
+        feed["v"] = np.full((2,), 5.0, np.float32)
+        out = fn(x)
+        np.testing.assert_allclose(out.numpy(), 6.0 * np.ones(2))
+
+    def test_copy_from_host_rejects_trace(self):
+        state = paddle.to_tensor(np.zeros((2,), np.float32))
+
+        def f(x):
+            state.copy_(np.ones((2,), np.float32))
+            if float(x.sum()) > 0:
+                return x + state
+            return x
+
+        fn = paddle.jit.to_static(f)
+        with pytest.warns(RuntimeWarning, match="set_value"):
+            fn(paddle.to_tensor(np.ones((2,), np.float32)))
+        assert next(iter(fn._partial.values())).dead is not None
+
+
+class TestDifferentiableReturns:
+    def test_differentiable_return_rejected_at_record_time(self):
+        """A broken-graph fn returning a grad-requiring tensor must not
+        be replayed (replays detach from the tape and would silently
+        kill training) — it stays eager, and backward keeps working."""
+        lin = nn.Linear(3, 1)
+
+        def f(x):
+            h = lin(x).sum()
+            if float(h) > 1e9:
+                return h * 0
+            return h          # differentiable: external backward() likely
+
+        fn = paddle.jit.to_static(f)
+        x = paddle.to_tensor(np.ones((2, 3), np.float32))
+        with pytest.warns(RuntimeWarning, match="differentiable"):
+            out = fn(x)
+        assert next(iter(fn._partial.values())).dead is not None
+        # eager path keeps the tape alive: backward reaches the params
+        out2 = fn(x)
+        assert not out2.stop_gradient
+        out2.backward()
+        assert lin.weight.grad is not None
+
+    def test_no_grad_returns_still_replay(self):
+        lin = nn.Linear(3, 1)
+
+        def body(x):
+            with paddle.no_grad():
+                h = lin(x).sum()
+            if float(h) > 1e9:
+                return h * 0
+            return h
+
+        f, calls = _make_counted(body)
+        fn = paddle.jit.to_static(f)
+        x = paddle.to_tensor(np.ones((2, 3), np.float32))
+        with pytest.warns(UserWarning, match="graph break"):
+            out1 = fn(x)
+        n = calls["n"]
+        out2 = fn(x)      # replays
+        assert calls["n"] == n
+        assert out2.stop_gradient
+        np.testing.assert_allclose(out1.numpy(), out2.numpy())
+
+
 class TestShapeBucketedBreaks:
     def test_pow2_bucket(self):
         assert [_pow2_bucket(n) for n in (0, 1, 2, 3, 4, 5, 127, 128, 129)] \

@@ -120,6 +120,42 @@ class TestAutotuneCache:
         assert all(256 % bq == 0 and 256 % bk == 0 for bq, bk in cands)
         assert at.candidates(100, 100, 128) == [(128, 128)]  # fallback
 
+    def test_key_is_batch_invariant(self, monkeypatch):
+        """Block choice depends on (seq, heads, head_dim), not batch —
+        bench's OOM-ladder batch halving must keep hitting the cache."""
+        from paddle_tpu.ops import autotune as at
+
+        monkeypatch.setattr(at, "_memory", {})
+        monkeypatch.setattr(at, "_loaded", True)  # no disk load
+        at._memory[at._key((8, 2048, 8, 128), (8, 2048, 8, 128),
+                           "bfloat16", True)] = (256, 256)
+        for b in (4, 2, 1):  # the OOM ladder
+            assert at.cached_flash_blocks(
+                (b, 2048, 8, 128), (b, 2048, 8, 128),
+                "bfloat16", True) == (256, 256)
+        # different seq is still a different key
+        assert at.cached_flash_blocks(
+            (8, 1024, 8, 128), (8, 1024, 8, 128), "bfloat16", True) is None
+
+    def test_committed_old_format_keys_migrate_on_load(self, tmp_path,
+                                                       monkeypatch):
+        """Pre-migration AUTOTUNE.json keys carried the batch dim; they
+        must keep hitting after the key change."""
+        import json
+
+        from paddle_tpu.ops import autotune as at
+
+        committed = tmp_path / "AUTOTUNE.json"
+        old_key = ("flash|(8, 2048, 8, 128)|(8, 2048, 8, 128)|bfloat16|"
+                   "True|" + __import__("jax").devices()[0].device_kind)
+        committed.write_text(json.dumps({old_key: [512, 256]}))
+        monkeypatch.setattr(at, "_COMMITTED_PATH", str(committed))
+        monkeypatch.setattr(at, "_CACHE_PATH", str(tmp_path / "rt.json"))
+        monkeypatch.setattr(at, "_memory", {})
+        monkeypatch.setattr(at, "_loaded", False)
+        assert at.cached_flash_blocks((2, 2048, 8, 128), (2, 2048, 8, 128),
+                                      "bfloat16", True) == (512, 256)
+
     def test_tune_persists_and_hits(self, tmp_path, monkeypatch):
         from paddle_tpu.ops import autotune as at
 

@@ -106,13 +106,44 @@ class TestPagedAttention:
         cache.write(1, k, k)       # pool reused
         assert cache.seq_lens[1] == 4
 
-    def test_pool_exhaustion_raises(self):
+    def test_pool_exhaustion_graceful_contract(self):
+        """Exhaustion at the op layer is a typed, state-clean signal the
+        serving engine turns into preemption — not a request failure:
+        PoolExhausted is raised WITHOUT taking any block (all-or-nothing),
+        try_allocate is the non-raising probe, and freeing a sequence
+        makes the same write succeed."""
+        from paddle_tpu.ops.paged_attention import PoolExhausted
+
         cache = BlockKVCache(num_blocks=3, block_size=2, num_heads=1,
                              head_dim=8)
         k = jnp.ones((4, 1, 8))
         cache.write(0, k, k)
-        with pytest.raises(RuntimeError):
+        with pytest.raises(PoolExhausted):
             cache.write(1, k, k)
+        # all-or-nothing: the failed write took nothing and left no table
+        assert 1 not in cache.block_tables
+        assert len(cache._free) == 0
+        assert cache.try_allocate(1, 4) is None
+        # degrade gracefully: preempt (free) seq 0 and the write succeeds
+        cache.free(0)
+        cache.write(1, k, k)
+        assert cache.seq_lens[1] == 4
+
+    def test_fork_shares_full_blocks_refcounted(self):
+        """Prefix sharing without copy: fork refcounts full blocks; a
+        shared block returns to the free list only at the LAST owner's
+        free."""
+        cache = BlockKVCache(num_blocks=8, block_size=2, num_heads=1,
+                             head_dim=8, dtype=jnp.float32)
+        k = jnp.ones((5, 1, 8))
+        cache.write(0, k, k)                 # 3 blocks (2 full + 1 partial)
+        assert cache.fork(0, 1) == 4         # only FULL blocks shared
+        assert cache.block_tables[1] == cache.block_tables[0][:2]
+        free_before = len(cache._free)
+        cache.free(0)                        # shared blocks stay allocated
+        assert len(cache._free) == free_before + 1   # only the partial one
+        cache.free(1)                        # last owner: everything back
+        assert len(cache._free) == 7
 
 
 class TestPallasPagedKernel:

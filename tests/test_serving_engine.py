@@ -1,0 +1,421 @@
+"""Serving-engine tests (paddle_tpu.serving): continuous batching,
+preemption-with-recompute, streaming/abort, metrics, and the bounded
+compile-count contract of the bucketed fixed-shape step programs."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.serving import (
+    EngineCore,
+    FinishReason,
+    KVCacheManager,
+    RequestState,
+    SamplingParams,
+    SchedulerConfig,
+    bucket_size,
+    stream_generate,
+)
+
+PROMPTS = [[5, 9, 23, 7], [40, 2, 11], [1, 2, 3, 4, 5, 6], [100, 101]]
+
+
+def _model(layers=4):
+    paddle.seed(0)
+    return LlamaForCausalLM(LlamaConfig.tiny(num_hidden_layers=layers))
+
+
+def _engine(model, num_blocks=64, block_size=4, max_num_seqs=4, **kw):
+    return EngineCore(model, num_blocks=num_blocks, block_size=block_size,
+                      scheduler_config=SchedulerConfig(
+                          max_num_seqs=max_num_seqs), **kw)
+
+
+def _solo_outputs(model, prompt, n, **samp):
+    eng = _engine(model)
+    req = eng.add_request(prompt, SamplingParams(max_new_tokens=n, **samp))
+    eng.run(max_steps=200)
+    return req.output_tokens
+
+
+class TestKVCacheManager:
+    def test_all_or_nothing_allocation(self):
+        kv = KVCacheManager(num_blocks=4, block_size=2)
+        assert kv.allocate("a", 4)          # 2 blocks
+        kv.commit("a", 4)
+        assert not kv.allocate("b", 4)      # needs 2, only 1 free
+        assert not kv.has("b")              # took nothing
+        assert kv.num_free == 1
+
+    def test_append_slot_and_commit(self):
+        kv = KVCacheManager(num_blocks=8, block_size=2)
+        kv.allocate("a", 2)
+        kv.commit("a", 2)
+        b, off = kv.append_slot("a")        # crosses into a new block
+        assert off == 0 and b == kv.table("a")[1]
+        # length advances only on commit: same slot until then
+        assert kv.append_slot("a") == (b, off)
+        kv.commit("a", 1)
+        assert kv.append_slot("a") == (b, 1)
+
+    def test_fork_refcounting(self):
+        kv = KVCacheManager(num_blocks=8, block_size=2)
+        kv.allocate("a", 5)
+        kv.commit("a", 5)
+        assert kv.fork("a", "b") == 4       # full blocks only
+        free_before = kv.num_free
+        assert kv.free("a") == 1            # partial block only
+        assert kv.num_free == free_before + 1
+        assert kv.free("b") == 2            # last owner returns the rest
+        assert kv.num_free == 7
+
+    def test_occupancy(self):
+        kv = KVCacheManager(num_blocks=5, block_size=2)
+        assert kv.occupancy() == 0.0
+        kv.allocate("a", 4)
+        assert kv.occupancy() == 0.5
+
+
+class TestBucketing:
+    def test_bucket_size(self):
+        assert [bucket_size(n) for n in (1, 2, 3, 4, 5, 8, 9)] \
+            == [1, 2, 4, 4, 8, 8, 16]
+        assert bucket_size(9, cap=8) == 8
+
+
+class TestContinuousBatching:
+    def test_interleaved_admission_isolation(self):
+        """Requests admitted while others are mid-decode must produce
+        exactly their solo outputs (greedy)."""
+        m = _model()
+        solo = [_solo_outputs(m, p, 6) for p in PROMPTS]
+
+        eng = _engine(m, max_num_seqs=3)  # forces staggered admission
+        reqs = [eng.add_request(p, SamplingParams(max_new_tokens=6))
+                for p in PROMPTS]
+        eng.run(max_steps=200)
+        for req, ref in zip(reqs, solo):
+            assert req.output_tokens == ref
+            assert req.finish_reason == FinishReason.LENGTH
+        assert eng.kv.num_free == eng.kv.num_blocks - 1  # pool drained
+
+    def test_preemption_recompute_token_identical(self):
+        """The N31 acceptance test: a pool too small for both requests
+        forces preemption; the preempted-and-recomputed request must
+        produce token-identical output to its uninterrupted run."""
+        m = _model()
+        ref = [_solo_outputs(m, p, 8) for p in PROMPTS[:2]]
+
+        eng = _engine(m, num_blocks=10, block_size=2, max_num_seqs=4)
+        reqs = [eng.add_request(p, SamplingParams(max_new_tokens=8))
+                for p in PROMPTS[:2]]
+        eng.run(max_steps=300)
+        assert eng.metrics.counters["preemptions"] >= 1
+        assert eng.metrics.counters["recompute_prefills"] >= 1
+        preempted = [r for r in reqs if r.num_preemptions > 0]
+        assert preempted, "pool sizing should have forced a preemption"
+        for req, r in zip(reqs, ref):
+            assert req.finish_reason == FinishReason.LENGTH
+            assert req.output_tokens == r
+        assert eng.kv.num_free == 9  # every block back
+
+    def test_exhaustion_completes_all_requests(self):
+        """≥2 active requests + exhaustion must complete EVERYONE via
+        preemption instead of raising (the graceful contract)."""
+        m = _model(layers=2)
+        eng = _engine(m, num_blocks=8, block_size=2, max_num_seqs=4)
+        reqs = [eng.add_request(p, SamplingParams(max_new_tokens=6))
+                for p in PROMPTS[:3]]
+        eng.run(max_steps=500)
+        assert all(r.finish_reason == FinishReason.LENGTH for r in reqs)
+        assert eng.metrics.counters["preemptions"] >= 1
+
+    def test_unservable_request_aborts_not_livelocks(self):
+        """A prompt that can NEVER fit the pool finishes as ABORT with an
+        error instead of wedging the queue."""
+        m = _model(layers=2)
+        eng = _engine(m, num_blocks=4, block_size=2)  # 3 usable blocks
+        big = eng.add_request(list(range(10)),
+                              SamplingParams(max_new_tokens=4))
+        ok = eng.add_request([1, 2], SamplingParams(max_new_tokens=3))
+        eng.run(max_steps=100)
+        assert big.finish_reason == FinishReason.ABORT
+        assert "blocks" in big.error
+        assert big.finish_time is not None
+        assert eng.metrics.counters["requests_finished_abort"] == 1
+        assert ok.finish_reason == FinishReason.LENGTH
+
+    def test_finished_requests_evicted_from_engine(self):
+        """The engine's request table must not grow without bound on a
+        long-lived server: finished requests are dropped (the caller
+        keeps the handle returned by add_request)."""
+        m = _model(layers=2)
+        eng = _engine(m)
+        reqs = [eng.add_request(p, SamplingParams(max_new_tokens=2))
+                for p in PROMPTS[:2]]
+        eng.run(max_steps=100)
+        assert all(r.finished for r in reqs)
+        assert eng.requests == {}
+
+    def test_prompt_filling_pool_exactly_is_served(self):
+        """A prompt needing exactly the usable pool admits (decode rides
+        the last block's free slots) instead of aborting as unservable."""
+        m = _model(layers=2)
+        eng = _engine(m, num_blocks=3, block_size=4)  # 2 usable blocks
+        req = eng.add_request(list(range(7)),         # exactly 2 blocks
+                              SamplingParams(max_new_tokens=2))
+        eng.run(max_steps=100)
+        assert req.finish_reason == FinishReason.LENGTH
+        assert len(req.output_tokens) == 2
+
+    def test_run_cap_not_hit_when_drained_on_last_step(self):
+        """Draining on exactly step max_steps is success, not an error."""
+        m = _model(layers=2)
+        eng = _engine(m)
+        eng.add_request(PROMPTS[0], SamplingParams(max_new_tokens=1))
+        eng.run(max_steps=1)  # the prefill emits the only token
+        assert not eng.scheduler.has_work()
+
+    def test_priority_picks_preemption_victim(self):
+        """The LOW-priority request (higher number) is the one evicted."""
+        m = _model(layers=2)
+        eng = _engine(m, num_blocks=8, block_size=2, max_num_seqs=4)
+        hi = eng.add_request(PROMPTS[0], SamplingParams(max_new_tokens=6),
+                             priority=0)
+        lo = eng.add_request(PROMPTS[1], SamplingParams(max_new_tokens=6),
+                             priority=5)
+        eng.run(max_steps=300)
+        if eng.metrics.counters["preemptions"]:
+            assert lo.num_preemptions >= 1
+            assert hi.num_preemptions == 0
+        assert hi.output_tokens and lo.output_tokens
+
+
+class TestStreaming:
+    def test_stream_yields_solo_tokens(self):
+        m = _model()
+        ref = _solo_outputs(m, PROMPTS[0], 5)
+        eng = _engine(m)
+        got = list(stream_generate(
+            eng, PROMPTS[0], SamplingParams(max_new_tokens=5)))
+        assert got == ref
+
+    def test_abort_mid_stream_frees_blocks(self):
+        m = _model()
+        eng = _engine(m)
+        req = eng.add_request(PROMPTS[0],
+                              SamplingParams(max_new_tokens=50))
+        other = eng.add_request(PROMPTS[1],
+                                SamplingParams(max_new_tokens=4))
+        stream = eng.stream(req.request_id)
+        got = [next(stream) for _ in range(3)]
+        assert len(got) == 3
+        assert eng.kv.num_owned_blocks(req.request_id) > 0
+        assert eng.abort_request(req.request_id)
+        assert eng.kv.num_owned_blocks(req.request_id) == 0
+        assert req.finish_reason == FinishReason.ABORT
+        assert list(stream) == []          # stream ends cleanly
+        assert not eng.abort_request(req.request_id)  # idempotent
+        eng.run(max_steps=100)             # others unaffected
+        assert other.finish_reason == FinishReason.LENGTH
+        assert eng.kv.num_free == eng.kv.num_blocks - 1
+
+    def test_seeded_sampling_is_deterministic_per_request(self):
+        m = _model(layers=2)
+        samp = dict(temperature=0.8, top_k=4)
+        a = _solo_outputs(m, PROMPTS[0], 5, **dict(samp, seed=7))
+        b = _solo_outputs(m, PROMPTS[0], 5, **dict(samp, seed=7))
+        assert a == b  # same seed, fresh engines: identical stream
+
+    def test_top_k_larger_than_vocab_clamps(self):
+        p = SamplingParams(temperature=1.0, top_k=10_000)
+        tok = p.sample(np.linspace(-1, 1, 8).astype(np.float32),
+                       np.random.default_rng(0))
+        assert 0 <= tok < 8
+
+
+class TestCompileBudget:
+    def test_bounded_traces_across_mixed_workload(self):
+        """The MPK fixed-shape contract: across a 20-request workload of
+        mixed prompt lengths and fluctuating batch composition, the
+        jitted decode/prefill programs compile at most once per shape
+        bucket — counted by in-trace counters, not call counts."""
+        m = _model(layers=2)
+        eng = _engine(m, num_blocks=256, block_size=4, max_num_seqs=4)
+        rng = np.random.default_rng(0)
+        reqs = []
+        for i in range(20):
+            plen = int(rng.integers(2, 14))
+            prompt = rng.integers(0, 256, plen).tolist()
+            n = int(rng.integers(2, 7))
+            reqs.append(eng.add_request(
+                prompt, SamplingParams(max_new_tokens=n)))
+        eng.run(max_steps=2000)
+        assert all(r.finished for r in reqs)
+        # the acceptance criterion: traces ≤ buckets, and few in absolute
+        assert eng.decode_trace_count <= len(eng.decode_buckets)
+        assert eng.prefill_trace_count <= len(eng.prefill_buckets)
+        assert eng.decode_trace_count + eng.prefill_trace_count <= 12
+
+    def test_replay_reuses_compiled_step(self):
+        """Same bucket ⇒ zero new traces on a later request."""
+        m = _model(layers=2)
+        eng = _engine(m)
+        eng.add_request(PROMPTS[0], SamplingParams(max_new_tokens=4))
+        eng.run(max_steps=50)
+        n_dec, n_pre = eng.decode_trace_count, eng.prefill_trace_count
+        eng.add_request([9, 8, 7, 6], SamplingParams(max_new_tokens=4))
+        eng.run(max_steps=50)
+        assert eng.decode_trace_count == n_dec
+        assert eng.prefill_trace_count == n_pre
+
+
+class TestMetrics:
+    def test_counters_and_latency_stats(self):
+        m = _model(layers=2)
+        eng = _engine(m)
+        eng.add_request(PROMPTS[0], SamplingParams(max_new_tokens=4))
+        eng.add_request(PROMPTS[1], SamplingParams(max_new_tokens=3))
+        eng.run(max_steps=100)
+        c = eng.metrics.counters
+        assert c["requests_admitted"] == 2
+        assert c["requests_finished_length"] == 2
+        assert c["engine_steps"] >= 4
+        lat = eng.metrics.latency
+        assert lat["time_to_first_token"].calls == 2
+        # 4+3 tokens total, 2 are first tokens
+        assert lat["inter_token_latency"].calls == 5
+        assert lat["prefill_step"].calls == 2
+        assert lat["decode_step"].calls >= 3
+        assert len(eng.metrics.kv_occupancy) == c["engine_steps"]
+
+    def test_eos_finish_reason_counted(self):
+        m = _model(layers=2)
+        probe = _engine(m)
+        r = probe.add_request(PROMPTS[0], SamplingParams(max_new_tokens=1))
+        probe.run(max_steps=20)
+        eos = r.output_tokens[0]
+
+        eng = _engine(m)
+        req = eng.add_request(PROMPTS[0], SamplingParams(
+            max_new_tokens=10, eos_token_id=eos))
+        eng.run(max_steps=50)
+        assert req.finish_reason == FinishReason.EOS
+        assert len(req.output_tokens) == 1
+        assert eng.metrics.counters["requests_finished_eos"] == 1
+
+    def test_gauges_bounded_with_exact_aggregates(self):
+        """Gauge memory is constant on a long-lived server: raw samples
+        keep only a recent window while summary stats stay exact."""
+        from paddle_tpu.serving.metrics import GAUGE_WINDOW, ServingMetrics
+
+        m = ServingMetrics()
+        for i in range(GAUGE_WINDOW + 100):
+            m.sample_gauges(i, 1, 0.5)
+        assert len(m.queue_depth) == GAUGE_WINDOW
+        name, n, avg, mx, mn = m._gauge_rows()[0]
+        assert name == "queue_depth" and n == GAUGE_WINDOW + 100
+        assert mx == f"{GAUGE_WINDOW + 99:.2f}" and mn == "0.00"
+
+    def test_summary_renders_profiler_style(self, capsys):
+        m = _model(layers=2)
+        eng = _engine(m)
+        eng.add_request(PROMPTS[0], SamplingParams(max_new_tokens=3))
+        eng.run(max_steps=50)
+        report = eng.metrics.summary()
+        capsys.readouterr()
+        assert "Serving latency summary" in report
+        assert "time_to_first_token" in report
+        assert "Serving counters" in report
+        assert "kv_pool_occupancy" in report
+        assert "Ratio(%)" in report  # statistic.py table format
+
+    def test_dispatch_timer_hook_integration(self, capsys):
+        """profile_ops=True routes run_op wall times through the
+        profiler's _set_op_timer hook into the serving summary."""
+        from paddle_tpu.core import dispatch as _dispatch
+
+        m = _model(layers=2)
+        eng = _engine(m, profile_ops=True)
+        eng.add_request(PROMPTS[0], SamplingParams(max_new_tokens=3))
+        eng.run(max_steps=50)
+        assert _dispatch._op_timer is None  # hook released after step
+        report = eng.metrics.summary()
+        capsys.readouterr()
+        assert "Host operator summary" in report
+
+
+class TestLLMEntrypoint:
+    def test_batch_generate_in_submission_order(self):
+        from paddle_tpu.serving import LLM
+
+        m = _model(layers=2)
+        refs = [_solo_outputs(m, p, 4) for p in PROMPTS[:3]]
+        llm = LLM(m, num_blocks=64, block_size=4, max_num_seqs=2)
+        outs = llm.generate(PROMPTS[:3], SamplingParams(max_new_tokens=4))
+        assert [o.token_ids for o in outs] == refs
+        assert all(o.finish_reason == "length" for o in outs)
+
+
+class TestSchedulerUnit:
+    def test_admission_respects_max_num_seqs(self):
+        from paddle_tpu.serving import (ContinuousBatchingScheduler,
+                                        Request)
+
+        kv = KVCacheManager(num_blocks=64, block_size=4)
+        sched = ContinuousBatchingScheduler(
+            SchedulerConfig(max_num_seqs=2, max_prefills_per_step=8), kv)
+        for i in range(4):
+            sched.add(Request(prompt_ids=[1, 2, 3]))
+        plan = sched.schedule()
+        assert len(plan.prefills) == 2
+        assert sched.queue_depth == 2
+
+    def test_same_step_admissions_do_not_overcommit(self):
+        """Blocks promised to the first prefill of a step count against
+        the second's admission check — the pool is never double-booked."""
+        from paddle_tpu.serving import (ContinuousBatchingScheduler,
+                                        Request)
+
+        kv = KVCacheManager(num_blocks=11, block_size=1)  # 10 usable
+        sched = ContinuousBatchingScheduler(
+            SchedulerConfig(max_num_seqs=4, max_prefills_per_step=4), kv)
+        a = Request(prompt_ids=list(range(8)))   # each needs 8 + 1 headroom
+        b = Request(prompt_ids=list(range(8)))
+        sched.add(a)
+        sched.add(b)
+        plan = sched.schedule()
+        assert plan.prefills == [a]
+        assert sched.waiting[0] is b
+
+    def test_preempted_request_requeues_at_front(self):
+        from paddle_tpu.serving import (ContinuousBatchingScheduler,
+                                        Request)
+
+        kv = KVCacheManager(num_blocks=4, block_size=2)  # 3 usable
+        sched = ContinuousBatchingScheduler(
+            SchedulerConfig(max_num_seqs=4), kv)
+        a = Request(prompt_ids=[1, 2])
+        sched.add(a)
+        plan = sched.schedule()
+        assert plan.prefills == [a]
+        kv.allocate(a.request_id, 2)
+        kv.commit(a.request_id, 1)         # mid-block: next slot is free
+        b = Request(prompt_ids=[3, 4])
+        sched.add(b)
+        plan = sched.schedule()
+        assert plan.prefills == [b]
+        kv.allocate(b.request_id, 2)
+        kv.commit(b.request_id, 2)
+        # force both to a block boundary with 0 free blocks
+        kv.commit(a.request_id, 1)
+        assert kv.allocate(a.request_id, 2) and kv.num_free == 0
+        kv.commit(a.request_id, 2)
+        sched.add(Request(prompt_ids=[9]))  # a bystander in the queue
+        plan = sched.schedule()
+        # a (older) keeps decoding; b (newer) preempts and requeues FIRST
+        assert plan.preempted == [b]
+        assert b.state == RequestState.PREEMPTED
+        assert sched.waiting[0] is b
+        assert a in plan.decodes

@@ -45,6 +45,20 @@ class TestWriteBackViews:
         x[..., 1].fill_(3.0)
         np.testing.assert_array_equal(x.numpy(), [[0, 3], [0, 3]])
 
+    def test_numpy_integer_index_is_a_view(self):
+        # np.int64(0) must behave like the plain int 0 (write-back view),
+        # not silently degrade to a gather copy
+        x = paddle.to_tensor(np.zeros((3, 4), np.float32))
+        a = x[np.int64(0)]
+        a.add_(paddle.to_tensor(np.ones(4, np.float32)))
+        np.testing.assert_array_equal(x.numpy()[0], np.ones(4))
+        x[np.int32(1), 2:].fill_(7.0)  # mixed tuple stays a view too
+        np.testing.assert_array_equal(x.numpy()[1], [0, 0, 7, 7])
+        # ...but numpy BOOLS keep rejecting (bool subclasses int there too)
+        b = x[np.bool_(True)]
+        b.fill_(9.0)
+        assert x.numpy()[1].tolist() == [0, 0, 7, 7]
+
     def test_advanced_indexing_is_a_copy(self):
         # gather indices are copies in the reference too — no write-back
         x = paddle.to_tensor(np.zeros((4,), np.float32))

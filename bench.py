@@ -67,8 +67,14 @@ def train_flops_per_token(n_params: int, num_layers: int, seq: int,
     (fwd 2N + bwd 4N) + 12·L·S·H for attention score/context matmuls
     (2·2S·H per of {QK^T fwd, AV fwd} = 4SH fwd, ×3 with backward,
     per layer).  The MFU denominator everyone reports against; pinned by
-    tests/test_mfu_accounting.py."""
-    return 6.0 * n_params + 12.0 * num_layers * seq * hidden
+    tests/test_mfu_accounting.py.  One accounting for the whole repo:
+    this delegates to ``distributed/auto_tuner.py``, which the auto-tuner
+    cost model and ``observability.telemetry`` also use."""
+    from paddle_tpu.distributed.auto_tuner import (
+        train_flops_per_token as _impl,
+    )
+
+    return _impl(n_params, num_layers, seq, hidden)
 
 
 def _probe_tpu() -> bool:
@@ -372,6 +378,11 @@ def inner(platform: str) -> None:
             n_params, cfg.num_hidden_layers, seq, cfg.hidden_size)
         peak = _peak_flops(jax.devices()[0].device_kind) if on_tpu else 0.0
         mfu = (flops_per_tok * tok_per_s / peak) if peak else 0.0
+        # process-registry snapshot (counters + gauges) rides in the phase
+        # record: BENCH_* files then carry jit build / autotune hit-miss
+        # counts and queue/occupancy gauges alongside the wall times
+        from paddle_tpu.observability import get_registry
+
         return {"metric": "llama_train_tokens_per_sec_per_chip",
                 "value": round(tok_per_s, 2), "unit": "tokens/s",
                 "vs_baseline": round(mfu / 0.40, 4), "phase": name,
@@ -379,7 +390,9 @@ def inner(platform: str) -> None:
                 "params": int(n_params),
                 "ms_per_step": round(dt * 1e3, 2),
                 "cv": round(cv, 4), "steady_state": steady,
-                "timed_steps": len(times), "warmup_steps": _WARMUP}
+                "timed_steps": len(times), "warmup_steps": _WARMUP,
+                "metrics": get_registry().snapshot(
+                    kinds=("counter", "gauge"))}
 
     if not on_tpu:  # CPU smoke mode so the script always produces a number
         res = run_phase("cpu_smoke", LlamaConfig.tiny(), 4, 64, 3)

@@ -124,16 +124,85 @@ def notify_ignored_module(fn_name: str):
             cb(fn_name)
 
 
-# Per-op host timing hook (paddle.profiler summary statistics): called
-# with (op_name, wall_seconds) for every run_op while a Profiler is
-# active.  On an async backend this is dispatch+trace time, not device
-# execution — the host-side operator table of the reference's summary().
+# Per-op host timing bus (paddle.profiler summary statistics + serving
+# metrics + user subscribers): every subscriber is called with
+# (op_name, wall_seconds) for every run_op.  On an async backend this is
+# dispatch+trace time, not device execution — the host-side operator
+# table of the reference's summary().
+#
+# ``_op_timer`` stays the run_op fast-path gate: it is the fan-out
+# callable while >=1 subscriber is attached and None otherwise, so the
+# hot path still pays a single ``is not None`` check and existing
+# ``dispatch._op_timer is None`` introspection keeps working.  The old
+# single-owner ``_set_op_timer`` survives as a compat shim holding ONE
+# legacy slot on the bus — Profiler and ServingMetrics now subscribe via
+# ``add_op_timer`` and coexist (ISSUE 2: no more silent no-op when both
+# want the hook).
 _op_timer = None
+_op_timer_subs = ()       # immutable tuple: lock-free fan-out iteration
+_op_timer_lock = None     # created lazily (threading import kept local)
+_legacy_timer = None      # the subscriber installed via _set_op_timer
+
+
+def _timer_lock():
+    global _op_timer_lock
+    if _op_timer_lock is None:
+        import threading
+
+        _op_timer_lock = threading.Lock()
+    return _op_timer_lock
+
+
+def _op_timer_fanout(name, dt):
+    for cb in _op_timer_subs:
+        try:
+            cb(name, dt)
+        except Exception as e:  # a broken subscriber must not kill ops
+            import sys
+
+            remove_op_timer(cb)
+            sys.stderr.write(
+                f"[paddle_tpu] op-timer subscriber {cb!r} raised "
+                f"{e!r}; unsubscribed\n")
+
+
+def _refresh_op_timer():
+    global _op_timer
+    _op_timer = _op_timer_fanout if _op_timer_subs else None
+
+
+def add_op_timer(callback):
+    """Subscribe ``callback(op_name, wall_seconds)`` to the op bus.
+    Returns a zero-arg remover.  Multiple subscribers coexist."""
+    global _op_timer_subs
+    with _timer_lock():
+        _op_timer_subs = _op_timer_subs + (callback,)
+        _refresh_op_timer()
+    return lambda: remove_op_timer(callback)
+
+
+def remove_op_timer(callback):
+    global _op_timer_subs
+    with _timer_lock():
+        _op_timer_subs = tuple(s for s in _op_timer_subs
+                               if s is not callback)
+        _refresh_op_timer()
 
 
 def _set_op_timer(timer):
-    global _op_timer
-    _op_timer = timer
+    """Legacy single-slot API: ``_set_op_timer(cb)`` replaces the
+    previously-set legacy timer (other bus subscribers are untouched);
+    ``_set_op_timer(None)`` clears the slot."""
+    global _legacy_timer, _op_timer_subs
+    with _timer_lock():
+        if _legacy_timer is not None:
+            _op_timer_subs = tuple(s for s in _op_timer_subs
+                                   if s is not _legacy_timer)
+            _legacy_timer = None
+        if timer is not None:
+            _legacy_timer = timer
+            _op_timer_subs = _op_timer_subs + (timer,)
+        _refresh_op_timer()
 
 
 def _tree_leaves_with_path(out):
@@ -149,14 +218,15 @@ def run_op(name: str, fn: Callable, *args, **kwargs):
     tensors are unwrapped but always non-differentiable — pass a tensor
     positionally if it needs a gradient.
     """
-    if _op_timer is not None:
+    timer = _op_timer  # capture: a subscriber may detach mid-op
+    if timer is not None:
         import time as _time
 
         t0 = _time.perf_counter()
         try:
             return _run_op_impl(name, fn, *args, **kwargs)
         finally:
-            _op_timer(name, _time.perf_counter() - t0)
+            timer(name, _time.perf_counter() - t0)
     return _run_op_impl(name, fn, *args, **kwargs)
 
 

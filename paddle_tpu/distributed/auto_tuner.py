@@ -91,6 +91,17 @@ def _divisors(n: int) -> List[int]:
     return [d for d in range(1, n + 1) if n % d == 0]
 
 
+def train_flops_per_token(n_params: float, num_layers: int = 0,
+                          seq_len: int = 0, hidden: int = 0) -> float:
+    """PaLM-style training FLOPs per token: ``6N`` for the parameter ops
+    (fwd 2N + bwd 4N) plus ``12·L·S·H`` for the attention score/context
+    matmuls when the geometry is given.  The MFU denominator everyone
+    reports against — the one accounting shared by the cost model below,
+    ``bench.py`` and ``observability.telemetry`` (pinned by
+    tests/test_mfu_accounting.py)."""
+    return 6.0 * n_params + 12.0 * num_layers * seq_len * hidden
+
+
 def estimate_step_time(cfg: TuneConfig, model: ModelSpec,
                        hw: Optional[HardwareSpec] = None) -> float:
     """Analytical seconds/step for one candidate — the compiled-cost
@@ -108,7 +119,7 @@ def estimate_step_time(cfg: TuneConfig, model: ModelSpec,
     if m.num_params == 0:
         return 0.0
     tokens = m.global_batch * m.seq_len
-    flops = 6.0 * m.num_params * tokens
+    flops = train_flops_per_token(m.num_params) * tokens
     denom = 1 if hw.timeshared else cfg.world
     compute = flops / denom / (hw.peak_flops * hw.achievable_mfu)
 

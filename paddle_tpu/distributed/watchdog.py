@@ -38,7 +38,9 @@ class StepWatchdog:
         self._lock = threading.Lock()
         self._active = {}   # token -> (label, deadline)
         self._counter = 0
-        self._fired = []
+        from collections import deque
+
+        self._fired = deque(maxlen=256)  # a wedged loop can fire forever
         self._thread = None
         self._stop = threading.Event()
 
@@ -62,12 +64,26 @@ class StepWatchdog:
 
     def _fire(self, label: str):
         self._fired.append(label)
+        dump_parts = []
+        for tid, frame in sys._current_frames().items():
+            dump_parts.append(f"--- thread {tid} ---\n"
+                              + "".join(traceback.format_stack(frame)))
+        dump = "".join(dump_parts)
         sys.stderr.write(
             f"[watchdog] section '{label}' exceeded {self.timeout}s — "
             f"possible hung collective / wedged step. Thread stacks:\n")
-        for tid, frame in sys._current_frames().items():
-            sys.stderr.write(f"--- thread {tid} ---\n")
-            sys.stderr.write("".join(traceback.format_stack(frame)))
+        sys.stderr.write(dump)
+        # structured event alongside the stderr dump: lands in the process
+        # span tracer (and any chrome export) with the thread dump attached
+        try:
+            from ..observability import get_tracer
+
+            get_tracer().instant("watchdog_timeout", cat="watchdog",
+                                 section=label,
+                                 timeout_seconds=self.timeout,
+                                 thread_dump=dump)
+        except Exception:
+            pass  # telemetry must never mask the timeout handling
         if self.on_timeout is not None:
             try:
                 self.on_timeout(label, self.timeout)

@@ -154,6 +154,14 @@ class DataLoader:
             for b in self._raw_batches():
                 yield _to_device(b)
             return
+        from ..observability import get_registry
+
+        reg = get_registry()
+        depth_g = reg.gauge("dataloader_queue_depth",
+                            "prefetch queue depth at consume time "
+                            "(0 = compute is data-starved)")
+        batches_c = reg.counter("dataloader_batches_total",
+                                "batches yielded by buffered DataLoaders")
         # async device prefetch: one batch in flight ahead of compute
         q: "queue.Queue" = queue.Queue(maxsize=self.prefetch_factor)
         sentinel = object()
@@ -171,9 +179,11 @@ class DataLoader:
         t = threading.Thread(target=producer, daemon=True)
         t.start()
         while True:
+            depth_g.set(q.qsize())
             item = q.get()
             if item is sentinel:
                 break
+            batches_c.inc()
             yield item
         t.join()
         if err:

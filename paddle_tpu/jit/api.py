@@ -337,6 +337,15 @@ class StaticFunction:
             self._eager_keys.add(key)
             self._eager_buckets.add(bucket)
             fname = getattr(self._fn, "__name__", str(self._fn))
+            from ..observability import get_registry, get_tracer
+
+            get_registry().counter(
+                "jit_graph_breaks_total",
+                "to_static signatures that fell back to partial/eager"
+            ).inc()
+            get_tracer().instant("graph_break", cat="jit", function=fname,
+                                 error=type(e).__name__,
+                                 site=_break_site(e))
             sig_txt = ", ".join(
                 f"{'x'.join(map(str, s))}:{d}" for s, d in key[0]) or "()"
             warnings.warn(
@@ -426,6 +435,18 @@ class StaticFunction:
             self._cache.pop(next(iter(self._cache)))
 
     def _build(self, args, kwargs):
+        from ..observability import get_registry, get_tracer
+
+        fname = getattr(self._fn, "__name__", str(self._fn))
+        get_registry().counter(
+            "jit_builds_total",
+            "to_static discovery+staging builds (one per new signature)"
+        ).inc()
+        with get_tracer().span("to_static_build", cat="jit",
+                               function=fname):
+            return self._build_inner(args, kwargs)
+
+    def _build_inner(self, args, kwargs):
         # ---- pass 1: discovery --------------------------------------------
         rec = _Recorder()
         rec.seed(_tree_tensors([args, kwargs], []))

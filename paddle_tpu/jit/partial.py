@@ -511,6 +511,16 @@ class TraceStore:
         result, trace, dead = record_call(fn, args, kwargs, arg_tensors)
         if trace is not None:
             self.traces.append(trace)
+            from ..observability import get_registry, get_tracer
+
+            get_registry().counter(
+                "jit_partial_traces_total",
+                "partial-graph linear traces recorded around graph breaks"
+            ).inc()
+            get_tracer().instant(
+                "partial_trace_recorded", cat="jit", function=self.fn_name,
+                segments=len(trace.segments),
+                compiled_ops=trace.n_compiled_ops)
             if self.announce is None or self.announce():
                 warnings.warn(
                     f"to_static[{self.fn_name}]: compiled a partial graph "

@@ -1,0 +1,88 @@
+"""``paddle_tpu.observability`` — the one telemetry substrate.
+
+Three pieces, shared by the profiler, the serving engine, the jit layer
+and user code (ISSUE 2 tentpole):
+
+* :class:`SpanTracer` (``tracer.py``) — thread-safe nestable named spans
+  with attributes in a bounded ring buffer, exported as real Chrome
+  trace-event JSON (``export.py``) and read back with
+  :func:`load_profiler_result`.
+* :class:`MetricsRegistry` (``metrics.py``) — Counter / Gauge /
+  Histogram with exact streaming aggregates and bounded memory,
+  rendered as Prometheus text exposition or a JSON snapshot.
+* the **op-observer bus** (``core/dispatch.add_op_timer``) — a
+  multi-subscriber replacement for the old single-owner ``_op_timer``
+  hook, so a :class:`~paddle_tpu.profiler.Profiler`, a
+  :class:`~paddle_tpu.serving.ServingMetrics` and user subscribers all
+  see per-op dispatch wall times at the same time.
+  :func:`subscribe_ops` / :func:`trace_dispatch` are the public surface.
+
+Process-wide defaults: :func:`get_tracer` / :func:`get_registry` return
+one shared instance each, so spans from the serving engine, jit compile
+events and watchdog timeouts land in one trace, and compile counters /
+KV-occupancy gauges land in one Prometheus page.
+"""
+
+from __future__ import annotations
+
+from .export import (  # noqa: F401
+    ProfilerResult,
+    export_chrome_trace,
+    load_profiler_result,
+)
+from .metrics import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    get_registry,
+    set_registry,
+)
+from .tracer import (  # noqa: F401
+    Span,
+    SpanTracer,
+    get_tracer,
+    set_tracer,
+)
+
+
+def subscribe_ops(callback):
+    """Attach ``callback(op_name, wall_seconds)`` to the dispatch op bus
+    alongside any active Profiler / ServingMetrics subscriber.  Returns a
+    zero-arg remover."""
+    from ..core import dispatch as _dispatch
+
+    return _dispatch.add_op_timer(callback)
+
+
+def trace_dispatch(tracer: "SpanTracer" = None, cat: str = "dispatch"):
+    """Record every eager op dispatch as a span on ``tracer`` (default:
+    the process tracer).  The span is recorded after the fact from the
+    bus timing, so the hot path pays only the existing timer cost.
+    Returns a zero-arg remover."""
+    import time as _time
+
+    tr = tracer if tracer is not None else get_tracer()
+
+    def _on_op(name, dt):
+        end = _time.perf_counter()
+        tr.add_span(name, end - dt, dt, cat=cat)
+
+    return subscribe_ops(_on_op)
+
+
+def _telemetry():
+    # lazy: telemetry pulls in distributed.auto_tuner, which must not be
+    # imported while the package __init__ is still executing.  Import by
+    # absolute name — ``from . import telemetry`` would re-enter
+    # __getattr__ via the package hasattr check and recurse.
+    import importlib
+
+    return importlib.import_module(__name__ + ".telemetry")
+
+
+def __getattr__(name):
+    if name in ("TrainStepTelemetry", "telemetry"):
+        mod = _telemetry()
+        return mod if name == "telemetry" else mod.TrainStepTelemetry
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -30,6 +30,28 @@ _COMMITTED_PATH = os.path.join(
 _memory: Dict[str, Tuple[int, int]] = {}
 _loaded = False
 
+_counter_cache = (None, None, None)  # (registry, hit_counter, miss_counter)
+
+
+def _count(hit: bool) -> None:
+    """Registry hit/miss counters (objects cached per registry identity:
+    the lookup path runs per flash-attention call, but a
+    ``set_registry()`` swap must not leave us writing to the old one)."""
+    global _counter_cache
+    from ..observability import get_registry
+
+    reg = get_registry()
+    cached_reg, hit_c, miss_c = _counter_cache
+    if cached_reg is not reg:
+        hit_c = reg.counter(
+            "autotune_cache_hits_total",
+            "flash block-geometry cache lookups that hit")
+        miss_c = reg.counter(
+            "autotune_cache_misses_total",
+            "flash block-geometry cache lookups that missed")
+        _counter_cache = (reg, hit_c, miss_c)
+    (hit_c if hit else miss_c).inc()
+
 
 def _migrate_key(key: str) -> str:
     """Normalize a persisted cache key to the batch-free format.
@@ -120,29 +142,35 @@ def tune_flash_blocks(q, k, v, causal: bool,
     _load()
     key = _key(q.shape, k.shape, str(q.dtype), causal)
     hit = _memory.get(key)
+    _count(hit is not None)
     if hit is not None:
         return hit
 
-    best, best_t = (128, 128), float("inf")
-    for bq, bk in candidates(q.shape[1], k.shape[1], q.shape[3]):
-        try:
-            def step(q_, k_, v_):
-                out, vjp = jax.vjp(
-                    lambda a, b, c: flash_attention(a, b, c, causal, bq, bk),
-                    q_, k_, v_)
-                return out, vjp(out)
+    from ..observability import get_tracer
 
-            jitted = jax.jit(step)
-            jax.block_until_ready(jitted(q, k, v))  # compile
-            t0 = time.perf_counter()
-            for _ in range(iters):
-                r = jitted(q, k, v)
-            jax.block_until_ready(r)
-            dt = (time.perf_counter() - t0) / iters
-        except Exception:
-            continue
-        if dt < best_t:
-            best, best_t = (bq, bk), dt
+    best, best_t = (128, 128), float("inf")
+    with get_tracer().span("autotune_sweep", cat="autotune",
+                           key=key) as sp:
+        for bq, bk in candidates(q.shape[1], k.shape[1], q.shape[3]):
+            try:
+                def step(q_, k_, v_):
+                    out, vjp = jax.vjp(
+                        lambda a, b, c: flash_attention(a, b, c, causal, bq, bk),
+                        q_, k_, v_)
+                    return out, vjp(out)
+
+                jitted = jax.jit(step)
+                jax.block_until_ready(jitted(q, k, v))  # compile
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    r = jitted(q, k, v)
+                jax.block_until_ready(r)
+                dt = (time.perf_counter() - t0) / iters
+            except Exception:
+                continue
+            if dt < best_t:
+                best, best_t = (bq, bk), dt
+        sp.set_attribute("best", str(best))
     _memory[key] = best
     _save()
     return best
@@ -152,7 +180,9 @@ def cached_flash_blocks(q_shape, kv_shape, dtype,
                         causal) -> Optional[Tuple[int, int]]:
     """Cache lookup only (no tuning) — the hot-path accessor."""
     _load()
-    return _memory.get(_key(q_shape, kv_shape, dtype, causal))
+    hit = _memory.get(_key(q_shape, kv_shape, dtype, causal))
+    _count(hit is not None)
+    return hit
 
 
 def record(q_shape, kv_shape, dtype, causal, blocks: Tuple[int, int],

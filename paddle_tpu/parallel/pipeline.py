@@ -120,10 +120,16 @@ class PipelineLayer(Layer):
         :func:`~paddle_tpu.parallel.pipeline_1f1b.pipeline_train_1f1b_auto`);
         lets ``fleet.distributed_model`` pipeline ANY sequential model, not
         just ones with a bespoke schedule hook."""
+        from ..observability import get_tracer
         from .pipeline_1f1b import pipeline_train_1f1b_auto
 
-        return pipeline_train_1f1b_auto(self, inputs, labels, n_microbatch,
-                                        recompute=recompute)
+        with get_tracer().span("pipeline_train_1f1b", cat="parallel",
+                               n_microbatch=n_microbatch,
+                               stages=self.num_stages,
+                               recompute=recompute):
+            return pipeline_train_1f1b_auto(self, inputs, labels,
+                                            n_microbatch,
+                                            recompute=recompute)
 
     def forward(self, x):
         for item, desc in zip(self.run_order, self._descs):
@@ -220,10 +226,19 @@ def pipeline_forward(layer: PipelineLayer, x: Tensor, n_microbatch: int,
     microbatch ring runs ``v`` sweeps, one per chunk round.  (The depth-first
     1F1B interleaving that shrinks the bubble further is a scheduling
     refinement on top of this placement.)"""
+    from ..observability import get_tracer
+
     n = _pp_degree()
     if n == 1:
         return layer(x)
 
+    with get_tracer().span("pipeline_forward", cat="parallel",
+                           stages=n, n_microbatch=n_microbatch,
+                           virtual_stages=layer.num_virtual_stages):
+        return _pipeline_forward_dispatch(layer, x, n_microbatch, extra, n)
+
+
+def _pipeline_forward_dispatch(layer, x, n_microbatch, extra, n):
     v = layer.num_virtual_stages
     stage_layers = [layer.get_stage_layers(s) for s in range(layer.num_stages)]
     homo = layer.__dict__.get("_stages_homo_cache")

@@ -215,6 +215,12 @@ def build_1f1b_schedule(n_stages: int, n_micro: int, v: int = 1) -> Schedule1F1B
     # the entry whose backward runs later in that same slot
     depth = min(depth + 1, n_micro)
 
+    from ..observability import get_tracer
+
+    get_tracer().instant("1f1b_schedule_built", cat="parallel",
+                         stages=n_stages, n_micro=n_micro, v=v,
+                         ticks=len(opc), buffer_depth=depth,
+                         peak_in_flight=max(peak) if peak else 0)
     return Schedule1F1B(opc, mb, ch, arr_f_mb, arr_f_ch, arr_c_mb, arr_c_ch,
                         peak, n, n_micro, v, depth)
 

@@ -52,7 +52,11 @@ def make_scheduler(closed=0, ready=0, record=1, repeat=0, skip_first=0):
 
 
 def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None):
+    """on-trace-ready handler directing output into ``dir_name``
+    (created here, like ``export_protobuf`` always did)."""
+
     def handler(prof):
+        os.makedirs(dir_name, exist_ok=True)
         prof._log_dir = dir_name
 
     return handler
@@ -78,6 +82,7 @@ class Profiler:
 
     def start(self):
         from ..core import dispatch as _dispatch
+        from ..observability import get_tracer, trace_dispatch
         from .statistic import HostOpRecorder
 
         if self._on_trace_ready:
@@ -85,17 +90,34 @@ class Profiler:
             # export_protobuf set _log_dir) — must happen BEFORE the trace
             # starts or they would point at an already-written trace
             self._on_trace_ready(self)
+        # a re-start() without stop() must not leak the previous pair of
+        # bus subscriptions (the old single-slot hook replaced them)
+        self._unsubscribe()
         self._host_recorder = HostOpRecorder()
-        _dispatch._set_op_timer(self._host_recorder)
+        # op-bus subscription: coexists with ServingMetrics / user
+        # subscribers instead of owning the old single-slot hook
+        self._remove_timer = _dispatch.add_op_timer(self._host_recorder)
+        # host spans: every dispatched op lands in the process span
+        # tracer, the source for export(path, format="json")
+        self._tracer = get_tracer()
+        self._remove_spans = trace_dispatch(self._tracer)
+        self._t_start = time.perf_counter()
+        self._t_stop = None
         if not self._timer_only:
             jax.profiler.start_trace(self._log_dir)
             self._active = True
         self._last_t = time.perf_counter()
 
-    def stop(self):
-        from ..core import dispatch as _dispatch
+    def _unsubscribe(self):
+        for attr in ("_remove_timer", "_remove_spans"):
+            remover = getattr(self, attr, None)
+            if remover is not None:
+                remover()
+                setattr(self, attr, None)
 
-        _dispatch._set_op_timer(None)
+    def stop(self):
+        self._unsubscribe()
+        self._t_stop = time.perf_counter()
         if self._active:
             jax.profiler.stop_trace()
             self._active = False
@@ -135,7 +157,34 @@ class Profiler:
         return report
 
     def export(self, path: str, format: str = "json"):
-        print(f"trace written under {self._log_dir} (XPlane/TensorBoard format)")
+        """Write this profiling session's host spans as chrome
+        trace-event JSON to ``path`` (loadable with
+        :func:`load_profiler_result`, viewable in Perfetto/chrome).
+        Previously a print-only stub.  Any device-side XPlane trace still
+        lives under ``self._log_dir`` for TensorBoard."""
+        if format != "json":
+            raise ValueError(
+                f"unsupported export format {format!r}: host spans export "
+                "as chrome trace-event 'json'; the device XPlane protobuf "
+                f"is under {self._log_dir}")
+        from ..observability.export import export_chrome_trace
+
+        tracer = getattr(self, "_tracer", None)
+        if tracer is None:
+            from ..observability import get_tracer
+
+            tracer = get_tracer()
+        # only THIS session's window: the shared process tracer may hold
+        # spans from before start() / after stop()
+        t0 = getattr(self, "_t_start", 0.0)
+        t1 = getattr(self, "_t_stop", None) or float("inf")
+        spans = [s for s in tracer.spans()
+                 if s.start + s.duration >= t0 and s.start <= t1]
+        export_chrome_trace(spans, path, epoch_offset=tracer.epoch_offset)
+        if self._active or getattr(self, "_captured", False):
+            print(f"host spans -> {path}; XPlane/TensorBoard trace under "
+                  f"{self._log_dir}")
+        return path
 
     def __enter__(self):
         self.start()
@@ -168,7 +217,13 @@ class RecordEvent:
 
 
 def load_profiler_result(filename: str):
-    raise NotImplementedError("use TensorBoard / Perfetto on the XPlane trace dir")
+    """Read an exported chrome trace-event JSON back into a
+    :class:`~paddle_tpu.observability.ProfilerResult` (flat events +
+    reconstructed span tree).  Previously a ``NotImplementedError``
+    stub; XPlane trace dirs remain TensorBoard/Perfetto territory."""
+    from ..observability.export import load_profiler_result as _load
+
+    return _load(filename)
 
 
 @contextlib.contextmanager

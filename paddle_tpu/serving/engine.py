@@ -65,7 +65,7 @@ class EngineCore:
 
     def __init__(self, model, num_blocks: int = 256, block_size: int = 16,
                  dtype=jnp.float32, scheduler_config: Optional[SchedulerConfig] = None,
-                 profile_ops: bool = False):
+                 profile_ops: bool = False, registry=None):
         cfg = model.config
         self.model = model
         self.kv = KVCacheManager(num_blocks, block_size)
@@ -73,7 +73,11 @@ class EngineCore:
         self.num_blocks = num_blocks
         self.scheduler = ContinuousBatchingScheduler(
             scheduler_config or SchedulerConfig(), self.kv)
-        self.metrics = ServingMetrics()
+        # registry=None keeps counts per-engine; pass
+        # observability.get_registry() to publish serving series on the
+        # process-wide Prometheus page next to the jit compile counters
+        self.metrics = ServingMetrics(registry=registry)
+        self.tracer = self.metrics.tracer
         self.requests: Dict[object, Request] = {}
         self._pool_dtype = jnp.dtype(dtype)
         shape = (num_blocks, block_size, cfg.num_key_value_heads, cfg.head_dim)
@@ -121,6 +125,12 @@ class EngineCore:
         its (block, offset) slot, attend through the block tables, return
         last-position logits + updated pools.  Shapes fixed per bucket."""
         self.decode_trace_count += 1
+        # host side-effects run only while JAX traces: these count
+        # COMPILATIONS (bounded by the bucket sets), not calls
+        self.metrics.count("decode_jit_traces")
+        self.tracer.instant("decode_jit_trace", cat="jit",
+                            batch=int(ids.shape[0]),
+                            table_width=int(tables.shape[1]))
         caches = []
         for k, v in zip(k_pools, v_pools):
             c = PagedCache(Tensor(k), Tensor(v))
@@ -138,6 +148,9 @@ class EngineCore:
         positions scatter into block 0 (the null page).  Returns the
         logits row of the LAST REAL token + updated pools."""
         self.prefill_trace_count += 1
+        self.metrics.count("prefill_jit_traces")
+        self.tracer.instant("prefill_jit_trace", cat="jit",
+                            prompt_bucket=int(ids.shape[1]))
         cfg = self.model.config
         Tb = ids.shape[1]
         dense = [
@@ -237,11 +250,14 @@ class EngineCore:
         blocks[:T0] = [table[p // self.block_size] for p in pos]
         offs = (np.arange(Tb) % self.block_size).astype(np.int32)
         self.prefill_buckets.add(("prefill", Tb))
-        with StepTimer(self.metrics, "prefill_step"):
-            last, self._k_pools, self._v_pools = self._jit_prefill(
-                self._param_vals(), self._k_pools, self._v_pools,
-                ids_arr, np.int32(T0 - 1), blocks, offs)
-            logits = np.asarray(last, np.float32)
+        with self.tracer.span("prefill_step", cat="serving",
+                              request=str(rid), tokens=T0, bucket=Tb,
+                              recompute=bool(req.output_tokens)):
+            with StepTimer(self.metrics, "prefill_step"):
+                last, self._k_pools, self._v_pools = self._jit_prefill(
+                    self._param_vals(), self._k_pools, self._v_pools,
+                    ids_arr, np.int32(T0 - 1), blocks, offs)
+                logits = np.asarray(last, np.float32)
         self._emit(req, req.sampling.sample(logits, req._rng))
 
     def _decode(self, reqs: List[Request]) -> Dict[object, int]:
@@ -267,11 +283,13 @@ class EngineCore:
             lens[i] = p + 1               # cache length AFTER this token
             slot_blocks[i], slot_offsets[i] = r._slot
         self.decode_buckets.add(("decode", Bb, Wb))
-        with StepTimer(self.metrics, "decode_step"):
-            out, self._k_pools, self._v_pools = self._jit_decode(
-                self._param_vals(), self._k_pools, self._v_pools,
-                ids, poss, tables, lens, slot_blocks, slot_offsets)
-            out = np.asarray(out, np.float32)
+        with self.tracer.span("decode_step", cat="serving", batch=B,
+                              batch_bucket=Bb, width_bucket=Wb):
+            with StepTimer(self.metrics, "decode_step"):
+                out, self._k_pools, self._v_pools = self._jit_decode(
+                    self._param_vals(), self._k_pools, self._v_pools,
+                    ids, poss, tables, lens, slot_blocks, slot_offsets)
+                out = np.asarray(out, np.float32)
         result = {}
         for i, r in enumerate(reqs):
             self.kv.commit(r.request_id, 1)
@@ -286,28 +304,40 @@ class EngineCore:
         remove_timer = (self.metrics.install_dispatch_timer()
                         if self._profile_ops else lambda: None)
         try:
-            plan = self.scheduler.schedule()
-            self.metrics.count("engine_steps")
-            self.metrics.count("preemptions", len(plan.preempted))
-            for req in plan.aborted:
-                # unservable at admission: scheduler set state/reason, the
-                # engine owns finish bookkeeping (timestamp + counter)
-                self._finish(req, FinishReason.ABORT)
-                self.requests.pop(req.request_id, None)
-            emitted: Dict[object, int] = {}
-            for req in plan.prefills:
-                self._prefill(req)
-                emitted[req.request_id] = req.output_tokens[-1]
-            decodes = [r for r in plan.decodes
-                       if r.state is RequestState.RUNNING]
-            if decodes:
-                emitted.update(self._decode(decodes))
-            for req in list(self.scheduler.running):
-                if req.finished:
-                    self._retire(req)
-            self.metrics.sample_gauges(self.scheduler.queue_depth,
-                                       self.scheduler.num_running,
-                                       self.kv.occupancy())
+            with self.tracer.span("engine_step", cat="serving") as sp:
+                plan = self.scheduler.schedule()
+                self.metrics.count("engine_steps")
+                self.metrics.count("preemptions", len(plan.preempted))
+                for req in plan.preempted:
+                    self.tracer.instant(
+                        "preemption", cat="serving",
+                        request=str(req.request_id),
+                        generated=len(req.output_tokens))
+                for req in plan.aborted:
+                    # unservable at admission: scheduler set state/reason,
+                    # the engine owns finish bookkeeping (timestamp +
+                    # counter)
+                    self._finish(req, FinishReason.ABORT)
+                    self.requests.pop(req.request_id, None)
+                emitted: Dict[object, int] = {}
+                for req in plan.prefills:
+                    self._prefill(req)
+                    emitted[req.request_id] = req.output_tokens[-1]
+                decodes = [r for r in plan.decodes
+                           if r.state is RequestState.RUNNING]
+                if decodes:
+                    emitted.update(self._decode(decodes))
+                for req in list(self.scheduler.running):
+                    if req.finished:
+                        self._retire(req)
+                self.metrics.sample_gauges(self.scheduler.queue_depth,
+                                           self.scheduler.num_running,
+                                           self.kv.occupancy())
+                sp.set_attribute(
+                    "step", int(self.metrics._counter("engine_steps").value))
+                sp.set_attribute("emitted", len(emitted))
+                sp.set_attribute("kv_occupancy",
+                                 round(self.kv.occupancy(), 4))
             return emitted
         finally:
             remove_timer()

@@ -1,11 +1,12 @@
 """Serving metrics: request-level latency + scheduler/pool health.
 
-Built on the SAME primitives as the profiler's summary statistics
-(``profiler/statistic.py``): latency distributions are
-:class:`~paddle_tpu.profiler.statistic.OpStat` entries rendered with
-``summary_table``, and the optional per-op host table reuses
-``HostOpRecorder`` through the dispatch ``_set_op_timer`` hook — so a
-serving summary reads exactly like a profiler summary.
+Registry-backed (ISSUE 2): every counter / gauge / latency distribution
+is a series in a :class:`~paddle_tpu.observability.MetricsRegistry`
+(``serving_*`` namespace), so a serving process exposes TTFT/ITL
+histograms and KV-occupancy gauges on the same Prometheus page as the
+jit compile counters — while the legacy inspection surface
+(``metrics.counters`` dict view, ``metrics.latency`` OpStat view, the
+profiler-style ``summary()`` tables) is preserved exactly.
 
 Tracked:
 
@@ -16,6 +17,11 @@ Tracked:
   once per engine step;
 * counters: admitted, finished-by-reason (eos/length/abort), preemptions,
   recompute prefills, decode/prefill jit traces.
+
+Per-op host times ride the dispatch **op-observer bus**
+(``core/dispatch.add_op_timer``): ``install_dispatch_timer`` subscribes
+alongside any active Profiler instead of the old first-owner-wins
+``_set_op_timer`` slot, so Profiler + ServingMetrics coexist.
 """
 
 from __future__ import annotations
@@ -24,46 +30,84 @@ import time
 from collections import deque
 from typing import Deque, Dict, Optional
 
+from ..observability.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+)
+from ..observability.tracer import SpanTracer, get_tracer
 from ..profiler.statistic import HostOpRecorder, OpStat, summary_table
 
 # how many raw per-step gauge samples to retain for inspection; the
-# summary's avg/max/min come from exact streaming aggregates, so a
-# long-lived server's memory stays constant no matter how many steps run
+# summary's avg/max/min come from exact streaming aggregates (registry
+# Gauge), so a long-lived server's memory stays constant no matter how
+# many steps run
 GAUGE_WINDOW = 4096
+
+# sub-second serving latencies: finer low end than the registry default
+LATENCY_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                   0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+_COUNTER_NAMES = (
+    "requests_admitted",
+    "requests_finished_eos",
+    "requests_finished_length",
+    "requests_finished_abort",
+    "preemptions",
+    "recompute_prefills",
+    "engine_steps",
+)
+
+_GAUGE_NAMES = ("queue_depth", "num_running", "kv_pool_occupancy")
 
 
 class ServingMetrics:
-    def __init__(self):
-        self.latency: Dict[str, OpStat] = {}
-        self.counters: Dict[str, int] = {
-            "requests_admitted": 0,
-            "requests_finished_eos": 0,
-            "requests_finished_length": 0,
-            "requests_finished_abort": 0,
-            "preemptions": 0,
-            "recompute_prefills": 0,
-            "engine_steps": 0,
-        }
-        # recent per-step gauge samples (bounded window) + full-history
-        # streaming aggregates [n, sum, max, min] per gauge
+    def __init__(self, registry: Optional[MetricsRegistry] = None,
+                 tracer: Optional[SpanTracer] = None):
+        # own registry by default so per-engine counts stay per-engine;
+        # pass get_registry() to publish on the process-wide /metrics page
+        self.registry = (registry if registry is not None
+                         else MetricsRegistry(max_series=256))
+        self.tracer = tracer if tracer is not None else get_tracer()
+        self._counters: Dict[str, Counter] = {}
+        for name in _COUNTER_NAMES:
+            self._counter(name)
+        self._hists: Dict[str, Histogram] = {}
+        # recent per-step gauge samples (bounded window) for inspection;
+        # exact full-history aggregates live on the registry Gauges
         self.queue_depth: Deque[int] = deque(maxlen=GAUGE_WINDOW)
         self.num_running: Deque[int] = deque(maxlen=GAUGE_WINDOW)
         self.kv_occupancy: Deque[float] = deque(maxlen=GAUGE_WINDOW)
-        self._gauge_agg: Dict[str, list] = {}
+        self._gauges: Dict[str, Gauge] = {
+            name: self.registry.gauge(f"serving_{name}",
+                                      f"per-engine-step {name}")
+            for name in _GAUGE_NAMES
+        }
         self._host_ops: Optional[HostOpRecorder] = None
 
     # --- recording ----------------------------------------------------------
-    def _stat(self, name: str) -> OpStat:
-        s = self.latency.get(name)
-        if s is None:
-            s = self.latency[name] = OpStat(name)
-        return s
+    def _counter(self, name: str) -> Counter:
+        c = self._counters.get(name)
+        if c is None:
+            c = self._counters[name] = self.registry.counter(
+                f"serving_{name}_total", f"serving {name.replace('_', ' ')}")
+        return c
+
+    def _hist(self, name: str) -> Histogram:
+        h = self._hists.get(name)
+        if h is None:
+            h = self._hists[name] = self.registry.histogram(
+                f"serving_{name}_seconds",
+                f"serving {name.replace('_', ' ')} (seconds)",
+                buckets=LATENCY_BUCKETS)
+        return h
 
     def count(self, name: str, n: int = 1) -> None:
-        self.counters[name] = self.counters.get(name, 0) + n
+        self._counter(name).inc(n)
 
     def observe(self, name: str, seconds: float) -> None:
-        self._stat(name).add(seconds)
+        self._hist(name).observe(seconds)
 
     def observe_ttft(self, seconds: float) -> None:
         self.observe("time_to_first_token", seconds)
@@ -78,61 +122,76 @@ class ServingMetrics:
                 ("num_running", self.num_running, num_running),
                 ("kv_pool_occupancy", self.kv_occupancy, kv_occupancy)):
             window.append(v)
-            agg = self._gauge_agg.get(name)
-            if agg is None:
-                self._gauge_agg[name] = [1, v, v, v]
-            else:
-                agg[0] += 1
-                agg[1] += v
-                agg[2] = max(agg[2], v)
-                agg[3] = min(agg[3], v)
+            self._gauges[name].set(v)
 
-    # --- dispatch-hook wiring (profiler integration) ------------------------
+    # --- legacy inspection views --------------------------------------------
+    @property
+    def counters(self) -> Dict[str, int]:
+        """{legacy_name: count} snapshot over the registry counters."""
+        return {name: int(c.value) for name, c in self._counters.items()}
+
+    @property
+    def latency(self) -> Dict[str, OpStat]:
+        """{name: OpStat} view over the latency histograms (the shape
+        ``profiler/statistic.summary_table`` renders)."""
+        out: Dict[str, OpStat] = {}
+        for name, h in self._hists.items():
+            st = OpStat(name)
+            st.calls = h.count
+            st.total = h.sum
+            if h.count:
+                st.max = h.max
+                st.min = h.min
+            out[name] = st
+        return out
+
+    # --- dispatch-bus wiring (profiler integration) -------------------------
     def install_dispatch_timer(self):
-        """Route per-op dispatch wall times into this metrics object via
-        the profiler's ``_set_op_timer`` hook (no-op if a Profiler already
-        owns the hook).  Returns a zero-arg remover."""
+        """Subscribe per-op dispatch wall times into this metrics object
+        via the multi-subscriber op bus — coexists with any active
+        Profiler (the old single-owner hook silently no-oped here).
+        Returns a zero-arg remover."""
         from ..core import dispatch as _dispatch
 
-        if _dispatch._op_timer is not None:
-            return lambda: None
         if self._host_ops is None:
             self._host_ops = HostOpRecorder()
-        _dispatch._set_op_timer(self._host_ops)
+        return _dispatch.add_op_timer(self._host_ops)
 
-        def remove():
-            if _dispatch._op_timer is self._host_ops:
-                _dispatch._set_op_timer(None)
+    # --- exporters ----------------------------------------------------------
+    def prometheus_text(self) -> str:
+        return self.registry.prometheus_text()
 
-        return remove
+    def snapshot(self) -> Dict:
+        return self.registry.snapshot()
 
     # --- reporting ----------------------------------------------------------
     def _gauge_rows(self):
         rows = []
-        for name in ("queue_depth", "num_running", "kv_pool_occupancy"):
-            agg = self._gauge_agg.get(name)
-            if agg is None:
+        for name in _GAUGE_NAMES:
+            g = self._gauges[name]
+            if g.samples == 0:
                 rows.append((name, 0, "-", "-", "-"))
             else:
-                n, total, mx, mn = agg
-                rows.append((name, n, f"{total / n:.2f}",
-                             f"{mx:.2f}", f"{mn:.2f}"))
+                rows.append((name, g.samples, f"{g.avg:.2f}",
+                             f"{g.max:.2f}", f"{g.min:.2f}"))
         return rows
 
     def summary(self, time_unit: str = "ms") -> str:
         """Render the serving report in ``profiler/statistic.py`` table
         style (printed AND returned, like ``Profiler.summary``)."""
         parts = []
-        if self.latency:
+        latency = self.latency
+        if latency:
             parts.append(summary_table(
-                self.latency, "Serving latency summary (request-level)",
+                latency, "Serving latency summary (request-level)",
                 time_unit=time_unit))
 
+        counters = self.counters
         header = f"{'Counter':32s} {'Value':>12s}"
         bar = "-" * len(header)
         lines = [bar, "Serving counters", bar, header, bar]
-        for name in sorted(self.counters):
-            lines.append(f"{name:32s} {self.counters[name]:12d}")
+        for name in sorted(counters):
+            lines.append(f"{name:32s} {counters[name]:12d}")
         lines.append(bar)
         parts.append("\n".join(lines))
 

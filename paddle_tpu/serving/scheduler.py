@@ -76,7 +76,7 @@ class ContinuousBatchingScheduler:
     def __init__(self, config: SchedulerConfig, kv: KVCacheManager):
         self.config = config
         self.kv = kv
-        self.waiting: Deque[Request] = deque()
+        self.waiting: Deque[Request] = deque()  # unbounded-ok: live work queue (admission drains it); not telemetry
         self.running: List[Request] = []
 
     # --- queue ops ----------------------------------------------------------

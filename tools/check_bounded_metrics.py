@@ -1,0 +1,112 @@
+#!/usr/bin/env python
+"""Bounded-memory lint for the telemetry layers (ISSUE 2 satellite).
+
+Long-lived serving processes must not let metrics/trace state grow
+without bound, so every accumulation container in
+``paddle_tpu/observability/`` and ``paddle_tpu/serving/`` has to declare
+its bound:
+
+* ``collections.deque(...)`` must pass ``maxlen=``;
+* ``queue.Queue(...)`` must pass ``maxsize=`` (positional or keyword);
+* a bare-list "reservoir" (``self.x = []`` later ``.append``ed from a
+  per-step/per-op path) is caught by the deque rule in practice — the
+  repo's convention is that windows/rings are deques.
+
+A genuinely-unbounded container that holds WORK (not telemetry) is
+allowed with an inline waiver comment stating why::
+
+    self.waiting = deque()  # unbounded-ok: live work queue, drained
+
+Run standalone (exits 1 on violations) or from the test suite
+(``tests/test_observability.py`` asserts ``scan()`` returns nothing).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from typing import List, Tuple
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCAN_DIRS = (
+    os.path.join(_REPO, "paddle_tpu", "observability"),
+    os.path.join(_REPO, "paddle_tpu", "serving"),
+)
+WAIVER = "unbounded-ok:"
+
+# call-name suffix -> required bound keyword
+_RULES = {
+    "deque": ("maxlen", 1),   # deque(iterable, maxlen) — kw or 2nd pos
+    "Queue": ("maxsize", 0),  # Queue(maxsize) — kw or 1st pos
+}
+
+
+def _call_name(node: ast.Call) -> str:
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return ""
+
+
+def _bounded(node: ast.Call, kw: str, pos: int) -> bool:
+    if any(k.arg == kw for k in node.keywords):
+        return True
+    return len(node.args) > pos
+
+
+def check_file(path: str) -> List[Tuple[str, int, str]]:
+    with open(path) as f:
+        source = f.read()
+    lines = source.splitlines()
+    out = []
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [(path, e.lineno or 0, f"syntax error: {e.msg}")]
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _call_name(node)
+        rule = _RULES.get(name)
+        if rule is None:
+            continue
+        kw, pos = rule
+        if _bounded(node, kw, pos):
+            continue
+        line_text = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
+        if WAIVER in line_text:
+            continue
+        out.append((path, node.lineno,
+                    f"{name}() without {kw}= — unbounded accumulation in a "
+                    f"long-lived process (add {kw}= or a "
+                    f"'# {WAIVER} <reason>' waiver)"))
+    return out
+
+
+def scan(dirs=SCAN_DIRS) -> List[Tuple[str, int, str]]:
+    out = []
+    for d in dirs:
+        for root, _, files in os.walk(d):
+            for fn in sorted(files):
+                if fn.endswith(".py"):
+                    out.extend(check_file(os.path.join(root, fn)))
+    return out
+
+
+def main() -> int:
+    violations = scan()
+    for path, lineno, msg in violations:
+        rel = os.path.relpath(path, _REPO)
+        print(f"{rel}:{lineno}: {msg}")
+    if violations:
+        print(f"{len(violations)} unbounded-accumulation violation(s)")
+        return 1
+    print("bounded-metrics lint: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

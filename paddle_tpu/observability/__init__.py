@@ -17,6 +17,11 @@ and user code (ISSUE 2 tentpole):
   see per-op dispatch wall times at the same time.
   :func:`subscribe_ops` / :func:`trace_dispatch` are the public surface.
 
+:func:`start_metrics_server` (``httpd.py``) serves any registry as a
+Prometheus ``/metrics`` scrape endpoint from a daemon thread — the same
+page the serving frontend exposes — so training jobs are fleet-scrapable
+too (closed ROADMAP follow-up (a)).
+
 Process-wide defaults: :func:`get_tracer` / :func:`get_registry` return
 one shared instance each, so spans from the serving engine, jit compile
 events and watchdog timeouts land in one trace, and compile counters /
@@ -29,6 +34,12 @@ from .export import (  # noqa: F401
     ProfilerResult,
     export_chrome_trace,
     load_profiler_result,
+)
+from .httpd import (  # noqa: F401
+    PROMETHEUS_CONTENT_TYPE,
+    MetricsServer,
+    metrics_page,
+    start_metrics_server,
 )
 from .metrics import (  # noqa: F401
     Counter,

@@ -13,15 +13,35 @@ paged-attention ops and predictor API:
   queue/pool gauges, preemption counters, profiler-style ``summary()``.
 * :class:`LLM` / :func:`stream_generate` (``entrypoints.py``) — batch and
   streaming user surfaces.
+* :class:`CompletionServer` (``server.py`` + ``protocol.py``) — asyncio
+  HTTP/SSE frontend: OpenAI-style ``POST /v1/completions`` (SSE when
+  ``stream=true``), ``/healthz`` / ``/readyz`` / ``/metrics``, admission
+  control (429 + Retry-After), per-request deadlines, graceful drain.
 
 Architecture sketch and scheduler invariants: see ``scheduler.py``'s
-module docstring and the README's serving section.
+module docstring and the README's serving sections.
 """
 
 from .engine import EngineCore  # noqa: F401
 from .entrypoints import LLM, CompletionOutput, stream_generate  # noqa: F401
 from .kv_manager import KVCacheManager, PoolExhausted  # noqa: F401
 from .metrics import ServingMetrics  # noqa: F401
+from .protocol import (  # noqa: F401
+    CompletionRequest,
+    ProtocolError,
+    parse_completion_request,
+)
+
+
+def __getattr__(name):
+    # lazy: eager `from .server import ...` would put the module in
+    # sys.modules before `python -m paddle_tpu.serving.server` executes
+    # it as __main__, tripping runpy's double-import warning
+    if name in ("CompletionServer", "ServerConfig", "server"):
+        from . import server as _server
+
+        return _server if name == "server" else getattr(_server, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 from .request import (  # noqa: F401
     FinishReason,
     Request,

@@ -172,11 +172,18 @@ class EngineCore:
 
     # --- request lifecycle --------------------------------------------------
     def add_request(self, prompt_ids, sampling: Optional[SamplingParams] = None,
-                    request_id=None, priority: int = 0) -> Request:
-        """Enqueue a request (admission happens inside ``step``)."""
+                    request_id=None, priority: int = 0,
+                    trace_id: Optional[str] = None) -> Request:
+        """Enqueue a request (admission happens inside ``step``).
+
+        ``trace_id`` (defaults to ``str(request_id)``) is attached to every
+        span/instant the engine records for this request, so a frontend can
+        reconstruct one request's prefill/preempt/decode lifecycle from the
+        exported chrome trace."""
         req = Request(prompt_ids=list(np.asarray(prompt_ids).reshape(-1)),
                       sampling=sampling or SamplingParams(),
-                      request_id=request_id, priority=priority)
+                      request_id=request_id, priority=priority,
+                      trace_id=trace_id)
         if req.request_id in self.requests:
             raise ValueError(f"request id {req.request_id!r} already exists")
         req.arrival_time = time.perf_counter()
@@ -185,15 +192,17 @@ class EngineCore:
         self.metrics.count("requests_admitted")
         return req
 
-    def abort_request(self, request_id) -> bool:
+    def abort_request(self, request_id,
+                      reason: FinishReason = FinishReason.ABORT) -> bool:
         """Abort: frees blocks immediately, ends any stream with
-        finish_reason ABORT.  True if the request was still live."""
+        ``reason`` (default ABORT; the HTTP frontend passes TIMEOUT for
+        deadline/drain aborts).  True if the request was still live."""
         req = self.requests.get(request_id)
         if req is None or req.finished:
             return False
         self.scheduler.remove(req)
         self.kv.free(req.request_id)
-        self._finish(req, FinishReason.ABORT)
+        self._finish(req, reason)
         self.requests.pop(request_id, None)
         return True
 
@@ -251,7 +260,8 @@ class EngineCore:
         offs = (np.arange(Tb) % self.block_size).astype(np.int32)
         self.prefill_buckets.add(("prefill", Tb))
         with self.tracer.span("prefill_step", cat="serving",
-                              request=str(rid), tokens=T0, bucket=Tb,
+                              request=str(rid), trace=req.trace_id,
+                              tokens=T0, bucket=Tb,
                               recompute=bool(req.output_tokens)):
             with StepTimer(self.metrics, "prefill_step"):
                 last, self._k_pools, self._v_pools = self._jit_prefill(
@@ -284,7 +294,11 @@ class EngineCore:
             slot_blocks[i], slot_offsets[i] = r._slot
         self.decode_buckets.add(("decode", Bb, Wb))
         with self.tracer.span("decode_step", cat="serving", batch=B,
-                              batch_bucket=Bb, width_bucket=Wb):
+                              batch_bucket=Bb, width_bucket=Wb,
+                              requests=",".join(str(r.request_id)
+                                                for r in reqs),
+                              traces=",".join(str(r.trace_id)
+                                              for r in reqs)):
             with StepTimer(self.metrics, "decode_step"):
                 out, self._k_pools, self._v_pools = self._jit_decode(
                     self._param_vals(), self._k_pools, self._v_pools,
@@ -311,7 +325,7 @@ class EngineCore:
                 for req in plan.preempted:
                     self.tracer.instant(
                         "preemption", cat="serving",
-                        request=str(req.request_id),
+                        request=str(req.request_id), trace=req.trace_id,
                         generated=len(req.output_tokens))
                 for req in plan.aborted:
                     # unservable at admission: scheduler set state/reason,
@@ -360,18 +374,29 @@ class EngineCore:
         when the request finishes (its ``finish_reason`` says why); an
         abort mid-stream simply ends the iteration.  The handle is
         resolved eagerly, so the stream stays valid after the engine
-        retires the finished request from ``self.requests``."""
+        retires the finished request from ``self.requests``.
+
+        Closing the generator early (``.close()`` / ``GeneratorExit`` /
+        garbage collection) aborts the underlying request and frees its
+        KV blocks — an abandoned stream must not leak scheduled work."""
         req = self.requests[request_id]
 
         def _gen():
             cursor = 0
-            while True:
-                while cursor < len(req.output_tokens):
-                    yield req.output_tokens[cursor]
-                    cursor += 1
-                if req.finished:
-                    return
-                self.step()
+            try:
+                while True:
+                    while cursor < len(req.output_tokens):
+                        yield req.output_tokens[cursor]
+                        cursor += 1
+                    if req.finished:
+                        return
+                    self.step()
+            finally:
+                # reached on GeneratorExit too: a consumer that walks away
+                # mid-stream must not leave the request running in the
+                # scheduler holding pool blocks
+                if not req.finished:
+                    self.abort_request(req.request_id)
 
         return _gen()
 

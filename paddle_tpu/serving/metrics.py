@@ -54,6 +54,8 @@ _COUNTER_NAMES = (
     "requests_finished_eos",
     "requests_finished_length",
     "requests_finished_abort",
+    "requests_finished_timeout",
+    "admission_rejected",
     "preemptions",
     "recompute_prefills",
     "engine_steps",

@@ -39,6 +39,7 @@ class FinishReason(Enum):
     EOS = "eos"          # emitted the eos token
     LENGTH = "length"    # hit max_new_tokens
     ABORT = "abort"      # caller abort / unservable request
+    TIMEOUT = "timeout"  # per-request deadline / drain deadline hit
 
 
 @dataclass
@@ -76,6 +77,10 @@ class Request:
     prompt_ids: List[int]
     sampling: SamplingParams = field(default_factory=SamplingParams)
     request_id: object = None
+    trace_id: Optional[str] = None   # rides every span/instant the engine
+                                     # records for this request, so one
+                                     # request's lifecycle is a filter over
+                                     # the exported chrome trace
     priority: int = 0            # lower = more important; ties break by
                                  # arrival order (newest preempted first)
     state: RequestState = RequestState.WAITING
@@ -92,6 +97,8 @@ class Request:
         self.arrival_seq = next(_req_counter)
         if self.request_id is None:
             self.request_id = self.arrival_seq
+        if self.trace_id is None:
+            self.trace_id = str(self.request_id)
         self.prompt_ids = [int(t) for t in np.asarray(self.prompt_ids).reshape(-1)]
         self._rng = np.random.default_rng(self.sampling.seed)
 

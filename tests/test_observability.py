@@ -469,6 +469,62 @@ class TestWatchdogEvent:
 
 
 # --------------------------------------------------------------------------
+# standalone /metrics scrape endpoint (ISSUE 3 satellite)
+# --------------------------------------------------------------------------
+class TestMetricsServer:
+    def test_scrape_shared_page_and_close(self):
+        """start_metrics_server serves the same Prometheus exposition the
+        serving frontend does, from a daemon thread — training jobs are
+        scrapable without the HTTP serving stack."""
+        import http.client
+
+        from paddle_tpu.observability import (MetricsRegistry, metrics_page,
+                                              start_metrics_server)
+        from paddle_tpu.observability import httpd as _httpd
+
+        reg = MetricsRegistry()
+        reg.counter("train_steps_total", "train steps").inc(3)
+        reg.gauge("tokens_per_second", "throughput").set(1234.5)
+        srv = start_metrics_server(reg, port=0)
+        try:
+            assert srv in _httpd._started      # atexit will close it
+            conn = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                              timeout=10)
+            conn.request("GET", "/metrics")
+            resp = conn.getresponse()
+            body = resp.read()
+            assert resp.status == 200
+            assert resp.getheader("Content-Type").startswith(
+                "text/plain; version=0.0.4")
+            # byte-identical to the shared page handler
+            assert body == metrics_page(reg)
+            assert b"train_steps_total 3" in body
+            assert b"tokens_per_second 1234.5" in body
+            conn.request("GET", "/healthz")
+            assert conn.getresponse().read() == b"ok\n"
+            conn.request("GET", "/nope")
+            assert conn.getresponse().status == 404
+            conn.close()
+        finally:
+            srv.close()
+        srv.close()  # idempotent
+        with pytest.raises(OSError):
+            c = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=2)
+            c.request("GET", "/metrics")
+            c.getresponse()
+
+    def test_close_without_start_does_not_hang(self):
+        """Regression: socketserver.shutdown() blocks on a flag only
+        serve_forever() sets — close() on a constructed-but-never-started
+        server must return (releasing the port), not deadlock."""
+        from paddle_tpu.observability import MetricsRegistry, MetricsServer
+
+        srv = MetricsServer(MetricsRegistry(), port=0)
+        srv.close()      # must return promptly
+        srv.close()      # and stay idempotent
+
+
+# --------------------------------------------------------------------------
 # bounded-metrics lint
 # --------------------------------------------------------------------------
 class TestBoundedMetricsLint:
@@ -492,3 +548,22 @@ class TestBoundedMetricsLint:
         hits = lint.check_file(str(bad))
         assert [(line, "deque" in msg or "Queue" in msg)
                 for _, line, msg in hits] == [(3, True), (5, True)]
+
+    def test_flags_asyncio_queues_and_simplequeue(self, tmp_path):
+        """The server-module extension: asyncio.Queue and the
+        Lifo/Priority variants need maxsize=; SimpleQueue (no bound
+        parameter at all) always needs a waiver."""
+        import check_bounded_metrics as lint
+
+        bad = tmp_path / "srv.py"
+        bad.write_text(
+            "import asyncio, queue\n"
+            "a = asyncio.Queue()\n"
+            "b = asyncio.Queue(maxsize=8)\n"
+            "c = queue.LifoQueue()\n"
+            "d = asyncio.PriorityQueue(4)\n"
+            "e = queue.SimpleQueue()\n"
+            "f = queue.SimpleQueue()  # unbounded-ok: test waiver\n")
+        hits = [(line, msg) for _, line, msg in lint.check_file(str(bad))]
+        assert [line for line, _ in hits] == [2, 4, 6]
+        assert "cannot be bounded" in hits[2][1]

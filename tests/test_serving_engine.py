@@ -221,6 +221,40 @@ class TestStreaming:
         assert other.finish_reason == FinishReason.LENGTH
         assert eng.kv.num_free == eng.kv.num_blocks - 1
 
+    def test_closing_stream_early_aborts_and_frees_blocks(self):
+        """Regression: an abandoned stream (consumer closes the generator
+        / GeneratorExit) must abort the request and free its KV blocks —
+        it used to leave the request scheduled, leaking pool blocks."""
+        m = _model(layers=2)
+        eng = _engine(m)
+        gen = stream_generate(eng, PROMPTS[0],
+                              SamplingParams(max_new_tokens=50))
+        got = [next(gen), next(gen)]
+        assert len(got) == 2
+        req = next(iter(eng.requests.values()))
+        assert eng.kv.num_owned_blocks(req.request_id) > 0
+        gen.close()
+        assert req.finish_reason == FinishReason.ABORT
+        assert eng.kv.occupancy() == 0.0           # pool back to empty
+        assert eng.kv.num_free == eng.kv.num_blocks - 1
+        assert eng.requests == {}
+        assert not eng.scheduler.has_work()
+
+    def test_dropped_stream_reference_aborts_via_gc(self):
+        """Dropping the only reference (no explicit close) also frees the
+        request: generator GC raises GeneratorExit into the frame."""
+        import gc
+
+        m = _model(layers=2)
+        eng = _engine(m)
+        gen = stream_generate(eng, PROMPTS[1],
+                              SamplingParams(max_new_tokens=50))
+        next(gen)
+        del gen
+        gc.collect()
+        assert eng.kv.occupancy() == 0.0
+        assert eng.requests == {}
+
     def test_seeded_sampling_is_deterministic_per_request(self):
         m = _model(layers=2)
         samp = dict(temperature=0.8, top_k=4)
@@ -344,6 +378,60 @@ class TestMetrics:
         report = eng.metrics.summary()
         capsys.readouterr()
         assert "Host operator summary" in report
+
+
+class TestRequestTracing:
+    def test_span_tree_reconstructs_across_preemption(self, tmp_path):
+        """ROADMAP follow-up (c): every span/instant the engine records
+        for a request carries its request_id/trace_id, so ONE request's
+        lifecycle — prefill, preemption, recompute prefill, decodes — is
+        a filter over the exported chrome JSON."""
+        from paddle_tpu.observability import (SpanTracer, set_tracer,
+                                              load_profiler_result)
+
+        prev = set_tracer(SpanTracer(capacity=16384))
+        try:
+            m = _model()
+            eng = _engine(m, num_blocks=10, block_size=2, max_num_seqs=4)
+            reqs = [eng.add_request(p, SamplingParams(max_new_tokens=8),
+                                    trace_id=f"trace-{i}")
+                    for i, p in enumerate(PROMPTS[:2])]
+            eng.run(max_steps=300)
+            assert eng.metrics.counters["preemptions"] >= 1
+            victim = next(r for r in reqs if r.num_preemptions > 0)
+            tid = victim.trace_id
+
+            path = eng.tracer.export_chrome(str(tmp_path / "trace.json"))
+            res = load_profiler_result(path)
+
+            prefills = [e for e in res.find("prefill_step")
+                        if e.attrs.get("trace") == tid]
+            assert len(prefills) >= 2          # admission + recompute
+            assert any(e.attrs.get("recompute") for e in prefills)
+            assert all(e.attrs.get("request") == str(victim.request_id)
+                       for e in prefills)
+            preempts = [e for e in res.find("preemption")
+                        if e.attrs.get("trace") == tid]
+            assert preempts
+            decodes = [e for e in res.find("decode_step")
+                       if tid in str(e.attrs.get("traces", "")).split(",")]
+            assert decodes
+            # the tree nests: every per-request event sits under its
+            # engine_step parent in the reconstructed hierarchy
+            by_id = {e.span_id: e for e in res.events
+                     if e.span_id is not None}
+            for e in prefills + decodes + preempts:
+                assert e.parent_id is not None
+                assert by_id[e.parent_id].name == "engine_step"
+        finally:
+            set_tracer(prev)
+
+    def test_default_trace_id_is_request_id(self):
+        m = _model(layers=2)
+        eng = _engine(m)
+        req = eng.add_request(PROMPTS[0], SamplingParams(max_new_tokens=1))
+        assert req.trace_id == str(req.request_id)
+        eng.run(max_steps=20)
 
 
 class TestLLMEntrypoint:

@@ -1,0 +1,457 @@
+"""Loopback tests for the HTTP/SSE serving frontend (ISSUE 3).
+
+A real :class:`CompletionServer` runs on an asyncio loop in a background
+thread; tests speak actual HTTP over ``http.client`` on 127.0.0.1 —
+concurrent SSE streams, admission-control 429s, request deadlines,
+graceful drain, and the Prometheus ``/metrics`` page.  Everything runs
+on the toy Llama under ``JAX_PLATFORMS=cpu`` (tier-1)."""
+
+import asyncio
+import http.client
+import json
+import os
+import re
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.serving import LLM, EngineCore, SamplingParams, SchedulerConfig
+from paddle_tpu.serving.protocol import (
+    ProtocolError,
+    parse_completion_request,
+    sse_event,
+)
+from paddle_tpu.serving.server import CompletionServer, ServerConfig
+
+PROMPTS = [[5, 9, 23, 7], [40, 2, 11], [1, 2, 3, 4, 5, 6], [100, 101]]
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _model(layers=2):
+    paddle.seed(0)
+    return LlamaForCausalLM(LlamaConfig.tiny(num_hidden_layers=layers))
+
+
+def _engine(model, num_blocks=64, block_size=4, max_num_seqs=4):
+    return EngineCore(model, num_blocks=num_blocks, block_size=block_size,
+                      scheduler_config=SchedulerConfig(
+                          max_num_seqs=max_num_seqs))
+
+
+class Harness:
+    """A live CompletionServer on an asyncio loop in a daemon thread."""
+
+    def __init__(self, engine, cfg=None):
+        self.loop = asyncio.new_event_loop()
+        self.thread = threading.Thread(target=self.loop.run_forever,
+                                       daemon=True)
+        self.thread.start()
+        self.server = CompletionServer(engine, cfg or ServerConfig())
+        self.run(self.server.start())
+        self.port = self.server.port
+
+    def run(self, coro, timeout=120):
+        return asyncio.run_coroutine_threadsafe(
+            coro, self.loop).result(timeout)
+
+    def submit(self, coro):
+        return asyncio.run_coroutine_threadsafe(coro, self.loop)
+
+    def close(self):
+        try:
+            self.run(self.server.shutdown(drain_timeout=1.0), timeout=60)
+        finally:
+            self.loop.call_soon_threadsafe(self.loop.stop)
+            self.thread.join(10)
+            self.loop.close()
+
+
+@pytest.fixture
+def harness_factory():
+    live = []
+
+    def make(engine, cfg=None):
+        h = Harness(engine, cfg)
+        live.append(h)
+        return h
+
+    yield make
+    for h in live:
+        h.close()
+
+
+# --- raw HTTP helpers -------------------------------------------------------
+
+def _request(port, method, path, body=None, timeout=120):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    payload = None if body is None else json.dumps(body)
+    conn.request(method, path, payload,
+                 {"Content-Type": "application/json"} if payload else {})
+    resp = conn.getresponse()
+    data = resp.read()
+    headers = {k.lower(): v for k, v in resp.getheaders()}
+    conn.close()
+    return resp.status, headers, data
+
+
+def _sse_request(port, body, timeout=120, stop_after=None):
+    """POST a streaming completion; parse SSE frames.  Returns
+    (tokens, finish_reason, saw_done).  ``stop_after=n`` closes the
+    connection after n tokens (client walks away)."""
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    conn.request("POST", "/v1/completions", json.dumps(dict(body, stream=True)),
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    assert resp.status == 200, resp.read()
+    assert resp.getheader("Content-Type") == "text/event-stream"
+    tokens, finish, done = [], None, False
+    while True:
+        line = resp.readline()
+        if not line:
+            break
+        line = line.rstrip(b"\n")
+        if not line:
+            continue  # blank separator between events
+        assert line.startswith(b"data: "), line
+        payload = line[len(b"data: "):]
+        if payload == b"[DONE]":
+            done = True
+            break
+        obj = json.loads(payload)
+        choice = obj["choices"][0]
+        tokens.extend(choice["token_ids"])
+        if choice["finish_reason"] is not None:
+            finish = choice["finish_reason"]
+        if stop_after is not None and len(tokens) >= stop_after:
+            break
+    conn.close()
+    return tokens, finish, done
+
+
+# --- protocol unit tests ----------------------------------------------------
+
+class TestProtocol:
+    def test_parse_minimal_and_defaults(self):
+        req = parse_completion_request(b'{"prompt": [1, 2, 3]}')
+        assert req.prompt_ids == [1, 2, 3]
+        assert req.max_tokens == 16 and not req.stream
+        assert req.sampling().temperature == 0.0
+
+    @pytest.mark.parametrize("body", [
+        b"not json",
+        b'[1,2]',
+        b'{}',
+        b'{"prompt": []}',
+        b'{"prompt": ["a"]}',
+        b'{"prompt": "hi"}',              # no tokenizer configured
+        b'{"prompt": [1], "max_tokens": 0}',
+        b'{"prompt": [1], "max_tokens": "4"}',
+        b'{"prompt": [1], "temperature": -1}',
+        b'{"prompt": [1], "temperature": NaN}',   # json accepts the literal
+        b'{"prompt": [1], "temperature": Infinity}',
+        b'{"prompt": [1], "timeout": 0}',
+        b'{"prompt": [1], "timeout": NaN}',
+        b'{"prompt": [1], "seed": -1}',           # np rng wants seed >= 0
+        b'{"prompt": [1], "stream": 1}',
+    ])
+    def test_parse_rejects(self, body):
+        with pytest.raises(ProtocolError):
+            parse_completion_request(body)
+
+    def test_string_prompt_with_tokenizer(self):
+        req = parse_completion_request(
+            b'{"prompt": "abc"}', tokenize=lambda s: [ord(c) for c in s])
+        assert req.prompt_ids == [97, 98, 99]
+
+    def test_sse_event_framing(self):
+        ev = sse_event({"a": 1})
+        assert ev == b'data: {"a":1}\n\n'
+
+
+# --- loopback integration ---------------------------------------------------
+
+class TestEndpoints:
+    def test_health_ready_metrics_and_404(self, harness_factory):
+        h = harness_factory(_engine(_model()))
+        assert _request(h.port, "GET", "/healthz")[0] == 200
+        assert _request(h.port, "GET", "/readyz")[0] == 200
+        status, headers, body = _request(h.port, "GET", "/metrics")
+        assert status == 200
+        assert headers["content-type"].startswith(
+            "text/plain; version=0.0.4")
+        assert _request(h.port, "GET", "/nope")[0] == 404
+        assert _request(h.port, "GET", "/v1/completions")[0] == 405
+
+    def test_bad_request_400(self, harness_factory):
+        h = harness_factory(_engine(_model()))
+        status, _, data = _request(h.port, "POST", "/v1/completions",
+                                   {"max_tokens": 4})
+        assert status == 400
+        assert "prompt" in json.loads(data)["error"]["message"]
+
+    def test_completion_roundtrip_token_identical(self, harness_factory):
+        m = _model()
+        ref = LLM(m, num_blocks=64, block_size=4).generate(
+            [PROMPTS[0]], SamplingParams(max_new_tokens=6))[0]
+        h = harness_factory(_engine(m))
+        status, _, data = _request(h.port, "POST", "/v1/completions",
+                                   {"prompt": PROMPTS[0], "max_tokens": 6})
+        assert status == 200
+        obj = json.loads(data)
+        choice = obj["choices"][0]
+        assert choice["token_ids"] == ref.token_ids
+        assert choice["finish_reason"] == "length"
+        assert obj["usage"] == {"prompt_tokens": 4, "completion_tokens": 6,
+                                "total_tokens": 10}
+
+    def test_concurrent_sse_streams_token_identical(self, harness_factory):
+        """The acceptance criterion: ≥4 concurrent SSE streaming requests
+        complete token-identical to offline LLM.generate under greedy
+        sampling, with the jitted-step compile count still bounded by the
+        shape buckets (in-trace counters)."""
+        m = _model()
+        refs = [o.token_ids for o in LLM(
+            m, num_blocks=64, block_size=4, max_num_seqs=4).generate(
+                PROMPTS, SamplingParams(max_new_tokens=6))]
+        engine = _engine(m, max_num_seqs=4)
+        h = harness_factory(engine)
+
+        results = [None] * len(PROMPTS)
+
+        def worker(i):
+            results[i] = _sse_request(
+                h.port, {"prompt": PROMPTS[i], "max_tokens": 6})
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(len(PROMPTS))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(120)
+        for (tokens, finish, done), ref in zip(results, refs):
+            assert tokens == ref
+            assert finish == "length"
+            assert done                       # [DONE] terminated the stream
+        # fixed-shape discipline survives the HTTP layer
+        assert engine.decode_trace_count <= len(engine.decode_buckets)
+        assert engine.prefill_trace_count <= len(engine.prefill_buckets)
+
+    def test_metrics_page_exposes_serving_series(self, harness_factory):
+        h = harness_factory(_engine(_model()))
+        _request(h.port, "POST", "/v1/completions",
+                 {"prompt": PROMPTS[0], "max_tokens": 3})
+        status, headers, data = _request(h.port, "GET", "/metrics")
+        assert status == 200
+        text = data.decode()
+        assert ("# TYPE serving_time_to_first_token_seconds histogram"
+                in text)
+        assert "serving_time_to_first_token_seconds_bucket{le=" in text
+        assert "serving_inter_token_latency_seconds_bucket{le=" in text
+        assert "serving_admission_rejected_total 0" in text
+        # the http counter ticks just after the response flushes; allow
+        # the scrape a moment to observe it
+        pat = (r'serving_http_requests_total\{code="200",'
+               r'route="/v1/completions"\} 1')
+        deadline = time.monotonic() + 5
+        while not re.search(pat, text) and time.monotonic() < deadline:
+            time.sleep(0.02)
+            text = _request(h.port, "GET", "/metrics")[2].decode()
+        assert re.search(pat, text)
+        # every sample line is valid exposition: name{labels}? value
+        sample = re.compile(
+            r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? "
+            r"(-?\d+(\.\d+)?([eE][+-]?\d+)?|\+Inf|-Inf|NaN)$")
+        for line in text.strip().splitlines():
+            if not line.startswith("#"):
+                assert sample.match(line), line
+
+
+class TestAdmissionControl:
+    def test_429_with_retry_after_when_saturated(self, harness_factory):
+        """With max_queue=1 and one stream in flight, the next POST is
+        rejected 429 with a Retry-After header and the
+        serving_admission_rejected_total counter increments."""
+        m = _model()
+        engine = _engine(m, num_blocks=256)
+        h = harness_factory(engine, ServerConfig(max_queue=1,
+                                                 retry_after_s=7))
+        got_token = threading.Event()
+        first = {}
+
+        def long_stream():
+            conn = http.client.HTTPConnection("127.0.0.1", h.port,
+                                              timeout=120)
+            conn.request("POST", "/v1/completions",
+                         json.dumps({"prompt": PROMPTS[0],
+                                     "max_tokens": 120, "stream": True}),
+                         {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            assert resp.status == 200
+            tokens, finish, done = [], None, False
+            while True:
+                line = resp.readline().rstrip(b"\n")
+                if not line:
+                    if not resp.isclosed():
+                        continue
+                    break
+                payload = line[len(b"data: "):]
+                if payload == b"[DONE]":
+                    done = True
+                    break
+                choice = json.loads(payload)["choices"][0]
+                tokens.extend(choice["token_ids"])
+                if tokens:
+                    # the stream provably holds the only admission slot
+                    got_token.set()
+                if choice["finish_reason"] is not None:
+                    finish = choice["finish_reason"]
+            conn.close()
+            first["result"] = (tokens, finish, done)
+
+        t = threading.Thread(target=long_stream)
+        t.start()
+        assert got_token.wait(60), "first stream never produced a token"
+        status, headers, data = _request(
+            h.port, "POST", "/v1/completions",
+            {"prompt": PROMPTS[1], "max_tokens": 2})
+        assert status == 429
+        assert headers["retry-after"] == "7"
+        assert json.loads(data)["error"]["type"] == "overloaded_error"
+        t.join(120)
+        tokens, finish, done = first["result"]
+        assert done and finish == "length" and len(tokens) == 120
+        # the rejection was counted; the admitted stream was unaffected
+        _, _, metrics = _request(h.port, "GET", "/metrics")
+        assert b"serving_admission_rejected_total 1" in metrics
+        assert engine.kv.num_free == engine.kv.num_blocks - 1
+
+
+class TestDeadlines:
+    def test_request_timeout_returns_partial(self, harness_factory):
+        m = _model()
+        engine = _engine(m, num_blocks=256)
+        h = harness_factory(engine)
+        t0 = time.monotonic()
+        status, _, data = _request(
+            h.port, "POST", "/v1/completions",
+            {"prompt": PROMPTS[0], "max_tokens": 10000, "timeout": 0.3})
+        assert status == 200
+        choice = json.loads(data)["choices"][0]
+        assert choice["finish_reason"] == "timeout"
+        assert len(choice["token_ids"]) < 10000    # partial output
+        assert time.monotonic() - t0 < 60
+        # abort propagated into the scheduler: blocks freed
+        deadline = time.monotonic() + 30
+        while (engine.kv.num_free != engine.kv.num_blocks - 1
+               and time.monotonic() < deadline):
+            time.sleep(0.02)
+        assert engine.kv.num_free == engine.kv.num_blocks - 1
+        _, _, metrics = _request(h.port, "GET", "/metrics")
+        assert b"serving_requests_finished_timeout_total 1" in metrics
+
+
+class TestDrain:
+    def test_graceful_drain(self, harness_factory):
+        """shutdown(): /readyz flips to 503 immediately, new requests get
+        503, in-flight requests finish or hit the drain deadline, and no
+        KV blocks leak (pool occupancy zero at exit)."""
+        m = _model()
+        engine = _engine(m, num_blocks=256)
+        h = harness_factory(engine)
+        assert _request(h.port, "GET", "/readyz")[0] == 200
+
+        stream_out = {}
+
+        def long_stream():
+            stream_out["result"] = _sse_request(
+                h.port, {"prompt": PROMPTS[0], "max_tokens": 5000})
+
+        t = threading.Thread(target=long_stream)
+        t.start()
+        # wait for the stream to be admitted (in-flight) before draining
+        deadline = time.monotonic() + 60
+        while (not engine.metrics.counters["requests_admitted"]
+               and time.monotonic() < deadline):
+            time.sleep(0.01)
+        assert engine.metrics.counters["requests_admitted"] == 1
+
+        fut = h.submit(h.server.shutdown(drain_timeout=0.3))
+        # readiness flips the moment the drain begins
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if _request(h.port, "GET", "/readyz")[0] == 503:
+                break
+            time.sleep(0.01)
+        assert _request(h.port, "GET", "/readyz")[0] == 503
+        # no new admission while draining
+        status, _, data = _request(h.port, "POST", "/v1/completions",
+                                   {"prompt": PROMPTS[1], "max_tokens": 2})
+        assert status == 503
+        assert json.loads(data)["error"]["type"] == "unavailable_error"
+
+        fut.result(timeout=60)
+        t.join(60)
+        tokens, finish, done = stream_out["result"]
+        assert done and finish == "timeout"        # drain-deadline abort
+        # no KV blocks leaked: pool occupancy zero at exit
+        assert engine.kv.occupancy() == 0.0
+        assert engine.kv.num_free == engine.kv.num_blocks - 1
+        assert not h.server._engine_thread.is_alive()
+        # the socket is closed: connections now fail
+        with pytest.raises(OSError):
+            _request(h.port, "GET", "/healthz", timeout=2)
+
+
+class TestEngineDeath:
+    def test_dead_engine_thread_turns_away_requests(self, harness_factory):
+        """If the engine thread dies (any step() exception), in-flight
+        handlers finish instead of hanging and NEW requests get 503 —
+        they must not be queued for a thread nobody runs."""
+        engine = _engine(_model())
+        h = harness_factory(engine)
+
+        def boom():
+            raise RuntimeError("induced engine crash")
+
+        engine.step = boom
+        # this request crashes the engine loop; its handler must still
+        # answer (finish_reason abort, empty output), not hang
+        status, _, data = _request(h.port, "POST", "/v1/completions",
+                                   {"prompt": PROMPTS[0], "max_tokens": 4})
+        assert status == 200
+        choice = json.loads(data)["choices"][0]
+        assert choice["finish_reason"] == "abort"
+        assert choice["token_ids"] == []
+        # engine thread is gone: readiness and admission both say 503
+        deadline = time.monotonic() + 10
+        while (h.server._engine_thread.is_alive()
+               and time.monotonic() < deadline):
+            time.sleep(0.01)
+        assert not h.server._engine_thread.is_alive()
+        assert "induced engine crash" in h.server._engine_error
+        assert _request(h.port, "GET", "/readyz")[0] == 503
+        status, _, data = _request(h.port, "POST", "/v1/completions",
+                                   {"prompt": PROMPTS[1], "max_tokens": 2})
+        assert status == 503
+        assert json.loads(data)["error"]["message"] == "engine is not running"
+        # but liveness and metrics still serve
+        assert _request(h.port, "GET", "/healthz")[0] == 200
+        assert _request(h.port, "GET", "/metrics")[0] == 200
+
+
+class TestSelftest:
+    def test_module_selftest_subprocess(self):
+        """`python -m paddle_tpu.serving.server --selftest` boots on an
+        ephemeral port, serves one completion, exits 0 (the CI hook)."""
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.serving.server",
+             "--selftest"],
+            cwd=_REPO, env=env, capture_output=True, text=True, timeout=300)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        assert "selftest: OK" in proc.stdout

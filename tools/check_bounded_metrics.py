@@ -7,7 +7,11 @@ without bound, so every accumulation container in
 its bound:
 
 * ``collections.deque(...)`` must pass ``maxlen=``;
-* ``queue.Queue(...)`` must pass ``maxsize=`` (positional or keyword);
+* ``queue.Queue(...)`` / ``asyncio.Queue(...)`` (and the Lifo/Priority
+  variants of either) must pass ``maxsize=`` (positional or keyword) —
+  the HTTP frontend's cross-thread submit/abort queues are the reason
+  this rule exists;
+* ``SimpleQueue`` has no bound at all, so any use needs a waiver;
 * a bare-list "reservoir" (``self.x = []`` later ``.append``ed from a
   per-step/per-op path) is caught by the deque rule in practice — the
   repo's convention is that windows/rings are deques.
@@ -35,11 +39,17 @@ SCAN_DIRS = (
 )
 WAIVER = "unbounded-ok:"
 
-# call-name suffix -> required bound keyword
+# call-name suffix -> required bound keyword; matches attribute calls
+# too, so queue.Queue and asyncio.Queue hit the same rule
 _RULES = {
-    "deque": ("maxlen", 1),   # deque(iterable, maxlen) — kw or 2nd pos
-    "Queue": ("maxsize", 0),  # Queue(maxsize) — kw or 1st pos
+    "deque": ("maxlen", 1),          # deque(iterable, maxlen) — kw or 2nd pos
+    "Queue": ("maxsize", 0),         # Queue(maxsize) — kw or 1st pos
+    "LifoQueue": ("maxsize", 0),
+    "PriorityQueue": ("maxsize", 0),
 }
+
+# constructors with NO bound parameter: always a violation without a waiver
+_UNBOUNDABLE = ("SimpleQueue",)
 
 
 def _call_name(node: ast.Call) -> str:
@@ -70,13 +80,20 @@ def check_file(path: str) -> List[Tuple[str, int, str]]:
         if not isinstance(node, ast.Call):
             continue
         name = _call_name(node)
+        line_text = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
+        if name in _UNBOUNDABLE:
+            if WAIVER not in line_text:
+                out.append((path, node.lineno,
+                            f"{name}() cannot be bounded — use "
+                            f"Queue(maxsize=...) or add a "
+                            f"'# {WAIVER} <reason>' waiver"))
+            continue
         rule = _RULES.get(name)
         if rule is None:
             continue
         kw, pos = rule
         if _bounded(node, kw, pos):
             continue
-        line_text = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
         if WAIVER in line_text:
             continue
         out.append((path, node.lineno,

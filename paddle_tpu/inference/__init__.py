@@ -155,11 +155,16 @@ class LLMPredictor:
         self.model = model
         self.block_size = block_size
         self.num_blocks = num_blocks
+        # prefix_cache off: the predictor is MANUAL mode (the caller owns
+        # scheduling, so the admission-time fork that feeds the cache
+        # never runs) — parking freed blocks in a reuse LRU would only
+        # obscure the `_free` introspection surface
         self.engine = EngineCore(
             model, num_blocks=num_blocks, block_size=block_size,
             dtype=dtype,
             scheduler_config=SchedulerConfig(
-                max_num_seqs=self.max_batch_size))
+                max_num_seqs=self.max_batch_size),
+            prefix_cache=False)
 
     # --- engine views (predictor-era introspection surface) -----------------
     @property

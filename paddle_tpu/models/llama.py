@@ -303,9 +303,17 @@ class LlamaAttention(Layer):
     def _paged_attention(self, q, k, v, cache, B, S, hd):
         """Decode (S=1) over the shared block pool: scatter this step's K/V
         into each sequence's slot (block, offset) then fused paged attention
-        (``ops/pallas_paged.py`` on TPU)."""
+        (``ops/pallas_paged.py`` on TPU).
+
+        When the cache routes [B, S] slot arrays (chunked prefill), the S
+        chunk tokens scatter into their per-token slots instead and attend
+        causally over the paged prefix INCLUDING the chunk itself —
+        ``cache.q_start`` offsets the causal mask to the chunk's global
+        position."""
         from ..ops import paged_attention as pa_mod
 
+        if cache.slot_blocks is not None and cache.slot_blocks.ndim == 2:
+            return self._chunk_paged_attention(q, k, v, cache, B, S, hd)
         assert S == 1, "paged cache path is decode-only (one token per step)"
         kp, vp = cache.k_pool, cache.v_pool
         blocks, offs = cache.slot_blocks, cache.slot_offsets
@@ -322,6 +330,32 @@ class LlamaAttention(Layer):
             )[:, None]
 
         out = run_op("paged_attention", attend, q, kp, vp)
+        out = run_op("merge_heads",
+                     lambda a: a.reshape(B, S, self.num_heads * hd), out)
+        return self.o_proj(out)
+
+    def _chunk_paged_attention(self, q, k, v, cache, B, S, hd):
+        """Chunked prefill over the shared block pool: scatter the chunk's
+        S tokens into their (block, offset) slots — pads write the null
+        page — then causal attention over the gathered pages
+        (``ops/paged_attention.paged_prefill_attention``)."""
+        from ..ops import paged_attention as pa_mod
+
+        kp, vp = cache.k_pool, cache.v_pool
+        blocks, offs = cache.slot_blocks, cache.slot_offsets  # [B, S]
+
+        def write(pool, new):
+            return pool.at[blocks, offs].set(new.astype(pool.dtype))
+
+        kp._rebind(run_op("paged_kv_write", write, kp, k))
+        vp._rebind(run_op("paged_kv_write", write, vp, v))
+
+        def attend(qv, kpool, vpool):
+            return pa_mod.paged_prefill_attention(
+                qv, kpool, vpool, cache.block_tables, cache.seq_lens,
+                cache.q_start)
+
+        out = run_op("paged_prefill_attention", attend, q, kp, vp)
         out = run_op("merge_heads",
                      lambda a: a.reshape(B, S, self.num_heads * hd), out)
         return self.o_proj(out)

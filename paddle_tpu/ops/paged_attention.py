@@ -15,8 +15,10 @@ shapes with a length mask instead of dynamic slicing.
 
 from __future__ import annotations
 
+import hashlib
 import math
-from typing import Optional, Tuple
+from collections import OrderedDict
+from typing import List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -31,26 +33,73 @@ class PoolExhausted(RuntimeError):
     failing the request."""
 
 
+#: Root of every block-hash chain (the hash of the empty prefix).
+#: Chains use SHA-256, not builtin ``hash()``: cached blocks are content-
+#: addressed across tenants, so a collision silently serves one prompt's
+#: KV to another — with a 64-bit non-cryptographic hash that is both
+#: reachable at volume and constructible by an adversarial prompt.
+_HASH_ROOT = hashlib.sha256(b"paddle_tpu.prefix_cache.v1").digest()
+
+
+def _hash_block(parent: bytes, block_tokens) -> bytes:
+    m = hashlib.sha256(parent)
+    m.update(b"".join(int(t).to_bytes(8, "little", signed=True)
+                      for t in block_tokens))
+    return m.digest()
+
+
 class BlockPool:
     """Refcounted block-pool bookkeeping (no device tensors) — the ONE
     implementation of the free-list / refcount / fork invariants, shared
     by :class:`BlockKVCache` (op layer) and the serving layer's
     :class:`~paddle_tpu.serving.KVCacheManager`.  Block 0 is the reserved
-    null page that padding rows of a bucketed batch write into."""
+    null page that padding rows of a bucketed batch write into.
 
-    def __init__(self, num_blocks: int, block_size: int):
+    **Prefix caching** (``enable_prefix_cache=True``): a FULL block whose
+    content is the KV of a known token chain carries a chain hash
+    ``h_i = sha256(h_{i-1} || block_tokens_i)`` registered via
+    :meth:`record_block_hashes`.  When its last owner frees it, the block
+    parks in a reuse LRU instead of the free list — content intact,
+    revivable by :meth:`fork_prefix` at zero recompute cost — and is
+    evicted (clobbered) only when an allocation cannot be covered by the
+    free list alone.  All hash/LRU structures are bounded by the pool
+    itself: at most ``num_blocks`` entries each, ever.
+    """
+
+    def __init__(self, num_blocks: int, block_size: int,
+                 enable_prefix_cache: bool = False):
         if num_blocks < 2:
             raise ValueError("need at least 2 blocks (block 0 is the null page)")
         self.num_blocks = num_blocks
         self.block_size = block_size
+        self.prefix_cache_enabled = enable_prefix_cache
         self._free: list = list(range(num_blocks - 1, 0, -1))
         self._ref: dict = {}     # block -> owner count (shared prefixes)
         self._tables: dict = {}  # seq_id -> list[int]
         self._lens: dict = {}    # seq_id -> int
+        # prefix-cache state — every structure is pool-bounded (≤ one
+        # entry per block), enforced by the invariants above
+        self._block_hash: dict = {}   # unbounded-ok: ≤ num_blocks entries (block -> chain hash)
+        self._hash_index: dict = {}   # unbounded-ok: ≤ num_blocks entries (chain hash -> block)
+        self._chain_state: dict = {}  # unbounded-ok: ≤ live seqs (seq -> (blocks_hashed, last_hash)) so per-chunk re-registration hashes only NEW blocks
+        self.cache_epoch = 0  # bumped whenever _hash_index changes, so
+                              # callers may memoize match_prefix results
+                              # keyed by (token_ids, epoch)
+        self._reuse: "OrderedDict" = OrderedDict()  # unbounded-ok: ≤ num_blocks entries (refcount-0 cached blocks, LRU)
+        self.reuse_evictions = 0  # monotonic: cached blocks clobbered for allocation
+        self.reuse_hits = 0       # monotonic: blocks served from the prefix cache
 
     @property
     def num_free(self) -> int:
+        """Blocks on the free list proper (never held cached content)."""
         return len(self._free)
+
+    @property
+    def num_available(self) -> int:
+        """Blocks an allocation can take: free list + evictable reuse LRU.
+        The capacity number schedulers must plan against — a drained pool
+        with a warm prefix cache has ``num_free < num_available``."""
+        return len(self._free) + len(self._reuse)
 
     def blocks_for(self, num_tokens: int) -> int:
         return -(-num_tokens // self.block_size)
@@ -61,18 +110,35 @@ class BlockPool:
         return max(0, self.blocks_for(cur + num_tokens) - held)
 
     def can_allocate(self, seq_id, num_tokens: int) -> bool:
-        return self.blocks_needed(seq_id, num_tokens) <= len(self._free)
+        return self.blocks_needed(seq_id, num_tokens) <= self.num_available
+
+    def _take_block(self) -> int:
+        """One block for a fresh allocation: free list first; then evict
+        the LRU-oldest reusable cached block (its hash entries die with
+        its content — a later prompt with that prefix just recomputes)."""
+        if self._free:
+            return self._free.pop()
+        b, _ = self._reuse.popitem(last=False)
+        self._drop_hash(b)
+        self.reuse_evictions += 1
+        return b
+
+    def _drop_hash(self, b: int) -> None:
+        h = self._block_hash.pop(b, None)
+        if h is not None and self._hash_index.get(h) == b:
+            del self._hash_index[h]
+            self.cache_epoch += 1
 
     def allocate(self, seq_id, num_tokens: int) -> bool:
         """All-or-nothing reservation of blocks for ``num_tokens`` more
         tokens; returns False (taking nothing) when the pool can't cover
         it, so the state stays clean for the caller's preemption/retry."""
         need = self.blocks_needed(seq_id, num_tokens)
-        if need > len(self._free):
+        if need > self.num_available:
             return False
         table = self._tables.setdefault(seq_id, [])
         for _ in range(need):
-            b = self._free.pop()
+            b = self._take_block()
             self._ref[b] = 1
             table.append(b)
         return True
@@ -93,19 +159,123 @@ class BlockPool:
         return n_full * self.block_size
 
     def free(self, seq_id) -> int:
-        """Release the sequence; returns how many blocks went back to the
-        pool (shared blocks stay out until their last owner frees)."""
+        """Release the sequence; returns how many blocks became available
+        again (shared blocks stay out until their last owner frees).  With
+        the prefix cache on, a hashed block parks in the reuse LRU instead
+        of the free list — still counted as available, but revivable.
+        Within one sequence, later-chain blocks enter the LRU eviction
+        side first, so a shrinking cache keeps the shortest (most
+        shareable) prefixes longest."""
         returned = 0
-        for b in self._tables.pop(seq_id, []):
+        for b in reversed(self._tables.pop(seq_id, [])):
             n = self._ref.get(b, 1) - 1
-            if n <= 0:
-                self._ref.pop(b, None)
-                self._free.append(b)
-                returned += 1
-            else:
+            if n > 0:
                 self._ref[b] = n
+                continue
+            self._ref.pop(b, None)
+            returned += 1
+            if self.prefix_cache_enabled and b in self._block_hash:
+                self._reuse[b] = self._block_hash[b]
+            else:
+                self._free.append(b)
         self._lens.pop(seq_id, None)
+        self._chain_state.pop(seq_id, None)
         return returned
+
+    # --- prefix cache -------------------------------------------------------
+    def match_prefix(self, token_ids) -> List[int]:
+        """Blocks holding the longest cached block-prefix of ``token_ids``,
+        capped so at least ONE token is always left to compute (the
+        prefill must still produce last-token logits).  The chain hash
+        ``h_i`` commits to every token in blocks 0..i, so one dict lookup
+        per block walks the prefix — hashing stops at the first miss (a
+        cold cache costs ONE block hash, not the whole prompt)."""
+        if not self.prefix_cache_enabled or len(token_ids) < 2:
+            return []
+        limit = (len(token_ids) - 1) // self.block_size
+        bs = self.block_size
+        blocks, h = [], _HASH_ROOT
+        for i in range(limit):
+            h = _hash_block(h, token_ids[i * bs:(i + 1) * bs])
+            b = self._hash_index.get(h)
+            if b is None:
+                break
+            blocks.append(b)
+        return blocks
+
+    def reuse_count(self, blocks) -> int:
+        """How many of ``blocks`` sit in the reuse LRU (refcount 0) —
+        those leave the available set when forked, so schedulers must
+        budget ``uncached_need + reuse_count``."""
+        return sum(1 for b in blocks if b in self._reuse)
+
+    def probe_prefix(self, token_ids) -> Tuple[int, int]:
+        """(hit_blocks, of_which_from_reuse) for admission planning — no
+        state change."""
+        blocks = self.match_prefix(token_ids)
+        return len(blocks), self.reuse_count(blocks)
+
+    def fork_prefix(self, seq_id, token_ids, blocks: Optional[List[int]] = None) -> int:
+        """Start ``seq_id`` on the longest cached block-prefix of
+        ``token_ids``: live cached blocks gain an owner (refcount++),
+        reuse-LRU blocks are revived (refcount 0 → 1) — zero recompute
+        either way.  Returns the number of cached tokens the sequence
+        starts with (0 on a cold miss or with the cache disabled).
+        ``blocks`` skips re-hashing when the caller just ran
+        :meth:`match_prefix` with NO pool mutation in between (admission
+        probes then forks in one pass)."""
+        if seq_id in self._tables:
+            raise ValueError(f"fork target seq {seq_id!r} already exists")
+        if blocks is None:
+            blocks = self.match_prefix(token_ids)
+        if blocks:
+            self._chain_state[seq_id] = (
+                len(blocks), self._block_hash[blocks[-1]])
+        for b in blocks:
+            if b in self._reuse:
+                del self._reuse[b]
+                self._ref[b] = 1
+            else:
+                self._ref[b] = self._ref.get(b, 0) + 1
+        self.reuse_hits += len(blocks)
+        self._tables[seq_id] = list(blocks)
+        self._lens[seq_id] = len(blocks) * self.block_size
+        return len(blocks) * self.block_size
+
+    def record_block_hashes(self, seq_id, token_ids,
+                            num_tokens: Optional[int] = None) -> int:
+        """Index ``seq_id``'s full blocks covered by the first
+        ``num_tokens`` of ``token_ids`` (default: all — only tokens whose
+        KV has been WRITTEN: callers register after the compute that fills
+        the pages).  Idempotent; first block to claim a chain hash keeps
+        it.  Returns how many new blocks were indexed.
+
+        Incremental: the per-sequence chain state remembers how far this
+        sequence has already been hashed, so a chunked prefill that
+        registers after every chunk hashes each block ONCE over the whole
+        prompt, not once per chunk (O(L) total, not O(L²))."""
+        if not self.prefix_cache_enabled:
+            return 0
+        table = self._tables.get(seq_id, [])
+        upto = len(token_ids) if num_tokens is None else num_tokens
+        n_full = min(upto // self.block_size, len(table))
+        done, h = self._chain_state.get(seq_id, (0, _HASH_ROOT))
+        if done > n_full:  # recompute path restarted shorter: re-walk
+            done, h = 0, _HASH_ROOT
+        bs = self.block_size
+        added = 0
+        for i in range(done, n_full):
+            h = _hash_block(h, token_ids[i * bs:(i + 1) * bs])
+            b = table[i]
+            if b in self._block_hash or h in self._hash_index:
+                continue
+            self._block_hash[b] = h
+            self._hash_index[h] = b
+            added += 1
+        self._chain_state[seq_id] = (n_full, h)
+        if added:
+            self.cache_epoch += 1
+        return added
 
 
 class BlockKVCache:
@@ -208,13 +378,21 @@ class PagedCache:
         self.block_tables = None   # [B, max_blocks] int32
         self.seq_lens = None       # [B] int32 (AFTER this step's token)
         self.slot_blocks = None    # [B] int32 — page of this step's token
+                                   # ([B, S] in chunked-prefill mode: one
+                                   # slot per chunk token)
         self.slot_offsets = None   # [B] int32 — offset within the page
+        self.q_start = None        # chunked prefill only: global position
+                                   # of the chunk's first token (scalar or
+                                   # [B] int32) — offsets the causal mask
 
-    def route(self, block_tables, seq_lens, slot_blocks, slot_offsets):
+    def route(self, block_tables, seq_lens, slot_blocks, slot_offsets,
+              q_start=None):
         self.block_tables = jnp.asarray(block_tables, jnp.int32)
         self.seq_lens = jnp.asarray(seq_lens, jnp.int32)
         self.slot_blocks = jnp.asarray(slot_blocks, jnp.int32)
         self.slot_offsets = jnp.asarray(slot_offsets, jnp.int32)
+        if q_start is not None:
+            self.q_start = jnp.asarray(q_start, jnp.int32)
 
 
 def _xla_paged_attention(q, k_cache, v_cache, block_tables, seq_lens):
@@ -240,6 +418,50 @@ def _xla_paged_attention(q, k_cache, v_cache, block_tables, seq_lens):
     probs = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum("bhrs,bshd->bhrd", probs, v.astype(jnp.float32))
     return out.reshape(B, H, D).astype(q.dtype)
+
+
+def paged_prefill_attention(q: jax.Array, k_cache: jax.Array,
+                            v_cache: jax.Array, block_tables: jax.Array,
+                            seq_lens: jax.Array,
+                            q_start: jax.Array) -> jax.Array:
+    """Chunked-prefill attention over a paged KV cache.
+
+    q: [B, S, H, D] — ``S`` new tokens per sequence sitting at global
+    positions ``q_start + [0, S)``; the chunk's own K/V has already been
+    scattered into the pool, so the causal mask ``col <= q_start + row``
+    covers both the previously computed prefix AND intra-chunk causality
+    with one predicate.  ``seq_lens`` is the total KV length after the
+    chunk (clamps pad rows away from garbage pages).  Returns
+    [B, S, H, D].
+
+    XLA gather path on purpose: a prefill chunk is compute-bound on the
+    [S, K] score matmul (unlike the latency-bound single-token decode the
+    Pallas kernel exists for), and the same grouped-einsum/float32-softmax
+    shape as the dense prefill keeps greedy outputs token-identical
+    between the chunked and one-shot programs.
+    """
+    B, S, H, D = q.shape
+    max_blocks = block_tables.shape[1]
+    bs = k_cache.shape[1]
+    Hkv = k_cache.shape[2]
+    rep = H // Hkv
+    scale = 1.0 / math.sqrt(D)
+
+    k = k_cache[block_tables].reshape(B, max_blocks * bs, Hkv, D)
+    v = v_cache[block_tables].reshape(B, max_blocks * bs, Hkv, D)
+
+    qg = q.reshape(B, S, Hkv, rep, D)
+    logits = jnp.einsum("bqhrd,bkhd->bhrqk", qg, k,
+                        preferred_element_type=jnp.float32) * scale
+    col = jnp.arange(max_blocks * bs)[None, None, :]
+    starts = (q_start[:, None, None] if jnp.ndim(q_start) == 1
+              else q_start)                       # scalar or per-sequence
+    row = starts + jnp.arange(S)[None, :, None]
+    mask = (col <= row) & (col < seq_lens[:, None, None])  # [B, S, K]
+    logits = jnp.where(mask[:, None, None], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhrqk,bkhd->bqhrd", probs.astype(v.dtype), v)
+    return out.reshape(B, S, H, D).astype(q.dtype)
 
 
 def paged_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,

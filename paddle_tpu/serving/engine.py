@@ -65,10 +65,12 @@ class EngineCore:
 
     def __init__(self, model, num_blocks: int = 256, block_size: int = 16,
                  dtype=jnp.float32, scheduler_config: Optional[SchedulerConfig] = None,
-                 profile_ops: bool = False, registry=None):
+                 profile_ops: bool = False, registry=None,
+                 prefix_cache: bool = True):
         cfg = model.config
         self.model = model
-        self.kv = KVCacheManager(num_blocks, block_size)
+        self.kv = KVCacheManager(num_blocks, block_size,
+                                 enable_prefix_cache=prefix_cache)
         self.block_size = block_size
         self.num_blocks = num_blocks
         self.scheduler = ContinuousBatchingScheduler(
@@ -95,7 +97,10 @@ class EngineCore:
         donate = (1, 2) if jax.default_backend() == "tpu" else ()
         self._jit_decode = jax.jit(self._decode_fn, donate_argnums=donate)
         self._jit_prefill = jax.jit(self._prefill_fn, donate_argnums=donate)
+        self._jit_chunk_prefill = jax.jit(self._chunk_prefill_fn,
+                                          donate_argnums=donate)
         self._profile_ops = profile_ops
+        self._evictions_seen = 0  # last-synced kv.reuse_evictions value
         model.eval()
 
     # --- functional model step (traced) ------------------------------------
@@ -170,6 +175,32 @@ class EngineCore:
             for vp, (_, vb) in zip(v_pools, dense))
         return last, new_k, new_v
 
+    def _chunk_prefill_fn(self, param_vals, k_pools, v_pools, ids, start,
+                          last_pos, tables, lens, slot_blocks,
+                          slot_offsets):
+        """Chunked/resumed prefill: run ``ids`` (one bucketed chunk of a
+        prompt, starting at absolute position ``start``) straight through
+        the PAGED pool — the chunk's K/V scatters into its (block, offset)
+        slots and attention covers the already-computed prefix (cached
+        fork or earlier chunks) plus the chunk itself.  Shapes are fixed
+        per (chunk-bucket, table-bucket) pair.  Returns the logits row of
+        the chunk's LAST REAL token + updated pools."""
+        self.prefill_trace_count += 1
+        self.metrics.count("prefill_jit_traces")
+        self.tracer.instant("prefill_jit_trace", cat="jit",
+                            chunk_bucket=int(ids.shape[1]),
+                            table_bucket=int(tables.shape[1]))
+        caches = []
+        for k, v in zip(k_pools, v_pools):
+            c = PagedCache(Tensor(k), Tensor(v))
+            c.route(tables, lens, slot_blocks, slot_offsets, q_start=start)
+            caches.append(c)
+        logits = self._call_model(ids, caches, start, param_vals)
+        last = jnp.take(logits[0], last_pos, axis=0).astype(jnp.float32)
+        return (last,
+                tuple(c.k_pool._value for c in caches),
+                tuple(c.v_pool._value for c in caches))
+
     # --- request lifecycle --------------------------------------------------
     def add_request(self, prompt_ids, sampling: Optional[SamplingParams] = None,
                     request_id=None, priority: int = 0,
@@ -239,36 +270,82 @@ class EngineCore:
         return tuple(p._value for p in self._params)
 
     def _prefill(self, req: Request) -> None:
-        """Run the bucketed prefill program for one request (first
-        admission or preemption-recompute) and sample its next token."""
+        """Run one bucketed prefill program for ``req`` — the whole
+        prompt (cold one-shot), or one chunk of it (token-budgeted
+        chunked prefill and/or resume past a prefix-cache hit).  Samples
+        the request's next token only when the prefill completes (the
+        final chunk's last-position logits ARE that token)."""
         rid = req.request_id
         ids = req.prompt_ids + req.output_tokens
-        if req.output_tokens:
-            self.metrics.count("recompute_prefills")
-        T0 = len(ids)
-        if not self.kv.allocate(rid, T0):
-            raise PoolExhausted(  # scheduler admission guarantees room
-                f"prefill of {T0} tokens for {rid!r} after admission")
-        self.kv.commit(rid, T0)
+        target = len(ids)
+        start = self.kv.seq_len(rid)  # cached fork + earlier chunks
+        n = req._chunk_tokens if req._chunk_tokens else target - start
+        req._chunk_tokens = None
+        if req.output_tokens and start == req.num_cached_tokens:
+            self.metrics.count("recompute_prefills")  # first chunk only
+        if not self.kv.allocate(rid, n):
+            raise PoolExhausted(  # scheduler planning guarantees room
+                f"prefill chunk of {n} tokens for {rid!r} after admission")
         table = self.kv.table(rid)
-        Tb = bucket_size(T0)
-        ids_arr = np.zeros((1, Tb), np.int64)
-        ids_arr[0, :T0] = ids
-        blocks = np.zeros((Tb,), np.int32)  # pads -> null page (block 0)
-        pos = np.arange(T0)
-        blocks[:T0] = [table[p // self.block_size] for p in pos]
-        offs = (np.arange(Tb) % self.block_size).astype(np.int32)
-        self.prefill_buckets.add(("prefill", Tb))
-        with self.tracer.span("prefill_step", cat="serving",
-                              request=str(rid), trace=req.trace_id,
-                              tokens=T0, bucket=Tb,
-                              recompute=bool(req.output_tokens)):
-            with StepTimer(self.metrics, "prefill_step"):
-                last, self._k_pools, self._v_pools = self._jit_prefill(
-                    self._param_vals(), self._k_pools, self._v_pools,
-                    ids_arr, np.int32(T0 - 1), blocks, offs)
-                logits = np.asarray(last, np.float32)
-        self._emit(req, req.sampling.sample(logits, req._rng))
+        pos = np.arange(start, start + n)
+        if start == 0 and n == target:
+            # cold one-shot: dense-cache forward + scatter (the cheapest
+            # program when nothing is cached and no budget splits it)
+            Tb = bucket_size(target)
+            ids_arr = np.zeros((1, Tb), np.int64)
+            ids_arr[0, :target] = ids
+            blocks = np.zeros((Tb,), np.int32)  # pads -> null page
+            blocks[:target] = [table[p // self.block_size] for p in pos]
+            offs = (np.arange(Tb) % self.block_size).astype(np.int32)
+            self.prefill_buckets.add(("prefill", Tb))
+            with self.tracer.span("prefill_step", cat="serving",
+                                  request=str(rid), trace=req.trace_id,
+                                  tokens=target, bucket=Tb,
+                                  recompute=bool(req.output_tokens)):
+                with StepTimer(self.metrics, "prefill_step"):
+                    last, self._k_pools, self._v_pools = self._jit_prefill(
+                        self._param_vals(), self._k_pools, self._v_pools,
+                        ids_arr, np.int32(target - 1), blocks, offs)
+                    logits = np.asarray(last, np.float32)
+        else:
+            # chunk / resume: the chunk scatters into its pages and
+            # attends over the paged prefix, so earlier chunks and
+            # prefix-cache forks need no recompute.  Two buckets bound
+            # the trace count: chunk width and block-table width.
+            Wb = bucket_size(n)
+            TWb = bucket_size(len(table))
+            ids_arr = np.zeros((1, Wb), np.int64)
+            ids_arr[0, :n] = ids[start:start + n]
+            blocks = np.zeros((1, Wb), np.int32)  # pads -> null page
+            blocks[0, :n] = [table[p // self.block_size] for p in pos]
+            offs = np.zeros((1, Wb), np.int32)
+            offs[0, :n] = pos % self.block_size
+            tables = np.zeros((1, TWb), np.int32)
+            tables[0, :len(table)] = table
+            lens = np.array([start + n], np.int32)
+            self.prefill_buckets.add(("chunk", Wb, TWb))
+            self.metrics.count("chunked_prefill_steps")
+            with self.tracer.span("prefill_step", cat="serving",
+                                  request=str(rid), trace=req.trace_id,
+                                  tokens=n, bucket=Wb, chunk=True,
+                                  start=start,
+                                  cached=req.num_cached_tokens,
+                                  recompute=bool(req.output_tokens)):
+                with StepTimer(self.metrics, "prefill_step"):
+                    last, self._k_pools, self._v_pools = \
+                        self._jit_chunk_prefill(
+                            self._param_vals(), self._k_pools,
+                            self._v_pools, ids_arr, np.int32(start),
+                            np.int32(n - 1), tables, lens, blocks, offs)
+                    logits = np.asarray(last, np.float32)
+        self.kv.commit(rid, n)
+        self.metrics.count("prefill_tokens_computed", n)
+        if self.kv.prefix_cache_enabled:
+            # index the fully-written blocks NOW, so a same-prefix request
+            # admitted next step shares them even mid-prefill
+            self.kv.record_block_hashes(rid, ids, start + n)
+        if start + n >= target:
+            self._emit(req, req.sampling.sample(logits, req._rng))
 
     def _decode(self, reqs: List[Request]) -> Dict[object, int]:
         """One bucketed decode step for ``reqs`` (slots already reserved
@@ -333,10 +410,24 @@ class EngineCore:
                     # counter)
                     self._finish(req, FinishReason.ABORT)
                     self.requests.pop(req.request_id, None)
+                for req in plan.admitted:
+                    cached = req.num_cached_tokens
+                    total = len(req.prompt_ids) + len(req.output_tokens)
+                    self.metrics.count("prefix_cache_hit_tokens", cached)
+                    self.metrics.count("prefix_cache_miss_tokens",
+                                       total - cached)
+                    if cached:
+                        self.tracer.instant(
+                            "prefix_cache_hit", cat="serving",
+                            request=str(req.request_id),
+                            trace=req.trace_id, cached_tokens=cached)
                 emitted: Dict[object, int] = {}
                 for req in plan.prefills:
+                    before = len(req.output_tokens)
                     self._prefill(req)
-                    emitted[req.request_id] = req.output_tokens[-1]
+                    if len(req.output_tokens) > before:  # prefill done —
+                        # a partial chunk emits nothing yet
+                        emitted[req.request_id] = req.output_tokens[-1]
                 decodes = [r for r in plan.decodes
                            if r.state is RequestState.RUNNING]
                 if decodes:
@@ -344,6 +435,12 @@ class EngineCore:
                 for req in list(self.scheduler.running):
                     if req.finished:
                         self._retire(req)
+                ev = self.kv.reuse_evictions
+                if ev > self._evictions_seen:
+                    self.metrics.count("prefix_cache_evictions",
+                                       ev - self._evictions_seen)
+                    self._evictions_seen = ev
+                self.metrics.set_cached_token_ratio()
                 self.metrics.sample_gauges(self.scheduler.queue_depth,
                                            self.scheduler.num_running,
                                            self.kv.occupancy())

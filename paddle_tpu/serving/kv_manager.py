@@ -37,13 +37,28 @@ class KVCacheManager(BlockPool):
     given (sequence, position), which is what lets one routing array drive
     the whole decoder stack.  This subclass adds the serving-loop surface:
     decode-slot reservation (``append_slot``/``commit``) and gauges.
+
+    With ``enable_prefix_cache=True`` (the serving default) the base
+    pool's automatic prefix caching is active: full prompt blocks are
+    content-hashed after prefill, refcount-0 cached blocks park in a
+    bounded reuse LRU instead of being clobbered, and admission forks the
+    longest cached block-prefix of a new prompt for free
+    (``fork_prefix``).  Capacity planning must then use
+    :attr:`num_available` (free + evictable-cached), not ``num_free``.
     """
+
+    def __init__(self, num_blocks: int, block_size: int,
+                 enable_prefix_cache: bool = True):
+        super().__init__(num_blocks, block_size,
+                         enable_prefix_cache=enable_prefix_cache)
 
     # --- capacity ----------------------------------------------------------
     def occupancy(self) -> float:
-        """Fraction of the usable pool currently held by sequences."""
+        """Fraction of the usable pool currently held by sequences.
+        Reuse-LRU blocks (cached content, no owner) count as free capacity
+        — they are evictable on demand."""
         usable = self.num_blocks - 1
-        return (usable - len(self._free)) / usable if usable else 0.0
+        return (usable - self.num_available) / usable if usable else 0.0
 
     # --- allocation --------------------------------------------------------
     def append_slot(self, seq_id) -> Optional[Tuple[int, int]]:

@@ -59,9 +59,16 @@ _COUNTER_NAMES = (
     "preemptions",
     "recompute_prefills",
     "engine_steps",
+    # prefix cache + chunked prefill (ISSUE 4)
+    "prefix_cache_hit_tokens",    # prompt tokens restored by fork (free)
+    "prefix_cache_miss_tokens",   # prompt tokens that needed compute
+    "prefix_cache_evictions",     # cached blocks clobbered for allocation
+    "prefill_tokens_computed",    # tokens the prefill programs actually ran
+    "chunked_prefill_steps",      # chunk-program launches (vs one-shot)
 )
 
-_GAUGE_NAMES = ("queue_depth", "num_running", "kv_pool_occupancy")
+_GAUGE_NAMES = ("queue_depth", "num_running", "kv_pool_occupancy",
+                "prefix_cached_token_ratio")
 
 
 class ServingMetrics:
@@ -116,6 +123,16 @@ class ServingMetrics:
 
     def observe_inter_token(self, seconds: float) -> None:
         self.observe("inter_token_latency", seconds)
+
+    def set_cached_token_ratio(self) -> None:
+        """Publish hit / (hit + computed) over the whole process life —
+        the fraction of prefill-bound tokens the prefix cache served for
+        free.  A no-op until any prefill ran."""
+        hit = self._counter("prefix_cache_hit_tokens").value
+        computed = self._counter("prefill_tokens_computed").value
+        if hit + computed:
+            self._gauges["prefix_cached_token_ratio"].set(
+                hit / (hit + computed))
 
     def sample_gauges(self, queue_depth: int, num_running: int,
                       kv_occupancy: float) -> None:

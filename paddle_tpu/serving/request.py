@@ -88,6 +88,12 @@ class Request:
     output_tokens: List[int] = field(default_factory=list)
     num_preemptions: int = 0
     error: Optional[str] = None
+    # prefix-cache accounting (scheduler-owned): tokens restored for free
+    # from the prefix cache at the LAST admission (fork, zero recompute).
+    # Reset on preemption (blocks freed), re-filled on re-admission.
+    # Prefill *progress* has no mirror here — kv.seq_len(request_id) is
+    # the single source of truth.
+    num_cached_tokens: int = 0
     # engine-stamped timing (perf_counter seconds)
     arrival_time: float = 0.0
     first_token_time: Optional[float] = None
@@ -101,6 +107,13 @@ class Request:
             self.trace_id = str(self.request_id)
         self.prompt_ids = [int(t) for t in np.asarray(self.prompt_ids).reshape(-1)]
         self._rng = np.random.default_rng(self.sampling.seed)
+        self._chunk_tokens = None  # this step's planned prefill chunk width
+                                   # (scheduler-stamped, engine-consumed)
+        self._probe_blocks = None  # memoized prefix-cache match for this
+        self._probe_epoch = -1     # prompt, valid while kv.cache_epoch is
+                                   # unchanged — a head-of-queue request
+                                   # blocked on capacity is not re-hashed
+                                   # every engine step
 
     # --- views --------------------------------------------------------------
     @property

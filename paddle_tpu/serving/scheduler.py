@@ -5,11 +5,22 @@ Attention shape, PAPERS.md): every engine step the scheduler
 
 1. **admits** waiting requests into the running set while (a) the running
    set is under ``max_num_seqs`` and (b) the pool can cover the request's
-   whole prompt *plus one decode block of headroom* without preempting
-   anyone — admission never steals blocks from running work;
-2. **reserves** this step's decode slot for every running request, and on
-   exhaustion **preempts** — the least-important running request (highest
-   ``(priority, arrival_seq)``) is evicted, its blocks freed, and it is
+   *uncached* prompt tail *plus one decode block of headroom* without
+   preempting anyone — admission never steals blocks from running work.
+   Admission first **forks the longest cached block-prefix** of the
+   prompt from the prefix cache (``KVCacheManager.fork_prefix``:
+   refcount++, zero recompute), so a cache hit both skips prefill work
+   AND shrinks the admission charge;
+2. **plans prefill chunks** under the per-step token budget
+   (``max_prefill_tokens_per_step``): a long prompt advances in chunks
+   across engine steps — continuing partial prefills outrank new
+   admissions — so prefill work shares steps with the running decode
+   batch instead of stalling it.  ``None`` (the default) keeps the
+   one-shot behaviour;
+3. **reserves** this step's decode slot for every fully-prefilled running
+   request, and on exhaustion **preempts** — the least-important running
+   request (highest ``(priority, arrival_seq)``) is evicted, its blocks
+   freed (shared prefix blocks stay with their other owners), and it is
    re-enqueued at the FRONT of the waiting queue for prefill-recompute.
    Exhaustion is a scheduling event, not an error.
 
@@ -56,14 +67,34 @@ class SchedulerConfig:
                                      # latency of running requests is
                                      # protected by not batching many
                                      # prefills into one engine step
+    max_prefill_tokens_per_step: Optional[int] = None
+                                     # chunked prefill: per-step token
+                                     # budget shared by ALL prefill work
+                                     # (continuations + admissions) so a
+                                     # long prompt advances in bucketed
+                                     # chunks alongside the decode batch
+                                     # instead of stalling it.  None =
+                                     # unlimited (one-shot prefill).
+
+    def __post_init__(self):
+        if (self.max_prefill_tokens_per_step is not None
+                and self.max_prefill_tokens_per_step < 1):
+            # a zero/negative budget plans NO prefill ever: requests would
+            # queue forever while has_work() stays True — fail fast instead
+            raise ValueError(
+                "max_prefill_tokens_per_step must be None or >= 1, got "
+                f"{self.max_prefill_tokens_per_step}")
 
 
 @dataclass
 class SchedulerOutput:
-    """One step's plan: prefills to run, the decode set, and who was
-    preempted to make room."""
+    """One step's plan: prefill chunks to run, the decode set, and who
+    was preempted to make room."""
 
     prefills: List[Request] = field(default_factory=list)
+    admitted: List[Request] = field(default_factory=list)  # ⊆ prefills:
+                                     # newly admitted this step (the
+                                     # engine counts their cache hits)
     decodes: List[Request] = field(default_factory=list)
     preempted: List[Request] = field(default_factory=list)
     aborted: List[Request] = field(default_factory=list)
@@ -107,16 +138,63 @@ class ContinuousBatchingScheduler:
     def _usable_blocks(self) -> int:
         return self.kv.num_blocks - 1  # block 0 = null page
 
-    def _admit(self, out: SchedulerOutput) -> None:
+    def _needs_prefill(self, req: Request) -> bool:
+        """True while ``req``'s prompt (+ kept output, on recompute) is
+        not yet in the pool.  The newest generated token's KV is written
+        by the decode step that consumes it, so a recompute that reaches
+        ``prompt + output - 1`` committed tokens resumes straight into
+        decode — the decode step IS its final prefill position."""
+        target = len(req.prompt_ids) + len(req.output_tokens)
+        if req.output_tokens:
+            target -= 1
+        return self.kv.seq_len(req.request_id) < target
+
+    def _chunk_capacity(self, req: Request, want: int, promised: int) -> int:
+        """Clamp a continuation chunk to what the pool can actually back
+        right now (``promised`` = blocks already pledged this pass): the
+        pool may have drained since this request was admitted, and a
+        chunk the engine cannot allocate must never be planned."""
+        rid = req.request_id
+        free_slots = (self.kv.num_owned_blocks(rid) * self.kv.block_size
+                      - self.kv.seq_len(rid))
+        avail = max(0, self.kv.num_available - promised)
+        return min(want, free_slots + avail * self.kv.block_size)
+
+    def _plan_prefills(self, out: SchedulerOutput) -> None:
+        """Plan this step's prefill work under the chunk token budget:
+        first continue partial prefills (most-important first — finishing
+        an in-flight prompt beats admitting a new one), then admit from
+        the waiting queue."""
+        budget = self.config.max_prefill_tokens_per_step
+        remaining = float("inf") if budget is None else int(budget)
+        promised = 0  # blocks pledged to prefills planned THIS pass: the
+                      # engine allocates them only when it runs the chunk,
+                      # so kv.num_available alone would double-count
+        for req in sorted(self.running, key=lambda r: r.preempt_key):
+            if req.state is not RequestState.RUNNING:
+                continue
+            if not self._needs_prefill(req):
+                continue
+            if remaining <= 0:
+                break
+            want = (len(req.prompt_ids) + len(req.output_tokens)
+                    - self.kv.seq_len(req.request_id))
+            n = self._chunk_capacity(req, min(want, remaining), promised)
+            if n <= 0:
+                continue  # pool pressure: wait for decode-side churn
+            req._chunk_tokens = int(n)
+            promised += self.kv.blocks_needed(req.request_id, n)
+            remaining -= n
+            out.prefills.append(req)
+
         admitted = 0
-        promised = 0  # blocks pledged to prefills admitted THIS pass: the
-                      # engine allocates them only when it runs the prefill,
-                      # so kv.num_free alone would double-count the pool
         while (self.waiting
                and len(self.running) < self.config.max_num_seqs
-               and admitted < self.config.max_prefills_per_step):
+               and admitted < self.config.max_prefills_per_step
+               and remaining > 0):
             req = self.waiting[0]
-            prompt_blocks = self.kv.blocks_for(req.num_computed_tokens)
+            ids = req.prompt_ids + req.output_tokens
+            prompt_blocks = self.kv.blocks_for(len(ids))
             if prompt_blocks > self._usable_blocks():
                 # can never fit, even with the whole pool: fail THIS request
                 # honestly rather than live-locking everyone behind it
@@ -127,27 +205,51 @@ class ContinuousBatchingScheduler:
                              f"pool has {self._usable_blocks()} usable")
                 out.aborted.append(req)
                 continue
+            # admit on the UNCACHED tail, not the whole prompt: blocks
+            # already in the prefix cache cost nothing new (live shares)
+            # or only their reuse-LRU slot (``from_reuse`` — those leave
+            # the available set when forked, so they are charged).  This
+            # is what makes a warm cache raise admission capacity.
+            if req._probe_epoch != self.kv.cache_epoch:
+                req._probe_blocks = self.kv.match_prefix(ids)
+                req._probe_epoch = self.kv.cache_epoch
+            hit = req._probe_blocks
+            from_reuse = self.kv.reuse_count(hit)
+            uncached = prompt_blocks - len(hit)
             # +1 decode-slot headroom, but never demand more than the pool
             # HAS: a prompt filling the pool exactly is still servable when
             # its decode tokens fit the last block's free slots
-            need = min(prompt_blocks + 1, self._usable_blocks())
-            if need > self.kv.num_free - promised:
+            need = min(uncached + 1, self._usable_blocks())
+            if need + from_reuse > self.kv.num_available - promised:
                 break  # admission never preempts running work
-            promised += need
             self.waiting.popleft()
+            cached = self.kv.fork_prefix(req.request_id, ids, blocks=hit)
+            req.num_cached_tokens = cached
+            promised += need  # the fork itself already moved from_reuse
+                              # blocks out of num_available
             req.state = RequestState.RUNNING
             self.running.append(req)
+            n = min(len(ids) - cached, remaining)
+            req._chunk_tokens = int(n)
+            remaining -= n
             out.prefills.append(req)
+            out.admitted.append(req)
             admitted += 1
 
     def _preempt(self, victim: Request) -> None:
-        """Evict ``victim``: free its blocks, re-enqueue at the FRONT of
+        """Evict ``victim``: free its blocks (shared prefix blocks stay
+        with their other owners — refcounts guarantee a preemption never
+        clobbers a block someone else forked), re-enqueue at the FRONT of
         the waiting queue (a preempted request outranks new arrivals, so
         it is re-admitted and recomputed as soon as blocks free up)."""
         self.running.remove(victim)
         self.kv.free(victim.request_id)
         victim.state = RequestState.PREEMPTED
         victim.num_preemptions += 1
+        victim.num_cached_tokens = 0
+        victim._chunk_tokens = None
+        victim._probe_blocks = None  # re-admission hashes prompt + output,
+        victim._probe_epoch = -1     # not the ids this match was for
         self.waiting.appendleft(victim)
 
     def _pick_victim(self, exclude) -> Optional[Request]:
@@ -168,6 +270,9 @@ class ContinuousBatchingScheduler:
         for req in sorted(list(self.running), key=lambda r: r.preempt_key):
             if req.state is not RequestState.RUNNING:
                 continue  # preempted by an earlier iteration
+            if self._needs_prefill(req):
+                continue  # mid-(chunked)-prefill: no decode slot yet —
+                          # the chunk planner advances it instead
             while True:
                 slot = self.kv.append_slot(req.request_id)
                 if slot is not None:
@@ -187,12 +292,12 @@ class ContinuousBatchingScheduler:
 
     def schedule(self) -> SchedulerOutput:
         """Plan one engine step.  Decode slots are reserved BEFORE
-        admission, so blocks promised to a freshly admitted prefill can
-        never be consumed by this step's decode appends.  Prefilled
-        requests decode their first token within the same step (the
-        prefill's last-position logits ARE that token), so they are not
+        prefill planning, so blocks promised to a freshly planned chunk
+        can never be consumed by this step's decode appends.  A request
+        whose prefill completes samples its first token from the final
+        chunk's last-position logits within the same step, so it is not
         in ``decodes``."""
         out = SchedulerOutput()
         self._reserve_decode_slots(out)
-        self._admit(out)
+        self._plan_prefills(out)
         return out

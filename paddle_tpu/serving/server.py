@@ -14,6 +14,13 @@ streams — no framework — exposing
   registry, byte-identical to ``observability.start_metrics_server``
   for the same registry (shared ``metrics_page`` handler).
 
+HTTP/1.1 connections are **persistent** (ISSUE 3 follow-up (a)): a
+handler loops request → response on one socket until the client sends
+``Connection: close``, goes idle past ``keepalive_timeout_s``, or the
+response is an SSE stream (self-delimiting — the socket closes after
+``data: [DONE]``).  HTTP/1.0 clients must opt in with
+``Connection: keep-alive``.
+
 Threading model — ONE engine thread, N async handlers:
 
     asyncio loop (handlers)          engine thread (owns EngineCore)
@@ -96,6 +103,9 @@ class ServerConfig:
     default_timeout_s: Optional[float] = None   # None = no deadline
     max_timeout_s: float = 600.0
     drain_timeout_s: float = 5.0  # shutdown(): grace for in-flight work
+    keepalive_timeout_s: float = 30.0  # idle wait for the NEXT request on
+                                       # a persistent connection (also the
+                                       # first-request header deadline)
     model_name: str = "paddle-tpu"
     tokenize: Optional[Callable[[str], List[int]]] = None
 
@@ -292,41 +302,64 @@ class CompletionServer:
     # --- HTTP plumbing ------------------------------------------------------
     async def _handle_conn(self, reader: asyncio.StreamReader,
                            writer: asyncio.StreamWriter) -> None:
+        """Serve one connection: HTTP/1.1 requests are persistent by
+        default (``Connection: close`` or HTTP/1.0 without an explicit
+        ``keep-alive`` opts out), so this loops request → response until
+        the client closes, opts out, hits the idle timeout, or switches
+        to a self-delimiting response (SSE streams close the socket —
+        their framing has no length)."""
         try:
-            head = await asyncio.wait_for(
-                reader.readuntil(b"\r\n\r\n"), timeout=30.0)
-        except (asyncio.TimeoutError, asyncio.IncompleteReadError,
-                asyncio.LimitOverrunError, ConnectionError):
-            writer.close()
-            return
-        try:
-            if len(head) > _MAX_HEADER_BYTES:
-                await self._respond(writer, 431, error_body(
-                    "headers too large"))
-                return
-            lines = head.decode("latin-1").split("\r\n")
-            parts = lines[0].split()
-            if len(parts) != 3:
-                await self._respond(writer, 400, error_body(
-                    "malformed request line"))
-                return
-            method, target = parts[0].upper(), parts[1]
-            headers = {}
-            for ln in lines[1:]:
-                if ":" in ln:
-                    k, v = ln.split(":", 1)
-                    headers[k.strip().lower()] = v.strip()
-            body = b""
-            clen = int(headers.get("content-length", 0) or 0)
-            if clen:
-                if clen > 2 * 1024 * 1024:
-                    await self._respond(writer, 413, error_body(
-                        "body too large"))
+            while True:
+                try:
+                    head = await asyncio.wait_for(
+                        reader.readuntil(b"\r\n\r\n"),
+                        timeout=self.cfg.keepalive_timeout_s)
+                except (asyncio.TimeoutError, asyncio.IncompleteReadError,
+                        asyncio.LimitOverrunError, ConnectionError):
+                    return  # idle timeout or client closed between requests
+                if len(head) > _MAX_HEADER_BYTES:
+                    await self._respond(writer, 431, error_body(
+                        "headers too large"))
                     return
-                body = await asyncio.wait_for(
-                    reader.readexactly(clen), timeout=30.0)
-            await self._dispatch(method, target.split("?", 1)[0],
-                                 body, writer)
+                lines = head.decode("latin-1").split("\r\n")
+                parts = lines[0].split()
+                if len(parts) != 3:
+                    await self._respond(writer, 400, error_body(
+                        "malformed request line"))
+                    return
+                method, target = parts[0].upper(), parts[1]
+                version = parts[2].upper()
+                headers = {}
+                for ln in lines[1:]:
+                    if ":" in ln:
+                        k, v = ln.split(":", 1)
+                        headers[k.strip().lower()] = v.strip()
+                conn_hdr = headers.get("connection", "").lower()
+                keep_alive = (conn_hdr != "close" if version == "HTTP/1.1"
+                              else conn_hdr == "keep-alive")
+                if "transfer-encoding" in headers:
+                    # bodies are framed by Content-Length only; a chunked
+                    # body left unread would desync the persistent stream
+                    # (its bytes would parse as the next request line), so
+                    # reject AND close
+                    await self._respond(writer, 411, error_body(
+                        "Transfer-Encoding unsupported; send "
+                        "Content-Length"))
+                    return
+                body = b""
+                clen = int(headers.get("content-length", 0) or 0)
+                if clen:
+                    if clen > 2 * 1024 * 1024:
+                        await self._respond(writer, 413, error_body(
+                            "body too large"))
+                        return
+                    body = await asyncio.wait_for(
+                        reader.readexactly(clen), timeout=30.0)
+                keep_alive = await self._dispatch(
+                    method, target.split("?", 1)[0], body, writer,
+                    keep_alive)
+                if not keep_alive:
+                    return
         except (ConnectionError, asyncio.TimeoutError,
                 asyncio.IncompleteReadError):
             pass  # client went away; per-request cleanup already ran
@@ -344,70 +377,87 @@ class CompletionServer:
 
     async def _respond(self, writer: asyncio.StreamWriter, status: int,
                        payload, content_type: str = "application/json",
-                       extra: Tuple[Tuple[str, str], ...] = ()) -> None:
+                       extra: Tuple[Tuple[str, str], ...] = (),
+                       keep_alive: bool = False) -> None:
         body = (json.dumps(payload).encode("utf-8") + b"\n"
                 if isinstance(payload, dict) else payload)
         reason = {200: "OK", 400: "Bad Request", 404: "Not Found",
-                  405: "Method Not Allowed", 413: "Payload Too Large",
+                  405: "Method Not Allowed", 411: "Length Required",
+                  413: "Payload Too Large",
                   429: "Too Many Requests", 431: "Headers Too Large",
                   500: "Internal Server Error",
                   503: "Service Unavailable"}.get(status, "OK")
         head = [f"HTTP/1.1 {status} {reason}",
                 f"Content-Type: {content_type}",
                 f"Content-Length: {len(body)}",
-                "Connection: close"]
+                "Connection: keep-alive" if keep_alive
+                else "Connection: close"]
         head += [f"{k}: {v}" for k, v in extra]
         writer.write(("\r\n".join(head) + "\r\n\r\n").encode("latin-1"))
         writer.write(body)
         await writer.drain()
 
     async def _dispatch(self, method: str, path: str, body: bytes,
-                        writer: asyncio.StreamWriter) -> None:
+                        writer: asyncio.StreamWriter,
+                        keep_alive: bool = False) -> bool:
+        """Route one request; returns whether the connection stays open
+        (an SSE stream always closes — its framing is delimited by EOF)."""
         with self.tracer.span("http_request", cat="serving",
                               method=method, path=path) as sp:
             if path == "/healthz":
                 status = 200
-                await self._respond(writer, status, b"ok\n", "text/plain")
+                await self._respond(writer, status, b"ok\n", "text/plain",
+                                    keep_alive=keep_alive)
             elif path == "/readyz":
                 status = 200 if self.ready else 503
                 msg = b"ok\n" if status == 200 else (
                     b"draining\n" if self._draining else b"not ready\n")
-                await self._respond(writer, status, msg, "text/plain")
+                await self._respond(writer, status, msg, "text/plain",
+                                    keep_alive=keep_alive)
             elif path == "/metrics":
                 status = 200
                 await self._respond(writer, status,
                                     metrics_page(self.registry),
-                                    PROMETHEUS_CONTENT_TYPE)
+                                    PROMETHEUS_CONTENT_TYPE,
+                                    keep_alive=keep_alive)
             elif path == "/v1/completions":
                 if method != "POST":
                     status = 405
                     await self._respond(writer, status, error_body(
-                        "use POST", "method_not_allowed"))
+                        "use POST", "method_not_allowed"),
+                        keep_alive=keep_alive)
                 else:
-                    status = await self._handle_completion(body, writer)
+                    status, keep_alive = await self._handle_completion(
+                        body, writer, keep_alive)
             else:
                 status = 404
                 await self._respond(writer, status, error_body(
-                    f"no route {path!r}", "not_found"))
+                    f"no route {path!r}", "not_found"),
+                    keep_alive=keep_alive)
             sp.set_attribute("status", status)
         self._count_http(path, status)
+        return keep_alive
 
     # --- the completions route ----------------------------------------------
     async def _handle_completion(self, body: bytes,
-                                 writer: asyncio.StreamWriter) -> int:
+                                 writer: asyncio.StreamWriter,
+                                 keep_alive: bool = False,
+                                 ) -> Tuple[int, bool]:
+        """Returns (status, connection-still-open)."""
         if not self.ready:
             # draining OR the engine thread died: either way nobody will
             # ever drain the submit queue, so refuse instead of hanging
             msg = ("server is draining" if self._draining or self._stop
                    else "engine is not running")
             await self._respond(writer, 503, error_body(
-                msg, "unavailable_error"))
-            return 503
+                msg, "unavailable_error"), keep_alive=keep_alive)
+            return 503, keep_alive
         try:
             creq = parse_completion_request(body, tokenize=self.cfg.tokenize)
         except ProtocolError as e:
-            await self._respond(writer, 400, error_body(str(e)))
-            return 400
+            await self._respond(writer, 400, error_body(str(e)),
+                                keep_alive=keep_alive)
+            return 400, keep_alive
 
         # admission control: bounded in-flight set, counted rejections
         if len(self._handles) >= self.cfg.max_queue:
@@ -416,8 +466,9 @@ class CompletionServer:
                 writer, 429,
                 error_body("admission queue is full; retry later",
                            "overloaded_error"),
-                extra=(("Retry-After", str(self.cfg.retry_after_s)),))
-            return 429
+                extra=(("Retry-After", str(self.cfg.retry_after_s)),),
+                keep_alive=keep_alive)
+            return 429, keep_alive
         rid = f"cmpl-{next(self._ids)}"
         handle = _Handle(rid, creq, asyncio.Event())
         self._handles[rid] = handle
@@ -430,8 +481,9 @@ class CompletionServer:
                 writer, 429,
                 error_body("admission queue is full; retry later",
                            "overloaded_error"),
-                extra=(("Retry-After", str(self.cfg.retry_after_s)),))
-            return 429
+                extra=(("Retry-After", str(self.cfg.retry_after_s)),),
+                keep_alive=keep_alive)
+            return 429, keep_alive
         self._wake.set()
 
         timeout = creq.timeout if creq.timeout is not None \
@@ -440,8 +492,11 @@ class CompletionServer:
             timeout = min(float(timeout), self.cfg.max_timeout_s)
         try:
             if creq.stream:
-                return await self._stream_response(handle, timeout, writer)
-            return await self._json_response(handle, timeout, writer)
+                status = await self._stream_response(handle, timeout, writer)
+                return status, False  # SSE framing is delimited by EOF
+            status = await self._json_response(handle, timeout, writer,
+                                               keep_alive)
+            return status, keep_alive
         except (ConnectionError, asyncio.TimeoutError):
             # client vanished mid-response: free the engine-side work
             self._request_abort(handle, FinishReason.ABORT)
@@ -492,13 +547,14 @@ class CompletionServer:
 
     async def _json_response(self, handle: _Handle,
                              timeout: Optional[float],
-                             writer: asyncio.StreamWriter) -> int:
+                             writer: asyncio.StreamWriter,
+                             keep_alive: bool = False) -> int:
         tokens, reason = await self._collect(handle, timeout)
         req = handle.req
         await self._respond(writer, 200, completion_body(
             handle.rid, self.cfg.model_name, tokens, reason,
             len(handle.creq.prompt_ids),
-            error=getattr(req, "error", None)))
+            error=getattr(req, "error", None)), keep_alive=keep_alive)
         return 200
 
     async def _stream_response(self, handle: _Handle,

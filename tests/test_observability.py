@@ -567,3 +567,32 @@ class TestBoundedMetricsLint:
         hits = [(line, msg) for _, line, msg in lint.check_file(str(bad))]
         assert [line for line, _ in hits] == [2, 4, 6]
         assert "cannot be bounded" in hits[2][1]
+
+    def test_flags_prefix_cache_lru_maps(self, tmp_path):
+        """The ISSUE 4 extension: OrderedDict/defaultdict (the prefix
+        cache's hash-map / reuse-LRU shapes) have no bound parameter, so
+        every construction needs a waiver stating the structural bound."""
+        import check_bounded_metrics as lint
+
+        bad = tmp_path / "lru.py"
+        bad.write_text(
+            "import collections\n"
+            "from collections import OrderedDict, defaultdict\n"
+            "a = OrderedDict()\n"
+            "b = OrderedDict()  # unbounded-ok: ≤ num_blocks entries\n"
+            "c = defaultdict(list)\n"
+            "d = collections.OrderedDict()\n")
+        hits = [(line, msg) for _, line, msg in lint.check_file(str(bad))]
+        assert [line for line, _ in hits] == [3, 5, 6]
+        assert all("cannot be bounded" in msg for _, msg in hits)
+
+    def test_scan_covers_block_pool_module(self):
+        """The prefix cache's hash/LRU structures live in
+        ops/paged_attention.py — outside the telemetry dirs — and must
+        stay under the lint's eye."""
+        import check_bounded_metrics as lint
+
+        assert any(p.endswith(os.path.join("ops", "paged_attention.py"))
+                   for p in lint.SCAN_FILES)
+        # and the module passes as-written (waivers state pool bounds)
+        assert [v for v in lint.scan(dirs=(), files=lint.SCAN_FILES)] == []

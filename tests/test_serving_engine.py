@@ -98,7 +98,9 @@ class TestContinuousBatching:
         for req, ref in zip(reqs, solo):
             assert req.output_tokens == ref
             assert req.finish_reason == FinishReason.LENGTH
-        assert eng.kv.num_free == eng.kv.num_blocks - 1  # pool drained
+        # pool drained: every block is free or parked (reusable) in the
+        # prefix cache's LRU — none still owned by a finished request
+        assert eng.kv.num_available == eng.kv.num_blocks - 1
 
     def test_preemption_recompute_token_identical(self):
         """The N31 acceptance test: a pool too small for both requests
@@ -118,7 +120,7 @@ class TestContinuousBatching:
         for req, r in zip(reqs, ref):
             assert req.finish_reason == FinishReason.LENGTH
             assert req.output_tokens == r
-        assert eng.kv.num_free == 9  # every block back
+        assert eng.kv.num_available == 9  # every block back (or cached)
 
     def test_exhaustion_completes_all_requests(self):
         """≥2 active requests + exhaustion must complete EVERYONE via
@@ -219,7 +221,7 @@ class TestStreaming:
         assert not eng.abort_request(req.request_id)  # idempotent
         eng.run(max_steps=100)             # others unaffected
         assert other.finish_reason == FinishReason.LENGTH
-        assert eng.kv.num_free == eng.kv.num_blocks - 1
+        assert eng.kv.num_available == eng.kv.num_blocks - 1
 
     def test_closing_stream_early_aborts_and_frees_blocks(self):
         """Regression: an abandoned stream (consumer closes the generator
@@ -236,7 +238,7 @@ class TestStreaming:
         gen.close()
         assert req.finish_reason == FinishReason.ABORT
         assert eng.kv.occupancy() == 0.0           # pool back to empty
-        assert eng.kv.num_free == eng.kv.num_blocks - 1
+        assert eng.kv.num_available == eng.kv.num_blocks - 1
         assert eng.requests == {}
         assert not eng.scheduler.has_work()
 
@@ -484,11 +486,13 @@ class TestSchedulerUnit:
         kv = KVCacheManager(num_blocks=4, block_size=2)  # 3 usable
         sched = ContinuousBatchingScheduler(
             SchedulerConfig(max_num_seqs=4), kv)
-        a = Request(prompt_ids=[1, 2])
+        a = Request(prompt_ids=[1])
         sched.add(a)
         plan = sched.schedule()
         assert plan.prefills == [a]
-        kv.allocate(a.request_id, 2)
+        # emulate the engine's prefill: the WHOLE prompt commits before a
+        # request becomes decode-eligible (chunked-prefill contract)
+        kv.allocate(a.request_id, 1)
         kv.commit(a.request_id, 1)         # mid-block: next slot is free
         b = Request(prompt_ids=[3, 4])
         sched.add(b)

@@ -271,6 +271,95 @@ class TestEndpoints:
                 assert sample.match(line), line
 
 
+class TestKeepAlive:
+    """HTTP/1.1 persistent connections (ISSUE 4 satellite; ISSUE 3
+    follow-up (a)): sequential requests ride ONE socket instead of a
+    connection per request."""
+
+    def test_two_sequential_completions_over_one_socket(self,
+                                                        harness_factory):
+        h = harness_factory(_engine(_model()))
+        conn = http.client.HTTPConnection("127.0.0.1", h.port, timeout=120)
+        got = []
+        for prompt in (PROMPTS[0], PROMPTS[1]):
+            conn.request("POST", "/v1/completions",
+                         json.dumps({"prompt": prompt, "max_tokens": 4}),
+                         {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            data = resp.read()
+            assert resp.status == 200
+            assert resp.getheader("Connection") == "keep-alive"
+            got.append(json.loads(data)["choices"][0]["token_ids"])
+            assert conn.sock is not None   # server left the socket open
+            if len(got) == 1:
+                local = conn.sock.getsockname()
+        # same client socket served both completions (no reconnect)
+        assert conn.sock.getsockname() == local
+        assert all(len(t) == 4 for t in got)
+        conn.close()
+
+    def test_mixed_routes_share_one_socket(self, harness_factory):
+        h = harness_factory(_engine(_model()))
+        conn = http.client.HTTPConnection("127.0.0.1", h.port, timeout=120)
+        conn.request("GET", "/healthz")
+        r = conn.getresponse()
+        assert r.status == 200 and r.read() == b"ok\n"
+        local = conn.sock.getsockname()
+        conn.request("GET", "/metrics")
+        r = conn.getresponse()
+        assert r.status == 200 and b"serving_engine_steps_total" in r.read()
+        assert conn.sock.getsockname() == local
+        conn.close()
+
+    def test_connection_close_header_honored(self, harness_factory):
+        h = harness_factory(_engine(_model()))
+        conn = http.client.HTTPConnection("127.0.0.1", h.port, timeout=120)
+        conn.request("GET", "/healthz", headers={"Connection": "close"})
+        r = conn.getresponse()
+        assert r.status == 200
+        assert r.getheader("Connection") == "close"
+        r.read()
+        # http.client tears the socket down when the server says close
+        assert conn.sock is None
+        conn.close()
+
+    def test_chunked_transfer_encoding_rejected_and_closed(
+            self, harness_factory):
+        """A chunked body would desync the persistent stream (its unread
+        bytes would parse as the next request line), so the server must
+        answer 411 AND close rather than keep the socket alive."""
+        h = harness_factory(_engine(_model()))
+        conn = http.client.HTTPConnection("127.0.0.1", h.port, timeout=120)
+        body = json.dumps({"prompt": PROMPTS[0], "max_tokens": 2})
+        payload = (f"{len(body):x}\r\n{body}\r\n0\r\n\r\n").encode()
+        conn.putrequest("POST", "/v1/completions",
+                        skip_accept_encoding=True)
+        conn.putheader("Transfer-Encoding", "chunked")
+        conn.putheader("Content-Type", "application/json")
+        conn.endheaders()
+        conn.send(payload)
+        r = conn.getresponse()
+        assert r.status == 411
+        assert r.getheader("Connection") == "close"
+        r.read()
+        assert conn.sock is None  # server closed; stray bytes discarded
+        conn.close()
+
+    def test_idle_connection_reaped_after_timeout(self, harness_factory):
+        h = harness_factory(_engine(_model()),
+                            ServerConfig(keepalive_timeout_s=0.3))
+        conn = http.client.HTTPConnection("127.0.0.1", h.port, timeout=120)
+        conn.request("GET", "/healthz")
+        r = conn.getresponse()
+        assert r.status == 200 and r.getheader("Connection") == "keep-alive"
+        r.read()
+        sock = conn.sock
+        sock.settimeout(10)
+        # past the idle deadline the SERVER closes: recv sees clean EOF
+        assert sock.recv(1) == b""
+        conn.close()
+
+
 class TestAdmissionControl:
     def test_429_with_retry_after_when_saturated(self, harness_factory):
         """With max_queue=1 and one stream in flight, the next POST is
@@ -328,7 +417,7 @@ class TestAdmissionControl:
         # the rejection was counted; the admitted stream was unaffected
         _, _, metrics = _request(h.port, "GET", "/metrics")
         assert b"serving_admission_rejected_total 1" in metrics
-        assert engine.kv.num_free == engine.kv.num_blocks - 1
+        assert engine.kv.num_available == engine.kv.num_blocks - 1
 
 
 class TestDeadlines:
@@ -347,10 +436,10 @@ class TestDeadlines:
         assert time.monotonic() - t0 < 60
         # abort propagated into the scheduler: blocks freed
         deadline = time.monotonic() + 30
-        while (engine.kv.num_free != engine.kv.num_blocks - 1
+        while (engine.kv.num_available != engine.kv.num_blocks - 1
                and time.monotonic() < deadline):
             time.sleep(0.02)
-        assert engine.kv.num_free == engine.kv.num_blocks - 1
+        assert engine.kv.num_available == engine.kv.num_blocks - 1
         _, _, metrics = _request(h.port, "GET", "/metrics")
         assert b"serving_requests_finished_timeout_total 1" in metrics
 
@@ -400,7 +489,7 @@ class TestDrain:
         assert done and finish == "timeout"        # drain-deadline abort
         # no KV blocks leaked: pool occupancy zero at exit
         assert engine.kv.occupancy() == 0.0
-        assert engine.kv.num_free == engine.kv.num_blocks - 1
+        assert engine.kv.num_available == engine.kv.num_blocks - 1
         assert not h.server._engine_thread.is_alive()
         # the socket is closed: connections now fail
         with pytest.raises(OSError):

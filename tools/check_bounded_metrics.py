@@ -12,9 +12,17 @@ its bound:
   the HTTP frontend's cross-thread submit/abort queues are the reason
   this rule exists;
 * ``SimpleQueue`` has no bound at all, so any use needs a waiver;
+* ``OrderedDict`` / ``defaultdict`` — the LRU/map shapes the prefix
+  cache introduced (ISSUE 4) — have no bound parameter either, so every
+  construction needs a waiver stating the structural bound (e.g. "≤
+  num_blocks entries": the block pool caps them);
 * a bare-list "reservoir" (``self.x = []`` later ``.append``ed from a
   per-step/per-op path) is caught by the deque rule in practice — the
   repo's convention is that windows/rings are deques.
+
+Besides the telemetry packages, ``SCAN_FILES`` pins individual modules
+that host long-lived caches — ``ops/paged_attention.py`` carries the
+serving block pool's prefix-hash map and reuse LRU.
 
 A genuinely-unbounded container that holds WORK (not telemetry) is
 allowed with an inline waiver comment stating why::
@@ -37,6 +45,10 @@ SCAN_DIRS = (
     os.path.join(_REPO, "paddle_tpu", "observability"),
     os.path.join(_REPO, "paddle_tpu", "serving"),
 )
+# single modules outside the telemetry dirs that host long-lived caches
+SCAN_FILES = (
+    os.path.join(_REPO, "paddle_tpu", "ops", "paged_attention.py"),
+)
 WAIVER = "unbounded-ok:"
 
 # call-name suffix -> required bound keyword; matches attribute calls
@@ -48,8 +60,10 @@ _RULES = {
     "PriorityQueue": ("maxsize", 0),
 }
 
-# constructors with NO bound parameter: always a violation without a waiver
-_UNBOUNDABLE = ("SimpleQueue",)
+# constructors with NO bound parameter: always a violation without a
+# waiver (the waiver must state the structural bound — e.g. the prefix
+# cache's hash map / reuse LRU are capped by the pool's block count)
+_UNBOUNDABLE = ("SimpleQueue", "OrderedDict", "defaultdict")
 
 
 def _call_name(node: ast.Call) -> str:
@@ -103,13 +117,15 @@ def check_file(path: str) -> List[Tuple[str, int, str]]:
     return out
 
 
-def scan(dirs=SCAN_DIRS) -> List[Tuple[str, int, str]]:
+def scan(dirs=SCAN_DIRS, files=SCAN_FILES) -> List[Tuple[str, int, str]]:
     out = []
     for d in dirs:
-        for root, _, files in os.walk(d):
-            for fn in sorted(files):
+        for root, _, fns in os.walk(d):
+            for fn in sorted(fns):
                 if fn.endswith(".py"):
                     out.extend(check_file(os.path.join(root, fn)))
+    for path in files:
+        out.extend(check_file(path))
     return out
 
 

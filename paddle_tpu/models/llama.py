@@ -326,8 +326,8 @@ class LlamaAttention(Layer):
 
         def attend(qv, kpool, vpool):
             return pa_mod.paged_attention(
-                qv[:, 0], kpool, vpool, cache.block_tables, cache.seq_lens
-            )[:, None]
+                qv[:, 0], kpool, vpool, cache.block_tables, cache.seq_lens,
+                use_pallas=cache.use_pallas)[:, None]
 
         out = run_op("paged_attention", attend, q, kp, vp)
         out = run_op("merge_heads",

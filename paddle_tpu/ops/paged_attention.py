@@ -11,6 +11,13 @@ array updated with scatter writes (XLA keeps it resident in HBM and donates
 the buffer between decode steps under jit); the gather of a sequence's
 blocks is one ``take`` along the block dim — compiler-friendly static
 shapes with a length mask instead of dynamic slicing.
+
+Multi-chip (ISSUE 5): the pool tensors shard along the **head** dim over
+the ``mp`` mesh axis (:func:`shard_kv_pool`) while every bookkeeping
+structure — block tables, free list, refcounts, hashes — stays host-side
+and replicated: one block index means the same page on every shard, so a
+single scheduler decision routes N shards and only the per-block byte
+footprint divides by mp.
 """
 
 from __future__ import annotations
@@ -293,8 +300,12 @@ class BlockKVCache:
                  head_dim: int, dtype=jnp.bfloat16):
         self.num_blocks = num_blocks
         self.block_size = block_size
-        self.k_cache = jnp.zeros((num_blocks, block_size, num_heads, head_dim), dtype)
-        self.v_cache = jnp.zeros((num_blocks, block_size, num_heads, head_dim), dtype)
+        # head-dim sharded over the mp mesh axis when one is live (the
+        # bookkeeping below stays host-side/replicated either way)
+        self.k_cache = shard_kv_pool(
+            jnp.zeros((num_blocks, block_size, num_heads, head_dim), dtype))
+        self.v_cache = shard_kv_pool(
+            jnp.zeros((num_blocks, block_size, num_heads, head_dim), dtype))
         self._pool = BlockPool(num_blocks, block_size)
         self._free = self._pool._free        # same objects, mutated in place
         self._ref = self._pool._ref
@@ -359,6 +370,35 @@ class BlockKVCache:
         return jnp.asarray(bt), jnp.asarray(lens)
 
 
+#: PartitionSpec entries for a ``[num_blocks, block_size, H, D]`` KV pool
+#: under tensor-parallel serving: sharded along the HEAD dim over ``mp``.
+#: The single source of truth — :func:`shard_kv_pool` places pools with it
+#: and the engine's explicit jit in/out shardings reuse it, so placement
+#: and program specs cannot drift (drift = silent full-pool resharding
+#: transfers every step).
+KV_POOL_SPEC = (None, None, "mp", None)
+
+
+def shard_kv_pool(pool):
+    """Place a ``[num_blocks, block_size, H, D]`` KV pool sharded along the
+    head dim over the ``mp`` mesh axis (tensor-parallel serving, ISSUE 5).
+
+    No-op (replicated placement semantics unchanged) when there is no
+    global mesh, the mesh has no ``mp`` axis, ``mp == 1``, or the head
+    count does not divide evenly — callers that require sharding must
+    validate divisibility themselves (the engine does)."""
+    from ..distributed import topology
+
+    mesh = topology.get_mesh()
+    if (mesh is None or "mp" not in mesh.axis_names
+            or mesh.shape["mp"] == 1 or pool.shape[2] % mesh.shape["mp"]):
+        return pool
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    return jax.device_put(
+        pool, NamedSharding(mesh, PartitionSpec(*KV_POOL_SPEC)))
+
+
 # Which path the most recent dispatch took: "pallas" | "xla" (same loud
 # fallback contract as ops/flash_attention.py).
 last_path: Optional[str] = None
@@ -384,6 +424,11 @@ class PagedCache:
         self.q_start = None        # chunked prefill only: global position
                                    # of the chunk's first token (scalar or
                                    # [B] int32) — offsets the causal mask
+        self.use_pallas = None     # decode kernel routing hint (ISSUE 5
+                                   # satellite): True forces the Pallas
+                                   # kernel (interpret mode off-TPU),
+                                   # False forces the XLA gather path,
+                                   # None keeps the auto dispatch
 
     def route(self, block_tables, seq_lens, slot_blocks, slot_offsets,
               q_start=None):
@@ -465,7 +510,8 @@ def paged_prefill_attention(q: jax.Array, k_cache: jax.Array,
 
 
 def paged_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
-                    block_tables: jax.Array, seq_lens: jax.Array) -> jax.Array:
+                    block_tables: jax.Array, seq_lens: jax.Array,
+                    use_pallas: Optional[bool] = None) -> jax.Array:
     """Decode-step attention over a paged KV cache.
 
     q: [B, H, D] (one new token per sequence); k/v_cache:
@@ -475,6 +521,15 @@ def paged_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
     Dispatches to the Pallas kernel (``pallas_paged.py`` — scalar-prefetch
     page DMA, no dense context copy) when shapes are TPU-tileable; falls
     back to the XLA gather path with a loud warning otherwise.
+
+    ``use_pallas`` overrides the auto dispatch (``EngineConfig.
+    use_pallas_paged``, ISSUE 5): ``True`` routes through the Pallas
+    kernel even when the tileability heuristic says no (off-TPU the
+    kernel runs in interpret mode — the CPU smoke-test path); ``False``
+    pins the XLA gather path (the mp>1 choice: GSPMD partitions the
+    gather einsums, while the Pallas kernel is single-shard).  The
+    operator kill switch (``PADDLE_TPU_DISABLE_PALLAS`` / the
+    ``disable_pallas_kernels`` flag) still wins over ``use_pallas=True``.
     """
     import os
 
@@ -485,7 +540,9 @@ def paged_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
     disable = (os.environ.get("PADDLE_TPU_DISABLE_PALLAS") == "1"
                or flags.flag("disable_pallas_kernels"))
     tileable = D % 128 == 0 and k_cache.shape[1] % 8 == 0
-    if not disable and tileable:
+    if use_pallas is False:
+        tileable = False          # pin the XLA gather path
+    if not disable and (tileable or use_pallas is True):
         try:
             from .pallas_paged import paged_attention_decode
 

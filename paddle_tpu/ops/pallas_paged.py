@@ -25,12 +25,17 @@ import math
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from .pallas_x32 import no_x64
 
-_NEG_INF = -1e30
+# np.float32 scalar, not a Python float: inside an OUTER jit the
+# interpret-mode kernel body is staged and re-evaluated outside the
+# no_x64() window, where a bare float would promote to f64 and trip
+# the MLIR verifier (same fix as pallas_flash's np-scalar consts)
+_NEG_INF = np.float32(-1e30)
 
 
 def _interpret() -> bool:
@@ -93,7 +98,7 @@ def _decode_kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
 
     @pl.when(j == n_pages - 1)
     def _finish():
-        o_ref[0] = (acc_ref[:] / jnp.maximum(l_ref[:, 0], 1e-9)[:, None]
+        o_ref[0] = (acc_ref[:] / jnp.maximum(l_ref[:, 0], np.float32(1e-9))[:, None]
                     ).astype(o_ref.dtype)
 
 

@@ -118,6 +118,13 @@ def apply_param_shardings(layer, mesh: Optional[Mesh] = None):
     of fleet's broadcast-on-init (``fleet/model.py:32``), except placement is
     declarative and XLA moves only the local shard.
 
+    Specs are rank/divisibility-fitted like :func:`sharding_constraint`
+    (and the serving engine's explicit jit in_shardings): an annotated dim
+    the mesh degree doesn't divide evenly is placed replicated instead of
+    crashing deep inside ``device_put`` — e.g. a model built BEFORE the
+    mesh existed (so the mp-layer constructor checks ran at degree 1)
+    with an odd ``intermediate_size`` under mp=2.
+
     Under a trace (AOT lowering with init fused into the program, e.g.
     ``tools/aot_lower_8b.py``) a ``device_put`` annotation is dropped by the
     lowering, so traced values get ``with_sharding_constraint`` instead —
@@ -127,6 +134,7 @@ def apply_param_shardings(layer, mesh: Optional[Mesh] = None):
         return layer
 
     def place(v, spec):
+        spec = _fit_spec(spec, jax.numpy.shape(v), mesh)
         if isinstance(v, jax.core.Tracer):
             if in_manual_mode():
                 # inside shard_map the value is a per-shard view — a

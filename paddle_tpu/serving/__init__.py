@@ -5,6 +5,11 @@ paged-attention ops and predictor API:
 
 * :class:`EngineCore` (``engine.py``) — request queue, bucketed
   fixed-shape jitted prefill/decode programs, streaming, abort.
+  :class:`EngineConfig` bundles deployment knobs (pool sizing, prefix
+  cache, ``use_pallas_paged`` kernel routing, expected ``mp`` degree);
+  under a live mesh with ``mp > 1`` the engine serves tensor-parallel
+  (KV pools head-sharded, routing replicated — README "Multi-chip
+  serving").
 * :class:`ContinuousBatchingScheduler` (``scheduler.py``) — admission
   control + decode-slot reservation with preemption-and-recompute.
 * :class:`KVCacheManager` (``kv_manager.py``) — refcounted paged block
@@ -22,7 +27,7 @@ Architecture sketch and scheduler invariants: see ``scheduler.py``'s
 module docstring and the README's serving sections.
 """
 
-from .engine import EngineCore  # noqa: F401
+from .engine import EngineConfig, EngineCore  # noqa: F401
 from .entrypoints import LLM, CompletionOutput, stream_generate  # noqa: F401
 from .kv_manager import KVCacheManager, PoolExhausted  # noqa: F401
 from .metrics import ServingMetrics  # noqa: F401

@@ -29,11 +29,27 @@ The model runs *functionally* inside the jitted step: parameters and KV
 pools enter as jit arguments (swapped into the eager module for the trace,
 restored after), updated pools return as outputs.  On TPU the pool
 arguments are donated, so the decode step updates KV in place in HBM.
+
+**Tensor-parallel serving (ISSUE 5):** when the global mesh
+(``distributed.topology``) carries an ``mp`` axis > 1, the engine runs the
+same loop mesh-spanning: parameters are placed per their
+``PartitionSpec`` annotations (the Megatron column→row pairing of
+``parallel/mp_layers.py`` — attention heads and MLP width sharded over
+``mp``), the KV pools shard along the **head** dim
+(``ops.paged_attention.shard_kv_pool``), and the jitted prefill/decode
+programs carry explicit in/out shardings: routing arrays (block tables,
+seq lens, slot indices, token ids) enter **replicated**, pools and
+activations sharded, and GSPMD inserts the collectives.  Everything
+host-side — BlockPool bookkeeping, scheduler state, admission math,
+prefix-cache hashes — is untouched: one scheduler decision drives N
+shards, and only the per-shard pool byte footprint divides by mp.  The
+bucket sets (and therefore the jit trace count) are mp-invariant.
 """
 
 from __future__ import annotations
 
 import time
+from dataclasses import dataclass
 from typing import Dict, Iterator, List, Optional, Sequence
 
 import jax
@@ -41,7 +57,13 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.tensor import Tensor
-from ..ops.paged_attention import PagedCache, PoolExhausted
+from ..distributed import topology
+from ..ops.paged_attention import (
+    KV_POOL_SPEC,
+    PagedCache,
+    PoolExhausted,
+    shard_kv_pool,
+)
 from .kv_manager import KVCacheManager
 from .metrics import ServingMetrics, StepTimer
 from .request import FinishReason, Request, RequestState, SamplingParams
@@ -50,6 +72,33 @@ from .scheduler import (
     SchedulerConfig,
     bucket_size,
 )
+
+
+@dataclass
+class EngineConfig:
+    """Engine-level deployment knobs (the config plumb-through of ISSUE 5).
+
+    ``EngineCore(model, config=EngineConfig(...))`` is the one-object
+    form; the legacy keyword arguments remain and are folded into one of
+    these when no config is passed.
+    """
+
+    num_blocks: int = 256
+    block_size: int = 16
+    dtype: object = None              # pool dtype; None = jnp.float32
+    prefix_cache: bool = True
+    profile_ops: bool = False
+    scheduler: Optional[SchedulerConfig] = None
+    # Pallas paged-decode routing (ROADMAP serving follow-up (b)): None =
+    # auto dispatch (kernel when TPU-tileable), True = force the kernel
+    # (interpret mode off-TPU — the smoke-test path), False = force the
+    # XLA gather path.  The on-chip A/B is now a config flip.
+    use_pallas_paged: Optional[bool] = None
+    # Expected tensor-parallel degree.  None = use whatever ``mp`` axis
+    # the global mesh has (1 when no mesh).  An explicit value that does
+    # not match the live mesh raises at engine build — a misconfigured
+    # deployment fails loudly instead of silently serving single-chip.
+    mp: Optional[int] = None
 
 
 class EngineCore:
@@ -61,20 +110,36 @@ class EngineCore:
     decode program, samples on the host with each request's own RNG
     stream, and retires finished requests.  ``stream()`` exposes a
     per-request generator that drives ``step()`` on demand.
+
+    Construction: pass ``config=EngineConfig(...)`` (the one-object form
+    — it then WINS over the legacy keyword arguments) or the individual
+    keywords, which are folded into an :class:`EngineConfig`
+    (``self.engine_config``).  ``self.mp`` is the resolved
+    tensor-parallel degree (1 single-chip).
     """
 
     def __init__(self, model, num_blocks: int = 256, block_size: int = 16,
                  dtype=jnp.float32, scheduler_config: Optional[SchedulerConfig] = None,
                  profile_ops: bool = False, registry=None,
-                 prefix_cache: bool = True):
+                 prefix_cache: bool = True,
+                 config: Optional[EngineConfig] = None,
+                 use_pallas_paged: Optional[bool] = None):
+        if config is None:
+            config = EngineConfig(
+                num_blocks=num_blocks, block_size=block_size, dtype=dtype,
+                prefix_cache=prefix_cache, profile_ops=profile_ops,
+                scheduler=scheduler_config, use_pallas_paged=use_pallas_paged)
+        self.engine_config = config
+        num_blocks, block_size = config.num_blocks, config.block_size
+        dtype = config.dtype if config.dtype is not None else jnp.float32
         cfg = model.config
         self.model = model
         self.kv = KVCacheManager(num_blocks, block_size,
-                                 enable_prefix_cache=prefix_cache)
+                                 enable_prefix_cache=config.prefix_cache)
         self.block_size = block_size
         self.num_blocks = num_blocks
         self.scheduler = ContinuousBatchingScheduler(
-            scheduler_config or SchedulerConfig(), self.kv)
+            config.scheduler or SchedulerConfig(), self.kv)
         # registry=None keeps counts per-engine; pass
         # observability.get_registry() to publish serving series on the
         # process-wide Prometheus page next to the jit compile counters
@@ -82,10 +147,41 @@ class EngineCore:
         self.tracer = self.metrics.tracer
         self.requests: Dict[object, Request] = {}
         self._pool_dtype = jnp.dtype(dtype)
+        # --- tensor-parallel resolution (ISSUE 5) ---------------------------
+        mesh = topology.get_mesh()
+        from ..parallel.utils import axis_size
+
+        self.mp = axis_size("mp")
+        if config.mp is not None and config.mp != self.mp:
+            raise ValueError(
+                f"EngineConfig.mp={config.mp} but the global mesh has "
+                f"mp={self.mp}; call distributed.topology.init_mesh(mp=...) "
+                "before building the engine")
+        self._use_pallas = config.use_pallas_paged
+        if self.mp > 1:
+            if cfg.num_key_value_heads % self.mp or \
+                    cfg.num_attention_heads % self.mp:
+                raise ValueError(
+                    f"mp={self.mp} must divide num_key_value_heads="
+                    f"{cfg.num_key_value_heads} and num_attention_heads="
+                    f"{cfg.num_attention_heads} (the KV pools shard along "
+                    "the head dim)")
+            if self._use_pallas:
+                raise ValueError(
+                    "use_pallas_paged=True requires mp=1: the Pallas decode "
+                    "kernel is single-shard; the mp path runs the XLA "
+                    "gather attention GSPMD partitions")
+            self._use_pallas = False  # pin XLA path inside the mesh program
+            from ..parallel.utils import apply_param_shardings
+
+            # place every annotated parameter (column/row/vocab-parallel
+            # specs from parallel/mp_layers.py) onto the mesh shard-wise
+            apply_param_shardings(model, mesh)
+        self.metrics.set_mp_shards(self.mp)
         shape = (num_blocks, block_size, cfg.num_key_value_heads, cfg.head_dim)
-        self._k_pools = tuple(jnp.zeros(shape, dtype)
+        self._k_pools = tuple(shard_kv_pool(jnp.zeros(shape, dtype))
                               for _ in range(cfg.num_hidden_layers))
-        self._v_pools = tuple(jnp.zeros(shape, dtype)
+        self._v_pools = tuple(shard_kv_pool(jnp.zeros(shape, dtype))
                               for _ in range(cfg.num_hidden_layers))
         self._params = list(model.parameters())
         # retrace counters: += 1 runs only while JAX traces the function,
@@ -95,13 +191,54 @@ class EngineCore:
         self.decode_buckets = set()
         self.prefill_buckets = set()
         donate = (1, 2) if jax.default_backend() == "tpu" else ()
-        self._jit_decode = jax.jit(self._decode_fn, donate_argnums=donate)
-        self._jit_prefill = jax.jit(self._prefill_fn, donate_argnums=donate)
+        if self.mp > 1:
+            jit_kw = self._mesh_jit_shardings(mesh, cfg)
+        else:
+            jit_kw = {"decode": {}, "prefill": {}, "chunk": {}}
+        self._jit_decode = jax.jit(self._decode_fn, donate_argnums=donate,
+                                   **jit_kw["decode"])
+        self._jit_prefill = jax.jit(self._prefill_fn, donate_argnums=donate,
+                                    **jit_kw["prefill"])
         self._jit_chunk_prefill = jax.jit(self._chunk_prefill_fn,
-                                          donate_argnums=donate)
-        self._profile_ops = profile_ops
+                                          donate_argnums=donate,
+                                          **jit_kw["chunk"])
+        self._profile_ops = config.profile_ops
         self._evictions_seen = 0  # last-synced kv.reuse_evictions value
         model.eval()
+
+    def _mesh_jit_shardings(self, mesh, cfg) -> Dict[str, dict]:
+        """Explicit in/out shardings for the three mesh-spanning jitted
+        programs: parameters per their fitted ``PartitionSpec``
+        annotations, KV pools head-sharded over ``mp``, every routing
+        array (ids, positions, tables, lens, slots) **replicated** — the
+        host keeps one logical view and GSPMD splits the compute.  Being
+        explicit (rather than letting propagation guess from committed
+        inputs) keeps placement deterministic per bucket."""
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from ..parallel.utils import _fit_spec, param_spec
+
+        repl = NamedSharding(mesh, PartitionSpec())
+        kv = NamedSharding(mesh, PartitionSpec(*KV_POOL_SPEC))  # matches
+        # shard_kv_pool's placement — same constant, cannot drift
+        pools = tuple(kv for _ in range(cfg.num_hidden_layers))
+        params = tuple(
+            NamedSharding(mesh, _fit_spec(param_spec(p), tuple(p.shape), mesh))
+            for p in self._params)
+        out = (repl, pools, pools)  # logits replicated, pools stay sharded
+        return {
+            # (param_vals, k_pools, v_pools, ids, pos, tables, lens,
+            #  slot_blocks, slot_offsets)
+            "decode": {"in_shardings": (params, pools, pools) + (repl,) * 6,
+                       "out_shardings": out},
+            # (param_vals, k_pools, v_pools, ids, last_pos, blocks, offs)
+            "prefill": {"in_shardings": (params, pools, pools) + (repl,) * 4,
+                        "out_shardings": out},
+            # (param_vals, k_pools, v_pools, ids, start, last_pos, tables,
+            #  lens, slot_blocks, slot_offsets)
+            "chunk": {"in_shardings": (params, pools, pools) + (repl,) * 7,
+                      "out_shardings": out},
+        }
 
     # --- functional model step (traced) ------------------------------------
     def _call_model(self, ids_val, caches, pos_val, param_vals):
@@ -140,6 +277,7 @@ class EngineCore:
         for k, v in zip(k_pools, v_pools):
             c = PagedCache(Tensor(k), Tensor(v))
             c.route(tables, lens, slot_blocks, slot_offsets)
+            c.use_pallas = self._use_pallas  # EngineConfig.use_pallas_paged
             caches.append(c)
         logits = self._call_model(ids, caches, pos, param_vals)
         return (logits[:, -1, :].astype(jnp.float32),
@@ -269,6 +407,14 @@ class EngineCore:
     def _param_vals(self):
         return tuple(p._value for p in self._params)
 
+    def _collective_phase(self, phase: str) -> Optional[str]:
+        """StepTimer's extra label for the mesh-spanning step: the wall
+        time also lands in ``serving_collective_seconds{phase=...}`` —
+        only when the step actually spans shards (mp > 1); the series
+        itself is pre-registered so it shows on ``/metrics`` either
+        way."""
+        return phase if self.mp > 1 else None
+
     def _prefill(self, req: Request) -> None:
         """Run one bucketed prefill program for ``req`` — the whole
         prompt (cold one-shot), or one chunk of it (token-budgeted
@@ -302,7 +448,8 @@ class EngineCore:
                                   request=str(rid), trace=req.trace_id,
                                   tokens=target, bucket=Tb,
                                   recompute=bool(req.output_tokens)):
-                with StepTimer(self.metrics, "prefill_step"):
+                with StepTimer(self.metrics, "prefill_step",
+                               self._collective_phase("prefill")):
                     last, self._k_pools, self._v_pools = self._jit_prefill(
                         self._param_vals(), self._k_pools, self._v_pools,
                         ids_arr, np.int32(target - 1), blocks, offs)
@@ -331,7 +478,8 @@ class EngineCore:
                                   start=start,
                                   cached=req.num_cached_tokens,
                                   recompute=bool(req.output_tokens)):
-                with StepTimer(self.metrics, "prefill_step"):
+                with StepTimer(self.metrics, "prefill_step",
+                               self._collective_phase("prefill")):
                     last, self._k_pools, self._v_pools = \
                         self._jit_chunk_prefill(
                             self._param_vals(), self._k_pools,
@@ -376,7 +524,8 @@ class EngineCore:
                                                 for r in reqs),
                               traces=",".join(str(r.trace_id)
                                               for r in reqs)):
-            with StepTimer(self.metrics, "decode_step"):
+            with StepTimer(self.metrics, "decode_step",
+                           self._collective_phase("decode")):
                 out, self._k_pools, self._v_pools = self._jit_decode(
                     self._param_vals(), self._k_pools, self._v_pools,
                     ids, poss, tables, lens, slot_blocks, slot_offsets)

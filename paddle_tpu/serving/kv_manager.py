@@ -14,6 +14,14 @@ exhaustion is a *scheduling event*, not an error — the engine preempts the
 lowest-priority running request (freeing its blocks for recompute later)
 instead of failing anyone.  Block 0 is the reserved null page that padding
 rows of a bucketed batch write into.
+
+Multi-chip (ISSUE 5): this manager is **per-process host state and stays
+replicated** when the engine serves tensor-parallel over the ``mp`` mesh
+axis.  The pool tensors shard along the head dim on device, but a block
+index means the same page on every shard, so the same table/refcount/
+hash bookkeeping routes all N shards — capacity, admission, preemption
+and prefix-cache math are all mp-invariant (per-shard block bytes =
+``block_size * Hkv/mp * D * itemsize``).
 """
 
 from __future__ import annotations

@@ -68,7 +68,12 @@ _COUNTER_NAMES = (
 )
 
 _GAUGE_NAMES = ("queue_depth", "num_running", "kv_pool_occupancy",
-                "prefix_cached_token_ratio")
+                "prefix_cached_token_ratio", "mp_shards")
+
+# mesh-spanning step phases (ISSUE 5): pre-registered so the
+# serving_collective_seconds series shows on /metrics even before (or
+# without) any multi-chip step running
+_COLLECTIVE_PHASES = ("prefill", "decode")
 
 
 class ServingMetrics:
@@ -92,6 +97,15 @@ class ServingMetrics:
             name: self.registry.gauge(f"serving_{name}",
                                       f"per-engine-step {name}")
             for name in _GAUGE_NAMES
+        }
+        # wall time of one mesh-spanning jitted step, labelled by phase
+        # (observed only when mp > 1; present on /metrics regardless)
+        self._collective: Dict[str, Histogram] = {
+            phase: self.registry.histogram(
+                "serving_collective_seconds",
+                "wall time of the mesh-spanning jitted step (mp > 1)",
+                buckets=LATENCY_BUCKETS, phase=phase)
+            for phase in _COLLECTIVE_PHASES
         }
         self._host_ops: Optional[HostOpRecorder] = None
 
@@ -123,6 +137,16 @@ class ServingMetrics:
 
     def observe_inter_token(self, seconds: float) -> None:
         self.observe("inter_token_latency", seconds)
+
+    def observe_collective(self, phase: str, seconds: float) -> None:
+        """One mesh-spanning jitted step's wall time (ISSUE 5):
+        ``serving_collective_seconds{phase="prefill"|"decode"}``."""
+        self._collective[phase].observe(seconds)
+
+    def set_mp_shards(self, mp: int) -> None:
+        """Publish the engine's tensor-parallel degree
+        (``serving_mp_shards``; 1 = single-chip)."""
+        self._gauges["mp_shards"].set(mp)
 
     def set_cached_token_ratio(self) -> None:
         """Publish hit / (hit + computed) over the whole process life —
@@ -235,16 +259,26 @@ class ServingMetrics:
 
 
 class StepTimer:
-    """``with StepTimer(metrics, "decode_step"): ...`` convenience."""
+    """``with StepTimer(metrics, "decode_step"): ...`` convenience.
 
-    def __init__(self, metrics: ServingMetrics, name: str):
+    ``collective_phase`` additionally feeds the same wall time into
+    ``serving_collective_seconds{phase=...}`` — the engine passes it only
+    when the timed step actually spans mesh shards (mp > 1), keeping ONE
+    timing path for both series."""
+
+    def __init__(self, metrics: ServingMetrics, name: str,
+                 collective_phase: Optional[str] = None):
         self.metrics = metrics
         self.name = name
+        self.collective_phase = collective_phase
 
     def __enter__(self):
         self._t0 = time.perf_counter()
         return self
 
     def __exit__(self, *exc):
-        self.metrics.observe(self.name, time.perf_counter() - self._t0)
+        dt = time.perf_counter() - self._t0
+        self.metrics.observe(self.name, dt)
+        if self.collective_phase is not None:
+            self.metrics.observe_collective(self.collective_phase, dt)
         return False

@@ -36,7 +36,13 @@ Invariants (tested by ``tests/test_serving_engine.py``):
 * batch composition changes NEVER change tensor shapes the compiler sees —
   the engine pads each batch to a size bucket (``bucket_size``), so the
   jitted decode step compiles once per bucket (MPK's fixed-shape
-  mega-program argument, PAPERS.md).
+  mega-program argument, PAPERS.md);
+* the scheduler is **mesh-oblivious** (ISSUE 5): under tensor-parallel
+  serving the KV pools shard over the ``mp`` axis but the block pool
+  bookkeeping this scheduler plans against is host-side and replicated —
+  one plan drives every shard, admission math is unchanged (the pool is
+  logically ONE pool; only the per-shard byte footprint divides by mp),
+  and the bucket sets (hence the jit trace bound) are mp-invariant.
 """
 
 from __future__ import annotations
@@ -61,6 +67,10 @@ def bucket_size(n: int, cap: Optional[int] = None) -> int:
 
 @dataclass
 class SchedulerConfig:
+    """Per-step planning knobs.  Rides ``EngineConfig.scheduler`` in the
+    one-object engine construction form, or the legacy
+    ``EngineCore(scheduler_config=...)`` keyword."""
+
     max_num_seqs: int = 8            # running-set cap (decode batch ≤ this)
     max_prefills_per_step: int = 1   # admission throttle: prefill is the
                                      # expensive fixed-shape program; decode

@@ -410,8 +410,12 @@ class CompletionServer:
                                     keep_alive=keep_alive)
             elif path == "/readyz":
                 status = 200 if self.ready else 503
-                msg = b"ok\n" if status == 200 else (
-                    b"draining\n" if self._draining else b"not ready\n")
+                # the mesh shape rides the probe body (ISSUE 5): a
+                # deployment that came up single-chip when the operator
+                # expected mp=N is visible from the readiness check alone
+                mp = getattr(self.engine, "mp", 1)
+                msg = (f"ok mp={mp}\n".encode() if status == 200 else (
+                    b"draining\n" if self._draining else b"not ready\n"))
                 await self._respond(writer, status, msg, "text/plain",
                                     keep_alive=keep_alive)
             elif path == "/metrics":
@@ -608,12 +612,17 @@ def _http(port: int, method: str, path: str, body: Optional[dict] = None):
 
 async def _selftest_async() -> int:
     loop = asyncio.get_running_loop()
-    server = CompletionServer(_toy_engine(), ServerConfig(port=0))
+    engine = _toy_engine()
+    server = CompletionServer(engine, ServerConfig(port=0))
     await server.start()
     try:
         status, data = await loop.run_in_executor(
             None, _http, server.port, "GET", "/readyz", None)
         assert status == 200, f"/readyz {status}"
+        # readiness must report the mesh shape (ISSUE 5): mp=1 single-chip,
+        # mp=N when a tensor-parallel mesh is live
+        assert f"mp={engine.mp}".encode() in data, \
+            f"/readyz body missing mesh shape: {data!r}"
         status, data = await loop.run_in_executor(
             None, _http, server.port, "POST", "/v1/completions",
             {"prompt": [5, 9, 23, 7], "max_tokens": 4})
@@ -626,7 +635,9 @@ async def _selftest_async() -> int:
             None, _http, server.port, "GET", "/metrics", None)
         assert status == 200 and b"serving_time_to_first_token" in data, \
             "metrics page missing serving histograms"
-        print(f"selftest: OK (port {server.port}, "
+        assert b"serving_mp_shards" in data, \
+            "metrics page missing the mp-shards gauge"
+        print(f"selftest: OK (port {server.port}, mp={engine.mp}, "
               f"tokens {choice['token_ids']})")
         return 0
     finally:
@@ -648,7 +659,7 @@ async def _serve_cli(args) -> int:
             loop.add_signal_handler(sig, server.request_shutdown)
     except (NotImplementedError, RuntimeError):
         pass
-    print(f"serving on http://{server.cfg.host}:{server.port} "
+    print(f"serving on http://{server.cfg.host}:{server.port} mp={engine.mp} "
           "(POST /v1/completions; GET /healthz /readyz /metrics)")
     await server.serve_forever()
     return 0
@@ -675,10 +686,23 @@ def main(argv=None) -> int:
     p.add_argument("--max-queue", type=int, default=64)
     p.add_argument("--timeout", type=float, default=None,
                    help="default per-request deadline (seconds)")
+    p.add_argument("--mp", type=int, default=1,
+                   help="tensor-parallel degree: init a mesh with this "
+                        "mp axis before building the engine (needs that "
+                        "many devices; on CPU set XLA_FLAGS="
+                        "--xla_force_host_platform_device_count=N)")
     p.add_argument("--selftest", action="store_true",
                    help="boot on an ephemeral port, serve one completion "
                         "against the toy model, exit 0 on success")
     args = p.parse_args(argv)
+    if args.mp > 1:
+        # tensor-parallel serving (ISSUE 5): build the mesh BEFORE any
+        # engine (selftest included — the probe must exercise the real
+        # degree) so parameters and KV pools land sharded.  On CPU this
+        # needs XLA_FLAGS=--xla_force_host_platform_device_count=N.
+        from ..distributed import topology
+
+        topology.init_mesh(mp=args.mp)
     if args.selftest:
         return asyncio.run(_selftest_async())
     return asyncio.run(_serve_cli(args))

@@ -46,8 +46,15 @@ SCAN_DIRS = (
     os.path.join(_REPO, "paddle_tpu", "serving"),
 )
 # single modules outside the telemetry dirs that host long-lived caches
+# or sit on the serving hot path (ISSUE 5 widened the net to the
+# tensor-parallel plumbing the multi-chip engine runs through)
 SCAN_FILES = (
     os.path.join(_REPO, "paddle_tpu", "ops", "paged_attention.py"),
+    os.path.join(_REPO, "paddle_tpu", "ops", "pallas_paged.py"),
+    os.path.join(_REPO, "paddle_tpu", "parallel", "mp_layers.py"),
+    os.path.join(_REPO, "paddle_tpu", "parallel", "utils.py"),
+    os.path.join(_REPO, "paddle_tpu", "parallel", "_compat.py"),
+    os.path.join(_REPO, "paddle_tpu", "distributed", "topology.py"),
 )
 WAIVER = "unbounded-ok:"
 

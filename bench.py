@@ -624,9 +624,162 @@ def serving_mp_bench() -> dict:
     return result
 
 
+def serving_fleet_bench() -> dict:
+    """Data-parallel fleet phase (ISSUE 6): two shared-prefix request
+    families through the prefix-affinity router at dp=1 vs dp=2 —
+    preemption pressure on, chunked prefill on — recording tokens/s,
+    per-replica cached-token ratios, routing counters, and jit trace
+    counts per replica.
+
+    The comparison splits a FIXED total capacity: dp=1 serves the whole
+    stream on one engine with the combined pool (29 blocks, 8 seqs);
+    dp=2 halves both per replica (15 blocks, 4 seqs each) — the honest
+    data-parallel framing, and preemption fires on every engine in both
+    runs.  The headline claim is the anti-dilution one: consistent-hash
+    prefix-affinity keeps each family on ONE replica, so every active
+    replica's cached-token ratio stays >= the dp=1 baseline (round-robin
+    would recompute every family's prefix on every replica it touched).
+    Greedy token identity dp=2 vs dp=1 and the per-replica bucket-bound
+    trace invariant are asserted alongside.  Wall times include each
+    replica's own jit compiles (trace counts ride the record).
+    """
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.serving import (
+        FleetRouter,
+        EngineCore,
+        SamplingParams,
+        SchedulerConfig,
+    )
+
+    from paddle_tpu.serving.fleet import affinity_replica_index
+
+    rng = np.random.default_rng(0)
+    fam_a = rng.integers(0, 256, 8).tolist()   # 2 full blocks shared
+    # pick the second family so its affinity target on the dp=2 ring is
+    # the OTHER replica (deterministic preview — no engines): the phase
+    # then exercises both concentration (within a family) and spread
+    # (across families), not just one busy replica
+    target_a = affinity_replica_index(fam_a, dp=2, block_size=4)
+    while True:
+        fam_b = rng.integers(0, 256, 8).tolist()
+        if affinity_replica_index(fam_b, dp=2, block_size=4) != target_a:
+            break
+    prompts = []
+    for _ in range(4):
+        prompts.append(fam_a + rng.integers(0, 256, 8).tolist())
+        prompts.append(fam_b + rng.integers(0, 256, 8).tolist())
+
+    def factory_for(dp: int):
+        # fixed total capacity across degrees: dp=1 gets the combined
+        # pool/concurrency, dp=2 splits it per replica.  Either way the
+        # pool cannot hold the concurrent 16+10-token sequences, so the
+        # stream preempts + recomputes (asserted below).
+        num_blocks = 29 if dp == 1 else 15
+        max_seqs = 8 if dp == 1 else 4
+
+        def make(i, registry):
+            paddle.seed(0)  # identical weights on every replica
+            model = LlamaForCausalLM(LlamaConfig.tiny(num_hidden_layers=2))
+            return EngineCore(
+                model, num_blocks=num_blocks, block_size=4,
+                scheduler_config=SchedulerConfig(
+                    max_num_seqs=max_seqs, max_prefill_tokens_per_step=8),
+                registry=registry, metrics_labels={"replica": str(i)})
+        return make
+
+    def run(dp: int) -> dict:
+        fleet = FleetRouter.build(factory_for(dp), dp=dp).start()
+        try:
+            t0 = time.perf_counter()
+            handles = [
+                fleet.submit_request(
+                    p, SamplingParams(max_new_tokens=10),
+                    request_id=f"r{i}")
+                for i, p in enumerate(prompts)]
+            fleet.wait(handles, timeout=600)
+            wall = time.perf_counter() - t0
+            gen = sum(len(h.output_tokens) for h in handles)
+            hit_total = comp_total = 0
+            per_replica = []
+            for r in fleet.replicas:
+                c = r.engine.metrics.counters
+                hit = c["prefix_cache_hit_tokens"]
+                comp = c["prefill_tokens_computed"]
+                hit_total += hit
+                comp_total += comp
+                per_replica.append({
+                    "replica": r.index,
+                    "requests_admitted": c["requests_admitted"],
+                    "prefix_cache_hit_tokens": hit,
+                    "prefill_tokens_computed": comp,
+                    "cached_token_ratio": round(hit / (hit + comp), 4)
+                    if hit + comp else None,
+                    "preemptions": c["preemptions"],
+                    "prefill_traces": r.engine.prefill_trace_count,
+                    "decode_traces": r.engine.decode_trace_count,
+                    "prefill_buckets": len(r.engine.prefill_buckets),
+                    "decode_buckets": len(r.engine.decode_buckets),
+                })
+            fleet.sample_gauges()
+            return {
+                "dp": dp, "wall_s": round(wall, 4),
+                "tokens_per_sec": round(gen / wall, 2),
+                "generated_tokens": gen,
+                "cached_token_ratio": round(
+                    hit_total / (hit_total + comp_total), 4)
+                if hit_total + comp_total else 0.0,
+                "affinity_hits": fleet.routing_counts["affinity_hit"],
+                "fallback_routed": fleet.routing_counts["fallback_routed"],
+                "replicas": per_replica,
+                "metrics": fleet.registry.snapshot(),
+                "outputs": {h.rid: h.output_tokens for h in handles},
+            }
+        finally:
+            fleet.shutdown(drain_timeout=2.0)
+
+    dp1, dp2 = run(1), run(2)
+    identical = dp1["outputs"] == dp2["outputs"]
+    bounded = all(
+        r["prefill_traces"] <= r["prefill_buckets"]
+        and r["decode_traces"] <= r["decode_buckets"]
+        for r in dp2["replicas"])
+    active_ratios = [r["cached_token_ratio"] for r in dp2["replicas"]
+                     if r["cached_token_ratio"] is not None]
+    ratio_kept = dp2["cached_token_ratio"] >= dp1["cached_token_ratio"]
+    result = {
+        "metric": "serving_fleet_dp2_tokens_per_sec",
+        "value": dp2["tokens_per_sec"], "unit": "tokens/s",
+        "phase": "serving_fleet",
+        "greedy_token_identical": identical,
+        "trace_count_bounded": bounded,
+        "affinity_keeps_cached_ratio": ratio_kept,
+        "dp2_active_replica_ratios": active_ratios,
+        "dp1": dp1, "dp2": dp2,
+    }
+    assert identical, "dp=2 fleet output diverged from dp=1 under greedy"
+    assert bounded, "a replica's jit trace count exceeded its bucket set"
+    assert ratio_kept, (
+        f"prefix-affinity diluted the cache: dp2 ratio "
+        f"{dp2['cached_token_ratio']} < dp1 {dp1['cached_token_ratio']}")
+    assert dp1["replicas"][0]["preemptions"] and all(
+        r["preemptions"] for r in dp2["replicas"]), \
+        "phase sized to exercise preemption-with-recompute, but none fired"
+    assert dp2["fallback_routed"] == 0, \
+        "an unsaturated fleet should route every keyed request by affinity"
+    assert len(active_ratios) == 2, \
+        "families were picked to spread over both replicas"
+    assert all(r >= dp1["cached_token_ratio"] for r in active_ratios), (
+        f"a replica's cached ratio fell below the dp=1 baseline: "
+        f"{active_ratios} < {dp1['cached_token_ratio']}")
+    return result
+
+
 def serving_main() -> dict:
-    """``--serving``: shared-prefix + tensor-parallel phases, combined
-    into one ``BENCH_SERVING.json`` record."""
+    """``--serving``: shared-prefix + tensor-parallel + fleet phases,
+    combined into one ``BENCH_SERVING.json`` record."""
     # must precede the FIRST jax import in this process: the mp phase
     # needs ≥2 host devices.  A pre-set count <2 (e.g. =1 exported for
     # single-device debugging) is raised, not trusted — otherwise
@@ -648,6 +801,10 @@ def serving_main() -> dict:
         # failure must not discard the completed shared-prefix numbers
         json.dump(result, f, indent=1)
     result["mp"] = serving_mp_bench()
+    with open(path, "w") as f:
+        # checkpoint again before the fleet phase for the same reason
+        json.dump(result, f, indent=1)
+    result["fleet"] = serving_fleet_bench()
     with open(path, "w") as f:
         json.dump(result, f, indent=1)
     return result

@@ -55,6 +55,26 @@ def _hash_block(parent: bytes, block_tokens) -> bytes:
     return m.digest()
 
 
+def prefix_chain_hashes(token_ids, block_size: int,
+                        max_blocks: Optional[int] = None) -> List[bytes]:
+    """Chain hashes of the leading FULL blocks of ``token_ids`` —
+    ``out[i]`` commits to every token in blocks ``0..i`` (the same
+    ``h_i = sha256(h_{i-1} || block_tokens_i)`` chain the prefix cache
+    registers).  This is the shareable form of the hash walk: a router
+    can compute it ONCE per request for prefix-affinity placement and
+    hand it to :meth:`BlockPool.match_prefix` via ``precomputed=`` so
+    admission does not re-hash the same leading blocks."""
+    n = len(token_ids) // block_size
+    if max_blocks is not None:
+        n = min(n, max_blocks)
+    out: List[bytes] = []
+    h = _HASH_ROOT
+    for i in range(n):
+        h = _hash_block(h, token_ids[i * block_size:(i + 1) * block_size])
+        out.append(h)
+    return out
+
+
 class BlockPool:
     """Refcounted block-pool bookkeeping (no device tensors) — the ONE
     implementation of the free-list / refcount / fork invariants, shared
@@ -190,20 +210,30 @@ class BlockPool:
         return returned
 
     # --- prefix cache -------------------------------------------------------
-    def match_prefix(self, token_ids) -> List[int]:
+    def match_prefix(self, token_ids,
+                     precomputed: Optional[List[bytes]] = None) -> List[int]:
         """Blocks holding the longest cached block-prefix of ``token_ids``,
         capped so at least ONE token is always left to compute (the
         prefill must still produce last-token logits).  The chain hash
         ``h_i`` commits to every token in blocks 0..i, so one dict lookup
         per block walks the prefix — hashing stops at the first miss (a
-        cold cache costs ONE block hash, not the whole prompt)."""
+        cold cache costs ONE block hash, not the whole prompt).
+
+        ``precomputed`` (optional) carries leading chain hashes already
+        computed elsewhere over the SAME leading tokens — e.g. the fleet
+        router's prefix-affinity key (:func:`prefix_chain_hashes`) — so
+        block ``i < len(precomputed)`` skips its hash; the walk resumes
+        the chain from the last precomputed digest."""
         if not self.prefix_cache_enabled or len(token_ids) < 2:
             return []
         limit = (len(token_ids) - 1) // self.block_size
         bs = self.block_size
         blocks, h = [], _HASH_ROOT
         for i in range(limit):
-            h = _hash_block(h, token_ids[i * bs:(i + 1) * bs])
+            if precomputed is not None and i < len(precomputed):
+                h = precomputed[i]
+            else:
+                h = _hash_block(h, token_ids[i * bs:(i + 1) * bs])
             b = self._hash_index.get(h)
             if b is None:
                 break

@@ -22,6 +22,12 @@ paged-attention ops and predictor API:
   HTTP/SSE frontend: OpenAI-style ``POST /v1/completions`` (SSE when
   ``stream=true``), ``/healthz`` / ``/readyz`` / ``/metrics``, admission
   control (429 + Retry-After), per-request deadlines, graceful drain.
+* :class:`FleetRouter` (``fleet.py``) — data-parallel serving fleet
+  (ISSUE 6): N engine replicas on their own engine threads behind
+  consistent-hash **prefix-affinity** routing (same chain hashes as the
+  prefix cache), least-loaded fallback, per-replica admission/health,
+  fleet-wide drain, and ``serving_fleet_*`` metrics.  The frontend wraps
+  any bare engine as a fleet of one, so dp=1 deployments are unchanged.
 
 Architecture sketch and scheduler invariants: see ``scheduler.py``'s
 module docstring and the README's serving sections.
@@ -29,6 +35,14 @@ module docstring and the README's serving sections.
 
 from .engine import EngineConfig, EngineCore  # noqa: F401
 from .entrypoints import LLM, CompletionOutput, stream_generate  # noqa: F401
+from .fleet import (  # noqa: F401
+    EngineReplica,
+    FleetConfig,
+    FleetDown,
+    FleetRouter,
+    FleetSaturated,
+    SubmitHandle,
+)
 from .kv_manager import KVCacheManager, PoolExhausted  # noqa: F401
 from .metrics import ServingMetrics  # noqa: F401
 from .protocol import (  # noqa: F401

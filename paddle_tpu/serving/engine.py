@@ -123,7 +123,8 @@ class EngineCore:
                  profile_ops: bool = False, registry=None,
                  prefix_cache: bool = True,
                  config: Optional[EngineConfig] = None,
-                 use_pallas_paged: Optional[bool] = None):
+                 use_pallas_paged: Optional[bool] = None,
+                 metrics_labels: Optional[Dict[str, str]] = None):
         if config is None:
             config = EngineConfig(
                 num_blocks=num_blocks, block_size=block_size, dtype=dtype,
@@ -142,8 +143,11 @@ class EngineCore:
             config.scheduler or SchedulerConfig(), self.kv)
         # registry=None keeps counts per-engine; pass
         # observability.get_registry() to publish serving series on the
-        # process-wide Prometheus page next to the jit compile counters
-        self.metrics = ServingMetrics(registry=registry)
+        # process-wide Prometheus page next to the jit compile counters.
+        # metrics_labels (e.g. {"replica": "0"}) lets N fleet replicas
+        # share ONE registry with per-replica-labeled serving series.
+        self.metrics = ServingMetrics(registry=registry,
+                                      labels=metrics_labels)
         self.tracer = self.metrics.tracer
         self.requests: Dict[object, Request] = {}
         self._pool_dtype = jnp.dtype(dtype)
@@ -342,17 +346,24 @@ class EngineCore:
     # --- request lifecycle --------------------------------------------------
     def add_request(self, prompt_ids, sampling: Optional[SamplingParams] = None,
                     request_id=None, priority: int = 0,
-                    trace_id: Optional[str] = None) -> Request:
+                    trace_id: Optional[str] = None,
+                    prefix_hashes: Optional[List[bytes]] = None) -> Request:
         """Enqueue a request (admission happens inside ``step``).
 
         ``trace_id`` (defaults to ``str(request_id)``) is attached to every
         span/instant the engine records for this request, so a frontend can
         reconstruct one request's prefill/preempt/decode lifecycle from the
-        exported chrome trace."""
+        exported chrome trace.
+
+        ``prefix_hashes`` (ISSUE 6) carries leading-block chain hashes a
+        router already computed for prefix-affinity placement
+        (``ops.paged_attention.prefix_chain_hashes`` over THIS prompt and
+        THIS engine's block size); the admission probe reuses them
+        instead of re-hashing the same blocks."""
         req = Request(prompt_ids=list(np.asarray(prompt_ids).reshape(-1)),
                       sampling=sampling or SamplingParams(),
                       request_id=request_id, priority=priority,
-                      trace_id=trace_id)
+                      trace_id=trace_id, prefix_hashes=prefix_hashes)
         if req.request_id in self.requests:
             raise ValueError(f"request id {req.request_id!r} already exists")
         req.arrival_time = time.perf_counter()

@@ -1,0 +1,747 @@
+"""``paddle_tpu.serving.fleet`` — data-parallel serving fleet (ISSUE 6).
+
+The HTTP frontend (PR 3) drives exactly ONE engine thread; the north
+star is heavy traffic, so this module adds the horizontal layer the
+ROADMAP names: a :class:`FleetRouter` that owns N :class:`EngineCore`
+replicas — each on its own engine thread with its own ``BlockPool`` /
+prefix cache and its own bounded submit/abort queues (the PR 3 bridge
+pattern, instantiated per replica) — behind one routing decision:
+
+**Prefix-affinity consistent-hash routing.**  The router chain-hashes
+the request's leading full prompt blocks (the SAME
+``h_i = sha256(h_{i-1} || block_tokens_i)`` chain the prefix cache of
+PR 4 registers — :func:`~paddle_tpu.ops.paged_attention.prefix_chain_hashes`)
+and maps the last digest onto a consistent-hash ring of replica vnodes.
+Identical prefixes therefore deterministically land on the SAME replica,
+whose prefix cache is warm — multiplying the PR 4 cached-token ratio
+instead of diluting it round-robin — while distinct prefixes spread
+uniformly.  The hashes are handed down with the request
+(``Request.prefix_hashes``) so the replica's admission probe does not
+re-hash the same blocks.  Consistent hashing (vnodes + clockwise walk)
+means a dead replica only remaps ITS keys; everyone else's affinity is
+untouched.
+
+**Least-loaded fallback + per-replica admission.**  When the affinity
+target is saturated (per-replica in-flight cap) or unhealthy (engine
+thread dead), the request falls back to the least-loaded eligible
+replica (``serving_fleet_fallback_routed_total`` vs
+``serving_fleet_affinity_hit_total``).  Admission is per replica: a
+request is rejected (:class:`FleetSaturated` → HTTP 429) only when
+EVERY eligible replica is at its cap, and refused
+(:class:`FleetDown` → HTTP 503) only when the whole fleet is down or
+draining.
+
+**Per-replica health + fleet drain.**  A replica whose engine thread
+died is excluded from routing (its in-flight handles are marked done and
+its engine requests aborted, so no handler hangs); the fleet keeps
+serving on the survivors.  ``shutdown()`` drains fleet-wide: stop
+admission instantly, let in-flight work finish to the deadline, abort
+stragglers through their OWNING replica, stop every engine thread —
+leaving zero pool occupancy on every replica (tested).
+
+**Observability.**  All replicas share ONE
+:class:`~paddle_tpu.observability.MetricsRegistry`: each engine's
+``serving_*`` series carries a ``replica="i"`` label
+(``EngineCore(metrics_labels=...)``), and the router adds the
+``serving_fleet_*`` family — replica occupancy / queue / in-flight
+gauges, alive gauges, and the affinity-hit vs fallback-routed counters.
+
+Threading model (N engine threads, lock-free bridges)::
+
+    handler / caller threads          engine thread i (owns replica i)
+    ────────────────────────          ───────────────────────────────
+    router.submit(handle) ──ring──▶   replica.submit_q (bounded)
+      · owner[rid] = replica i          drain → EngineCore.add_request
+    router.abort(rid) ──owner map─▶   replica.abort_q (bounded)
+    read handle.req.output_tokens     step(); evict finished handles
+                                      (owner map entry evicted too)
+
+The request→replica **owner map** is how an abort/timeout/disconnect
+reaches the replica that actually holds the request's blocks; entries
+are evicted when the request finishes, so the map is bounded by the sum
+of per-replica admission caps.
+
+Everything is CPU-provable with host threads: dp=2 greedy output is
+token-identical to dp=1 (each replica keeps the established
+batch-composition-independence contract), per-replica jit trace counts
+stay within the single-engine bucket bound, and a full-fleet drain
+leaves every pool empty — ``tests/test_serving_fleet.py``.
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+import itertools
+import queue
+import threading
+import time
+import traceback
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Sequence
+
+from ..observability.metrics import MetricsRegistry
+from ..ops.paged_attention import prefix_chain_hashes
+from .engine import EngineCore
+from .request import FinishReason, SamplingParams
+
+
+class FleetSaturated(RuntimeError):
+    """Every eligible replica rejected the request (per-replica
+    admission caps all hit) — the HTTP frontend answers 429."""
+
+
+class FleetDown(RuntimeError):
+    """No live replica to route to (all engine threads dead, or the
+    fleet is draining) — the HTTP frontend answers 503."""
+
+
+@dataclass
+class FleetConfig:
+    """Router-level knobs (per-replica engine knobs ride
+    :class:`~paddle_tpu.serving.EngineConfig` in the factory)."""
+
+    max_queue: int = 64       # per-replica in-flight admission cap
+    affinity_blocks: int = 2  # leading FULL prompt blocks hashed into the
+                              # affinity key: requests sharing at least
+                              # this much prefix co-locate.  Shorter
+                              # prompts hash the full blocks they have;
+                              # prompts under one block have no key and
+                              # route least-loaded.
+    vnodes: int = 16          # ring points per replica (smoother spread
+                              # + smaller remap slice on replica death)
+    drain_timeout_s: float = 5.0  # shutdown(): grace for in-flight work
+
+
+def _build_ring(dp: int, vnodes: int) -> List:
+    """Consistent-hash ring: ``vnodes`` points per replica, sorted by
+    the 64-bit prefix of each vnode's SHA-256."""
+    return sorted(
+        (int.from_bytes(hashlib.sha256(
+            f"paddle_tpu.fleet.replica.{i}.{j}".encode()).digest()[:8],
+            "big"), i)
+        for i in range(dp)
+        for j in range(max(1, vnodes)))
+
+
+def _key_int(hashes: List[bytes]) -> int:
+    """Ring position of an affinity key: the 64-bit prefix of the
+    deepest leading-block chain hash."""
+    return int.from_bytes(hashes[-1][:8], "big")
+
+
+def _ring_walk(ring: List, ring_keys: List[int], key_int: int,
+               eligible: set) -> Optional[int]:
+    """First ring point clockwise of ``key_int`` owned by an eligible
+    replica index.  Skipping ineligible vnodes (instead of rebuilding
+    the ring) is what makes the hash consistent: a dead replica only
+    remaps ITS keys."""
+    n = len(ring)
+    start = bisect.bisect_left(ring_keys, key_int)
+    for step in range(n):
+        _, idx = ring[(start + step) % n]
+        if idx in eligible:
+            return idx
+    return None
+
+
+def affinity_replica_index(prompt_ids, dp: int, block_size: int,
+                           affinity_blocks: Optional[int] = None,
+                           vnodes: Optional[int] = None) -> Optional[int]:
+    """Pure routing preview (no engines): the replica index a prompt's
+    affinity key maps to on a healthy dp-replica ring, or ``None`` when
+    the prompt has no full block (those route least-loaded).  Benchmarks
+    and capacity planning use this to predict placement; it shares the
+    chain hash, ring construction, and walk with
+    :meth:`FleetRouter.submit`.  The defaults mirror ``FleetConfig()`` —
+    for a fleet built with non-default knobs pass them explicitly, or
+    use :meth:`FleetRouter.predict_replica`, which reads the live
+    config."""
+    cfg = FleetConfig()
+    if affinity_blocks is None:
+        affinity_blocks = cfg.affinity_blocks
+    if vnodes is None:
+        vnodes = cfg.vnodes
+    hashes = prefix_chain_hashes(prompt_ids, block_size,
+                                 max_blocks=affinity_blocks)
+    if not hashes:
+        return None
+    ring = _build_ring(dp, vnodes)
+    return _ring_walk(ring, [k for k, _ in ring], _key_int(hashes),
+                      set(range(dp)))
+
+
+class SubmitHandle:
+    """One in-flight request as the router, the owning replica's engine
+    thread, and the caller all see it.  ``req`` is the engine-side
+    :class:`~paddle_tpu.serving.Request` once the replica admits it;
+    ``done`` covers the admission-less terminal paths (cancelled before
+    admission, or the owning engine thread died).  ``event`` is an
+    optional waker the HTTP frontend attaches (an ``asyncio.Event`` set
+    via ``call_soon_threadsafe``); direct callers poll instead."""
+
+    __slots__ = ("rid", "prompt_ids", "sampling", "priority",
+                 "prefix_hashes", "req", "done", "cancel_reason", "event",
+                 "replica")
+
+    def __init__(self, rid, prompt_ids: List[int],
+                 sampling: Optional[SamplingParams] = None,
+                 priority: int = 0, event=None):
+        self.rid = rid
+        self.prompt_ids = [int(t) for t in prompt_ids]
+        self.sampling = sampling or SamplingParams()
+        self.priority = priority
+        self.prefix_hashes: Optional[List[bytes]] = None  # router-stamped
+        self.req = None                  # engine Request, set by engine thread
+        self.done = False                # terminal without admission
+        self.cancel_reason: Optional[FinishReason] = None
+        self.event = event
+        self.replica: Optional["EngineReplica"] = None
+
+    @property
+    def finished(self) -> bool:
+        return self.done or (self.req is not None and self.req.finished)
+
+    @property
+    def output_tokens(self) -> List[int]:
+        return list(self.req.output_tokens) if self.req is not None else []
+
+    @property
+    def finish_reason(self) -> Optional[str]:
+        if self.req is not None and self.req.finish_reason is not None:
+            return self.req.finish_reason.value
+        if self.done:
+            return (self.cancel_reason.value if self.cancel_reason
+                    else FinishReason.ABORT.value)
+        return None
+
+
+class EngineReplica:
+    """One :class:`EngineCore` + its engine thread + the PR 3
+    bounded-queue bridge, instantiated per fleet replica.
+
+    The engine is NOT thread-safe and its jitted steps block, so each
+    replica runs its own background thread; callers talk to it only
+    through the bounded ``submit_q`` / ``abort_q`` and the append-only
+    per-request state (safe under the GIL).  The replica's ``handles``
+    dict (rid → handle) is its in-flight set: admission counts it,
+    engine death marks every entry done, and the engine thread evicts
+    entries as their requests finish (also evicting the router's
+    owner-map entry — bounded maps, no long-server leak)."""
+
+    def __init__(self, index: int, engine: EngineCore, max_queue: int,
+                 notify: Callable[["EngineReplica"], None],
+                 on_finish: Callable[[object, "EngineReplica"], None]):
+        self.index = index
+        self.engine = engine
+        self.max_queue = max(1, max_queue)
+        self.submit_q: "queue.Queue" = queue.Queue(maxsize=self.max_queue)
+        # aborts are bounded by in-flight requests; 2x leaves room for
+        # drain-time aborts racing handler-deadline aborts
+        self.abort_q: "queue.Queue" = queue.Queue(
+            maxsize=2 * self.max_queue + 8)
+        self.wake = threading.Event()
+        self.handles: Dict[object, SubmitHandle] = {}  # rid -> handle;
+        # bounded by max_queue (try_submit refuses past the cap) and
+        # evicted on finish by the engine thread
+        self.thread: Optional[threading.Thread] = None
+        self.error: Optional[str] = None
+        self._stop = False
+        # notify/on_finish are scoped to THIS replica: the frontend
+        # wakes only the handlers whose requests this replica owns (so
+        # wakeup work per step stays per-replica instead of dp x
+        # fleet-wide), and an owner-map eviction names its replica so a
+        # stale eviction can never drop another replica's entry
+        self._notify = lambda: notify(self)
+        self._on_finish = lambda rid: on_finish(rid, self)
+
+    # --- caller-side surface ------------------------------------------------
+    @property
+    def alive(self) -> bool:
+        return (self.thread is not None and self.thread.is_alive()
+                and self.error is None)
+
+    @property
+    def in_flight(self) -> int:
+        return len(self.handles)
+
+    def start(self) -> None:
+        self.thread = threading.Thread(
+            target=self._loop, name=f"serving-engine-{self.index}",
+            daemon=True)
+        self.thread.start()
+
+    def try_submit(self, handle: SubmitHandle) -> bool:
+        """Admit ``handle`` onto this replica, or refuse (cap hit /
+        dead).  The handle enters ``handles`` BEFORE the queue so the
+        in-flight count can never undercount a queued request."""
+        if not self.alive or self._stop or self.in_flight >= self.max_queue:
+            return False
+        self.handles[handle.rid] = handle
+        try:
+            self.submit_q.put_nowait(handle)
+        except queue.Full:
+            if self.handles.pop(handle.rid, None) is None:
+                # a death sweep claimed the handle while it was briefly
+                # visible: it is being terminated, not reroutable
+                return True
+            return False
+        self.wake.set()
+        if not self.alive:
+            # the engine thread died between the liveness check and the
+            # hand-off.  Ownership rule: whoever POPS the handle from
+            # ``handles`` owns its fate (dict.pop is the atomic claim).
+            # If WE win the pop, the terminal sweep can never touch this
+            # handle again, so reclaiming + refusing is safe and the
+            # router retries elsewhere.  If the sweep won, it marks the
+            # handle done (terminal, like death right after admission) —
+            # report it submitted.
+            if self.handles.pop(handle.rid, None) is not None:
+                return False
+        return True
+
+    def request_abort(self, rid, reason: FinishReason) -> None:
+        h = self.handles.get(rid)
+        if h is not None and h.cancel_reason is None:
+            h.cancel_reason = reason
+        try:
+            self.abort_q.put_nowait((rid, reason))
+        except queue.Full:
+            pass  # sized to the in-flight bound; a drop only delays cleanup
+        self.wake.set()
+
+    def request_stop(self) -> None:
+        self._stop = True
+        self.wake.set()
+
+    def join(self, timeout: float = 10.0) -> None:
+        if self.thread is not None:
+            self.thread.join(timeout)
+
+    # --- engine thread ------------------------------------------------------
+    def _loop(self) -> None:
+        eng = self.engine
+        try:
+            while True:
+                self._drain_submissions()
+                self._drain_aborts()
+                self._evict_finished()
+                if self._stop and not eng.scheduler.has_work():
+                    break
+                if eng.scheduler.has_work():
+                    eng.step()
+                    self._notify()
+                else:
+                    self.wake.wait(timeout=0.02)
+                    self.wake.clear()
+        except Exception:
+            # fail loudly but leave no handler hanging and no block held
+            self.error = traceback.format_exc()
+            for req in list(eng.requests.values()):
+                eng.abort_request(req.request_id)
+        finally:
+            for rid, h in list(self.handles.items()):
+                if self.handles.pop(rid, None) is None:
+                    # a racing try_submit reclaimed it (atomic pop wins
+                    # ownership): it is being re-routed — not ours to end
+                    continue
+                h.done = True
+                self._on_finish(rid)
+            self._notify()
+
+    def _drain_submissions(self) -> None:
+        while True:
+            try:
+                h = self.submit_q.get_nowait()
+            except queue.Empty:
+                return
+            if h.cancel_reason is not None or self._stop:
+                # deadline fired (or drain began) before admission: the
+                # request never enters the scheduler
+                h.done = True
+                self._notify()
+                continue
+            h.req = self.engine.add_request(
+                h.prompt_ids, sampling=h.sampling, request_id=h.rid,
+                priority=h.priority, trace_id=str(h.rid),
+                prefix_hashes=h.prefix_hashes)
+
+    def _drain_aborts(self) -> None:
+        did = False
+        while True:
+            try:
+                rid, reason = self.abort_q.get_nowait()
+            except queue.Empty:
+                break
+            if self.engine.abort_request(rid, reason):
+                did = True
+            else:
+                h = self.handles.get(rid)
+                if h is not None and h.req is None:
+                    h.done = True
+                    did = True
+        if did:
+            self._notify()
+
+    def _evict_finished(self) -> None:
+        """Drop finished requests from the in-flight set (and the
+        router's owner map) — this is what keeps both maps bounded and
+        what the satellite bugfix relies on: an abort can only be routed
+        while the request is actually live on this replica."""
+        for rid, h in list(self.handles.items()):
+            if h.done or (h.req is not None and h.req.finished):
+                self.handles.pop(rid, None)
+                self._on_finish(rid)
+
+
+class FleetRouter:
+    """N engine replicas behind one prefix-affinity routing decision.
+
+    Construction: pass pre-built engines (``FleetRouter(engines)``) or
+    use :meth:`build` with an ``engine_factory(i, registry)`` that
+    constructs replica ``i``'s :class:`EngineCore` on the shared
+    registry (conventionally with ``metrics_labels={"replica": str(i)}``
+    so /metrics separates the replicas).  Each replica needs its OWN
+    model instance: the engine swaps parameter values during its traced
+    step, so two engine threads must never share module objects.
+
+    ``start()`` spawns the engine threads; ``submit()`` routes;
+    ``shutdown()`` drains the whole fleet.  :meth:`from_engine` wraps a
+    single engine as a fleet of one — the dp=1 compatibility path the
+    HTTP frontend uses when handed a bare ``EngineCore``."""
+
+    def __init__(self, engines: Sequence[EngineCore],
+                 config: Optional[FleetConfig] = None,
+                 registry: Optional[MetricsRegistry] = None):
+        if not engines:
+            raise ValueError("a fleet needs at least one engine replica")
+        self.cfg = config or FleetConfig()
+        self.engines: List[EngineCore] = list(engines)
+        bs = {e.block_size for e in self.engines}
+        if len(bs) != 1:
+            raise ValueError(
+                f"all replicas must share one block_size (affinity hashes "
+                f"are computed once, fleet-wide); got {sorted(bs)}")
+        self.block_size = self.engines[0].block_size
+        mps = {e.mp for e in self.engines}
+        if len(mps) != 1:
+            raise ValueError(f"replicas disagree on mp degree: {sorted(mps)}")
+        self.mp = self.engines[0].mp
+        self._notify_cb: Callable[[Optional[EngineReplica]], None] = \
+            lambda replica=None: None
+        if len(self.engines) > 1:
+            # replicas sharing one registry MUST carry distinct metric
+            # labels — identical (name, labels) keys get-or-create the
+            # SAME series, so every "per-replica" counter would silently
+            # double-count fleet totals
+            seen: Dict[int, set] = {}
+            for e in self.engines:
+                lbls = tuple(sorted(e.metrics.labels.items()))
+                reg_seen = seen.setdefault(id(e.metrics.registry), set())
+                if lbls in reg_seen:
+                    raise ValueError(
+                        "replicas sharing a metrics registry need "
+                        "distinct metrics_labels (e.g. EngineCore("
+                        "metrics_labels={'replica': str(i)})); duplicate "
+                        f"label set {dict(lbls)}")
+                reg_seen.add(lbls)
+        self.registry = (registry if registry is not None
+                         else self.engines[0].metrics.registry)
+        self.replicas: List[EngineReplica] = [
+            EngineReplica(i, eng, self.cfg.max_queue,
+                          notify=self._notify, on_finish=self._release)
+            for i, eng in enumerate(self.engines)
+        ]
+        self._owner: Dict[object, EngineReplica] = {}  # rid -> replica;
+        # bounded by dp * max_queue (entries exist only while the request
+        # is in flight on its replica) — evicted on finish/death
+        self._submit_lock = threading.Lock()  # serializes submitters:
+        # the duplicate-rid check and the owner-map write must be one
+        # atomic step when several caller threads submit concurrently
+        self._ids = itertools.count(1)
+        self._draining = False
+        # consistent-hash ring: vnodes per replica, clockwise walk skips
+        # dead replicas so only the dead replica's keys remap
+        self._ring: List = _build_ring(len(self.replicas), self.cfg.vnodes)
+        self._ring_keys = [k for k, _ in self._ring]
+        # --- serving_fleet_* observability ---------------------------------
+        g, c = self.registry.gauge, self.registry.counter
+        self._g_replicas = g("serving_fleet_replicas",
+                             "configured data-parallel replica count")
+        self._g_alive = g("serving_fleet_replicas_alive",
+                          "replicas with a live engine thread")
+        self._g_in_flight = g("serving_fleet_in_flight",
+                              "in-flight requests fleet-wide")
+        self._affinity_hit = c(
+            "serving_fleet_affinity_hit_total",
+            "requests routed to their prefix-affinity replica")
+        self._fallback = c(
+            "serving_fleet_fallback_routed_total",
+            "requests routed least-loaded (no key, or affinity target "
+            "saturated/unhealthy)")
+        self._g_replica_alive = {
+            r.index: g("serving_fleet_replica_alive",
+                       "1 while the replica's engine thread is live",
+                       replica=str(r.index))
+            for r in self.replicas}
+        self._g_replica_in_flight = {
+            r.index: g("serving_fleet_replica_in_flight",
+                       "in-flight requests on the replica",
+                       replica=str(r.index))
+            for r in self.replicas}
+        self._g_replica_occupancy = {
+            r.index: g("serving_fleet_replica_occupancy",
+                       "replica KV-pool occupancy fraction",
+                       replica=str(r.index))
+            for r in self.replicas}
+        self._g_replica_queue = {
+            r.index: g("serving_fleet_replica_queue_depth",
+                       "replica scheduler waiting-queue depth",
+                       replica=str(r.index))
+            for r in self.replicas}
+        self._g_replicas.set(len(self.replicas))
+        self.sample_gauges()
+
+    # --- constructors -------------------------------------------------------
+    @classmethod
+    def build(cls, engine_factory: Callable[[int, MetricsRegistry],
+                                            EngineCore],
+              dp: int, config: Optional[FleetConfig] = None,
+              registry: Optional[MetricsRegistry] = None) -> "FleetRouter":
+        """Build a dp-replica fleet on one shared registry.  The factory
+        gets ``(replica_index, registry)`` and should construct the
+        engine with ``registry=registry,
+        metrics_labels={"replica": str(index)}``."""
+        if dp < 1:
+            raise ValueError(f"dp must be >= 1, got {dp}")
+        registry = (registry if registry is not None
+                    else MetricsRegistry(max_series=4096))
+        engines = [engine_factory(i, registry) for i in range(dp)]
+        return cls(engines, config=config, registry=registry)
+
+    @classmethod
+    def from_engine(cls, engine: EngineCore,
+                    max_queue: int = 64) -> "FleetRouter":
+        """Wrap ONE pre-built engine as a fleet of one (the dp=1 compat
+        path): the engine keeps its own registry and its ``serving_*``
+        series stay unlabeled, exactly as before.  The ``serving_fleet_*``
+        family IS added to that registry (dp=1 reports itself as a
+        one-replica fleet — the selftest asserts it), so budget ~12
+        extra series."""
+        return cls([engine], config=FleetConfig(max_queue=max_queue))
+
+    # --- lifecycle ----------------------------------------------------------
+    @property
+    def dp(self) -> int:
+        return len(self.replicas)
+
+    @property
+    def alive(self) -> bool:
+        return any(r.alive for r in self.replicas)
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    @property
+    def in_flight(self) -> int:
+        return len(self._owner)
+
+    def start(self,
+              notify: Optional[Callable[[Optional[EngineReplica]], None]]
+              = None) -> "FleetRouter":
+        """Spawn every replica's engine thread.  ``notify(replica)`` is
+        invoked (from engine threads) after any step/terminal transition
+        of that replica — the HTTP frontend wakes the handlers whose
+        requests it owns; direct callers poll."""
+        if notify is not None:
+            self._notify_cb = notify
+        for r in self.replicas:
+            if r.thread is None:
+                r.start()
+        self.sample_gauges()
+        return self
+
+    def begin_drain(self) -> None:
+        """Stop admitting instantly (submit() raises FleetDown); running
+        work keeps stepping until :meth:`stop`."""
+        self._draining = True
+
+    def stop(self, join_timeout: float = 10.0) -> None:
+        """Stop + join every engine thread (each exits once its
+        scheduler runs dry — callers abort stragglers first)."""
+        for r in self.replicas:
+            r.request_stop()
+        for r in self.replicas:
+            r.join(join_timeout)
+        self.sample_gauges()
+
+    def shutdown(self, drain_timeout: Optional[float] = None) -> None:
+        """Synchronous fleet-wide graceful drain (direct/non-HTTP use;
+        the HTTP frontend orchestrates the same phases on its own loop):
+        stop admission now, wait for in-flight work up to the deadline,
+        abort stragglers through their owning replica, stop every engine
+        thread.  Leaves zero pool occupancy on every replica."""
+        self.begin_drain()
+        deadline = time.monotonic() + (
+            drain_timeout if drain_timeout is not None
+            else self.cfg.drain_timeout_s)
+        while self._owner and time.monotonic() < deadline:
+            time.sleep(0.005)
+        for rid in list(self._owner):
+            self.abort(rid, FinishReason.TIMEOUT)
+        self.stop()
+
+    # --- routing ------------------------------------------------------------
+    def _notify(self, replica: Optional[EngineReplica] = None) -> None:
+        self._notify_cb(replica)
+
+    def _release(self, rid, replica: Optional[EngineReplica] = None) -> None:
+        """Evict an owner-map entry.  A replica-side eviction names its
+        replica and only drops the entry while it still points there —
+        a stale eviction racing a re-route must not orphan the entry the
+        router just wrote for another replica."""
+        if replica is None or self._owner.get(rid) is replica:
+            self._owner.pop(rid, None)
+
+    def _ring_target(self, key_int: int,
+                     eligible: List[EngineReplica]
+                     ) -> Optional[EngineReplica]:
+        """Consistent-hash affinity target among ``eligible`` replicas
+        (shared :func:`_ring_walk`)."""
+        idx = _ring_walk(self._ring, self._ring_keys, key_int,
+                         {r.index for r in eligible})
+        return None if idx is None else self.replicas[idx]
+
+    def affinity_key(self, prompt_ids) -> Optional[List[bytes]]:
+        """Leading-block chain hashes of the prompt (≤ affinity_blocks
+        full blocks); ``None`` when the prompt has no full block."""
+        hashes = prefix_chain_hashes(prompt_ids, self.block_size,
+                                     max_blocks=self.cfg.affinity_blocks)
+        return hashes or None
+
+    def predict_replica(self, prompt_ids) -> Optional[int]:
+        """Routing preview against THIS fleet's live config and ring
+        (all replicas eligible): the replica index an unloaded, healthy
+        fleet would pick, or ``None`` for a keyless (short) prompt."""
+        hashes = self.affinity_key(prompt_ids)
+        if hashes is None:
+            return None
+        return _ring_walk(self._ring, self._ring_keys, _key_int(hashes),
+                          set(range(len(self.replicas))))
+
+    @property
+    def routing_counts(self) -> Dict[str, int]:
+        """Public snapshot of the routing counters:
+        ``{"affinity_hit": n, "fallback_routed": m}``."""
+        return {"affinity_hit": int(self._affinity_hit.value),
+                "fallback_routed": int(self._fallback.value)}
+
+    def submit(self, handle: SubmitHandle) -> EngineReplica:
+        """Route ``handle``: affinity target first, least-loaded eligible
+        fallback.  Raises :class:`FleetDown` when no replica is live (or
+        the fleet drains) and :class:`FleetSaturated` when every eligible
+        replica is at its admission cap (per-replica 429 semantics: the
+        fleet rejects only when ALL of them reject).  Thread-safe: a
+        lock serializes submitters, so the duplicate-rid check, the
+        owner-map write, and the replica hand-off are one atomic step
+        (replica threads never take this lock — they only pop)."""
+        if self._draining:
+            raise FleetDown("fleet is draining")
+        with self._submit_lock:
+            if handle.rid in self._owner:
+                # reject duplicates HERE, synchronously — letting the id
+                # through would either silently orphan the first
+                # request's owner-map entry (different replicas) or
+                # raise inside the owning engine thread and kill the
+                # whole replica (same replica).  Mirrors
+                # EngineCore.add_request's own check.
+                raise ValueError(
+                    f"request id {handle.rid!r} is already in flight")
+            eligible = [r for r in self.replicas if r.alive]
+            if not eligible:
+                raise FleetDown("no live engine replica")
+            hashes = self.affinity_key(handle.prompt_ids)
+            handle.prefix_hashes = hashes
+            target = None
+            if hashes is not None:
+                target = self._ring_target(_key_int(hashes), eligible)
+            order: List[EngineReplica] = \
+                [target] if target is not None else []
+            order += [r for r in sorted(eligible,
+                                        key=lambda r: r.in_flight)
+                      if r is not target]
+            for r in order:
+                # the owner-map entry is written BEFORE the queue
+                # hand-off: once the replica can see the handle, its
+                # finish/death eviction path must be able to find (and
+                # pop) the entry — writing it after try_submit would let
+                # that eviction race ahead and leave a permanently
+                # leaked entry
+                handle.replica = r
+                self._owner[handle.rid] = r
+                if r.try_submit(handle):
+                    if target is not None and r is target:
+                        self._affinity_hit.inc()
+                    else:
+                        self._fallback.inc()
+                    self._g_in_flight.set(len(self._owner))
+                    return r
+                self._owner.pop(handle.rid, None)
+                handle.replica = None
+        if not any(r.alive for r in self.replicas):
+            # every refusal was a death race, not a cap: report the
+            # fleet as down (HTTP 503), not saturated (429)
+            raise FleetDown("no live engine replica")
+        raise FleetSaturated(
+            f"all {len(eligible)} eligible replica(s) at their "
+            f"{self.cfg.max_queue}-request admission cap")
+
+    def submit_request(self, prompt_ids,
+                       sampling: Optional[SamplingParams] = None,
+                       request_id=None, priority: int = 0) -> SubmitHandle:
+        """Convenience for direct (non-HTTP) callers: build a handle,
+        route it, return it.  Poll ``handle.finished`` /
+        ``handle.output_tokens`` (or use :meth:`wait`)."""
+        rid = request_id if request_id is not None else \
+            f"fleet-{next(self._ids)}"
+        handle = SubmitHandle(rid, list(prompt_ids), sampling=sampling,
+                              priority=priority)
+        self.submit(handle)
+        return handle
+
+    def abort(self, rid, reason: FinishReason = FinishReason.ABORT) -> bool:
+        """Route an abort to the replica that OWNS ``rid`` (the
+        request→replica map; evicted on finish).  True if the request was
+        still owned — an already-finished rid is a no-op."""
+        owner = self._owner.get(rid)
+        if owner is None:
+            return False
+        owner.request_abort(rid, reason)
+        return True
+
+    def wait(self, handles: Sequence[SubmitHandle],
+             timeout: float = 120.0) -> None:
+        """Block until every handle reaches a terminal state."""
+        deadline = time.monotonic() + timeout
+        for h in handles:
+            while not h.finished:
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"request {h.rid!r} not finished in {timeout}s")
+                time.sleep(0.002)
+
+    # --- observability ------------------------------------------------------
+    def sample_gauges(self) -> None:
+        """Refresh the serving_fleet_* gauges from replica state (the
+        HTTP frontend calls this on every /metrics scrape; direct
+        callers, whenever they snapshot)."""
+        self._g_alive.set(sum(1 for r in self.replicas if r.alive))
+        self._g_in_flight.set(len(self._owner))
+        for r in self.replicas:
+            self._g_replica_alive[r.index].set(1 if r.alive else 0)
+            self._g_replica_in_flight[r.index].set(r.in_flight)
+            self._g_replica_occupancy[r.index].set(
+                r.engine.kv.occupancy())
+            self._g_replica_queue[r.index].set(
+                r.engine.scheduler.queue_depth)

@@ -78,12 +78,19 @@ _COLLECTIVE_PHASES = ("prefill", "decode")
 
 class ServingMetrics:
     def __init__(self, registry: Optional[MetricsRegistry] = None,
-                 tracer: Optional[SpanTracer] = None):
+                 tracer: Optional[SpanTracer] = None,
+                 labels: Optional[Dict[str, str]] = None):
         # own registry by default so per-engine counts stay per-engine;
-        # pass get_registry() to publish on the process-wide /metrics page
+        # pass get_registry() to publish on the process-wide /metrics page.
+        # ``labels`` rides EVERY series this object creates — the fleet
+        # router (ISSUE 6) builds each replica engine with
+        # ``labels={"replica": str(i)}`` on one shared registry, so
+        # /metrics exposes per-replica-labeled serving series side by
+        # side without name collisions.
         self.registry = (registry if registry is not None
                          else MetricsRegistry(max_series=256))
         self.tracer = tracer if tracer is not None else get_tracer()
+        self.labels: Dict[str, str] = dict(labels or {})
         self._counters: Dict[str, Counter] = {}
         for name in _COUNTER_NAMES:
             self._counter(name)
@@ -95,7 +102,8 @@ class ServingMetrics:
         self.kv_occupancy: Deque[float] = deque(maxlen=GAUGE_WINDOW)
         self._gauges: Dict[str, Gauge] = {
             name: self.registry.gauge(f"serving_{name}",
-                                      f"per-engine-step {name}")
+                                      f"per-engine-step {name}",
+                                      **self.labels)
             for name in _GAUGE_NAMES
         }
         # wall time of one mesh-spanning jitted step, labelled by phase
@@ -104,7 +112,7 @@ class ServingMetrics:
             phase: self.registry.histogram(
                 "serving_collective_seconds",
                 "wall time of the mesh-spanning jitted step (mp > 1)",
-                buckets=LATENCY_BUCKETS, phase=phase)
+                buckets=LATENCY_BUCKETS, phase=phase, **self.labels)
             for phase in _COLLECTIVE_PHASES
         }
         self._host_ops: Optional[HostOpRecorder] = None
@@ -114,7 +122,8 @@ class ServingMetrics:
         c = self._counters.get(name)
         if c is None:
             c = self._counters[name] = self.registry.counter(
-                f"serving_{name}_total", f"serving {name.replace('_', ' ')}")
+                f"serving_{name}_total", f"serving {name.replace('_', ' ')}",
+                **self.labels)
         return c
 
     def _hist(self, name: str) -> Histogram:
@@ -123,7 +132,7 @@ class ServingMetrics:
             h = self._hists[name] = self.registry.histogram(
                 f"serving_{name}_seconds",
                 f"serving {name.replace('_', ' ')} (seconds)",
-                buckets=LATENCY_BUCKETS)
+                buckets=LATENCY_BUCKETS, **self.labels)
         return h
 
     def count(self, name: str, n: int = 1) -> None:

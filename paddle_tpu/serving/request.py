@@ -94,6 +94,12 @@ class Request:
     # Prefill *progress* has no mirror here — kv.seq_len(request_id) is
     # the single source of truth.
     num_cached_tokens: int = 0
+    # externally-computed leading-block chain hashes (ISSUE 6): the fleet
+    # router hashes the prompt's leading full blocks once for
+    # prefix-affinity placement and hands them down, so the scheduler's
+    # admission probe (kv.match_prefix) does not re-hash those blocks.
+    # None = the probe hashes everything itself (single-engine path).
+    prefix_hashes: Optional[List[bytes]] = None
     # engine-stamped timing (perf_counter seconds)
     arrival_time: float = 0.0
     first_token_time: Optional[float] = None

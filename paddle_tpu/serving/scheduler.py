@@ -221,7 +221,10 @@ class ContinuousBatchingScheduler:
             # the available set when forked, so they are charged).  This
             # is what makes a warm cache raise admission capacity.
             if req._probe_epoch != self.kv.cache_epoch:
-                req._probe_blocks = self.kv.match_prefix(ids)
+                # leading-block hashes the fleet router already computed
+                # (req.prefix_hashes) are reused, not re-hashed
+                req._probe_blocks = self.kv.match_prefix(
+                    ids, precomputed=req.prefix_hashes)
                 req._probe_epoch = self.kv.cache_epoch
             hit = req._probe_blocks
             from_reuse = self.kv.reuse_count(hit)

@@ -21,39 +21,51 @@ response is an SSE stream (self-delimiting — the socket closes after
 ``data: [DONE]``).  HTTP/1.0 clients must opt in with
 ``Connection: keep-alive``.
 
-Threading model — ONE engine thread, N async handlers:
+Threading model — a FLEET of engine threads, N async handlers
+(ISSUE 6; dp=1 is simply a fleet of one):
 
-    asyncio loop (handlers)          engine thread (owns EngineCore)
+    asyncio loop (handlers)          engine thread i (owns replica i)
     ───────────────────────          ───────────────────────────────
-    parse request ──submit q──────▶  add_request(trace_id=...)
+    parse ──router──▶ submit q_i ──▶ add_request(trace_id=...)
     await handle.event   ◀─notify──  step(): prefill/decode/sample
     read req.output_tokens[cursor:]  retire finished
-    deadline hit ──abort q────────▶  abort_request(rid, TIMEOUT)
+    deadline hit ──owner──▶ abort q_i▶ abort_request(rid, TIMEOUT)
 
-``EngineCore`` is not thread-safe and its jitted steps block, so the
-engine loop runs on one background thread; handlers never touch the
-scheduler.  Handlers communicate through two **bounded** stdlib queues
-(submit/abort) and read each request's append-only ``output_tokens``
-directly (safe under the GIL); the engine thread wakes sleeping handlers
-via ``loop.call_soon_threadsafe`` after every step.
+``EngineCore`` is not thread-safe and its jitted steps block, so each
+replica runs its own background thread (``serving.fleet.EngineReplica``
+— the PR 3 bounded submit/abort queue bridge, per replica); handlers
+never touch a scheduler.  The :class:`~paddle_tpu.serving.fleet
+.FleetRouter` places each request by **prefix-affinity consistent
+hashing** over its leading prompt blocks (least-loaded fallback), and
+routes aborts through the request→replica owner map so a deadline or
+disconnect reaches the replica that actually holds the blocks.
+Handlers read each request's append-only ``output_tokens`` directly
+(safe under the GIL); engine threads wake sleeping handlers via
+``loop.call_soon_threadsafe`` after every step.
 
-The frontend owns three policies the engine deliberately does not:
+The frontend owns three policies the engines deliberately do not:
 
-* **admission control** — at most ``max_queue`` requests in flight
-  (pending + running); beyond that a POST gets ``429`` with a
-  ``Retry-After`` header and the ``serving_admission_rejected_total``
-  counter increments.  Both cross-thread queues are bounded
+* **admission control** — per replica: at most ``max_queue`` requests in
+  flight on each; a POST gets ``429`` (+ ``Retry-After``,
+  ``serving_admission_rejected_total``) only when EVERY eligible replica
+  is at its cap.  All cross-thread queues are bounded
   (``queue.Queue(maxsize=...)`` — ``tools/check_bounded_metrics.py``
-  lints this file).
+  lints this package).
 * **per-request deadlines** — ``timeout`` in the body (clamped to
   ``max_timeout_s``, defaulting to ``default_timeout_s``); on expiry the
-  handler propagates ``abort(TIMEOUT)`` into the scheduler, the
-  request's blocks are freed, and the partial output is returned with
-  ``finish_reason="timeout"``.
+  handler propagates ``abort(TIMEOUT)`` through the router into the
+  OWNING replica's scheduler, the request's blocks are freed, and the
+  partial output is returned with ``finish_reason="timeout"``.
 * **graceful drain** — ``shutdown()`` (or SIGTERM under the CLI) flips
-  ``/readyz`` to 503 immediately and stops admitting; in-flight requests
-  run to completion up to the drain deadline, then are aborted with
-  TIMEOUT; the engine thread exits only once the pool is empty.
+  ``/readyz`` to 503 immediately and stops admitting fleet-wide;
+  in-flight requests run to completion up to the drain deadline, then
+  are aborted with TIMEOUT; every engine thread exits only once its pool
+  is empty.
+
+Per-replica health rides the router: a dead engine thread is excluded
+from routing and the fleet serves on; ``/readyz`` (and POSTs) answer 503
+only when the WHOLE fleet is down.  ``/readyz``'s body reports the fleet
+shape — ``ok dp=N mp=M``.
 
 Every request gets a trace id (``cmpl-<n>``) attached to the engine's
 prefill/preempt/decode spans, so one request's lifecycle is
@@ -69,15 +81,20 @@ from __future__ import annotations
 import asyncio
 import itertools
 import json
-import queue
 import threading
 import time
-import traceback
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Tuple
 
 from ..observability.httpd import PROMETHEUS_CONTENT_TYPE, metrics_page
 from .engine import EngineCore
+from .fleet import (
+    FleetConfig,
+    FleetDown,
+    FleetRouter,
+    FleetSaturated,
+    SubmitHandle,
+)
 from .protocol import (
     SSE_DONE,
     CompletionRequest,
@@ -98,7 +115,10 @@ _ROUTES = ("/v1/completions", "/healthz", "/readyz", "/metrics")
 class ServerConfig:
     host: str = "127.0.0.1"
     port: int = 0                 # 0 = ephemeral, read back from .port
-    max_queue: int = 64           # in-flight cap (pending + running)
+    max_queue: int = 64           # per-replica engine-side in-flight cap
+                                  # (must match FleetConfig.max_queue for
+                                  # a pre-built fleet); the HTTP-side
+                                  # in-flight set is capped at dp x this
     retry_after_s: int = 1        # 429 Retry-After hint
     default_timeout_s: Optional[float] = None   # None = no deadline
     max_timeout_s: float = 600.0
@@ -110,66 +130,86 @@ class ServerConfig:
     tokenize: Optional[Callable[[str], List[int]]] = None
 
 
-class _Handle:
-    """One in-flight HTTP completion as both threads see it."""
+class _Handle(SubmitHandle):
+    """One in-flight HTTP completion: the fleet's :class:`SubmitHandle`
+    (rid / prompt / sampling / req / done / cancel_reason, routed and
+    owned by one replica) plus the parsed protocol request and the
+    asyncio waker created on the server's loop."""
 
-    __slots__ = ("rid", "creq", "event", "req", "done", "cancel_reason")
+    __slots__ = ("creq",)
 
     def __init__(self, rid: str, creq: CompletionRequest,
                  event: asyncio.Event):
-        self.rid = rid
+        super().__init__(rid, creq.prompt_ids, sampling=creq.sampling(),
+                         priority=creq.priority, event=event)
         self.creq = creq
-        self.event = event          # created on the server's loop
-        self.req = None             # engine Request, set by engine thread
-        self.done = False           # terminal without admission
-        self.cancel_reason: Optional[FinishReason] = None
 
 
 class CompletionServer:
-    """HTTP frontend bound to one :class:`EngineCore`.
+    """HTTP frontend bound to a fleet of engine replicas.
 
-    ``await start()`` spawns the engine thread and binds the socket;
-    ``await shutdown()`` drains gracefully.  ``registry`` defaults to the
-    engine's own metrics registry, so ``GET /metrics`` serves the
-    ``serving_*`` TTFT/ITL histograms next to whatever else the caller
+    Accepts either a :class:`FleetRouter` (dp ≥ 1, ISSUE 6) or a bare
+    :class:`EngineCore` — the latter is wrapped as a fleet of one: its
+    ``serving_*`` series stay unlabeled on its own registry as before,
+    with the ``serving_fleet_*`` family (a one-replica fleet) added
+    alongside.  ``await start()`` spawns the engine threads and binds
+    the socket; ``await shutdown()`` drains the whole fleet gracefully.
+    ``registry`` defaults to the fleet's shared metrics registry, so
+    ``GET /metrics`` serves per-replica-labeled ``serving_*`` series,
+    the ``serving_fleet_*`` family, and whatever else the caller
     registered there."""
 
-    def __init__(self, engine: EngineCore,
+    def __init__(self, engine,
                  config: Optional[ServerConfig] = None, registry=None):
-        self.engine = engine
         self.cfg = config or ServerConfig()
+        if isinstance(engine, FleetRouter):
+            self.fleet = engine
+            if self.cfg.max_queue != self.fleet.cfg.max_queue:
+                # admission lives in the router (per-replica caps), so a
+                # divergent ServerConfig.max_queue would be silently dead
+                # configuration — refuse instead of letting the operator
+                # believe their overload cap is enforced
+                raise ValueError(
+                    f"ServerConfig.max_queue={self.cfg.max_queue} but the "
+                    f"fleet was built with FleetConfig.max_queue="
+                    f"{self.fleet.cfg.max_queue}; admission is per-replica "
+                    "and owned by the fleet — set the cap there (or pass "
+                    "matching values)")
+        else:
+            self.fleet = FleetRouter.from_engine(
+                engine, max_queue=self.cfg.max_queue)
+        # replica 0's engine doubles as the single-engine compat surface
+        # (selftest / existing callers poke .engine.mp, .engine.kv, ...)
+        self.engine = self.fleet.replicas[0].engine
         self.registry = (registry if registry is not None
-                         else engine.metrics.registry)
-        self.tracer = engine.tracer
+                         else self.fleet.registry)
+        self.tracer = self.engine.tracer
         self._handles: Dict[str, _Handle] = {}
-        self._submit_q: "queue.Queue" = queue.Queue(
-            maxsize=max(1, self.cfg.max_queue))
-        # aborts are bounded by in-flight requests; 2x leaves room for
-        # drain-time aborts racing handler-deadline aborts
-        self._abort_q: "queue.Queue" = queue.Queue(
-            maxsize=2 * max(1, self.cfg.max_queue) + 8)
-        self._wake = threading.Event()
         self._ids = itertools.count(1)
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._server: Optional[asyncio.AbstractServer] = None
-        self._engine_thread: Optional[threading.Thread] = None
         self._draining = False
         self._stop = False
         self._shutdown_done: Optional[asyncio.Event] = None
-        self._engine_error: Optional[str] = None
-        m = engine.metrics
-        self._rejected = m.registry.counter(
+        self._rejected = self.registry.counter(
             "serving_admission_rejected_total",
-            "requests rejected 429 at admission (queue saturated)")
+            "requests rejected 429 at admission (every replica saturated)")
         self.port: Optional[int] = None
+
+    # --- single-engine compat views (dp=1 tests/tools poke these) -----------
+    @property
+    def _engine_thread(self) -> Optional[threading.Thread]:
+        return self.fleet.replicas[0].thread
+
+    @property
+    def _engine_error(self) -> Optional[str]:
+        return self.fleet.replicas[0].error
 
     # --- lifecycle ----------------------------------------------------------
     async def start(self) -> "CompletionServer":
         self._loop = asyncio.get_running_loop()
         self._shutdown_done = asyncio.Event()
-        self._engine_thread = threading.Thread(
-            target=self._engine_loop, name="serving-engine", daemon=True)
-        self._engine_thread.start()
+        self.fleet.start(notify=self._notify)
         self._server = await asyncio.start_server(
             self._handle_conn, self.cfg.host, self.cfg.port)
         self.port = self._server.sockets[0].getsockname()[1]
@@ -183,14 +223,17 @@ class CompletionServer:
             lambda: self._loop.create_task(self.shutdown()))
 
     async def shutdown(self, drain_timeout: Optional[float] = None) -> None:
-        """Graceful drain: stop admission now (``/readyz`` → 503), let
-        in-flight requests finish until the drain deadline, abort the
-        stragglers with TIMEOUT, stop the engine thread, close the
-        socket.  Idempotent; concurrent callers await the first drain."""
+        """Fleet-wide graceful drain: stop admission now (``/readyz`` →
+        503 instantly, router refuses), let in-flight requests finish
+        until the drain deadline, abort the stragglers with TIMEOUT
+        through their owning replicas, stop every engine thread, close
+        the socket.  Every replica exits with zero pool occupancy.
+        Idempotent; concurrent callers await the first drain."""
         if self._draining:
             await self._shutdown_done.wait()
             return
         self._draining = True
+        self.fleet.begin_drain()
         deadline = time.monotonic() + (
             drain_timeout if drain_timeout is not None
             else self.cfg.drain_timeout_s)
@@ -203,10 +246,7 @@ class CompletionServer:
         while self._handles and time.monotonic() < flush_deadline:
             await asyncio.sleep(0.01)
         self._stop = True
-        self._wake.set()
-        if self._engine_thread is not None:
-            await self._loop.run_in_executor(
-                None, self._engine_thread.join, 10.0)
+        await self._loop.run_in_executor(None, self.fleet.stop)
         if self._server is not None:
             self._server.close()
             await self._server.wait_closed()
@@ -217,75 +257,24 @@ class CompletionServer:
 
     @property
     def ready(self) -> bool:
+        # ready while ANY replica's engine thread lives: the router
+        # excludes dead replicas, so a partial fleet still serves (503
+        # only when the whole fleet is down or draining)
         return (self._server is not None and not self._draining
-                and self._engine_thread is not None
-                and self._engine_thread.is_alive())
+                and self.fleet.alive)
 
-    # --- engine thread ------------------------------------------------------
-    def _engine_loop(self) -> None:
-        eng = self.engine
-        try:
-            while True:
-                self._drain_submissions()
-                self._drain_aborts()
-                if self._stop and not eng.scheduler.has_work():
-                    break
-                if eng.scheduler.has_work():
-                    eng.step()
-                    self._notify()
-                else:
-                    self._wake.wait(timeout=0.02)
-                    self._wake.clear()
-        except Exception:
-            # fail loudly but leave no handler hanging and no block held
-            self._engine_error = traceback.format_exc()
-            for req in list(eng.requests.values()):
-                eng.abort_request(req.request_id)
-        finally:
-            for h in list(self._handles.values()):
-                h.done = True
-            self._notify()
-
-    def _drain_submissions(self) -> None:
-        while True:
-            try:
-                h = self._submit_q.get_nowait()
-            except queue.Empty:
-                return
-            if h.cancel_reason is not None or self._stop:
-                # deadline fired (or drain ended) before admission: the
-                # request never enters the scheduler
-                h.done = True
-                self._notify()
-                continue
-            c = h.creq
-            h.req = self.engine.add_request(
-                c.prompt_ids, sampling=c.sampling(), request_id=h.rid,
-                priority=c.priority, trace_id=h.rid)
-
-    def _drain_aborts(self) -> None:
-        did = False
-        while True:
-            try:
-                rid, reason = self._abort_q.get_nowait()
-            except queue.Empty:
-                break
-            if self.engine.abort_request(rid, reason):
-                did = True
-            else:
-                h = self._handles.get(rid)
-                if h is not None and h.req is None:
-                    h.done = True
-                    did = True
-        if did:
-            self._notify()
-
-    def _notify(self) -> None:
-        """Wake every waiting handler (engine → loop thread)."""
+    # --- fleet bridge -------------------------------------------------------
+    def _notify(self, replica=None) -> None:
+        """Wake waiting handlers (engine threads → loop thread).  The
+        stepping replica passes itself, so only the handlers whose
+        requests it owns are woken — wakeup work per step stays
+        per-replica instead of dp × fleet-wide.  ``None`` wakes all."""
         loop = self._loop
         if loop is None or loop.is_closed():
             return
         for h in list(self._handles.values()):
+            if replica is not None and h.replica is not replica:
+                continue
             try:
                 loop.call_soon_threadsafe(h.event.set)
             except RuntimeError:
@@ -293,11 +282,9 @@ class CompletionServer:
 
     def _request_abort(self, h: _Handle, reason: FinishReason) -> None:
         h.cancel_reason = reason
-        try:
-            self._abort_q.put_nowait((h.rid, reason))
-        except queue.Full:
-            pass  # sized to in-flight bound; a drop only delays cleanup
-        self._wake.set()
+        # the router's request→replica owner map sends the abort to the
+        # replica that actually holds the request's blocks
+        self.fleet.abort(h.rid, reason)
 
     # --- HTTP plumbing ------------------------------------------------------
     async def _handle_conn(self, reader: asyncio.StreamReader,
@@ -410,16 +397,21 @@ class CompletionServer:
                                     keep_alive=keep_alive)
             elif path == "/readyz":
                 status = 200 if self.ready else 503
-                # the mesh shape rides the probe body (ISSUE 5): a
-                # deployment that came up single-chip when the operator
-                # expected mp=N is visible from the readiness check alone
+                # the fleet shape rides the probe body (ISSUE 5/6): a
+                # deployment that came up single-replica or single-chip
+                # when the operator expected dp=N / mp=M is visible from
+                # the readiness check alone
                 mp = getattr(self.engine, "mp", 1)
-                msg = (f"ok mp={mp}\n".encode() if status == 200 else (
-                    b"draining\n" if self._draining else b"not ready\n"))
+                msg = (f"ok dp={self.fleet.dp} mp={mp}\n".encode()
+                       if status == 200 else (
+                           b"draining\n" if self._draining
+                           else b"not ready\n"))
                 await self._respond(writer, status, msg, "text/plain",
                                     keep_alive=keep_alive)
             elif path == "/metrics":
                 status = 200
+                # refresh serving_fleet_* replica gauges at scrape time
+                self.fleet.sample_gauges()
                 await self._respond(writer, status,
                                     metrics_page(self.registry),
                                     PROMETHEUS_CONTENT_TYPE,
@@ -448,13 +440,16 @@ class CompletionServer:
                                  keep_alive: bool = False,
                                  ) -> Tuple[int, bool]:
         """Returns (status, connection-still-open)."""
+        unavailable_msg = ("server is draining"
+                           if self._draining or self._stop
+                           else "engine is not running")
         if not self.ready:
-            # draining OR the engine thread died: either way nobody will
-            # ever drain the submit queue, so refuse instead of hanging
-            msg = ("server is draining" if self._draining or self._stop
-                   else "engine is not running")
+            # draining OR every engine thread died: either way nobody
+            # will ever drain a submit queue, so refuse instead of
+            # hanging
             await self._respond(writer, 503, error_body(
-                msg, "unavailable_error"), keep_alive=keep_alive)
+                unavailable_msg, "unavailable_error"),
+                keep_alive=keep_alive)
             return 503, keep_alive
         try:
             creq = parse_completion_request(body, tokenize=self.cfg.tokenize)
@@ -463,8 +458,12 @@ class CompletionServer:
                                 keep_alive=keep_alive)
             return 400, keep_alive
 
-        # admission control: bounded in-flight set, counted rejections
-        if len(self._handles) >= self.cfg.max_queue:
+        # two admission layers: the router's per-replica caps bound
+        # ENGINE-side work (evicted as requests finish computing), while
+        # this server-wide cap bounds HTTP-side work — handles, sockets,
+        # buffered output still flushing to slow clients — which can
+        # outlive the engine's interest in a request
+        if len(self._handles) >= self.cfg.max_queue * self.fleet.dp:
             self._rejected.inc()
             await self._respond(
                 writer, 429,
@@ -473,13 +472,14 @@ class CompletionServer:
                 extra=(("Retry-After", str(self.cfg.retry_after_s)),),
                 keep_alive=keep_alive)
             return 429, keep_alive
+        # router admission is per replica: prefix-affinity target first,
+        # least-loaded fallback; 429 only when EVERY eligible replica is
+        # at its in-flight cap
         rid = f"cmpl-{next(self._ids)}"
         handle = _Handle(rid, creq, asyncio.Event())
-        self._handles[rid] = handle
         try:
-            self._submit_q.put_nowait(handle)
-        except queue.Full:
-            del self._handles[rid]
+            self.fleet.submit(handle)
+        except FleetSaturated:
             self._rejected.inc()
             await self._respond(
                 writer, 429,
@@ -488,7 +488,12 @@ class CompletionServer:
                 extra=(("Retry-After", str(self.cfg.retry_after_s)),),
                 keep_alive=keep_alive)
             return 429, keep_alive
-        self._wake.set()
+        except FleetDown:
+            await self._respond(writer, 503, error_body(
+                unavailable_msg, "unavailable_error"),
+                keep_alive=keep_alive)
+            return 503, keep_alive
+        self._handles[rid] = handle
 
         timeout = creq.timeout if creq.timeout is not None \
             else self.cfg.default_timeout_s
@@ -586,13 +591,29 @@ class CompletionServer:
 # --- CLI / selftest ---------------------------------------------------------
 
 def _toy_engine(layers: int = 2, num_blocks: int = 64,
-                block_size: int = 4) -> EngineCore:
+                block_size: int = 4, registry=None,
+                metrics_labels=None) -> EngineCore:
     import paddle_tpu as paddle
     from ..models import LlamaConfig, LlamaForCausalLM
 
     paddle.seed(0)
     model = LlamaForCausalLM(LlamaConfig.tiny(num_hidden_layers=layers))
-    return EngineCore(model, num_blocks=num_blocks, block_size=block_size)
+    return EngineCore(model, num_blocks=num_blocks, block_size=block_size,
+                      registry=registry, metrics_labels=metrics_labels)
+
+
+def _toy_fleet(dp: int = 1, layers: int = 2, num_blocks: int = 64,
+               max_queue: int = 64) -> FleetRouter:
+    """A dp-replica fleet of toy engines on one shared registry: each
+    replica gets its OWN model instance (engine threads swap parameter
+    values during the traced step — modules must not be shared) with
+    per-replica-labeled serving series.  Composes with ``--mp``: build
+    the mesh first and every replica's engine runs mesh-spanning."""
+    return FleetRouter.build(
+        lambda i, registry: _toy_engine(
+            layers=layers, num_blocks=num_blocks, registry=registry,
+            metrics_labels={"replica": str(i)}),
+        dp=dp, config=FleetConfig(max_queue=max_queue))
 
 
 def _http(port: int, method: str, path: str, body: Optional[dict] = None):
@@ -610,19 +631,21 @@ def _http(port: int, method: str, path: str, body: Optional[dict] = None):
     return status, data
 
 
-async def _selftest_async() -> int:
+async def _selftest_async(dp: int = 1) -> int:
     loop = asyncio.get_running_loop()
-    engine = _toy_engine()
-    server = CompletionServer(engine, ServerConfig(port=0))
+    fleet = _toy_fleet(dp=dp)
+    server = CompletionServer(fleet, ServerConfig(port=0))
+    engine = server.engine
     await server.start()
     try:
         status, data = await loop.run_in_executor(
             None, _http, server.port, "GET", "/readyz", None)
         assert status == 200, f"/readyz {status}"
-        # readiness must report the mesh shape (ISSUE 5): mp=1 single-chip,
-        # mp=N when a tensor-parallel mesh is live
-        assert f"mp={engine.mp}".encode() in data, \
-            f"/readyz body missing mesh shape: {data!r}"
+        # readiness must report the fleet shape (ISSUE 5/6): a deployment
+        # that came up single-replica or single-chip when the operator
+        # expected dp=N / mp=M is visible from the probe body alone
+        assert f"dp={fleet.dp} mp={engine.mp}".encode() in data, \
+            f"/readyz body missing fleet shape: {data!r}"
         status, data = await loop.run_in_executor(
             None, _http, server.port, "POST", "/v1/completions",
             {"prompt": [5, 9, 23, 7], "max_tokens": 4})
@@ -637,16 +660,23 @@ async def _selftest_async() -> int:
             "metrics page missing serving histograms"
         assert b"serving_mp_shards" in data, \
             "metrics page missing the mp-shards gauge"
-        print(f"selftest: OK (port {server.port}, mp={engine.mp}, "
-              f"tokens {choice['token_ids']})")
+        # the probe went through the router: fleet series must exist and
+        # exactly one routing counter must have counted it
+        assert b"serving_fleet_replicas" in data, \
+            "metrics page missing the serving_fleet_* family"
+        routed = sum(fleet.routing_counts.values())
+        assert routed >= 1, "completion did not route through the fleet"
+        print(f"selftest: OK (port {server.port}, dp={fleet.dp}, "
+              f"mp={engine.mp}, tokens {choice['token_ids']})")
         return 0
     finally:
         await server.shutdown(drain_timeout=2.0)
 
 
 async def _serve_cli(args) -> int:
-    engine = _toy_engine(layers=args.layers, num_blocks=args.blocks)
-    server = CompletionServer(engine, ServerConfig(
+    fleet = _toy_fleet(dp=args.dp, layers=args.layers,
+                       num_blocks=args.blocks, max_queue=args.max_queue)
+    server = CompletionServer(fleet, ServerConfig(
         host=args.host, port=args.port,
         max_queue=args.max_queue,
         default_timeout_s=args.timeout))
@@ -659,7 +689,8 @@ async def _serve_cli(args) -> int:
             loop.add_signal_handler(sig, server.request_shutdown)
     except (NotImplementedError, RuntimeError):
         pass
-    print(f"serving on http://{server.cfg.host}:{server.port} mp={engine.mp} "
+    print(f"serving on http://{server.cfg.host}:{server.port} "
+          f"dp={fleet.dp} mp={server.engine.mp} "
           "(POST /v1/completions; GET /healthz /readyz /metrics)")
     await server.serve_forever()
     return 0
@@ -688,13 +719,21 @@ def main(argv=None) -> int:
                    help="default per-request deadline (seconds)")
     p.add_argument("--mp", type=int, default=1,
                    help="tensor-parallel degree: init a mesh with this "
-                        "mp axis before building the engine (needs that "
+                        "mp axis before building the engines (needs that "
                         "many devices; on CPU set XLA_FLAGS="
                         "--xla_force_host_platform_device_count=N)")
+    p.add_argument("--dp", type=int, default=1,
+                   help="data-parallel fleet degree: N engine replicas "
+                        "behind the prefix-affinity router (composes "
+                        "with --mp: '--dp 2 --mp 2' is a dp×mp fleet of "
+                        "2 replicas, each mesh-spanning 2 shards)")
     p.add_argument("--selftest", action="store_true",
                    help="boot on an ephemeral port, serve one completion "
-                        "against the toy model, exit 0 on success")
+                        "against the toy fleet through the router path, "
+                        "exit 0 on success")
     args = p.parse_args(argv)
+    if args.dp < 1:
+        p.error(f"--dp must be >= 1, got {args.dp}")
     if args.mp > 1:
         # tensor-parallel serving (ISSUE 5): build the mesh BEFORE any
         # engine (selftest included — the probe must exercise the real
@@ -704,7 +743,7 @@ def main(argv=None) -> int:
 
         topology.init_mesh(mp=args.mp)
     if args.selftest:
-        return asyncio.run(_selftest_async())
+        return asyncio.run(_selftest_async(dp=args.dp))
     return asyncio.run(_serve_cli(args))
 
 

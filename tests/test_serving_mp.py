@@ -311,6 +311,9 @@ class TestBoundedMetricsLint:
                      "paddle_tpu/parallel/utils.py",
                      "paddle_tpu/parallel/_compat.py",
                      "paddle_tpu/distributed/topology.py",
-                     "paddle_tpu/ops/pallas_paged.py"):
+                     "paddle_tpu/ops/pallas_paged.py",
+                     # ISSUE 6: the fleet's per-replica queues/maps are
+                     # pinned even if the module leaves the serving dir
+                     "paddle_tpu/serving/fleet.py"):
             assert need in covered, f"{need} missing from lint SCAN_FILES"
         assert lint.scan(dirs=(), files=lint.SCAN_FILES) == []

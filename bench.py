@@ -504,8 +504,11 @@ def serving_bench() -> dict:
         t0 = time.perf_counter()
         # max_new_tokens=6 keeps requests alive long enough that BOTH
         # runs sweep the same decode batch buckets {1,2,4} — the trace
-        # counts then compare exactly, not just boundedly
-        reqs = [eng.add_request(p, SamplingParams(max_new_tokens=6))
+        # counts then compare exactly, not just boundedly.  slo_ms
+        # scores every request into the serving_slo_* goodput pair so
+        # the phase record carries a populated SLO breakdown (ISSUE 8).
+        reqs = [eng.add_request(p, SamplingParams(max_new_tokens=6),
+                                slo_ms=60_000.0)
                 for p in prompts]
         eng.run(max_steps=2000)
         wall = time.perf_counter() - t0
@@ -523,6 +526,9 @@ def serving_bench() -> dict:
             "prefix_cache_evictions": c["prefix_cache_evictions"],
             "prefill_traces": eng.prefill_trace_count,
             "decode_traces": eng.decode_trace_count,
+            # per-phase SLO breakdown (ISSUE 8): queue_wait / prefill /
+            # decode_itl / e2e quantiles + the goodput pair
+            "slo": eng.metrics.slo_breakdown(),
             # full registry snapshot: serving_* TTFT/ITL histograms ride
             # in the phase record like the train phases embed theirs
             "metrics": eng.metrics.snapshot(),
@@ -582,7 +588,8 @@ def serving_mp_bench() -> dict:
                 scheduler_config=SchedulerConfig(
                     max_num_seqs=4, max_prefill_tokens_per_step=8),
                 prefix_cache=True)
-            reqs = [eng.add_request(p, SamplingParams(max_new_tokens=10))
+            reqs = [eng.add_request(p, SamplingParams(max_new_tokens=10),
+                                    slo_ms=60_000.0)
                     for p in prompts]
             t0 = time.perf_counter()
             eng.run(max_steps=4000)
@@ -598,6 +605,7 @@ def serving_mp_bench() -> dict:
                 "decode_traces": eng.decode_trace_count,
                 "prefill_buckets": len(eng.prefill_buckets),
                 "decode_buckets": len(eng.decode_buckets),
+                "slo": eng.metrics.slo_breakdown(),  # ISSUE 8 breakdown
                 "metrics": eng.metrics.snapshot(),
                 "outputs": [list(r.output_tokens) for r in reqs],
             }
@@ -697,7 +705,7 @@ def serving_fleet_bench() -> dict:
             handles = [
                 fleet.submit_request(
                     p, SamplingParams(max_new_tokens=10),
-                    request_id=f"r{i}")
+                    request_id=f"r{i}", slo_ms=60_000.0)
                 for i, p in enumerate(prompts)]
             fleet.wait(handles, timeout=600)
             wall = time.perf_counter() - t0
@@ -722,6 +730,10 @@ def serving_fleet_bench() -> dict:
                     "decode_traces": r.engine.decode_trace_count,
                     "prefill_buckets": len(r.engine.prefill_buckets),
                     "decode_buckets": len(r.engine.decode_buckets),
+                    # per-replica SLO breakdown (ISSUE 8): the labeled
+                    # serving_* series split the fleet's goodput per
+                    # replica
+                    "slo": r.engine.metrics.slo_breakdown(),
                 })
             fleet.sample_gauges()
             return {

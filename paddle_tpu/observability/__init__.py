@@ -20,7 +20,18 @@ and user code (ISSUE 2 tentpole):
 :func:`start_metrics_server` (``httpd.py``) serves any registry as a
 Prometheus ``/metrics`` scrape endpoint from a daemon thread — the same
 page the serving frontend exposes — so training jobs are fleet-scrapable
-too (closed ROADMAP follow-up (a)).
+too (closed ROADMAP follow-up (a)); :class:`PushGateway` (``push.py``)
+is the inverse for jobs behind NAT — a daemon thread POSTs the registry
+to a configured URL with capped exponential backoff.
+
+The per-request layer (ISSUE 8): :class:`LifecycleTracker`
+(``lifecycle.py``) keeps a bounded structured event timeline per
+serving request — routing, admission, prefill chunks, sampled decode
+ITL, preemption, finish — exportable as a single-request chrome trace;
+:class:`FlightRecorder` (``flight.py``) mirrors those events into
+bounded per-replica rings and dumps atomic post-mortem bundles on
+anomaly triggers (engine death, watchdog, preemption storms, 429
+bursts, drain overruns).
 
 Process-wide defaults: :func:`get_tracer` / :func:`get_registry` return
 one shared instance each, so spans from the serving engine, jit compile
@@ -32,14 +43,23 @@ from __future__ import annotations
 
 from .export import (  # noqa: F401
     ProfilerResult,
+    chrome_trace_dict,
     export_chrome_trace,
     load_profiler_result,
+)
+from .flight import (  # noqa: F401
+    FlightConfig,
+    FlightRecorder,
 )
 from .httpd import (  # noqa: F401
     PROMETHEUS_CONTENT_TYPE,
     MetricsServer,
     metrics_page,
     start_metrics_server,
+)
+from .lifecycle import (  # noqa: F401
+    LifecycleTracker,
+    RequestTimeline,
 )
 from .metrics import (  # noqa: F401
     Counter,
@@ -48,6 +68,10 @@ from .metrics import (  # noqa: F401
     MetricsRegistry,
     get_registry,
     set_registry,
+)
+from .push import (  # noqa: F401
+    PushGateway,
+    start_push_gateway,
 )
 from .tracer import (  # noqa: F401
     Span,

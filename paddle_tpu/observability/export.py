@@ -27,14 +27,11 @@ def _jsonable(v):
     return str(v)
 
 
-def export_chrome_trace(spans, path: str,
-                        epoch_offset: float = 0.0) -> str:
-    """Serialize ``spans`` (``tracer.Span`` objects) to ``path``.
-
-    ``epoch_offset`` shifts perf_counter timestamps onto the wall clock;
-    output dirs are created as needed.  Returns ``path``."""
-    parent = os.path.dirname(os.path.abspath(path))
-    os.makedirs(parent, exist_ok=True)
+def chrome_trace_dict(spans, epoch_offset: float = 0.0) -> Dict:
+    """Serialize ``spans`` (``tracer.Span`` objects) to a Chrome
+    trace-event dict — the in-memory form behind
+    :func:`export_chrome_trace` and the serving frontend's per-request
+    ``GET /v1/requests/{id}?format=chrome`` body."""
     events: List[Dict] = [{
         "ph": "M", "name": "process_name", "pid": _PID, "tid": 0,
         "args": {"name": "paddle_tpu host"},
@@ -59,9 +56,19 @@ def export_chrome_trace(spans, path: str,
             ev["ph"] = "i"
             ev["s"] = "t"
         events.append(ev)
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def export_chrome_trace(spans, path: str,
+                        epoch_offset: float = 0.0) -> str:
+    """Serialize ``spans`` (``tracer.Span`` objects) to ``path``.
+
+    ``epoch_offset`` shifts perf_counter timestamps onto the wall clock;
+    output dirs are created as needed.  Returns ``path``."""
+    parent = os.path.dirname(os.path.abspath(path))
+    os.makedirs(parent, exist_ok=True)
     with open(path, "w") as f:
-        json.dump({"traceEvents": events,
-                   "displayTimeUnit": "ms"}, f)
+        json.dump(chrome_trace_dict(spans, epoch_offset=epoch_offset), f)
     return path
 
 

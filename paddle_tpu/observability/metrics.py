@@ -185,6 +185,31 @@ class Histogram(_Metric):
     def avg(self) -> float:
         return self.sum / self.count if self.count else 0.0
 
+    def quantile(self, q: float) -> Optional[float]:
+        """Bucket-based quantile estimate (the Prometheus
+        ``histogram_quantile`` method): find the bucket holding the
+        q-th observation, interpolate linearly inside it.  Exact
+        streaming ``min``/``max`` clamp the ends — the estimate never
+        leaves the observed range.  ``None`` while empty."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile must be in [0, 1], got {q}")
+        with self._lock:
+            if self.count == 0:
+                return None
+            rank = q * self.count
+            cum = 0
+            lo = 0.0 if self.min >= 0 else self.min
+            for bound, c in zip(self.bounds, self._counts):
+                if cum + c >= rank and c:
+                    frac = (rank - cum) / c
+                    est = lo + (bound - lo) * frac
+                    return min(max(est, self.min), self.max)
+                cum += c
+                lo = bound
+            # the +Inf overflow bucket has no upper bound to interpolate
+            # against; the exact streaming max is the honest answer
+            return self.max
+
     def bucket_counts(self) -> Dict[str, int]:
         """Cumulative counts keyed by ``le`` bound (incl. ``+Inf``)."""
         out, cum = {}, 0
@@ -209,6 +234,12 @@ class Histogram(_Metric):
                 "avg": self.avg,
                 "max": None if self.count == 0 else self.max,
                 "min": None if self.count == 0 else self.min,
+                # bucket-interpolated estimates (None while empty); the
+                # Prometheus text exposition is unchanged — these ride
+                # only the JSON snapshot / summary surfaces
+                "p50": self.quantile(0.50),
+                "p95": self.quantile(0.95),
+                "p99": self.quantile(0.99),
                 "buckets": self.bucket_counts()}
 
 

@@ -58,6 +58,8 @@ import numpy as np
 
 from ..core.tensor import Tensor
 from ..distributed import topology
+from ..observability import lifecycle as _lc
+from ..observability.lifecycle import LifecycleTracker
 from ..ops.paged_attention import (
     KV_POOL_SPEC,
     PagedCache,
@@ -99,6 +101,21 @@ class EngineConfig:
     # not match the live mesh raises at engine build — a misconfigured
     # deployment fails loudly instead of silently serving single-chip.
     mp: Optional[int] = None
+    # Request-lifecycle tracing (ISSUE 8): per-request bounded event
+    # timelines (admission, routing handoff, prefill chunks, sampled
+    # decode ITL, preemption, finish), queryable via the serving debug
+    # endpoints and exportable as per-request chrome traces.  Off =
+    # zero per-event work on the hot path.
+    lifecycle_events: bool = True
+    # share a tracker across engines (the fleet router rebinds replicas
+    # onto ONE tracker so router + engine events land in one timeline);
+    # None = the engine builds its own on its metrics registry
+    lifecycle: Optional[LifecycleTracker] = None
+    # record every Nth decode-token EVENT on the timeline (aggregates
+    # and the ITL histograms see every token regardless; sampled-out
+    # tokens also skip the flight-ring fan-out, so this knob bounds the
+    # per-token cost on the decode hot path); 0 = none
+    decode_event_sample: int = 8
 
 
 class EngineCore:
@@ -149,6 +166,18 @@ class EngineCore:
         self.metrics = ServingMetrics(registry=registry,
                                       labels=metrics_labels)
         self.tracer = self.metrics.tracer
+        # --- request-lifecycle tracing (ISSUE 8) ---------------------------
+        # the fleet router rebinds all replicas onto ONE tracker via
+        # set_lifecycle() so router + engine events share a timeline
+        self._replica_label = (metrics_labels or {}).get("replica", "0")
+        self._lifecycle_on = config.lifecycle_events
+        if config.lifecycle is not None:
+            self.lifecycle = config.lifecycle
+        else:
+            self.lifecycle = LifecycleTracker(
+                registry=self.metrics.registry,
+                enabled=config.lifecycle_events,
+                decode_sample=config.decode_event_sample)
         self.requests: Dict[object, Request] = {}
         self._pool_dtype = jnp.dtype(dtype)
         # --- tensor-parallel resolution (ISSUE 5) ---------------------------
@@ -344,10 +373,32 @@ class EngineCore:
                 tuple(c.v_pool._value for c in caches))
 
     # --- request lifecycle --------------------------------------------------
+    def set_lifecycle(self, tracker: LifecycleTracker,
+                      replica: Optional[str] = None) -> None:
+        """Rebind this engine onto a shared lifecycle tracker (the fleet
+        router calls this before any request exists, so router-thread
+        routing events and engine-thread execution events land in ONE
+        timeline per request).  ``replica`` pins the identity this
+        engine stamps on every event — the router passes the replica
+        INDEX so flight-recorder rings and the ``engine_death`` trigger
+        key always agree, regardless of what the metrics labels say.
+        The engine's own ``EngineConfig.lifecycle_events`` gate still
+        applies."""
+        self.lifecycle = tracker
+        if replica is not None:
+            self._replica_label = str(replica)
+
+    def _lc(self, rid, name: str, **attrs) -> None:
+        """One lifecycle event, replica-stamped; no-op when gated off."""
+        if self._lifecycle_on:
+            self.lifecycle.event(rid, name, replica=self._replica_label,
+                                 **attrs)
+
     def add_request(self, prompt_ids, sampling: Optional[SamplingParams] = None,
                     request_id=None, priority: int = 0,
                     trace_id: Optional[str] = None,
-                    prefix_hashes: Optional[List[bytes]] = None) -> Request:
+                    prefix_hashes: Optional[List[bytes]] = None,
+                    slo_ms: Optional[float] = None) -> Request:
         """Enqueue a request (admission happens inside ``step``).
 
         ``trace_id`` (defaults to ``str(request_id)``) is attached to every
@@ -363,13 +414,17 @@ class EngineCore:
         req = Request(prompt_ids=list(np.asarray(prompt_ids).reshape(-1)),
                       sampling=sampling or SamplingParams(),
                       request_id=request_id, priority=priority,
-                      trace_id=trace_id, prefix_hashes=prefix_hashes)
+                      trace_id=trace_id, prefix_hashes=prefix_hashes,
+                      slo_ms=slo_ms)
         if req.request_id in self.requests:
             raise ValueError(f"request id {req.request_id!r} already exists")
         req.arrival_time = time.perf_counter()
         self.requests[req.request_id] = req
         self.scheduler.add(req)
         self.metrics.count("requests_admitted")
+        self._lc(req.request_id, _lc.EV_ENQUEUED, trace_id=req.trace_id,
+                 prompt_tokens=len(req.prompt_ids), slo_ms=slo_ms,
+                 queue_depth=self.scheduler.queue_depth)
         return req
 
     def abort_request(self, request_id,
@@ -391,15 +446,31 @@ class EngineCore:
         req.finish_reason = reason
         req.finish_time = time.perf_counter()
         self.metrics.count(f"requests_finished_{reason.value}")
+        e2e = req.finish_time - req.arrival_time
+        self.metrics.observe_finish(e2e, req.slo_ms)
+        self._lc(req.request_id, _lc.EV_FINISH, reason=reason.value,
+                 e2e_s=round(e2e, 6), generated=len(req.output_tokens),
+                 preemptions=req.num_preemptions)
 
     def _emit(self, req: Request, tok: int) -> None:
         """Append one sampled token + finish-state bookkeeping."""
         now = time.perf_counter()
         if req.first_token_time is None:
             req.first_token_time = now
-            self.metrics.observe_ttft(now - req.arrival_time)
+            ttft = now - req.arrival_time
+            self.metrics.observe_ttft(ttft)
+            if req.prefill_start_time is not None:
+                # the whole prefill PHASE (chunks + recomputes), the
+                # middle leg of the SLO breakdown
+                self.metrics.observe_prefill_phase(
+                    now - req.prefill_start_time)
+            self._lc(req.request_id, _lc.EV_FIRST_TOKEN,
+                     ttft_s=round(ttft, 6))
         else:
-            self.metrics.observe_inter_token(now - req._last_emit)
+            itl = now - req._last_emit
+            self.metrics.observe_inter_token(itl)
+            self._lc(req.request_id, _lc.EV_DECODE_TOKEN,
+                     itl_s=round(itl, 6))
         req._last_emit = now
         req.append_token(tok)
         if req.hit_eos(tok):
@@ -438,7 +509,14 @@ class EngineCore:
         start = self.kv.seq_len(rid)  # cached fork + earlier chunks
         n = req._chunk_tokens if req._chunk_tokens else target - start
         req._chunk_tokens = None
-        if req.output_tokens and start == req.num_cached_tokens:
+        t_chunk0 = time.perf_counter()
+        recompute = bool(req.output_tokens and start == req.num_cached_tokens)
+        if req.prefill_start_time is None:
+            # first prefill work for this request: the queue-wait leg of
+            # the SLO breakdown ends here
+            req.prefill_start_time = t_chunk0
+            self.metrics.observe_queue_wait(t_chunk0 - req.arrival_time)
+        if recompute:
             self.metrics.count("recompute_prefills")  # first chunk only
         if not self.kv.allocate(rid, n):
             raise PoolExhausted(  # scheduler planning guarantees room
@@ -498,6 +576,10 @@ class EngineCore:
                             np.int32(n - 1), tables, lens, blocks, offs)
                     logits = np.asarray(last, np.float32)
         self.kv.commit(rid, n)
+        self._lc(rid, _lc.EV_PREFILL_CHUNK, start=start, tokens=n,
+                 target=target, chunk=bool(start or n != target),
+                 recompute=recompute,
+                 duration_s=round(time.perf_counter() - t_chunk0, 6))
         self.metrics.count("prefill_tokens_computed", n)
         if self.kv.prefix_cache_enabled:
             # index the fully-written blocks NOW, so a same-prefix request
@@ -564,10 +646,14 @@ class EngineCore:
                         "preemption", cat="serving",
                         request=str(req.request_id), trace=req.trace_id,
                         generated=len(req.output_tokens))
+                    self._lc(req.request_id, _lc.EV_PREEMPTED,
+                             generated=len(req.output_tokens))
                 for req in plan.aborted:
                     # unservable at admission: scheduler set state/reason,
                     # the engine owns finish bookkeeping (timestamp +
                     # counter)
+                    self._lc(req.request_id, _lc.EV_ADMISSION_REJECTED,
+                             reason="abort", error=req.error)
                     self._finish(req, FinishReason.ABORT)
                     self.requests.pop(req.request_id, None)
                 for req in plan.admitted:
@@ -576,6 +662,9 @@ class EngineCore:
                     self.metrics.count("prefix_cache_hit_tokens", cached)
                     self.metrics.count("prefix_cache_miss_tokens",
                                        total - cached)
+                    self._lc(req.request_id, _lc.EV_ADMITTED,
+                             cached_tokens=cached,
+                             recompute=bool(req.output_tokens))
                     if cached:
                         self.tracer.instant(
                             "prefix_cache_hit", cat="serving",
@@ -599,6 +688,10 @@ class EngineCore:
                 if ev > self._evictions_seen:
                     self.metrics.count("prefix_cache_evictions",
                                        ev - self._evictions_seen)
+                    # engine-level event (no single owning request):
+                    # rid=None goes to flight-recorder rings only
+                    self._lc(None, "prefix_cache_eviction",
+                             evicted=ev - self._evictions_seen)
                     self._evictions_seen = ev
                 self.metrics.set_cached_token_ratio()
                 self.metrics.sample_gauges(self.scheduler.queue_depth,
@@ -691,8 +784,10 @@ class EngineCore:
 
     def release(self, request_id) -> None:
         """Drop a request and free its blocks (no finish bookkeeping —
-        the predictor's ``free``)."""
+        the predictor's ``free``).  The timeline IS closed: an active
+        timeline with no owner would sit in the tracker forever."""
         req = self.requests.pop(request_id, None)
         if req is not None:
             self.scheduler.remove(req)
+            self._lc(request_id, _lc.EV_FINISH, reason="released")
         self.kv.free(request_id)

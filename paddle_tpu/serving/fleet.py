@@ -80,10 +80,27 @@ import traceback
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Sequence
 
+from ..observability import lifecycle as _lc
+from ..observability.flight import FlightConfig, FlightRecorder
+from ..observability.lifecycle import LifecycleTracker
 from ..observability.metrics import MetricsRegistry
 from ..ops.paged_attention import prefix_chain_hashes
 from .engine import EngineCore
 from .request import FinishReason, SamplingParams
+
+# pre-registered metric names this module owns (tools/check_metrics_docs
+# lints that each appears in README's metrics table)
+METRIC_NAMES = (
+    "serving_fleet_replicas",
+    "serving_fleet_replicas_alive",
+    "serving_fleet_in_flight",
+    "serving_fleet_affinity_hit_total",
+    "serving_fleet_fallback_routed_total",
+    "serving_fleet_replica_alive",
+    "serving_fleet_replica_in_flight",
+    "serving_fleet_replica_occupancy",
+    "serving_fleet_replica_queue_depth",
+)
 
 
 class FleetSaturated(RuntimeError):
@@ -111,6 +128,12 @@ class FleetConfig:
     vnodes: int = 16          # ring points per replica (smoother spread
                               # + smaller remap slice on replica death)
     drain_timeout_s: float = 5.0  # shutdown(): grace for in-flight work
+    # flight recorder (ISSUE 8): None keeps the bounded per-replica
+    # event rings (cheap, always on) but writes no post-mortem bundles;
+    # a directory enables atomic bundle dumps on anomaly triggers
+    flight_dir: Optional[str] = None
+    flight: Optional[FlightRecorder] = None  # pre-built recorder wins
+                                             # over flight_dir
 
 
 def _build_ring(dp: int, vnodes: int) -> List:
@@ -182,15 +205,17 @@ class SubmitHandle:
 
     __slots__ = ("rid", "prompt_ids", "sampling", "priority",
                  "prefix_hashes", "req", "done", "cancel_reason", "event",
-                 "replica")
+                 "replica", "slo_ms")
 
     def __init__(self, rid, prompt_ids: List[int],
                  sampling: Optional[SamplingParams] = None,
-                 priority: int = 0, event=None):
+                 priority: int = 0, event=None,
+                 slo_ms: Optional[float] = None):
         self.rid = rid
         self.prompt_ids = [int(t) for t in prompt_ids]
         self.sampling = sampling or SamplingParams()
         self.priority = priority
+        self.slo_ms = slo_ms
         self.prefix_hashes: Optional[List[bytes]] = None  # router-stamped
         self.req = None                  # engine Request, set by engine thread
         self.done = False                # terminal without admission
@@ -246,6 +271,7 @@ class EngineReplica:
         # evicted on finish by the engine thread
         self.thread: Optional[threading.Thread] = None
         self.error: Optional[str] = None
+        self.flight: Optional[FlightRecorder] = None  # router-stamped
         self._stop = False
         # notify/on_finish are scoped to THIS replica: the frontend
         # wakes only the handlers whose requests this replica owns (so
@@ -337,6 +363,17 @@ class EngineReplica:
         except Exception:
             # fail loudly but leave no handler hanging and no block held
             self.error = traceback.format_exc()
+            if self.flight is not None:
+                # post-mortem BEFORE the aborts below: the bundle then
+                # captures the dying requests' timelines while they are
+                # still in flight, plus the last-K events of THIS
+                # replica's ring (fired once per replica)
+                try:
+                    self.flight.trigger("engine_death",
+                                        replica=str(self.index),
+                                        detail=self.error)
+                except Exception:
+                    pass  # telemetry must never mask the death handling
             for req in list(eng.requests.values()):
                 eng.abort_request(req.request_id)
         finally:
@@ -346,6 +383,12 @@ class EngineReplica:
                     # ownership): it is being re-routed — not ours to end
                     continue
                 h.done = True
+                if h.req is None:
+                    # never admitted: the engine's finish path will not
+                    # close this timeline — do it here so it moves to
+                    # the tracker's bounded recent ring
+                    eng._lc(rid, _lc.EV_FINISH, reason="abort",
+                            error="engine thread exited before admission")
                 self._on_finish(rid)
             self._notify()
 
@@ -357,14 +400,19 @@ class EngineReplica:
                 return
             if h.cancel_reason is not None or self._stop:
                 # deadline fired (or drain began) before admission: the
-                # request never enters the scheduler
+                # request never enters the scheduler (timeline closed
+                # here — no engine finish path will ever see it)
                 h.done = True
+                self.engine._lc(
+                    h.rid, _lc.EV_FINISH,
+                    reason=(h.cancel_reason.value if h.cancel_reason
+                            else FinishReason.TIMEOUT.value))
                 self._notify()
                 continue
             h.req = self.engine.add_request(
                 h.prompt_ids, sampling=h.sampling, request_id=h.rid,
                 priority=h.priority, trace_id=str(h.rid),
-                prefix_hashes=h.prefix_hashes)
+                prefix_hashes=h.prefix_hashes, slo_ms=h.slo_ms)
 
     def _drain_aborts(self) -> None:
         did = False
@@ -379,6 +427,8 @@ class EngineReplica:
                 h = self.handles.get(rid)
                 if h is not None and h.req is None:
                     h.done = True
+                    self.engine._lc(rid, _lc.EV_FINISH,
+                                    reason=reason.value)
                     did = True
         if did:
             self._notify()
@@ -447,11 +497,62 @@ class FleetRouter:
                 reg_seen.add(lbls)
         self.registry = (registry if registry is not None
                          else self.engines[0].metrics.registry)
+        # --- request-lifecycle tracing + flight recorder (ISSUE 8) ----------
+        # ONE tracker for the whole fleet: the router's routing events
+        # (caller thread) and each replica's execution events (engine
+        # thread) land in the same per-request timeline, keyed by rid —
+        # the router's duplicate-rid admission check guarantees
+        # uniqueness across replicas.  Replicas are rebound before any
+        # request exists, with their ring/ trigger identity pinned to
+        # the replica INDEX (metrics labels are free-form and need not
+        # match it).  The engines' lifecycle knobs must agree — the
+        # router's own events ride the same tracker, so a per-replica
+        # disagreement would silently half-apply (e.g. a gated-off
+        # engine never closing timelines the router opened).
+        gates = {e.engine_config.lifecycle_events for e in self.engines}
+        samples = {e.engine_config.decode_event_sample
+                   for e in self.engines}
+        if len(gates) != 1 or len(samples) != 1:
+            raise ValueError(
+                "replicas disagree on lifecycle config: "
+                f"lifecycle_events={sorted(gates)}, "
+                f"decode_event_sample={sorted(samples)} — the fleet "
+                "shares ONE tracker, so every replica must use the "
+                "same EngineConfig knobs")
+        gate = gates.pop()
+        explicit = [e.engine_config.lifecycle for e in self.engines]
+        if explicit[0] is not None and \
+                all(t is explicit[0] for t in explicit):
+            # every engine was built onto the SAME caller-supplied
+            # tracker: adopt it — but its enabled flag must match the
+            # engines' gate, or the router would open timelines (enabled
+            # tracker) that the gated-off engines never close
+            if explicit[0].enabled != gate:
+                raise ValueError(
+                    f"EngineConfig.lifecycle tracker has enabled="
+                    f"{explicit[0].enabled} but the engines set "
+                    f"lifecycle_events={gate}; the two must agree")
+            self.lifecycle = explicit[0]
+        else:
+            self.lifecycle = LifecycleTracker(
+                registry=self.registry, enabled=gate,
+                decode_sample=samples.pop())
+        for i, eng in enumerate(self.engines):
+            eng.set_lifecycle(self.lifecycle, replica=str(i))
+        if self.cfg.flight is not None:
+            self.flight = self.cfg.flight
+            self.flight.bind_lifecycle(self.lifecycle)
+        else:
+            self.flight = FlightRecorder(
+                registry=self.registry, lifecycle=self.lifecycle,
+                config=FlightConfig(dump_dir=self.cfg.flight_dir))
         self.replicas: List[EngineReplica] = [
             EngineReplica(i, eng, self.cfg.max_queue,
                           notify=self._notify, on_finish=self._release)
             for i, eng in enumerate(self.engines)
         ]
+        for r in self.replicas:
+            r.flight = self.flight
         self._owner: Dict[object, EngineReplica] = {}  # rid -> replica;
         # bounded by dp * max_queue (entries exist only while the request
         # is in flight on its replica) — evicted on finish/death
@@ -588,7 +689,15 @@ class FleetRouter:
             else self.cfg.drain_timeout_s)
         while self._owner and time.monotonic() < deadline:
             time.sleep(0.005)
-        for rid in list(self._owner):
+        stragglers = list(self._owner)
+        if stragglers:
+            # drain-deadline overrun (ISSUE 8): capture the stragglers'
+            # timelines BEFORE the aborts end them
+            self.flight.trigger(
+                "drain_overrun",
+                detail=f"{len(stragglers)} request(s) still in flight "
+                       f"at the drain deadline")
+        for rid in stragglers:
             self.abort(rid, FinishReason.TIMEOUT)
         self.stop()
 
@@ -661,6 +770,14 @@ class FleetRouter:
             eligible = [r for r in self.replicas if r.alive]
             if not eligible:
                 raise FleetDown("no live engine replica")
+            # the timeline starts HERE, on the router/caller thread: a
+            # per-request trace shows routing before any engine thread
+            # touches the request.  Terminal rejects below finish the
+            # timeline (into the bounded recent ring) so nothing leaks.
+            self.lifecycle.event(
+                handle.rid, _lc.EV_SUBMITTED, trace_id=str(handle.rid),
+                prompt_tokens=len(handle.prompt_ids),
+                slo_ms=handle.slo_ms)
             hashes = self.affinity_key(handle.prompt_ids)
             handle.prefix_hashes = hashes
             target = None
@@ -681,32 +798,43 @@ class FleetRouter:
                 handle.replica = r
                 self._owner[handle.rid] = r
                 if r.try_submit(handle):
-                    if target is not None and r is target:
+                    affinity = target is not None and r is target
+                    if affinity:
                         self._affinity_hit.inc()
                     else:
                         self._fallback.inc()
                     self._g_in_flight.set(len(self._owner))
+                    self.lifecycle.event(
+                        handle.rid, _lc.EV_ROUTE, replica=str(r.index),
+                        affinity=affinity,
+                        keyed=hashes is not None,
+                        in_flight=r.in_flight)
                     return r
                 self._owner.pop(handle.rid, None)
                 handle.replica = None
         if not any(r.alive for r in self.replicas):
             # every refusal was a death race, not a cap: report the
             # fleet as down (HTTP 503), not saturated (429)
+            self.lifecycle.event(handle.rid, _lc.EV_ADMISSION_REJECTED,
+                                 reason="fleet_down")
             raise FleetDown("no live engine replica")
+        self.lifecycle.event(handle.rid, _lc.EV_ADMISSION_REJECTED,
+                             reason="saturated")
         raise FleetSaturated(
             f"all {len(eligible)} eligible replica(s) at their "
             f"{self.cfg.max_queue}-request admission cap")
 
     def submit_request(self, prompt_ids,
                        sampling: Optional[SamplingParams] = None,
-                       request_id=None, priority: int = 0) -> SubmitHandle:
+                       request_id=None, priority: int = 0,
+                       slo_ms: Optional[float] = None) -> SubmitHandle:
         """Convenience for direct (non-HTTP) callers: build a handle,
         route it, return it.  Poll ``handle.finished`` /
         ``handle.output_tokens`` (or use :meth:`wait`)."""
         rid = request_id if request_id is not None else \
             f"fleet-{next(self._ids)}"
         handle = SubmitHandle(rid, list(prompt_ids), sampling=sampling,
-                              priority=priority)
+                              priority=priority, slo_ms=slo_ms)
         self.submit(handle)
         return handle
 

@@ -65,15 +65,48 @@ _COUNTER_NAMES = (
     "prefix_cache_evictions",     # cached blocks clobbered for allocation
     "prefill_tokens_computed",    # tokens the prefill programs actually ran
     "chunked_prefill_steps",      # chunk-program launches (vs one-shot)
+    # SLO goodput pair (ISSUE 8): slo counts every finished request that
+    # carried a per-request slo_ms; slo_good the subset that met it
+    "slo",
+    "slo_good",
 )
 
 _GAUGE_NAMES = ("queue_depth", "num_running", "kv_pool_occupancy",
                 "prefix_cached_token_ratio", "mp_shards")
 
+# pre-registered so every latency surface shows on /metrics from the
+# first scrape.  The last four are the per-request SLO breakdown
+# (ISSUE 8) derived from the lifecycle timestamps: arrival → first
+# prefill chunk (queue_wait) → first token (prefill) → finish (e2e),
+# with decode_itl the per-token gap (observed alongside the legacy
+# inter_token_latency series).
+_HISTOGRAM_NAMES = (
+    "time_to_first_token",
+    "inter_token_latency",
+    "prefill_step",
+    "decode_step",
+    "queue_wait",
+    "prefill",
+    "decode_itl",
+    "e2e",
+)
+
+# the SLO breakdown quartet, in pipeline order (bench.py embeds these)
+SLO_PHASES = ("queue_wait", "prefill", "decode_itl", "e2e")
+
 # mesh-spanning step phases (ISSUE 5): pre-registered so the
 # serving_collective_seconds series shows on /metrics even before (or
 # without) any multi-chip step running
 _COLLECTIVE_PHASES = ("prefill", "decode")
+
+# every full metric name this module pre-registers, for the README
+# metrics-table lint (tools/check_metrics_docs.py)
+METRIC_NAMES = tuple(
+    [f"serving_{n}_total" for n in _COUNTER_NAMES]
+    + [f"serving_{n}" for n in _GAUGE_NAMES]
+    + [f"serving_{n}_seconds" for n in _HISTOGRAM_NAMES]
+    + ["serving_collective_seconds"]
+)
 
 
 class ServingMetrics:
@@ -95,6 +128,8 @@ class ServingMetrics:
         for name in _COUNTER_NAMES:
             self._counter(name)
         self._hists: Dict[str, Histogram] = {}
+        for name in _HISTOGRAM_NAMES:
+            self._hist(name)
         # recent per-step gauge samples (bounded window) for inspection;
         # exact full-history aggregates live on the registry Gauges
         self.queue_depth: Deque[int] = deque(maxlen=GAUGE_WINDOW)
@@ -145,7 +180,55 @@ class ServingMetrics:
         self.observe("time_to_first_token", seconds)
 
     def observe_inter_token(self, seconds: float) -> None:
+        # decode_itl is the SLO-breakdown name for the same measurement
+        # (ISSUE 8); the legacy inter_token_latency series is preserved
         self.observe("inter_token_latency", seconds)
+        self.observe("decode_itl", seconds)
+
+    def observe_queue_wait(self, seconds: float) -> None:
+        """Arrival → first prefill chunk (observed once per request, at
+        the moment its first prefill program launches)."""
+        self.observe("queue_wait", seconds)
+
+    def observe_prefill_phase(self, seconds: float) -> None:
+        """First prefill chunk → first emitted token (the whole prefill
+        phase, chunks and recomputes included — distinct from the
+        per-program ``prefill_step`` wall time)."""
+        self.observe("prefill", seconds)
+
+    def observe_finish(self, e2e_seconds: float,
+                       slo_ms: Optional[float] = None) -> None:
+        """End-to-end latency + the SLO goodput pair: every finished
+        request that carried an ``slo_ms`` counts toward
+        ``serving_slo_total``; the ones that met it toward
+        ``serving_slo_good_total`` (goodput = good/total)."""
+        self.observe("e2e", e2e_seconds)
+        if slo_ms is not None:
+            self.count("slo")
+            if e2e_seconds * 1e3 <= slo_ms:
+                self.count("slo_good")
+
+    def slo_breakdown(self) -> Dict[str, Dict]:
+        """JSON-able per-phase latency breakdown (the shape ``bench.py``
+        embeds per phase): count/avg/p50/p95/p99 for each SLO phase plus
+        the goodput pair."""
+        out: Dict[str, Dict] = {}
+        for name in SLO_PHASES:
+            h = self._hist(name)
+            out[name] = {
+                "count": h.count,
+                "avg_s": round(h.avg, 6) if h.count else None,
+                "p50_s": _round6(h.quantile(0.50)),
+                "p95_s": _round6(h.quantile(0.95)),
+                "p99_s": _round6(h.quantile(0.99)),
+            }
+        total = self._counter("slo").value
+        good = self._counter("slo_good").value
+        out["goodput"] = {
+            "slo_total": int(total), "slo_good": int(good),
+            "ratio": round(good / total, 4) if total else None,
+        }
+        return out
 
     def observe_collective(self, phase: str, seconds: float) -> None:
         """One mesh-spanning jitted step's wall time (ISSUE 5):
@@ -247,6 +330,28 @@ class ServingMetrics:
         lines.append(bar)
         parts.append("\n".join(lines))
 
+        header = (f"{'SLO phase':16s} {'Count':>8s} {'Avg(ms)':>10s} "
+                  f"{'p50(ms)':>10s} {'p95(ms)':>10s} {'p99(ms)':>10s}")
+        bar = "-" * len(header)
+        lines = [bar, "SLO breakdown (bucket-quantile estimates)", bar,
+                 header, bar]
+        for name in SLO_PHASES:
+            h = self._hist(name)
+            cells = [(f"{q * 1e3:10.3f}" if q is not None else
+                      f"{'-':>10s}")
+                     for q in (h.avg if h.count else None,
+                               h.quantile(0.50), h.quantile(0.95),
+                               h.quantile(0.99))]
+            lines.append(f"{name:16s} {h.count:8d} " + " ".join(cells))
+        total = self._counter("slo").value
+        good = self._counter("slo_good").value
+        lines.append(bar)
+        lines.append(f"goodput: {int(good)}/{int(total)} requests met "
+                     "their slo_ms" if total else
+                     "goodput: no request carried an slo_ms")
+        lines.append(bar)
+        parts.append("\n".join(lines))
+
         header = (f"{'Gauge':24s} {'Samples':>8s} {'Avg':>10s} "
                   f"{'Max':>10s} {'Min':>10s}")
         bar = "-" * len(header)
@@ -265,6 +370,10 @@ class ServingMetrics:
         report = "\n\n".join(parts)
         print(report)
         return report
+
+
+def _round6(v: Optional[float]) -> Optional[float]:
+    return None if v is None else round(v, 6)
 
 
 class StepTimer:

@@ -59,6 +59,9 @@ class CompletionRequest:
     stream: bool = False
     timeout: Optional[float] = None   # seconds; server clamps to its max
     priority: int = 0
+    slo_ms: Optional[float] = None    # per-request latency objective:
+                                      # scored into the serving_slo_*
+                                      # goodput pair on finish
 
     def sampling(self) -> SamplingParams:
         return SamplingParams(
@@ -135,6 +138,10 @@ def parse_completion_request(
     seed = _typed(obj, "seed", int, 0)
     if seed < 0:
         raise ProtocolError("'seed' must be >= 0")  # np rng requirement
+    slo_ms = _typed(obj, "slo_ms", (int, float), None, none_ok=True)
+    if slo_ms is not None and (not math.isfinite(float(slo_ms))
+                               or float(slo_ms) <= 0):
+        raise ProtocolError("'slo_ms' must be finite and > 0 milliseconds")
 
     return CompletionRequest(
         prompt_ids=[int(t) for t in prompt],
@@ -146,6 +153,7 @@ def parse_completion_request(
         stream=_typed(obj, "stream", bool, False),
         timeout=None if timeout is None else float(timeout),
         priority=_typed(obj, "priority", int, 0),
+        slo_ms=None if slo_ms is None else float(slo_ms),
     )
 
 

@@ -100,8 +100,14 @@ class Request:
     # admission probe (kv.match_prefix) does not re-hash those blocks.
     # None = the probe hashes everything itself (single-engine path).
     prefix_hashes: Optional[List[bytes]] = None
+    # per-request latency objective (ISSUE 8): when set, the engine
+    # scores the finished request against it — serving_slo_total /
+    # serving_slo_good_total are the fleet's goodput pair.  None = the
+    # request carries no objective and is not scored.
+    slo_ms: Optional[float] = None
     # engine-stamped timing (perf_counter seconds)
     arrival_time: float = 0.0
+    prefill_start_time: Optional[float] = None  # first prefill chunk ran
     first_token_time: Optional[float] = None
     finish_time: Optional[float] = None
 

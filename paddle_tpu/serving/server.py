@@ -108,7 +108,15 @@ from .protocol import (
 from .request import FinishReason
 
 _MAX_HEADER_BYTES = 16384
-_ROUTES = ("/v1/completions", "/healthz", "/readyz", "/metrics")
+_ROUTES = ("/v1/completions", "/v1/requests", "/healthz", "/readyz",
+           "/metrics")
+
+# pre-registered metric names this module owns (tools/check_metrics_docs
+# lints that each appears in README's metrics table)
+METRIC_NAMES = (
+    "serving_admission_rejected_total",
+    "serving_http_requests_total",
+)
 
 
 @dataclass
@@ -141,7 +149,8 @@ class _Handle(SubmitHandle):
     def __init__(self, rid: str, creq: CompletionRequest,
                  event: asyncio.Event):
         super().__init__(rid, creq.prompt_ids, sampling=creq.sampling(),
-                         priority=creq.priority, event=event)
+                         priority=creq.priority, event=event,
+                         slo_ms=creq.slo_ms)
         self.creq = creq
 
 
@@ -239,7 +248,16 @@ class CompletionServer:
             else self.cfg.drain_timeout_s)
         while self._handles and time.monotonic() < deadline:
             await asyncio.sleep(0.01)
-        for h in list(self._handles.values()):
+        stragglers = list(self._handles.values())
+        if stragglers:
+            # drain-deadline overrun: post-mortem bundle BEFORE the
+            # aborts end the stragglers' timelines (flight recorder,
+            # ISSUE 8)
+            self.fleet.flight.trigger(
+                "drain_overrun",
+                detail=f"{len(stragglers)} request(s) still in flight "
+                       f"at the HTTP drain deadline")
+        for h in stragglers:
             self._request_abort(h, FinishReason.TIMEOUT)
         # handlers still need loop time to flush their (aborted) responses
         flush_deadline = time.monotonic() + 5.0
@@ -343,8 +361,7 @@ class CompletionServer:
                     body = await asyncio.wait_for(
                         reader.readexactly(clen), timeout=30.0)
                 keep_alive = await self._dispatch(
-                    method, target.split("?", 1)[0], body, writer,
-                    keep_alive)
+                    method, target, body, writer, keep_alive)
                 if not keep_alive:
                     return
         except (ConnectionError, asyncio.TimeoutError,
@@ -357,6 +374,8 @@ class CompletionServer:
                 pass
 
     def _count_http(self, route: str, status: int) -> None:
+        if route.startswith("/v1/requests"):
+            route = "/v1/requests"  # one series for all request ids
         route = route if route in _ROUTES else "other"
         self.registry.counter(
             "serving_http_requests_total", "HTTP requests served",
@@ -384,11 +403,12 @@ class CompletionServer:
         writer.write(body)
         await writer.drain()
 
-    async def _dispatch(self, method: str, path: str, body: bytes,
+    async def _dispatch(self, method: str, target: str, body: bytes,
                         writer: asyncio.StreamWriter,
                         keep_alive: bool = False) -> bool:
         """Route one request; returns whether the connection stays open
         (an SSE stream always closes — its framing is delimited by EOF)."""
+        path, _, query = target.partition("?")
         with self.tracer.span("http_request", cat="serving",
                               method=method, path=path) as sp:
             if path == "/healthz":
@@ -425,6 +445,15 @@ class CompletionServer:
                 else:
                     status, keep_alive = await self._handle_completion(
                         body, writer, keep_alive)
+            elif path == "/v1/requests" or path.startswith("/v1/requests/"):
+                if method != "GET":
+                    status = 405
+                    await self._respond(writer, status, error_body(
+                        "use GET", "method_not_allowed"),
+                        keep_alive=keep_alive)
+                else:
+                    status = await self._handle_requests_debug(
+                        path, query, writer, keep_alive)
             else:
                 status = 404
                 await self._respond(writer, status, error_body(
@@ -433,6 +462,50 @@ class CompletionServer:
             sp.set_attribute("status", status)
         self._count_http(path, status)
         return keep_alive
+
+    # --- request-lifecycle debug routes (ISSUE 8) ---------------------------
+    async def _handle_requests_debug(self, path: str, query: str,
+                                     writer: asyncio.StreamWriter,
+                                     keep_alive: bool) -> int:
+        """``GET /v1/requests?state=active|recent`` (timeline summaries)
+        and ``GET /v1/requests/{id}[?format=chrome]`` (one request's full
+        timeline, or its per-request Chrome trace)."""
+        import urllib.parse
+
+        params = urllib.parse.parse_qs(query)
+        lc = self.fleet.lifecycle
+        if path == "/v1/requests":
+            state = params.get("state", ["active"])[0]
+            if state not in ("active", "recent"):
+                await self._respond(writer, 400, error_body(
+                    "state must be 'active' or 'recent'"),
+                    keep_alive=keep_alive)
+                return 400
+            await self._respond(
+                writer, 200,
+                {"object": "list", "state": state,
+                 "data": lc.summaries(state)},
+                keep_alive=keep_alive)
+            return 200
+        rid = urllib.parse.unquote(path[len("/v1/requests/"):])
+        tl = lc.get(rid)
+        if tl is None:
+            await self._respond(writer, 404, error_body(
+                f"no timeline for request {rid!r} (it may have aged out "
+                "of the recent ring)", "not_found"),
+                keep_alive=keep_alive)
+            return 404
+        if params.get("format", [None])[0] == "chrome":
+            # build from the timeline already in hand — a second lookup
+            # could miss (the recent ring is bounded) and return None
+            from ..observability.export import chrome_trace_dict
+
+            payload = chrome_trace_dict(tl.chrome_spans(),
+                                        epoch_offset=lc.epoch_offset)
+        else:
+            payload = dict(tl.to_dict(lc.epoch_offset), object="request")
+        await self._respond(writer, 200, payload, keep_alive=keep_alive)
+        return 200
 
     # --- the completions route ----------------------------------------------
     async def _handle_completion(self, body: bytes,
@@ -465,6 +538,7 @@ class CompletionServer:
         # outlive the engine's interest in a request
         if len(self._handles) >= self.cfg.max_queue * self.fleet.dp:
             self._rejected.inc()
+            self.fleet.flight.note_rejection()
             await self._respond(
                 writer, 429,
                 error_body("admission queue is full; retry later",
@@ -481,6 +555,7 @@ class CompletionServer:
             self.fleet.submit(handle)
         except FleetSaturated:
             self._rejected.inc()
+            self.fleet.flight.note_rejection()
             await self._respond(
                 writer, 429,
                 error_body("admission queue is full; retry later",
@@ -563,7 +638,8 @@ class CompletionServer:
         await self._respond(writer, 200, completion_body(
             handle.rid, self.cfg.model_name, tokens, reason,
             len(handle.creq.prompt_ids),
-            error=getattr(req, "error", None)), keep_alive=keep_alive)
+            error=getattr(req, "error", None)),
+            extra=(("X-Request-Id", handle.rid),), keep_alive=keep_alive)
         return 200
 
     async def _stream_response(self, handle: _Handle,
@@ -572,7 +648,13 @@ class CompletionServer:
         writer.write(b"HTTP/1.1 200 OK\r\n"
                      b"Content-Type: text/event-stream\r\n"
                      b"Cache-Control: no-store\r\n"
-                     b"Connection: close\r\n\r\n")
+                     + f"X-Request-Id: {handle.rid}\r\n".encode("latin-1")
+                     + b"Connection: close\r\n\r\n")
+        # id-bearing FIRST chunk, before any token exists: an SSE client
+        # learns the request id immediately (for /v1/requests/{id} or an
+        # out-of-band abort) instead of only once the first token lands
+        writer.write(sse_event(chunk_body(
+            handle.rid, self.cfg.model_name, [], None)))
         await writer.drain()
 
         async def on_tokens(new: List[int]) -> None:
@@ -603,7 +685,8 @@ def _toy_engine(layers: int = 2, num_blocks: int = 64,
 
 
 def _toy_fleet(dp: int = 1, layers: int = 2, num_blocks: int = 64,
-               max_queue: int = 64) -> FleetRouter:
+               max_queue: int = 64,
+               flight_dir: Optional[str] = None) -> FleetRouter:
     """A dp-replica fleet of toy engines on one shared registry: each
     replica gets its OWN model instance (engine threads swap parameter
     values during the traced step — modules must not be shared) with
@@ -613,7 +696,8 @@ def _toy_fleet(dp: int = 1, layers: int = 2, num_blocks: int = 64,
         lambda i, registry: _toy_engine(
             layers=layers, num_blocks=num_blocks, registry=registry,
             metrics_labels={"replica": str(i)}),
-        dp=dp, config=FleetConfig(max_queue=max_queue))
+        dp=dp, config=FleetConfig(max_queue=max_queue,
+                                  flight_dir=flight_dir))
 
 
 def _http(port: int, method: str, path: str, body: Optional[dict] = None):
@@ -654,10 +738,21 @@ async def _selftest_async(dp: int = 1) -> int:
         choice = obj["choices"][0]
         assert len(choice["token_ids"]) == 4, choice
         assert choice["finish_reason"] == "length", choice
+        # lifecycle debug surface (ISSUE 8): the completion's timeline is
+        # queryable after it finished
+        status, data = await loop.run_in_executor(
+            None, _http, server.port, "GET", "/v1/requests?state=recent",
+            None)
+        assert status == 200, f"/v1/requests {status}"
+        rows = json.loads(data)["data"]
+        assert any(row["id"] == obj["id"] for row in rows), \
+            f"finished completion missing from /v1/requests: {rows}"
         status, data = await loop.run_in_executor(
             None, _http, server.port, "GET", "/metrics", None)
         assert status == 200 and b"serving_time_to_first_token" in data, \
             "metrics page missing serving histograms"
+        assert b"serving_e2e_seconds" in data, \
+            "metrics page missing the SLO breakdown histograms"
         assert b"serving_mp_shards" in data, \
             "metrics page missing the mp-shards gauge"
         # the probe went through the router: fleet series must exist and
@@ -675,11 +770,18 @@ async def _selftest_async(dp: int = 1) -> int:
 
 async def _serve_cli(args) -> int:
     fleet = _toy_fleet(dp=args.dp, layers=args.layers,
-                       num_blocks=args.blocks, max_queue=args.max_queue)
+                       num_blocks=args.blocks, max_queue=args.max_queue,
+                       flight_dir=args.flight_dir)
     server = CompletionServer(fleet, ServerConfig(
         host=args.host, port=args.port,
         max_queue=args.max_queue,
         default_timeout_s=args.timeout))
+    pusher = None
+    if args.push_gateway:
+        from ..observability.push import PushGateway
+
+        pusher = PushGateway(args.push_gateway, registry=fleet.registry,
+                             interval_s=args.push_interval).start()
     await server.start()
     loop = asyncio.get_running_loop()
     try:
@@ -691,8 +793,13 @@ async def _serve_cli(args) -> int:
         pass
     print(f"serving on http://{server.cfg.host}:{server.port} "
           f"dp={fleet.dp} mp={server.engine.mp} "
-          "(POST /v1/completions; GET /healthz /readyz /metrics)")
-    await server.serve_forever()
+          "(POST /v1/completions; GET /healthz /readyz /metrics "
+          "/v1/requests)")
+    try:
+        await server.serve_forever()
+    finally:
+        if pusher is not None:
+            pusher.close()
     return 0
 
 
@@ -727,6 +834,16 @@ def main(argv=None) -> int:
                         "behind the prefix-affinity router (composes "
                         "with --mp: '--dp 2 --mp 2' is a dp×mp fleet of "
                         "2 replicas, each mesh-spanning 2 shards)")
+    p.add_argument("--push-gateway", default=None, metavar="URL",
+                   help="POST Prometheus text exposition of the fleet "
+                        "registry to this URL on an interval (daemon "
+                        "thread, capped exponential backoff on failure)")
+    p.add_argument("--push-interval", type=float, default=15.0,
+                   help="push-gateway export interval in seconds")
+    p.add_argument("--flight-dir", default=None, metavar="DIR",
+                   help="write flight-recorder post-mortem bundles "
+                        "(engine death, preemption storms, 429 bursts, "
+                        "drain overruns) into this directory")
     p.add_argument("--selftest", action="store_true",
                    help="boot on an ephemeral port, serve one completion "
                         "against the toy fleet through the router path, "

@@ -465,6 +465,22 @@ def inner(platform: str) -> None:
     print(json.dumps(final))  # last JSON line = headline for the outer
 
 
+def _step_profile_report(eng) -> dict:
+    """Per-phase bucket-utilization / padding-waste report (ISSUE 9),
+    asserted before it is embedded: the padding ratio must be computed
+    (programs ran) and the StepProfiler's scheduled-token sum must
+    exactly equal the scheduler's planned-work ledger — the invariant
+    that makes the padding numbers trustworthy."""
+    rep = eng.stepprof.utilization_report()
+    assert rep["padding_ratio"] is not None, \
+        "no step programs recorded — padding ratio not computed"
+    planned = eng.scheduler.tokens_planned
+    assert rep["scheduled_tokens"] == planned, (
+        f"scheduled-token invariant broken: profiler saw "
+        f"{rep['scheduled_tokens']}, scheduler planned {planned}")
+    return rep
+
+
 def serving_bench() -> dict:
     """Serving phase (ISSUE 4): a shared-prefix workload through the
     continuous-batching engine with the prefix cache ON vs OFF — both
@@ -529,6 +545,9 @@ def serving_bench() -> dict:
             # per-phase SLO breakdown (ISSUE 8): queue_wait / prefill /
             # decode_itl / e2e quantiles + the goodput pair
             "slo": eng.metrics.slo_breakdown(),
+            # per-phase bucket-utilization report (ISSUE 9): padding
+            # ratio + scheduled-token invariant asserted inside
+            "step_profile": _step_profile_report(eng),
             # full registry snapshot: serving_* TTFT/ITL histograms ride
             # in the phase record like the train phases embed theirs
             "metrics": eng.metrics.snapshot(),
@@ -606,6 +625,7 @@ def serving_mp_bench() -> dict:
                 "prefill_buckets": len(eng.prefill_buckets),
                 "decode_buckets": len(eng.decode_buckets),
                 "slo": eng.metrics.slo_breakdown(),  # ISSUE 8 breakdown
+                "step_profile": _step_profile_report(eng),  # ISSUE 9
                 "metrics": eng.metrics.snapshot(),
                 "outputs": [list(r.output_tokens) for r in reqs],
             }
@@ -734,6 +754,9 @@ def serving_fleet_bench() -> dict:
                     # serving_* series split the fleet's goodput per
                     # replica
                     "slo": r.engine.metrics.slo_breakdown(),
+                    # per-replica bucket-utilization report (ISSUE 9) —
+                    # the scheduled-token invariant holds replica-wise
+                    "step_profile": _step_profile_report(r.engine),
                 })
             fleet.sample_gauges()
             return {

@@ -33,6 +33,13 @@ bounded per-replica rings and dumps atomic post-mortem bundles on
 anomaly triggers (engine death, watchdog, preemption storms, 429
 bursts, drain overruns).
 
+The step/compiler layer (ISSUE 9): :class:`StepProfiler`
+(``stepprof.py``) accounts bucket utilization and padding waste per
+bucketed program launch, attributes trace+compile wall time per
+(program, bucket), and arms bounded on-demand capture windows —
+N annotated engine-step spans as a chrome trace, wrapped in
+``jax.profiler`` start/stop on real devices.
+
 Process-wide defaults: :func:`get_tracer` / :func:`get_registry` return
 one shared instance each, so spans from the serving engine, jit compile
 events and watchdog timeouts land in one trace, and compile counters /
@@ -72,6 +79,11 @@ from .metrics import (  # noqa: F401
 from .push import (  # noqa: F401
     PushGateway,
     start_push_gateway,
+)
+from .stepprof import (  # noqa: F401
+    CaptureBusy,
+    CaptureWindow,
+    StepProfiler,
 )
 from .tracer import (  # noqa: F401
     Span,

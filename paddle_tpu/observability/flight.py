@@ -93,6 +93,11 @@ class FlightRecorder:
         self._bundles: List[str] = []  # unbounded-ok: capped at cfg.max_bundles by trigger()
         self._seq = 0
         self._remove_listener = None
+        # replica -> StepProfiler (ISSUE 9): bundles embed the owning
+        # replica's last-K per-step records, so a post-mortem shows what
+        # the engine was computing (program/bucket/utilization) when it
+        # died.  Bounded by the fleet's replica set.
+        self._stepprofs: Dict[str, object] = {}
         self._dumps = {
             t: (registry.counter(
                 "serving_flight_dumps_total",
@@ -102,6 +107,12 @@ class FlightRecorder:
         }
         if lifecycle is not None:
             self._remove_listener = lifecycle.add_listener(self._on_event)
+
+    def bind_step_profilers(self, profilers: Dict[str, object]) -> None:
+        """Register per-replica step profilers (``{replica_index_str:
+        StepProfiler}``) — the fleet router calls this at build so
+        post-mortem bundles carry each replica's recent step records."""
+        self._stepprofs = dict(profilers)
 
     def bind_lifecycle(self, lifecycle: LifecycleTracker) -> None:
         """(Re)subscribe this recorder to a tracker — the fleet router
@@ -257,6 +268,16 @@ class FlightRecorder:
         threads = {}
         for tid, frame in sys._current_frames().items():
             threads[str(tid)] = "".join(traceback.format_stack(frame))
+        # last-K step records of the affected replica (all replicas for
+        # fleet-wide triggers): what the engine was computing when the
+        # anomaly fired, with program/bucket/utilization per step
+        step_profile = {}
+        for rep, sp in self._stepprofs.items():
+            if replica is not None and str(replica) != rep:
+                continue
+            recs = sp.records()
+            if recs:
+                step_profile[rep] = recs
         return {
             "bundle": "paddle_tpu.flight",
             "trigger": trigger,
@@ -265,6 +286,7 @@ class FlightRecorder:
             "time_unix": round(time.time(), 6),
             "events": events,
             "in_flight_requests": requests,
+            "step_profile": step_profile,
             "metrics": (self.registry.snapshot()
                         if self.registry is not None else {}),
             "threads": threads,

@@ -60,6 +60,7 @@ from ..core.tensor import Tensor
 from ..distributed import topology
 from ..observability import lifecycle as _lc
 from ..observability.lifecycle import LifecycleTracker
+from ..observability.stepprof import StepProfiler
 from ..ops.paged_attention import (
     KV_POOL_SPEC,
     PagedCache,
@@ -116,6 +117,13 @@ class EngineConfig:
     # tokens also skip the flight-ring fan-out, so this knob bounds the
     # per-token cost on the decode hot path); 0 = none
     decode_event_sample: int = 8
+    # Step-level performance introspection (ISSUE 9): per-program/bucket
+    # utilization + padding-waste metrics, compile-time attribution, and
+    # on-demand capture windows (StepProfiler).  Default on — O(1)
+    # aggregates per program launch, spans only while a capture window
+    # is armed; False keeps /metrics free of every serving_step_* /
+    # serving_compile_* / serving_padding_* series.
+    step_profile: bool = True
 
 
 class EngineCore:
@@ -166,6 +174,15 @@ class EngineCore:
         self.metrics = ServingMetrics(registry=registry,
                                       labels=metrics_labels)
         self.tracer = self.metrics.tracer
+        # --- step-level introspection (ISSUE 9) ----------------------------
+        # bucket-utilization/padding accounting + compile attribution +
+        # capture windows, on the same registry (replica-labeled under a
+        # fleet); disabled = the registry never sees a serving_step_*
+        # series and every hook below is a cheap early-return
+        self.stepprof = StepProfiler(registry=self.metrics.registry,
+                                     labels=metrics_labels,
+                                     enabled=config.step_profile)
+        self.metrics.attach_step_profiler(self.stepprof)
         # --- request-lifecycle tracing (ISSUE 8) ---------------------------
         # the fleet router rebinds all replicas onto ONE tracker via
         # set_lifecycle() so router + engine events share a timeline
@@ -533,16 +550,24 @@ class EngineCore:
             blocks[:target] = [table[p // self.block_size] for p in pos]
             offs = (np.arange(Tb) % self.block_size).astype(np.int32)
             self.prefill_buckets.add(("prefill", Tb))
+            traces0 = self.prefill_trace_count
             with self.tracer.span("prefill_step", cat="serving",
                                   request=str(rid), trace=req.trace_id,
                                   tokens=target, bucket=Tb,
                                   recompute=bool(req.output_tokens)):
                 with StepTimer(self.metrics, "prefill_step",
-                               self._collective_phase("prefill")):
+                               self._collective_phase("prefill")) as st:
                     last, self._k_pools, self._v_pools = self._jit_prefill(
                         self._param_vals(), self._k_pools, self._v_pools,
                         ids_arr, np.int32(target - 1), blocks, offs)
                     logits = np.asarray(last, np.float32)
+            if self.prefill_trace_count > traces0:
+                # the in-trace counter advanced during THIS launch, so
+                # its wall time is the trace+compile of this bucket
+                self.stepprof.record_compile("prefill", (Tb,), st.dt)
+            self.stepprof.record_program(
+                "prefill", (Tb,), scheduled=n, capacity=Tb, wall_s=st.dt,
+                request=str(rid))
         else:
             # chunk / resume: the chunk scatters into its pages and
             # attends over the paged prefix, so earlier chunks and
@@ -561,6 +586,7 @@ class EngineCore:
             lens = np.array([start + n], np.int32)
             self.prefill_buckets.add(("chunk", Wb, TWb))
             self.metrics.count("chunked_prefill_steps")
+            traces0 = self.prefill_trace_count
             with self.tracer.span("prefill_step", cat="serving",
                                   request=str(rid), trace=req.trace_id,
                                   tokens=n, bucket=Wb, chunk=True,
@@ -568,13 +594,19 @@ class EngineCore:
                                   cached=req.num_cached_tokens,
                                   recompute=bool(req.output_tokens)):
                 with StepTimer(self.metrics, "prefill_step",
-                               self._collective_phase("prefill")):
+                               self._collective_phase("prefill")) as st:
                     last, self._k_pools, self._v_pools = \
                         self._jit_chunk_prefill(
                             self._param_vals(), self._k_pools,
                             self._v_pools, ids_arr, np.int32(start),
                             np.int32(n - 1), tables, lens, blocks, offs)
                     logits = np.asarray(last, np.float32)
+            if self.prefill_trace_count > traces0:
+                self.stepprof.record_compile("chunk", (Wb, TWb), st.dt)
+            self.stepprof.record_program(
+                "chunk", (Wb, TWb), scheduled=n, capacity=Wb,
+                wall_s=st.dt, request=str(rid), start=start,
+                table_width=len(table))
         self.kv.commit(rid, n)
         self._lc(rid, _lc.EV_PREFILL_CHUNK, start=start, tokens=n,
                  target=target, chunk=bool(start or n != target),
@@ -611,6 +643,7 @@ class EngineCore:
             lens[i] = p + 1               # cache length AFTER this token
             slot_blocks[i], slot_offsets[i] = r._slot
         self.decode_buckets.add(("decode", Bb, Wb))
+        traces0 = self.decode_trace_count
         with self.tracer.span("decode_step", cat="serving", batch=B,
                               batch_bucket=Bb, width_bucket=Wb,
                               requests=",".join(str(r.request_id)
@@ -618,11 +651,23 @@ class EngineCore:
                               traces=",".join(str(r.trace_id)
                                               for r in reqs)):
             with StepTimer(self.metrics, "decode_step",
-                           self._collective_phase("decode")):
+                           self._collective_phase("decode")) as st:
                 out, self._k_pools, self._v_pools = self._jit_decode(
                     self._param_vals(), self._k_pools, self._v_pools,
                     ids, poss, tables, lens, slot_blocks, slot_offsets)
                 out = np.asarray(out, np.float32)
+        if self.decode_trace_count > traces0:
+            self.stepprof.record_compile("decode", (Bb, Wb), st.dt)
+        # token/row accounting only: scheduled = B real rows (one token
+        # each) vs the Bb row bucket — this is the axis the scheduler's
+        # tokens_planned ledger counts, so the invariant stays exact.
+        # Width-bucket padding (tables padded `width` -> Wb with null
+        # pages) is NOT in these counters; it rides the record as the
+        # table_width attr next to the bucket shape.
+        self.stepprof.record_program(
+            "decode", (Bb, Wb), scheduled=B, capacity=Bb, wall_s=st.dt,
+            table_width=width,
+            requests=",".join(str(r.request_id) for r in reqs))
         result = {}
         for i, r in enumerate(reqs):
             self.kv.commit(r.request_id, 1)
@@ -636,6 +681,7 @@ class EngineCore:
         retire.  Returns {request_id: token} emitted this step."""
         remove_timer = (self.metrics.install_dispatch_timer()
                         if self._profile_ops else lambda: None)
+        self.stepprof.begin_step()
         try:
             with self.tracer.span("engine_step", cat="serving") as sp:
                 plan = self.scheduler.schedule()
@@ -704,6 +750,9 @@ class EngineCore:
                                  round(self.kv.occupancy(), 4))
             return emitted
         finally:
+            # runs on the death path too: the partial step record still
+            # reaches the last-K ring the flight bundle embeds
+            self.stepprof.end_step()
             remove_timer()
 
     def run(self, max_steps: Optional[int] = None) -> None:

@@ -519,6 +519,15 @@ class FleetRouter:
                 f"decode_event_sample={sorted(samples)} — the fleet "
                 "shares ONE tracker, so every replica must use the "
                 "same EngineConfig knobs")
+        sprof = {e.engine_config.step_profile for e in self.engines}
+        if len(sprof) != 1:
+            # same failure shape as the lifecycle gate: a half-profiled
+            # fleet would read as "replica i never retraced / never
+            # padded" on /v1/debug/compiles and in flight bundles
+            raise ValueError(
+                f"replicas disagree on step_profile={sorted(sprof)}; "
+                "the debug surfaces report fleet-wide, so every "
+                "replica must use the same EngineConfig knob")
         gate = gates.pop()
         explicit = [e.engine_config.lifecycle for e in self.engines]
         if explicit[0] is not None and \
@@ -546,6 +555,11 @@ class FleetRouter:
             self.flight = FlightRecorder(
                 registry=self.registry, lifecycle=self.lifecycle,
                 config=FlightConfig(dump_dir=self.cfg.flight_dir))
+        # per-replica step profilers (ISSUE 9): post-mortem bundles embed
+        # the owning replica's last-K step records, keyed by the same
+        # replica index the flight rings use
+        self.flight.bind_step_profilers(
+            {str(i): e.stepprof for i, e in enumerate(self.engines)})
         self.replicas: List[EngineReplica] = [
             EngineReplica(i, eng, self.cfg.max_queue,
                           notify=self._notify, on_finish=self._release)

@@ -151,6 +151,13 @@ class ServingMetrics:
             for phase in _COLLECTIVE_PHASES
         }
         self._host_ops: Optional[HostOpRecorder] = None
+        self._stepprof = None  # StepProfiler, attached by the engine
+
+    def attach_step_profiler(self, stepprof) -> None:
+        """Bind the engine's :class:`~paddle_tpu.observability.stepprof
+        .StepProfiler` so :meth:`summary` can render the per-program
+        bucket-utilization / padding-waste table (ISSUE 9)."""
+        self._stepprof = stepprof
 
     # --- recording ----------------------------------------------------------
     def _counter(self, name: str) -> Counter:
@@ -352,6 +359,36 @@ class ServingMetrics:
         lines.append(bar)
         parts.append("\n".join(lines))
 
+        prog_rows = (self._stepprof.program_table()
+                     if self._stepprof is not None
+                     and self._stepprof.enabled else [])
+        if prog_rows:
+            header = (f"{'Program/bucket':20s} {'Launches':>8s} "
+                      f"{'Sched':>8s} {'Capacity':>8s} {'Util':>7s} "
+                      f"{'Waste':>7s} {'Wall(ms)':>10s}")
+            bar = "-" * len(header)
+            lines = [bar, "Bucket utilization / padding waste "
+                          "(per step program)", bar, header, bar]
+            for row in prog_rows:
+                lines.append(
+                    f"{row['program'] + '/' + row['bucket']:20s} "
+                    f"{row['launches']:8d} "
+                    f"{row['scheduled_tokens']:8d} "
+                    f"{row['capacity_tokens']:8d} "
+                    f"{row['utilization']:7.3f} "
+                    f"{row['padding_ratio']:7.3f} "
+                    f"{row['wall_s'] * 1e3:10.3f}")
+            comp = self._stepprof.compile_totals()
+            lines.append(bar)
+            if comp:
+                lines.append("compile attribution: " + ", ".join(
+                    f"{p}: {t['count']}x {t['seconds'] * 1e3:.1f}ms"
+                    for p, t in sorted(comp.items())))
+            else:
+                lines.append("compile attribution: no traces observed")
+            lines.append(bar)
+            parts.append("\n".join(lines))
+
         header = (f"{'Gauge':24s} {'Samples':>8s} {'Avg':>10s} "
                   f"{'Max':>10s} {'Min':>10s}")
         bar = "-" * len(header)
@@ -389,13 +426,16 @@ class StepTimer:
         self.metrics = metrics
         self.name = name
         self.collective_phase = collective_phase
+        self.dt: Optional[float] = None  # wall seconds, set on exit —
+        # the engine reads it for the StepProfiler record so step-level
+        # introspection shares this ONE timing path
 
     def __enter__(self):
         self._t0 = time.perf_counter()
         return self
 
     def __exit__(self, *exc):
-        dt = time.perf_counter() - self._t0
+        dt = self.dt = time.perf_counter() - self._t0
         self.metrics.observe(self.name, dt)
         if self.collective_phase is not None:
             self.metrics.observe_collective(self.collective_phase, dt)

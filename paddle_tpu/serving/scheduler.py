@@ -119,6 +119,13 @@ class ContinuousBatchingScheduler:
         self.kv = kv
         self.waiting: Deque[Request] = deque()  # unbounded-ok: live work queue (admission drains it); not telemetry
         self.running: List[Request] = []
+        # exact planned-work ledger (ISSUE 9): every prefill token and
+        # decode row this scheduler ever put in a plan.  The engine
+        # executes plans verbatim, so the StepProfiler's scheduled-token
+        # sum must equal these — the bucket-utilization invariant tests
+        # and bench assert.
+        self.tokens_planned_prefill = 0
+        self.tokens_planned_decode = 0
 
     # --- queue ops ----------------------------------------------------------
     def add(self, req: Request) -> None:
@@ -313,4 +320,14 @@ class ContinuousBatchingScheduler:
         out = SchedulerOutput()
         self._reserve_decode_slots(out)
         self._plan_prefills(out)
+        self.tokens_planned_prefill += sum(
+            r._chunk_tokens or 0 for r in out.prefills)
+        self.tokens_planned_decode += len(out.decodes)
         return out
+
+    @property
+    def tokens_planned(self) -> int:
+        """Total tokens ever planned (prefill chunk tokens + one per
+        decode row) — the scheduler side of the scheduled-token
+        invariant."""
+        return self.tokens_planned_prefill + self.tokens_planned_decode

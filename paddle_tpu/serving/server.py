@@ -108,8 +108,8 @@ from .protocol import (
 from .request import FinishReason
 
 _MAX_HEADER_BYTES = 16384
-_ROUTES = ("/v1/completions", "/v1/requests", "/healthz", "/readyz",
-           "/metrics")
+_ROUTES = ("/v1/completions", "/v1/requests", "/v1/debug/compiles",
+           "/v1/debug/profile", "/healthz", "/readyz", "/metrics")
 
 # pre-registered metric names this module owns (tools/check_metrics_docs
 # lints that each appears in README's metrics table)
@@ -388,7 +388,8 @@ class CompletionServer:
         body = (json.dumps(payload).encode("utf-8") + b"\n"
                 if isinstance(payload, dict) else payload)
         reason = {200: "OK", 400: "Bad Request", 404: "Not Found",
-                  405: "Method Not Allowed", 411: "Length Required",
+                  405: "Method Not Allowed", 409: "Conflict",
+                  411: "Length Required",
                   413: "Payload Too Large",
                   429: "Too Many Requests", 431: "Headers Too Large",
                   500: "Internal Server Error",
@@ -445,15 +446,32 @@ class CompletionServer:
                 else:
                     status, keep_alive = await self._handle_completion(
                         body, writer, keep_alive)
-            elif path == "/v1/requests" or path.startswith("/v1/requests/"):
+            elif path == "/v1/requests" or path.startswith("/v1/requests/") \
+                    or path.startswith("/v1/debug/"):
                 if method != "GET":
                     status = 405
                     await self._respond(writer, status, error_body(
                         "use GET", "method_not_allowed"),
                         keep_alive=keep_alive)
                 else:
-                    status = await self._handle_requests_debug(
-                        path, query, writer, keep_alive)
+                    # debug surfaces answer JSON for every outcome —
+                    # unknown ids are 404 and malformed query params 400
+                    # (never a 500 or a dropped connection; satellite
+                    # bugfix, protocol-tested)
+                    try:
+                        if path.startswith("/v1/debug/"):
+                            status = await self._handle_debug(
+                                path, query, writer, keep_alive)
+                        else:
+                            status = await self._handle_requests_debug(
+                                path, query, writer, keep_alive)
+                    except (ConnectionError, asyncio.TimeoutError):
+                        raise
+                    except Exception as e:
+                        status = 500
+                        await self._respond(writer, status, error_body(
+                            f"debug handler failed: {e}", "internal_error"),
+                            keep_alive=keep_alive)
             else:
                 status = 404
                 await self._respond(writer, status, error_body(
@@ -488,6 +506,14 @@ class CompletionServer:
                 keep_alive=keep_alive)
             return 200
         rid = urllib.parse.unquote(path[len("/v1/requests/"):])
+        fmt = params.get("format", [None])[0]
+        if fmt not in (None, "json", "chrome"):
+            # invalid query param: a crisp JSON 400, not a silently
+            # ignored knob (satellite bugfix)
+            await self._respond(writer, 400, error_body(
+                f"format must be 'json' or 'chrome', got {fmt!r}"),
+                keep_alive=keep_alive)
+            return 400
         tl = lc.get(rid)
         if tl is None:
             await self._respond(writer, 404, error_body(
@@ -495,7 +521,7 @@ class CompletionServer:
                 "of the recent ring)", "not_found"),
                 keep_alive=keep_alive)
             return 404
-        if params.get("format", [None])[0] == "chrome":
+        if fmt == "chrome":
             # build from the timeline already in hand — a second lookup
             # could miss (the recent ring is bounded) and return None
             from ..observability.export import chrome_trace_dict
@@ -506,6 +532,127 @@ class CompletionServer:
             payload = dict(tl.to_dict(lc.epoch_offset), object="request")
         await self._respond(writer, 200, payload, keep_alive=keep_alive)
         return 200
+
+    # --- step-level introspection routes (ISSUE 9) --------------------------
+    def _debug_int(self, params, name: str, default: int,
+                   lo: int, hi: int) -> int:
+        """Parse an integer query param in [lo, hi]; raises ValueError
+        with an operator-readable message (mapped to a JSON 400)."""
+        raw = params.get(name, [None])[0]
+        if raw is None:
+            return default
+        try:
+            v = int(raw)
+        except ValueError:
+            raise ValueError(
+                f"{name} must be an integer, got {raw!r}") from None
+        if not lo <= v <= hi:
+            raise ValueError(f"{name} must be in [{lo}, {hi}], got {v}")
+        return v
+
+    async def _handle_debug(self, path: str, query: str,
+                            writer: asyncio.StreamWriter,
+                            keep_alive: bool) -> int:
+        """``GET /v1/debug/compiles`` — per-replica compile-time
+        attribution table (every observed trace+compile with its wall
+        seconds); ``GET /v1/debug/profile?steps=N[&replica=i]`` — arm a
+        bounded capture window on the replica's StepProfiler, wait for
+        the next N engine steps, answer the annotated Chrome trace."""
+        import urllib.parse
+
+        from ..observability.stepprof import CaptureBusy
+
+        params = urllib.parse.parse_qs(query)
+        if path == "/v1/debug/compiles":
+            data = []
+            totals: Dict[str, Dict] = {}
+            for r in self.fleet.replicas:
+                sp = r.engine.stepprof
+                for row in sp.compile_table():
+                    data.append(dict(row, replica=str(r.index)))
+                for prog, t in sp.compile_totals().items():
+                    agg = totals.setdefault(
+                        prog, {"seconds": 0.0, "count": 0})
+                    agg["seconds"] = round(agg["seconds"] + t["seconds"], 6)
+                    agg["count"] += t["count"]
+            await self._respond(
+                writer, 200,
+                {"object": "list", "data": data, "totals": totals,
+                 "step_profile": self.engine.stepprof.enabled},
+                keep_alive=keep_alive)
+            return 200
+        if path != "/v1/debug/profile":
+            await self._respond(writer, 404, error_body(
+                f"no route {path!r}", "not_found"),
+                keep_alive=keep_alive)
+            return 404
+        try:
+            timeout_s = self._debug_int(params, "timeout_s", 30, 1, 300)
+            replica = self._debug_int(params, "replica", 0,
+                                      0, 1 << 30)
+        except ValueError as e:
+            await self._respond(writer, 400, error_body(str(e)),
+                                keep_alive=keep_alive)
+            return 400
+        if replica >= self.fleet.dp:
+            # an unknown id is a 404, not a malformed request
+            await self._respond(writer, 404, error_body(
+                f"no replica {replica} (fleet has dp={self.fleet.dp})",
+                "not_found"), keep_alive=keep_alive)
+            return 404
+        sp = self.fleet.replicas[replica].engine.stepprof
+        try:
+            # bound against the TARGET profiler's own cap — one limit,
+            # owned by arm_capture, never duplicated here
+            steps = self._debug_int(params, "steps", 32, 1,
+                                    sp.max_capture_steps)
+            window = sp.arm_capture(steps)
+        except CaptureBusy as e:
+            await self._respond(writer, 409, error_body(
+                str(e), "conflict"), keep_alive=keep_alive)
+            return 409
+        except (RuntimeError, ValueError) as e:
+            # step_profile disabled, or a steps value the profiler's
+            # own validation refuses — either way a client error
+            await self._respond(writer, 400, error_body(str(e)),
+                                keep_alive=keep_alive)
+            return 400
+        try:
+            deadline = time.monotonic() + timeout_s
+            while not window.done.is_set() \
+                    and time.monotonic() < deadline:
+                await asyncio.sleep(0.01)
+            if not window.done.is_set():
+                # idle/slow engine: return what the window captured so
+                # far (``complete: false``) instead of hanging.  The
+                # finalize runs in an executor — a device stop_trace
+                # flushing its XPlane dump must not stall the event
+                # loop — and may lose to a concurrent engine-side
+                # finalize, so keep polling ``done`` afterwards: never
+                # read a half-built result
+                await self._loop.run_in_executor(
+                    None, sp.cancel_capture, window)
+                grace = time.monotonic() + 30.0
+                while not window.done.is_set() \
+                        and time.monotonic() < grace:
+                    await asyncio.sleep(0.01)
+            if window.result is None:
+                await self._respond(writer, 503, error_body(
+                    "capture window did not finalize in time",
+                    "unavailable_error"), keep_alive=keep_alive)
+                return 503
+            await self._respond(writer, 200, window.result,
+                                keep_alive=keep_alive)
+            return 200
+        finally:
+            # the handler task can die mid-wait (client disconnect,
+            # CancelledError on shutdown): an armed window left behind
+            # would 409 every future capture — and on device leave
+            # jax.profiler tracing.  No-op when already finalized; runs
+            # on its own thread so a slow device stop_trace never
+            # stalls the event loop (and cancellation can't skip it).
+            threading.Thread(target=sp.cancel_capture, args=(window,),
+                             daemon=True).start()
 
     # --- the completions route ----------------------------------------------
     async def _handle_completion(self, body: bytes,
@@ -794,7 +941,7 @@ async def _serve_cli(args) -> int:
     print(f"serving on http://{server.cfg.host}:{server.port} "
           f"dp={fleet.dp} mp={server.engine.mp} "
           "(POST /v1/completions; GET /healthz /readyz /metrics "
-          "/v1/requests)")
+          "/v1/requests /v1/debug/compiles /v1/debug/profile)")
     try:
         await server.serve_forever()
     finally:

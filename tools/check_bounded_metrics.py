@@ -60,6 +60,9 @@ SCAN_FILES = (
     os.path.join(_REPO, "paddle_tpu", "observability", "lifecycle.py"),
     os.path.join(_REPO, "paddle_tpu", "observability", "flight.py"),
     os.path.join(_REPO, "paddle_tpu", "observability", "push.py"),
+    # ISSUE 9: the step profiler's record ring, compile table and
+    # capture windows must stay bounded (deque maxlen= / explicit caps)
+    os.path.join(_REPO, "paddle_tpu", "observability", "stepprof.py"),
     os.path.join(_REPO, "paddle_tpu", "ops", "paged_attention.py"),
     os.path.join(_REPO, "paddle_tpu", "ops", "pallas_paged.py"),
     os.path.join(_REPO, "paddle_tpu", "parallel", "mp_layers.py"),

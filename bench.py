@@ -812,9 +812,99 @@ def serving_fleet_bench() -> dict:
     return result
 
 
+def serving_audit_bench() -> dict:
+    """Numerics-audit phase (ISSUE 10): the preempting shared-prefix
+    stream through the engine with online auditing OFF vs ON at
+    ``sample_every=1`` — every step's decode shadow-re-executed through
+    the XLA gather reference.  Asserts greedy token identity, equal jit
+    trace counts (the in-trace logit stats are part of the program
+    either way), ZERO divergences with a clean ``ok`` auditor, and
+    records the audit-on vs audit-off tokens/s overhead — the price of
+    the always-on correctness net, measured.
+    """
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.observability.audit import AuditConfig
+    from paddle_tpu.serving import (
+        EngineConfig,
+        EngineCore,
+        SamplingParams,
+        SchedulerConfig,
+    )
+
+    rng = np.random.default_rng(0)
+    prefix = rng.integers(0, 256, 8).tolist()
+    prompts = [prefix + rng.integers(0, 256, 8).tolist() for _ in range(6)]
+
+    def run(audit_on: bool) -> dict:
+        paddle.seed(0)
+        model = LlamaForCausalLM(LlamaConfig.tiny(num_hidden_layers=2))
+        # 14 usable blocks of 4 can't hold 4 concurrent 16+10-token
+        # sequences: the stream preempts + recomputes under audit too
+        eng = EngineCore(model, config=EngineConfig(
+            num_blocks=15, block_size=4,
+            scheduler=SchedulerConfig(
+                max_num_seqs=4, max_prefill_tokens_per_step=8),
+            audit=(AuditConfig(enabled=True, sample_every=1)
+                   if audit_on else None)))
+        reqs = [eng.add_request(p, SamplingParams(max_new_tokens=10),
+                                slo_ms=60_000.0)
+                for p in prompts]
+        t0 = time.perf_counter()
+        eng.run(max_steps=4000)
+        wall = time.perf_counter() - t0
+        assert all(r.finished for r in reqs)
+        gen = sum(len(r.output_tokens) for r in reqs)
+        rec = {
+            "audit": audit_on, "wall_s": round(wall, 4),
+            "tokens_per_sec": round(gen / wall, 2),
+            "generated_tokens": gen,
+            "preemptions": eng.metrics.counters["preemptions"],
+            "prefill_traces": eng.prefill_trace_count,
+            "decode_traces": eng.decode_trace_count,
+            "outputs": [list(r.output_tokens) for r in reqs],
+        }
+        if audit_on:
+            snap = eng.audit.snapshot()
+            assert snap["status"] == "ok", snap
+            assert sum(snap["divergences"].values()) == 0, snap
+            assert sum(snap["audited_launches"].values()) > 0, snap
+            assert snap["oracle_failures"] == 0, snap
+            rec["audit_state"] = {k: snap[k] for k in (
+                "status", "sample_every", "steps", "audited_launches",
+                "divergences", "nonfinite_values", "oracle_failures")}
+        return rec
+
+    off, on = run(False), run(True)
+    identical = on["outputs"] == off["outputs"]
+    equal_traces = (on["prefill_traces"] == off["prefill_traces"]
+                    and on["decode_traces"] == off["decode_traces"])
+    result = {
+        "metric": "serving_audit_on_tokens_per_sec",
+        "value": on["tokens_per_sec"], "unit": "tokens/s",
+        "phase": "serving_audit",
+        "greedy_token_identical": identical,
+        "equal_trace_counts": equal_traces,
+        "audit_off_tokens_per_sec": off["tokens_per_sec"],
+        "audit_on_tokens_per_sec": on["tokens_per_sec"],
+        "audit_overhead_pct": round(
+            (off["tokens_per_sec"] - on["tokens_per_sec"])
+            / off["tokens_per_sec"] * 100, 2),
+        "audit_off": off, "audit_on": on,
+    }
+    assert identical, "audit-on output diverged from audit-off under greedy"
+    assert equal_traces, "auditing changed the jit trace count"
+    assert on["preemptions"] and off["preemptions"], \
+        "phase sized to exercise preemption-with-recompute, but none fired"
+    return result
+
+
 def serving_main() -> dict:
-    """``--serving``: shared-prefix + tensor-parallel + fleet phases,
-    combined into one ``BENCH_SERVING.json`` record."""
+    """``--serving``: shared-prefix + tensor-parallel + fleet +
+    numerics-audit phases, combined into one ``BENCH_SERVING.json``
+    record."""
     # must precede the FIRST jax import in this process: the mp phase
     # needs ≥2 host devices.  A pre-set count <2 (e.g. =1 exported for
     # single-device debugging) is raised, not trusted — otherwise
@@ -840,6 +930,10 @@ def serving_main() -> dict:
         # checkpoint again before the fleet phase for the same reason
         json.dump(result, f, indent=1)
     result["fleet"] = serving_fleet_bench()
+    with open(path, "w") as f:
+        # checkpoint before the audit phase for the same reason
+        json.dump(result, f, indent=1)
+    result["audit"] = serving_audit_bench()
     with open(path, "w") as f:
         json.dump(result, f, indent=1)
     return result

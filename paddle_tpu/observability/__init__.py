@@ -40,6 +40,14 @@ bucketed program launch, attributes trace+compile wall time per
 N annotated engine-step spans as a chrome trace, wrapped in
 ``jax.profiler`` start/stop on real devices.
 
+The value layer (ISSUE 10): :class:`NumericsAuditor` (``audit.py``)
+watches the serving programs' *outputs* — a NaN/Inf sentinel over
+in-trace logit reductions on every launch, shadow-oracle differential
+re-execution of sampled decode steps through the XLA gather reference
+(replicated single-shard under mp>1), and atomic size-capped ``.npz``
+repro bundles (:func:`replay_repro`) on divergence via the flight
+machinery.
+
 Process-wide defaults: :func:`get_tracer` / :func:`get_registry` return
 one shared instance each, so spans from the serving engine, jit compile
 events and watchdog timeouts land in one trace, and compile counters /
@@ -48,6 +56,13 @@ KV-occupancy gauges land in one Prometheus page.
 
 from __future__ import annotations
 
+from .audit import (  # noqa: F401
+    AuditConfig,
+    NumericsAuditor,
+    load_repro,
+    logit_stats,
+    replay_repro,
+)
 from .export import (  # noqa: F401
     ProfilerResult,
     chrome_trace_dict,

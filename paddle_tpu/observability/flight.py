@@ -27,6 +27,11 @@ Triggers (``serving_flight_dumps_total{trigger=...}`` counts the dumps):
                           ``burst_window_s``
 ``drain_overrun``         a graceful drain hit its deadline with requests
                           still in flight (stragglers TIMEOUT-aborted)
+``nonfinite``             the numerics auditor saw NaN/Inf in a step
+                          program's logits (``observability/audit.py``)
+``divergence``            the shadow-oracle re-execution disagreed with the
+                          primary program (token or logit divergence); the
+                          ``.npz`` repro path rides ``detail``
 ========================  ====================================================
 
 Boundedness (``tools/check_bounded_metrics.py`` lints this module): each
@@ -53,7 +58,7 @@ from .lifecycle import LifecycleTracker
 from .metrics import MetricsRegistry
 
 TRIGGERS = ("engine_death", "watchdog", "preemption_storm",
-            "rejection_burst", "drain_overrun")
+            "rejection_burst", "drain_overrun", "nonfinite", "divergence")
 
 # pre-registered metric names this module owns (tools/check_metrics_docs
 # lints that each appears in README's metrics table)

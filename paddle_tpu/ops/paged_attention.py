@@ -472,7 +472,13 @@ class PagedCache:
 
 def _xla_paged_attention(q, k_cache, v_cache, block_tables, seq_lens):
     """XLA gather path: materializes the padded [B, S, H, D] context (GQA
-    via grouped einsum, KV never head-repeated)."""
+    via grouped einsum, KV never head-repeated).
+
+    This is also the **standing differential-testing oracle** for the
+    Pallas decode kernel (``pallas_paged.decode_oracle`` re-exports it):
+    the interpret-mode parity tests and the online numerics auditor
+    (``observability/audit.py``) both compare the kernel against this
+    path, so any kernel drift is caught offline AND in production."""
     B, H, D = q.shape
     max_blocks = block_tables.shape[1]
     bs = k_cache.shape[1]

@@ -102,6 +102,21 @@ def _decode_kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
                     ).astype(o_ref.dtype)
 
 
+def decode_oracle(q, k_cache, v_cache, block_tables, seq_lens):
+    """The kernel's differential-testing oracle: the XLA gather path
+    with identical routing semantics (``paged_attention._xla_paged_
+    attention``), paired here so kernel and oracle live side by side.
+    The fast CPU interpret-mode parity tests run every decode bucket
+    shape through both, and the online :class:`~paddle_tpu
+    .observability.audit.NumericsAuditor` re-executes sampled serving
+    decode steps through the same reference — the standing harness the
+    ROADMAP's ragged-kernel rewrite will land against."""
+    from .paged_attention import _xla_paged_attention
+
+    return _xla_paged_attention(q, k_cache, v_cache, block_tables,
+                                seq_lens)
+
+
 def paged_attention_decode(q, k_cache, v_cache, block_tables, seq_lens):
     """Fused paged decode attention; returns [B, H, D]."""
     B, H, D = q.shape

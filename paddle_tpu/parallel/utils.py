@@ -11,6 +11,7 @@ layers steer GSPMD with ``with_sharding_constraint`` and parameter
 from __future__ import annotations
 
 import contextlib
+import threading
 from typing import Optional, Sequence
 
 import jax
@@ -22,22 +23,26 @@ from ..distributed import topology
 
 # Set while tracing under shard_map (pipeline / ring-attention bodies):
 # GSPMD sharding constraints are meaningless on per-shard views, so the
-# constraint helpers become no-ops there.
-_manual_mode_depth = 0
+# constraint helpers become no-ops there.  THREAD-LOCAL: jax traces on
+# the calling thread, and concurrent engine threads (a dp>1 fleet, or
+# the numerics auditor's single-device shadow trace next to a replica
+# tracing a first-seen bucket) must never see each other's manual
+# window — a constraint silently no-oped into another thread's cached
+# executable would mis-place that bucket forever.
+_manual_mode = threading.local()
 
 
 @contextlib.contextmanager
 def manual_sharding_mode():
-    global _manual_mode_depth
-    _manual_mode_depth += 1
+    _manual_mode.depth = getattr(_manual_mode, "depth", 0) + 1
     try:
         yield
     finally:
-        _manual_mode_depth -= 1
+        _manual_mode.depth -= 1
 
 
 def in_manual_mode() -> bool:
-    return _manual_mode_depth > 0
+    return getattr(_manual_mode, "depth", 0) > 0
 
 
 def axis_size(axis: str) -> int:

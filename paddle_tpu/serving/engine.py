@@ -59,6 +59,7 @@ import numpy as np
 from ..core.tensor import Tensor
 from ..distributed import topology
 from ..observability import lifecycle as _lc
+from ..observability.audit import AuditConfig, NumericsAuditor, logit_stats
 from ..observability.lifecycle import LifecycleTracker
 from ..observability.stepprof import StepProfiler
 from ..ops.paged_attention import (
@@ -124,6 +125,16 @@ class EngineConfig:
     # is armed; False keeps /metrics free of every serving_step_* /
     # serving_compile_* / serving_padding_* series.
     step_profile: bool = True
+    # Online numerics auditing (ISSUE 10): NaN/Inf sentinel + logit-
+    # stats telemetry on every step-program launch, and shadow-oracle
+    # differential re-execution of sampled decode steps through the XLA
+    # gather reference (single-shard replicated re-run under mp>1),
+    # with size-capped .npz repro bundles on divergence.  None/default
+    # = disabled: zero serving_audit_*/serving_logit_* series on
+    # /metrics and no host-side audit work (the in-trace logit stats
+    # are computed unconditionally, so audit on vs off is the SAME
+    # compiled program — trace counts provably unchanged).
+    audit: Optional[AuditConfig] = None
 
 
 class EngineCore:
@@ -183,6 +194,13 @@ class EngineCore:
                                      labels=metrics_labels,
                                      enabled=config.step_profile)
         self.metrics.attach_step_profiler(self.stepprof)
+        # --- online numerics auditing (ISSUE 10) ---------------------------
+        # NaN/Inf sentinel + logit telemetry on every launch, shadow-
+        # oracle re-execution of sampled decode steps; the fleet router
+        # binds it to the flight recorder keyed by replica index
+        self.audit = NumericsAuditor(self, config=config.audit,
+                                     registry=self.metrics.registry,
+                                     labels=metrics_labels)
         # --- request-lifecycle tracing (ISSUE 8) ---------------------------
         # the fleet router rebinds all replicas onto ONE tracker via
         # set_lifecycle() so router + engine events share a timeline
@@ -275,7 +293,8 @@ class EngineCore:
         params = tuple(
             NamedSharding(mesh, _fit_spec(param_spec(p), tuple(p.shape), mesh))
             for p in self._params)
-        out = (repl, pools, pools)  # logits replicated, pools stay sharded
+        # logits + audit logit-stats replicated, pools stay sharded
+        out = (repl, repl, pools, pools)
         return {
             # (param_vals, k_pools, v_pools, ids, pos, tables, lens,
             #  slot_blocks, slot_offsets)
@@ -330,7 +349,11 @@ class EngineCore:
             c.use_pallas = self._use_pallas  # EngineConfig.use_pallas_paged
             caches.append(c)
         logits = self._call_model(ids, caches, pos, param_vals)
-        return (logits[:, -1, :].astype(jnp.float32),
+        last = logits[:, -1, :].astype(jnp.float32)
+        # numerics-audit sentinel (ISSUE 10): tiny in-trace reductions
+        # over the output logits ride the launch as one extra output —
+        # computed unconditionally so audit on/off is the SAME program
+        return (last, logit_stats(last),
                 tuple(c.k_pool._value for c in caches),
                 tuple(c.v_pool._value for c in caches))
 
@@ -361,7 +384,7 @@ class EngineCore:
         new_v = tuple(
             vp.at[blocks, offs].set(vb._value[0].astype(vp.dtype))
             for vp, (_, vb) in zip(v_pools, dense))
-        return last, new_k, new_v
+        return last, logit_stats(last), new_k, new_v
 
     def _chunk_prefill_fn(self, param_vals, k_pools, v_pools, ids, start,
                           last_pos, tables, lens, slot_blocks,
@@ -385,7 +408,7 @@ class EngineCore:
             caches.append(c)
         logits = self._call_model(ids, caches, start, param_vals)
         last = jnp.take(logits[0], last_pos, axis=0).astype(jnp.float32)
-        return (last,
+        return (last, logit_stats(last),
                 tuple(c.k_pool._value for c in caches),
                 tuple(c.v_pool._value for c in caches))
 
@@ -557,9 +580,11 @@ class EngineCore:
                                   recompute=bool(req.output_tokens)):
                 with StepTimer(self.metrics, "prefill_step",
                                self._collective_phase("prefill")) as st:
-                    last, self._k_pools, self._v_pools = self._jit_prefill(
-                        self._param_vals(), self._k_pools, self._v_pools,
-                        ids_arr, np.int32(target - 1), blocks, offs)
+                    last, stats, self._k_pools, self._v_pools = \
+                        self._jit_prefill(
+                            self._param_vals(), self._k_pools,
+                            self._v_pools, ids_arr, np.int32(target - 1),
+                            blocks, offs)
                     logits = np.asarray(last, np.float32)
             if self.prefill_trace_count > traces0:
                 # the in-trace counter advanced during THIS launch, so
@@ -568,6 +593,14 @@ class EngineCore:
             self.stepprof.record_program(
                 "prefill", (Tb,), scheduled=n, capacity=Tb, wall_s=st.dt,
                 request=str(rid))
+            if self.audit.enabled:
+                self.audit.observe_program(
+                    "prefill", np.asarray(stats, np.float32), (Tb,),
+                    logits=logits[None, :],
+                    inputs={"ids": ids_arr, "blocks": blocks,
+                            "offs": offs},
+                    requests=[{"id": str(rid),
+                               "greedy": req.sampling.temperature == 0.0}])
         else:
             # chunk / resume: the chunk scatters into its pages and
             # attends over the paged prefix, so earlier chunks and
@@ -595,7 +628,7 @@ class EngineCore:
                                   recompute=bool(req.output_tokens)):
                 with StepTimer(self.metrics, "prefill_step",
                                self._collective_phase("prefill")) as st:
-                    last, self._k_pools, self._v_pools = \
+                    last, stats, self._k_pools, self._v_pools = \
                         self._jit_chunk_prefill(
                             self._param_vals(), self._k_pools,
                             self._v_pools, ids_arr, np.int32(start),
@@ -607,6 +640,15 @@ class EngineCore:
                 "chunk", (Wb, TWb), scheduled=n, capacity=Wb,
                 wall_s=st.dt, request=str(rid), start=start,
                 table_width=len(table))
+            if self.audit.enabled:
+                self.audit.observe_program(
+                    "chunk", np.asarray(stats, np.float32), (Wb, TWb),
+                    logits=logits[None, :],
+                    inputs={"ids": ids_arr, "start": np.int32(start),
+                            "tables": tables, "lens": lens,
+                            "slot_blocks": blocks, "slot_offsets": offs},
+                    requests=[{"id": str(rid),
+                               "greedy": req.sampling.temperature == 0.0}])
         self.kv.commit(rid, n)
         self._lc(rid, _lc.EV_PREFILL_CHUNK, start=start, tokens=n,
                  target=target, chunk=bool(start or n != target),
@@ -644,6 +686,11 @@ class EngineCore:
             slot_blocks[i], slot_offsets[i] = r._slot
         self.decode_buckets.add(("decode", Bb, Wb))
         traces0 = self.decode_trace_count
+        # shadow-oracle capture (ISSUE 10): on sampled audit steps the
+        # PRE-step pools are snapshotted so the auditor can re-execute
+        # this exact step through the XLA gather reference program
+        pre_pools = self.audit.snapshot_pools(self._k_pools,
+                                              self._v_pools)
         with self.tracer.span("decode_step", cat="serving", batch=B,
                               batch_bucket=Bb, width_bucket=Wb,
                               requests=",".join(str(r.request_id)
@@ -652,9 +699,11 @@ class EngineCore:
                                               for r in reqs)):
             with StepTimer(self.metrics, "decode_step",
                            self._collective_phase("decode")) as st:
-                out, self._k_pools, self._v_pools = self._jit_decode(
-                    self._param_vals(), self._k_pools, self._v_pools,
-                    ids, poss, tables, lens, slot_blocks, slot_offsets)
+                out, stats, self._k_pools, self._v_pools = \
+                    self._jit_decode(
+                        self._param_vals(), self._k_pools, self._v_pools,
+                        ids, poss, tables, lens, slot_blocks,
+                        slot_offsets)
                 out = np.asarray(out, np.float32)
         if self.decode_trace_count > traces0:
             self.stepprof.record_compile("decode", (Bb, Wb), st.dt)
@@ -668,6 +717,20 @@ class EngineCore:
             "decode", (Bb, Wb), scheduled=B, capacity=Bb, wall_s=st.dt,
             table_width=width,
             requests=",".join(str(r.request_id) for r in reqs))
+        if self.audit.enabled:
+            # sentinel over the REAL rows (pad rows attend the null page
+            # — their logits are not part of the serving contract), plus
+            # the shadow re-execution when this step is sampled
+            self.audit.observe_program(
+                "decode", np.asarray(stats, np.float32)[:B], (Bb, Wb),
+                logits=out[:B],
+                inputs={"ids": ids, "pos": poss, "tables": tables,
+                        "lens": lens, "slot_blocks": slot_blocks,
+                        "slot_offsets": slot_offsets},
+                pre_pools=pre_pools,
+                requests=[{"id": str(r.request_id),
+                           "greedy": r.sampling.temperature == 0.0}
+                          for r in reqs])
         result = {}
         for i, r in enumerate(reqs):
             self.kv.commit(r.request_id, 1)
@@ -682,6 +745,7 @@ class EngineCore:
         remove_timer = (self.metrics.install_dispatch_timer()
                         if self._profile_ops else lambda: None)
         self.stepprof.begin_step()
+        self.audit.begin_step()
         try:
             with self.tracer.span("engine_step", cat="serving") as sp:
                 plan = self.scheduler.schedule()

@@ -528,6 +528,16 @@ class FleetRouter:
                 f"replicas disagree on step_profile={sorted(sprof)}; "
                 "the debug surfaces report fleet-wide, so every "
                 "replica must use the same EngineConfig knob")
+        audits = {e.audit.cfg for e in self.engines}
+        if len(audits) != 1:
+            # a half-audited fleet would read as "replica i never
+            # diverged" on /v1/debug/audit and silently skip the oracle
+            # on some replicas — refuse heterogeneous audit configs
+            raise ValueError(
+                "replicas disagree on audit config "
+                f"({sorted(repr(a) for a in audits)}); the audit "
+                "surface reports fleet-wide, so every replica must use "
+                "the same EngineConfig.audit")
         gate = gates.pop()
         explicit = [e.engine_config.lifecycle for e in self.engines]
         if explicit[0] is not None and \
@@ -560,6 +570,10 @@ class FleetRouter:
         # replica index the flight rings use
         self.flight.bind_step_profilers(
             {str(i): e.stepprof for i, e in enumerate(self.engines)})
+        # numerics auditors (ISSUE 10): divergence/nonfinite triggers and
+        # .npz repros carry the replica INDEX, matching the flight rings
+        for i, e in enumerate(self.engines):
+            e.audit.bind_flight(self.flight, replica=str(i))
         self.replicas: List[EngineReplica] = [
             EngineReplica(i, eng, self.cfg.max_queue,
                           notify=self._notify, on_finish=self._release)

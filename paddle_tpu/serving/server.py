@@ -109,7 +109,8 @@ from .request import FinishReason
 
 _MAX_HEADER_BYTES = 16384
 _ROUTES = ("/v1/completions", "/v1/requests", "/v1/debug/compiles",
-           "/v1/debug/profile", "/healthz", "/readyz", "/metrics")
+           "/v1/debug/profile", "/v1/debug/audit", "/healthz", "/readyz",
+           "/metrics")
 
 # pre-registered metric names this module owns (tools/check_metrics_docs
 # lints that each appears in README's metrics table)
@@ -423,7 +424,15 @@ class CompletionServer:
                 # when the operator expected dp=N / mp=M is visible from
                 # the readiness check alone
                 mp = getattr(self.engine, "mp", 1)
-                msg = (f"ok dp={self.fleet.dp} mp={mp}\n".encode()
+                # a degraded numerics auditor ANNOTATES readiness but
+                # never flips it (ISSUE 10): the fleet still serves —
+                # the operator sees the flag on every probe and digs in
+                # via /v1/debug/audit
+                audit_ann = (" audit=degraded" if any(
+                    r.engine.audit.degraded for r in self.fleet.replicas)
+                    else "")
+                msg = (f"ok dp={self.fleet.dp} mp={mp}{audit_ann}\n"
+                       .encode()
                        if status == 200 else (
                            b"draining\n" if self._draining
                            else b"not ready\n"))
@@ -563,6 +572,38 @@ class CompletionServer:
         from ..observability.stepprof import CaptureBusy
 
         params = urllib.parse.parse_qs(query)
+        if path == "/v1/debug/audit":
+            # numerics-audit status (ISSUE 10): per-replica auditor
+            # snapshots (counters, last divergence, repro paths) plus a
+            # fleet-level status roll-up — "ok" only when every enabled
+            # auditor is clean, "degraded" the moment any diverged,
+            # "disabled" when no replica audits
+            try:
+                replica = self._debug_int(params, "replica", -1,
+                                          -1, 1 << 30)
+            except ValueError as e:
+                await self._respond(writer, 400, error_body(str(e)),
+                                    keep_alive=keep_alive)
+                return 400
+            if replica >= self.fleet.dp:
+                await self._respond(writer, 404, error_body(
+                    f"no replica {replica} (fleet has dp="
+                    f"{self.fleet.dp})", "not_found"),
+                    keep_alive=keep_alive)
+                return 404
+            reps = (self.fleet.replicas if replica < 0
+                    else [self.fleet.replicas[replica]])
+            data = [dict(r.engine.audit.snapshot(), replica=str(r.index))
+                    for r in reps]
+            enabled = [d for d in data if d["enabled"]]
+            status = ("disabled" if not enabled else
+                      "degraded" if any(d["status"] == "degraded"
+                                        for d in enabled) else "ok")
+            await self._respond(
+                writer, 200,
+                {"object": "list", "status": status, "data": data},
+                keep_alive=keep_alive)
+            return 200
         if path == "/v1/debug/compiles":
             data = []
             totals: Dict[str, Dict] = {}
@@ -821,19 +862,24 @@ class CompletionServer:
 
 def _toy_engine(layers: int = 2, num_blocks: int = 64,
                 block_size: int = 4, registry=None,
-                metrics_labels=None) -> EngineCore:
+                metrics_labels=None, audit=None) -> EngineCore:
     import paddle_tpu as paddle
     from ..models import LlamaConfig, LlamaForCausalLM
+    from .engine import EngineConfig
 
     paddle.seed(0)
     model = LlamaForCausalLM(LlamaConfig.tiny(num_hidden_layers=layers))
-    return EngineCore(model, num_blocks=num_blocks, block_size=block_size,
+    return EngineCore(model,
+                      config=EngineConfig(num_blocks=num_blocks,
+                                          block_size=block_size,
+                                          audit=audit),
                       registry=registry, metrics_labels=metrics_labels)
 
 
 def _toy_fleet(dp: int = 1, layers: int = 2, num_blocks: int = 64,
                max_queue: int = 64,
-               flight_dir: Optional[str] = None) -> FleetRouter:
+               flight_dir: Optional[str] = None,
+               audit=None) -> FleetRouter:
     """A dp-replica fleet of toy engines on one shared registry: each
     replica gets its OWN model instance (engine threads swap parameter
     values during the traced step — modules must not be shared) with
@@ -842,7 +888,7 @@ def _toy_fleet(dp: int = 1, layers: int = 2, num_blocks: int = 64,
     return FleetRouter.build(
         lambda i, registry: _toy_engine(
             layers=layers, num_blocks=num_blocks, registry=registry,
-            metrics_labels={"replica": str(i)}),
+            metrics_labels={"replica": str(i)}, audit=audit),
         dp=dp, config=FleetConfig(max_queue=max_queue,
                                   flight_dir=flight_dir))
 
@@ -862,9 +908,15 @@ def _http(port: int, method: str, path: str, body: Optional[dict] = None):
     return status, data
 
 
-async def _selftest_async(dp: int = 1) -> int:
+async def _selftest_async(dp: int = 1, audit_sample: int = 1) -> int:
+    from ..observability.audit import AuditConfig
+
     loop = asyncio.get_running_loop()
-    fleet = _toy_fleet(dp=dp)
+    # the selftest always exercises the numerics-audit surface (ISSUE
+    # 10): every step sampled by default, so the probe completion runs
+    # with the shadow oracle live and must come back divergence-free
+    fleet = _toy_fleet(dp=dp, audit=AuditConfig(
+        enabled=True, sample_every=max(1, audit_sample)))
     server = CompletionServer(fleet, ServerConfig(port=0))
     engine = server.engine
     await server.start()
@@ -908,17 +960,41 @@ async def _selftest_async(dp: int = 1) -> int:
             "metrics page missing the serving_fleet_* family"
         routed = sum(fleet.routing_counts.values())
         assert routed >= 1, "completion did not route through the fleet"
+        # numerics-audit surface (ISSUE 10): the completion ran under
+        # sample_every=1, so at least one step was shadow-audited with
+        # zero divergences and the debug endpoint reports ok
+        assert b"serving_audit_steps_total" in data, \
+            "metrics page missing the serving_audit_* family"
+        status, data = await loop.run_in_executor(
+            None, _http, server.port, "GET", "/v1/debug/audit", None)
+        assert status == 200, f"/v1/debug/audit {status}"
+        audit = json.loads(data)
+        assert audit["status"] == "ok", audit
+        audited = sum(sum(row["audited_launches"].values())
+                      for row in audit["data"])
+        assert audited > 0, f"no audited step launches: {audit}"
+        assert all(sum(row["divergences"].values()) == 0
+                   for row in audit["data"]), audit
+        # a crashed shadow oracle must not pass as "audited clean"
+        assert all(row["oracle_failures"] == 0
+                   for row in audit["data"]), audit
         print(f"selftest: OK (port {server.port}, dp={fleet.dp}, "
-              f"mp={engine.mp}, tokens {choice['token_ids']})")
+              f"mp={engine.mp}, tokens {choice['token_ids']}, "
+              f"audited launches {audited})")
         return 0
     finally:
         await server.shutdown(drain_timeout=2.0)
 
 
 async def _serve_cli(args) -> int:
+    audit = None
+    if args.audit_sample:
+        from ..observability.audit import AuditConfig
+
+        audit = AuditConfig(enabled=True, sample_every=args.audit_sample)
     fleet = _toy_fleet(dp=args.dp, layers=args.layers,
                        num_blocks=args.blocks, max_queue=args.max_queue,
-                       flight_dir=args.flight_dir)
+                       flight_dir=args.flight_dir, audit=audit)
     server = CompletionServer(fleet, ServerConfig(
         host=args.host, port=args.port,
         max_queue=args.max_queue,
@@ -941,7 +1017,8 @@ async def _serve_cli(args) -> int:
     print(f"serving on http://{server.cfg.host}:{server.port} "
           f"dp={fleet.dp} mp={server.engine.mp} "
           "(POST /v1/completions; GET /healthz /readyz /metrics "
-          "/v1/requests /v1/debug/compiles /v1/debug/profile)")
+          "/v1/requests /v1/debug/compiles /v1/debug/profile "
+          "/v1/debug/audit)")
     try:
         await server.serve_forever()
     finally:
@@ -990,7 +1067,14 @@ def main(argv=None) -> int:
     p.add_argument("--flight-dir", default=None, metavar="DIR",
                    help="write flight-recorder post-mortem bundles "
                         "(engine death, preemption storms, 429 bursts, "
-                        "drain overruns) into this directory")
+                        "drain overruns, numerics divergences) into "
+                        "this directory")
+    p.add_argument("--audit-sample", type=int, default=None, metavar="N",
+                   help="enable online numerics auditing with a shadow-"
+                        "oracle re-execution every Nth engine step "
+                        "(NaN/Inf sentinel + logit telemetry on every "
+                        "step; .npz repros land in --flight-dir); off "
+                        "by default")
     p.add_argument("--selftest", action="store_true",
                    help="boot on an ephemeral port, serve one completion "
                         "against the toy fleet through the router path, "
@@ -998,6 +1082,8 @@ def main(argv=None) -> int:
     args = p.parse_args(argv)
     if args.dp < 1:
         p.error(f"--dp must be >= 1, got {args.dp}")
+    if args.audit_sample is not None and args.audit_sample < 1:
+        p.error(f"--audit-sample must be >= 1, got {args.audit_sample}")
     if args.mp > 1:
         # tensor-parallel serving (ISSUE 5): build the mesh BEFORE any
         # engine (selftest included — the probe must exercise the real
@@ -1007,7 +1093,8 @@ def main(argv=None) -> int:
 
         topology.init_mesh(mp=args.mp)
     if args.selftest:
-        return asyncio.run(_selftest_async(dp=args.dp))
+        return asyncio.run(_selftest_async(
+            dp=args.dp, audit_sample=args.audit_sample or 1))
     return asyncio.run(_serve_cli(args))
 
 

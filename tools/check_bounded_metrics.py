@@ -63,6 +63,9 @@ SCAN_FILES = (
     # ISSUE 9: the step profiler's record ring, compile table and
     # capture windows must stay bounded (deque maxlen= / explicit caps)
     os.path.join(_REPO, "paddle_tpu", "observability", "stepprof.py"),
+    # ISSUE 10: the numerics auditor's repro-path ring and divergence
+    # bookkeeping must stay bounded (deque maxlen= / fired-once keys)
+    os.path.join(_REPO, "paddle_tpu", "observability", "audit.py"),
     os.path.join(_REPO, "paddle_tpu", "ops", "paged_attention.py"),
     os.path.join(_REPO, "paddle_tpu", "ops", "pallas_paged.py"),
     os.path.join(_REPO, "paddle_tpu", "parallel", "mp_layers.py"),

@@ -901,10 +901,111 @@ def serving_audit_bench() -> dict:
     return result
 
 
+def serving_unified_bench() -> dict:
+    """Unified ragged step phase (ISSUE 11): the preempting shared-prefix
+    stream through the engine with the legacy three-family dispatch vs
+    ``EngineConfig.unified_step=True`` (one packed ragged launch per
+    step, decode rows + prefill chunks under ONE
+    ``max_tokens_per_step=8`` budget).  Asserts greedy token identity,
+    STRICTLY fewer jit traces than the legacy baseline, and records the
+    per-program padding-waste delta (PR 8's
+    ``serving_padding_tokens_total`` accounting) — the bucket-set
+    collapse measured, not asserted.
+    """
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.serving import (
+        EngineConfig,
+        EngineCore,
+        SamplingParams,
+        SchedulerConfig,
+    )
+
+    rng = np.random.default_rng(0)
+    prefix = rng.integers(0, 256, 8).tolist()
+    prompts = [prefix + rng.integers(0, 256, 8).tolist() for _ in range(6)]
+
+    def run(unified: bool) -> dict:
+        paddle.seed(0)
+        model = LlamaForCausalLM(LlamaConfig.tiny(num_hidden_layers=2))
+        # 14 usable blocks of 4 can't hold 4 concurrent 16+10-token
+        # sequences: the stream preempts + recomputes either way.  The
+        # packed budget of 8 keeps the unified token bucket on the same
+        # power-of-two boundary the legacy chunk budget uses.
+        eng = EngineCore(model, config=EngineConfig(
+            num_blocks=15, block_size=4,
+            scheduler=SchedulerConfig(
+                max_num_seqs=4, max_prefill_tokens_per_step=8,
+                max_tokens_per_step=8 if unified else None),
+            unified_step=unified))
+        reqs = [eng.add_request(p, SamplingParams(max_new_tokens=10),
+                                slo_ms=60_000.0)
+                for p in prompts]
+        t0 = time.perf_counter()
+        eng.run(max_steps=4000)
+        wall = time.perf_counter() - t0
+        assert all(r.finished for r in reqs)
+        gen = sum(len(r.output_tokens) for r in reqs)
+        rep = _step_profile_report(eng)
+        return {
+            "unified": unified, "wall_s": round(wall, 4),
+            "tokens_per_sec": round(gen / wall, 2),
+            "generated_tokens": gen,
+            "preemptions": eng.metrics.counters["preemptions"],
+            "trace_count": (eng.prefill_trace_count
+                            + eng.decode_trace_count
+                            + eng.ragged_trace_count),
+            "bucket_count": (len(eng.prefill_buckets)
+                             + len(eng.decode_buckets)
+                             + len(eng.ragged_buckets)),
+            "padding_ratio": rep["padding_ratio"],
+            "padding_tokens": rep["padding_tokens"],
+            "scheduled_tokens": rep["scheduled_tokens"],
+            "step_profile": rep,
+            "slo": eng.metrics.slo_breakdown(),
+            "metrics": eng.metrics.snapshot(),
+            "outputs": [list(r.output_tokens) for r in reqs],
+        }
+
+    legacy, unified = run(False), run(True)
+    identical = unified["outputs"] == legacy["outputs"]
+    fewer_traces = unified["trace_count"] < legacy["trace_count"]
+    result = {
+        "metric": "serving_unified_padding_ratio",
+        "value": unified["padding_ratio"], "unit": "padding/capacity",
+        "phase": "serving_unified",
+        "greedy_token_identical": identical,
+        "fewer_traces": fewer_traces,
+        "legacy_trace_count": legacy["trace_count"],
+        "unified_trace_count": unified["trace_count"],
+        "legacy_bucket_count": legacy["bucket_count"],
+        "unified_bucket_count": unified["bucket_count"],
+        "legacy_padding_ratio": legacy["padding_ratio"],
+        "unified_padding_ratio": unified["padding_ratio"],
+        "padding_ratio_delta": round(
+            unified["padding_ratio"] - legacy["padding_ratio"], 4),
+        "legacy_tokens_per_sec": legacy["tokens_per_sec"],
+        "unified_tokens_per_sec": unified["tokens_per_sec"],
+        "legacy": legacy, "unified": unified,
+    }
+    assert identical, "unified output diverged from legacy under greedy"
+    assert fewer_traces, (
+        f"unified step did not collapse the compile count: "
+        f"{unified['trace_count']} vs legacy {legacy['trace_count']}")
+    assert unified["padding_ratio"] < legacy["padding_ratio"], (
+        f"unified padding ratio {unified['padding_ratio']} did not "
+        f"improve on legacy {legacy['padding_ratio']}")
+    assert legacy["preemptions"] and unified["preemptions"], \
+        "phase sized to exercise preemption-with-recompute, but none fired"
+    return result
+
+
 def serving_main() -> dict:
     """``--serving``: shared-prefix + tensor-parallel + fleet +
-    numerics-audit phases, combined into one ``BENCH_SERVING.json``
-    record."""
+    numerics-audit + unified-ragged phases, combined into one
+    ``BENCH_SERVING.json`` record."""
     # must precede the FIRST jax import in this process: the mp phase
     # needs ≥2 host devices.  A pre-set count <2 (e.g. =1 exported for
     # single-device debugging) is raised, not trusted — otherwise
@@ -934,6 +1035,10 @@ def serving_main() -> dict:
         # checkpoint before the audit phase for the same reason
         json.dump(result, f, indent=1)
     result["audit"] = serving_audit_bench()
+    with open(path, "w") as f:
+        # checkpoint before the unified phase for the same reason
+        json.dump(result, f, indent=1)
+    result["unified"] = serving_unified_bench()
     with open(path, "w") as f:
         json.dump(result, f, indent=1)
     return result

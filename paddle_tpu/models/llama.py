@@ -235,8 +235,15 @@ class LlamaAttention(Layer):
             cos_t, sin_t = self._rope_cos, self._rope_sin
 
             def rope_at(a, p):
-                # scalar pos: shared offset; [B] pos: per-sequence offsets
-                idx = (p[:, None] if jnp.ndim(p) == 1 else p) + jnp.arange(S)
+                # scalar pos: shared offset; [B] pos: per-sequence
+                # offsets; [B, S] pos: absolute per-TOKEN positions (the
+                # packed ragged step, where row `t` of the flat token
+                # batch sits at an arbitrary position of its own segment)
+                if jnp.ndim(p) == 2:
+                    idx = p
+                else:
+                    idx = (p[:, None] if jnp.ndim(p) == 1 else p) \
+                        + jnp.arange(S)
                 return _apply_rope(a, jnp.asarray(cos_t)[idx],
                                    jnp.asarray(sin_t)[idx])
 
@@ -312,6 +319,8 @@ class LlamaAttention(Layer):
         position."""
         from ..ops import paged_attention as pa_mod
 
+        if cache.seg_ids is not None:
+            return self._ragged_paged_attention(q, k, v, cache, B, S, hd)
         if cache.slot_blocks is not None and cache.slot_blocks.ndim == 2:
             return self._chunk_paged_attention(q, k, v, cache, B, S, hd)
         assert S == 1, "paged cache path is decode-only (one token per step)"
@@ -330,6 +339,35 @@ class LlamaAttention(Layer):
                 use_pallas=cache.use_pallas)[:, None]
 
         out = run_op("paged_attention", attend, q, kp, vp)
+        out = run_op("merge_heads",
+                     lambda a: a.reshape(B, S, self.num_heads * hd), out)
+        return self.o_proj(out)
+
+    def _ragged_paged_attention(self, q, k, v, cache, B, S, hd):
+        """Unified ragged step (ISSUE 11): the batch is ONE packed row of
+        S tokens spanning many sequences — each token scatters into its
+        own (block, offset) slot (pads write the null page), then one
+        fused ragged attention launch serves every decode row and prefill
+        chunk together (``ops/ragged_paged.py``: Pallas via shard_map
+        over ``mp``, or the XLA gather reference)."""
+        from ..ops import ragged_paged as rp_mod
+
+        kp, vp = cache.k_pool, cache.v_pool
+        blocks, offs = cache.slot_blocks, cache.slot_offsets  # [T]
+
+        def write(pool, new):
+            return pool.at[blocks, offs].set(new[0].astype(pool.dtype))
+
+        kp._rebind(run_op("paged_kv_write", write, kp, k))
+        vp._rebind(run_op("paged_kv_write", write, vp, v))
+
+        def attend(qv, kpool, vpool):
+            return rp_mod.ragged_paged_attention(
+                qv[0], kpool, vpool, cache.block_tables, cache.seq_lens,
+                cache.seg_ids, cache.q_start,
+                use_pallas=cache.use_pallas)[None]
+
+        out = run_op("ragged_paged_attention", attend, q, kp, vp)
         out = run_op("merge_heads",
                      lambda a: a.reshape(B, S, self.num_heads * hd), out)
         return self.o_proj(out)

@@ -64,8 +64,9 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-# the three bucketed program families the engine dispatches (PR 1/4)
-AUDIT_PROGRAMS = ("prefill", "chunk", "decode")
+# the bucketed program families the engine dispatches: the legacy three
+# (PR 1/4) plus the unified packed ragged step (ISSUE 11)
+AUDIT_PROGRAMS = ("prefill", "chunk", "decode", "ragged")
 
 # divergence taxonomy: greedy token flipped / logits outside tolerance /
 # non-finite values in the primary output
@@ -185,6 +186,8 @@ class NumericsAuditor:
         self._attempt_cooldown_s = 30.0
         self._seq = 0
         self._jit_ref_decode = None
+        self._jit_ref_ragged = None  # unified packed-step reference
+        # (ISSUE 11): the XLA ragged_oracle path, independently jitted
         self._ref_params = None  # mp>1: host-gathered params, cached —
         # serving weights are immutable, so the full device-to-host
         # gather happens once, not per sampled step
@@ -324,17 +327,20 @@ class NumericsAuditor:
                 arrays_fn=lambda: self._repro_arrays(inputs, pre_pools,
                                                      primary=logits))
             return "nonfinite"
-        if program == "decode" and self.sampled and pre_pools is not None \
-                and logits is not None:
-            return self._shadow_decode(pre_pools, inputs, logits, bucket,
-                                       requests)
+        if program in ("decode", "ragged") and self.sampled \
+                and pre_pools is not None and logits is not None:
+            return self._shadow_step(program, pre_pools, inputs, logits,
+                                     bucket, requests)
         return None
 
     # --- shadow oracle ------------------------------------------------------
-    def _shadow_decode(self, pre_pools, inputs, primary, bucket,
-                       requests) -> Optional[str]:
+    def _shadow_step(self, program, pre_pools, inputs, primary, bucket,
+                     requests) -> Optional[str]:
         try:
-            ref = self._reference_decode(pre_pools, inputs)
+            if program == "ragged":
+                ref = self._reference_ragged(pre_pools, inputs)
+            else:
+                ref = self._reference_decode(pre_pools, inputs)
         except Exception as e:  # the oracle must never kill the engine —
             # but a crashed oracle means this step was NOT compared, so
             # it is counted loudly: "audited launches > 0 with zero
@@ -371,7 +377,7 @@ class NumericsAuditor:
         else:
             return None
         self._divergence(
-            kind, "decode", bucket,
+            kind, program, bucket,
             info={"max_abs_diff": round(maxdiff, 8),
                   "token_rows": token_rows,
                   "greedy_rows": [int(i) for i in range(B) if greedy[i]],
@@ -414,6 +420,54 @@ class NumericsAuditor:
             # retraces per decode bucket, exactly like the engine's own
             # program — bounded by the same bucket set
             self._jit_ref_decode = jax.jit(ref_fn)
+        return self._run_reference(
+            self._jit_ref_decode, pre_pools,
+            tuple(inputs[k] for k in ("ids", "pos", "tables", "lens",
+                                      "slot_blocks", "slot_offsets")))
+
+    def _reference_ragged(self, pre_pools, inputs) -> np.ndarray:
+        """Re-execute one packed ragged step (ISSUE 11) through the
+        reference program: the XLA gather path of
+        ``ops.ragged_paged.ragged_oracle`` (``use_pallas=False``) with
+        the SAME packing metadata, traced as a plain single-device jit —
+        for mp>1 engines the replicated single-shard re-run of the
+        shard_map kernel program."""
+        import jax
+        import jax.numpy as jnp
+
+        eng = self.engine
+        if self._jit_ref_ragged is None:
+            from ..core.tensor import Tensor
+            from ..ops.paged_attention import PagedCache
+
+            def ref_fn(param_vals, k_pools, v_pools, ids, pos, seg_ids,
+                       last_idx, tables, lens, slot_blocks,
+                       slot_offsets):
+                caches = []
+                for k, v in zip(k_pools, v_pools):
+                    c = PagedCache(Tensor(k), Tensor(v))
+                    c.route(tables, lens, slot_blocks, slot_offsets,
+                            q_start=pos[0], seg_ids=seg_ids)
+                    c.use_pallas = False  # the XLA ragged oracle
+                    caches.append(c)
+                logits = eng._call_model(ids, caches, pos, param_vals)
+                return jnp.take(logits[0], last_idx,
+                                axis=0).astype(jnp.float32)
+
+            # retraces per packed bucket — bounded by the collapsed
+            # ragged bucket set
+            self._jit_ref_ragged = jax.jit(ref_fn)
+        return self._run_reference(
+            self._jit_ref_ragged, pre_pools,
+            tuple(inputs[k] for k in ("ids", "pos", "seg_ids",
+                                      "last_idx", "tables", "lens",
+                                      "slot_blocks", "slot_offsets")))
+
+    def _run_reference(self, jit_ref, pre_pools, step_args) -> np.ndarray:
+        """Shared reference-execution tail: host-gathered params (cached
+        — serving weights are immutable) + thread-local manual-sharding
+        trace window under mp>1, plain jit call otherwise."""
+        eng = self.engine
         if eng.mp > 1:
             if self._ref_params is None:
                 self._ref_params = tuple(
@@ -429,15 +483,9 @@ class NumericsAuditor:
             # trace window cannot leak into another replica's engine
             # thread tracing its own bucket concurrently
             with manual_sharding_mode():
-                out = self._jit_ref_decode(
-                    params, k_pools, v_pools, inputs["ids"],
-                    inputs["pos"], inputs["tables"], inputs["lens"],
-                    inputs["slot_blocks"], inputs["slot_offsets"])
+                out = jit_ref(params, k_pools, v_pools, *step_args)
         else:
-            out = self._jit_ref_decode(
-                params, k_pools, v_pools, inputs["ids"], inputs["pos"],
-                inputs["tables"], inputs["lens"], inputs["slot_blocks"],
-                inputs["slot_offsets"])
+            out = jit_ref(params, k_pools, v_pools, *step_args)
         return np.asarray(out, np.float32)
 
     # --- divergence handling ------------------------------------------------
@@ -639,6 +687,14 @@ def replay_repro(path: str, engine) -> Dict:
             (tuple(a["k_pools"]), tuple(a["v_pools"])),
             {k: a[k] for k in ("ids", "pos", "tables", "lens",
                                "slot_blocks", "slot_offsets")})
+        ref = ref[:primary.shape[0]] if primary is not None else ref
+        out["replayed"] = True
+    elif program == "ragged" and "k_pools" in a and "v_pools" in a:
+        ref = engine.audit._reference_ragged(
+            (tuple(a["k_pools"]), tuple(a["v_pools"])),
+            {k: a[k] for k in ("ids", "pos", "seg_ids", "last_idx",
+                               "tables", "lens", "slot_blocks",
+                               "slot_offsets")})
         ref = ref[:primary.shape[0]] if primary is not None else ref
         out["replayed"] = True
     else:

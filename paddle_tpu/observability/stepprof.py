@@ -62,9 +62,11 @@ from typing import Dict, List, Optional, Tuple
 from .metrics import MetricsRegistry
 from .tracer import Span
 
-# the three bucketed program families the engine dispatches (PR 1/4):
-# one-shot prefill, chunked/resumed prefill, batched decode
-STEP_PROGRAMS = ("prefill", "chunk", "decode")
+# the bucketed program families the engine dispatches: the legacy three
+# (PR 1/4 — one-shot prefill, chunked/resumed prefill, batched decode)
+# plus "ragged", the unified packed prefill+decode program (ISSUE 11)
+# that replaces them under EngineConfig.unified_step
+STEP_PROGRAMS = ("prefill", "chunk", "decode", "ragged")
 
 # pre-registered metric names this module owns (tools/check_metrics_docs
 # lints that each appears in README's metrics table)

@@ -434,6 +434,43 @@ def shard_kv_pool(pool):
 last_path: Optional[str] = None
 
 
+def pallas_dispatch(kernel_fn, oracle_fn, use_pallas, tileable,
+                    name: str):
+    """ONE home for the kernel-vs-oracle dispatch policy shared by the
+    decode kernel (:func:`paged_attention`) and the unified ragged
+    kernel (``ops.ragged_paged.ragged_paged_attention``): the operator
+    kill switch (``PADDLE_TPU_DISABLE_PALLAS`` / the
+    ``disable_pallas_kernels`` flag) always wins, ``use_pallas=True``
+    forces the kernel past the tileability heuristic (interpret mode
+    off-TPU), ``False`` pins the oracle, and a kernel failure falls back
+    loudly (or re-raises under ``PADDLE_TPU_STRICT_PALLAS`` /
+    ``strict_pallas``).  Returns ``(out, path)`` with ``path`` in
+    ``{"pallas", "xla"}`` — callers publish it as their module's
+    ``last_path``."""
+    import os
+
+    from ..core import flags
+
+    disable = (os.environ.get("PADDLE_TPU_DISABLE_PALLAS") == "1"
+               or flags.flag("disable_pallas_kernels"))
+    if use_pallas is False:
+        tileable = False          # pin the XLA gather path
+    if not disable and (tileable or use_pallas is True):
+        try:
+            return kernel_fn(), "pallas"
+        except Exception as e:
+            import warnings
+
+            if (os.environ.get("PADDLE_TPU_STRICT_PALLAS") == "1"
+                    or flags.flag("strict_pallas")):
+                raise
+            warnings.warn(
+                f"{name} failed, falling back to the XLA gather path: "
+                f"{type(e).__name__}: {e}",
+                RuntimeWarning, stacklevel=3)
+    return oracle_fn(), "xla"
+
+
 class PagedCache:
     """Per-layer view of the shared block pool, handed to the model's
     attention as its ``cache`` (the model writes K/V into the slot and
@@ -453,7 +490,15 @@ class PagedCache:
         self.slot_offsets = None   # [B] int32 — offset within the page
         self.q_start = None        # chunked prefill only: global position
                                    # of the chunk's first token (scalar or
-                                   # [B] int32) — offsets the causal mask
+                                   # [B] int32) — offsets the causal mask.
+                                   # In ragged mode ([T] int32): the
+                                   # absolute position of EVERY packed
+                                   # token
+        self.seg_ids = None        # unified ragged step (ISSUE 11): [T]
+                                   # int32 row index of each packed token
+                                   # — non-None routes the model's
+                                   # attention through ops/ragged_paged.py
+                                   # (one fused prefill+decode launch)
         self.use_pallas = None     # decode kernel routing hint (ISSUE 5
                                    # satellite): True forces the Pallas
                                    # kernel (interpret mode off-TPU),
@@ -461,13 +506,15 @@ class PagedCache:
                                    # None keeps the auto dispatch
 
     def route(self, block_tables, seq_lens, slot_blocks, slot_offsets,
-              q_start=None):
+              q_start=None, seg_ids=None):
         self.block_tables = jnp.asarray(block_tables, jnp.int32)
         self.seq_lens = jnp.asarray(seq_lens, jnp.int32)
         self.slot_blocks = jnp.asarray(slot_blocks, jnp.int32)
         self.slot_offsets = jnp.asarray(slot_offsets, jnp.int32)
         if q_start is not None:
             self.q_start = jnp.asarray(q_start, jnp.int32)
+        if seg_ids is not None:
+            self.seg_ids = jnp.asarray(seg_ids, jnp.int32)
 
 
 def _xla_paged_attention(q, k_cache, v_cache, block_tables, seq_lens):
@@ -562,39 +609,27 @@ def paged_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
     use_pallas_paged``, ISSUE 5): ``True`` routes through the Pallas
     kernel even when the tileability heuristic says no (off-TPU the
     kernel runs in interpret mode — the CPU smoke-test path); ``False``
-    pins the XLA gather path (the mp>1 choice: GSPMD partitions the
-    gather einsums, while the Pallas kernel is single-shard).  The
-    operator kill switch (``PADDLE_TPU_DISABLE_PALLAS`` / the
-    ``disable_pallas_kernels`` flag) still wins over ``use_pallas=True``.
+    pins the XLA gather path (the mp>1 choice for the LEGACY programs:
+    GSPMD partitions the gather einsums, while this kernel is
+    single-shard — the unified ragged kernel spans the mesh instead).
+    The operator kill switch (``PADDLE_TPU_DISABLE_PALLAS`` / the
+    ``disable_pallas_kernels`` flag) still wins over ``use_pallas=True``
+    (:func:`pallas_dispatch` is the one policy implementation).
     """
-    import os
-
     global last_path
-    from ..core import flags
 
     B, H, D = q.shape
-    disable = (os.environ.get("PADDLE_TPU_DISABLE_PALLAS") == "1"
-               or flags.flag("disable_pallas_kernels"))
     tileable = D % 128 == 0 and k_cache.shape[1] % 8 == 0
-    if use_pallas is False:
-        tileable = False          # pin the XLA gather path
-    if not disable and (tileable or use_pallas is True):
-        try:
-            from .pallas_paged import paged_attention_decode
 
-            out = paged_attention_decode(q, k_cache, v_cache,
-                                         block_tables, seq_lens)
-            last_path = "pallas"
-            return out
-        except Exception as e:
-            import warnings
+    def kernel():
+        from .pallas_paged import paged_attention_decode
 
-            if (os.environ.get("PADDLE_TPU_STRICT_PALLAS") == "1"
-                    or flags.flag("strict_pallas")):
-                raise
-            warnings.warn(
-                f"pallas paged attention failed, falling back to the XLA "
-                f"gather path: {type(e).__name__}: {e}",
-                RuntimeWarning, stacklevel=2)
-    last_path = "xla"
-    return _xla_paged_attention(q, k_cache, v_cache, block_tables, seq_lens)
+        return paged_attention_decode(q, k_cache, v_cache, block_tables,
+                                      seq_lens)
+
+    out, last_path = pallas_dispatch(
+        kernel,
+        lambda: _xla_paged_attention(q, k_cache, v_cache, block_tables,
+                                     seq_lens),
+        use_pallas, tileable, "pallas paged attention")
+    return out

@@ -135,6 +135,16 @@ class EngineConfig:
     # are computed unconditionally, so audit on vs off is the SAME
     # compiled program — trace counts provably unchanged).
     audit: Optional[AuditConfig] = None
+    # Unified ragged step program (ISSUE 11): every engine step runs ONE
+    # packed ragged launch (ops/ragged_paged.py) serving mixed prefill
+    # chunks and decode rows together, instead of picking from the three
+    # legacy program families (one-shot prefill / chunked prefill /
+    # decode).  The bucket set collapses to (total-token, table-width)
+    # pairs — strictly fewer traces — and at mp>1 the Pallas fast path
+    # runs mesh-spanning through shard_map instead of being auto-pinned
+    # off.  Default off this PR; token-identical to the legacy dispatch
+    # under greedy decoding (tested).
+    unified_step: bool = False
 
 
 class EngineCore:
@@ -225,7 +235,12 @@ class EngineCore:
                 f"EngineConfig.mp={config.mp} but the global mesh has "
                 f"mp={self.mp}; call distributed.topology.init_mesh(mp=...) "
                 "before building the engine")
+        self._unified = bool(config.unified_step)
         self._use_pallas = config.use_pallas_paged
+        # the unified ragged program keeps its own routing: its Pallas
+        # kernel is expressed through shard_map over the mp axis, so it
+        # is NEVER subject to the legacy single-shard pin below
+        self._use_pallas_ragged = config.use_pallas_paged
         if self.mp > 1:
             if cfg.num_key_value_heads % self.mp or \
                     cfg.num_attention_heads % self.mp:
@@ -234,12 +249,21 @@ class EngineCore:
                     f"{cfg.num_key_value_heads} and num_attention_heads="
                     f"{cfg.num_attention_heads} (the KV pools shard along "
                     "the head dim)")
-            if self._use_pallas:
+            if self._use_pallas and not self._unified:
+                # the ONLY remaining mp>1 kernel restriction (ISSUE 11
+                # lifted the silent auto-pin): forcing the LEGACY
+                # single-shard decode kernel into a mesh program fails
+                # loudly instead of being quietly overridden
                 raise ValueError(
-                    "use_pallas_paged=True requires mp=1: the Pallas decode "
-                    "kernel is single-shard; the mp path runs the XLA "
-                    "gather attention GSPMD partitions")
-            self._use_pallas = False  # pin XLA path inside the mesh program
+                    "use_pallas_paged=True at mp>1 requires "
+                    "unified_step=True: the legacy decode kernel is "
+                    "single-shard — the unified ragged program runs the "
+                    "kernel mesh-spanning via shard_map, or drop the "
+                    "force to use the XLA gather path")
+            self._use_pallas = False  # legacy three-family programs pin
+            # the XLA path inside the mesh program (single-shard kernel);
+            # self._use_pallas_ragged keeps the configured routing — the
+            # shard_map ragged kernel IS the mp fast path
             from ..parallel.utils import apply_param_shardings
 
             # place every annotated parameter (column/row/vocab-parallel
@@ -256,13 +280,16 @@ class EngineCore:
         # so these count COMPILATIONS, not calls (the N31 acceptance hook)
         self.decode_trace_count = 0
         self.prefill_trace_count = 0
+        self.ragged_trace_count = 0
         self.decode_buckets = set()
         self.prefill_buckets = set()
+        self.ragged_buckets = set()
         donate = (1, 2) if jax.default_backend() == "tpu" else ()
         if self.mp > 1:
             jit_kw = self._mesh_jit_shardings(mesh, cfg)
         else:
-            jit_kw = {"decode": {}, "prefill": {}, "chunk": {}}
+            jit_kw = {"decode": {}, "prefill": {}, "chunk": {},
+                      "ragged": {}}
         self._jit_decode = jax.jit(self._decode_fn, donate_argnums=donate,
                                    **jit_kw["decode"])
         self._jit_prefill = jax.jit(self._prefill_fn, donate_argnums=donate,
@@ -270,6 +297,8 @@ class EngineCore:
         self._jit_chunk_prefill = jax.jit(self._chunk_prefill_fn,
                                           donate_argnums=donate,
                                           **jit_kw["chunk"])
+        self._jit_unified = jax.jit(self._unified_fn, donate_argnums=donate,
+                                    **jit_kw["ragged"])
         self._profile_ops = config.profile_ops
         self._evictions_seen = 0  # last-synced kv.reuse_evictions value
         model.eval()
@@ -307,6 +336,13 @@ class EngineCore:
             #  lens, slot_blocks, slot_offsets)
             "chunk": {"in_shardings": (params, pools, pools) + (repl,) * 7,
                       "out_shardings": out},
+            # (param_vals, k_pools, v_pools, ids, pos, seg_ids, last_idx,
+            #  tables, lens, slot_blocks, slot_offsets) — the unified
+            # ragged step (ISSUE 11): packed routing metadata replicated,
+            # pools sharded; inside, the ragged kernel re-partitions over
+            # mp via shard_map
+            "ragged": {"in_shardings": (params, pools, pools) + (repl,) * 8,
+                       "out_shardings": out},
         }
 
     # --- functional model step (traced) ------------------------------------
@@ -408,6 +444,38 @@ class EngineCore:
             caches.append(c)
         logits = self._call_model(ids, caches, start, param_vals)
         last = jnp.take(logits[0], last_pos, axis=0).astype(jnp.float32)
+        return (last, logit_stats(last),
+                tuple(c.k_pool._value for c in caches),
+                tuple(c.v_pool._value for c in caches))
+
+    def _unified_fn(self, param_vals, k_pools, v_pools, ids, pos, seg_ids,
+                    last_idx, tables, lens, slot_blocks, slot_offsets):
+        """ONE packed ragged step (ISSUE 11): ``ids`` is a flat
+        ``[1, Tb]`` token batch mixing decode rows (1 token each) and
+        prefill chunks, with per-token absolute positions ``pos``
+        ([1, Tb]), per-token row routing ``seg_ids`` ([Tb]) and per-ROW
+        block tables / KV lengths ([Tb, TWb] / [Tb]; rows past the real
+        count are null-page pads).  Every token scatters its K/V into its
+        own (block, offset) slot and attends causally over its row's
+        pages — the single fused program that replaces the three legacy
+        families.  Returns each row's last-real-token logits (gathered
+        at ``last_idx``) + updated pools.  Shapes fixed per
+        (token-bucket, table-bucket) pair."""
+        self.ragged_trace_count += 1
+        self.metrics.count("ragged_jit_traces")
+        self.tracer.instant("ragged_jit_trace", cat="jit",
+                            token_bucket=int(ids.shape[1]),
+                            table_bucket=int(tables.shape[1]))
+        caches = []
+        for k, v in zip(k_pools, v_pools):
+            c = PagedCache(Tensor(k), Tensor(v))
+            c.route(tables, lens, slot_blocks, slot_offsets,
+                    q_start=pos[0], seg_ids=seg_ids)
+            c.use_pallas = self._use_pallas_ragged  # shard_map kernel —
+            # the mp>1 auto-pin does NOT apply to the ragged program
+            caches.append(c)
+        logits = self._call_model(ids, caches, pos, param_vals)
+        last = jnp.take(logits[0], last_idx, axis=0).astype(jnp.float32)
         return (last, logit_stats(last),
                 tuple(c.k_pool._value for c in caches),
                 tuple(c.v_pool._value for c in caches))
@@ -537,6 +605,53 @@ class EngineCore:
         way."""
         return phase if self.mp > 1 else None
 
+    def _begin_prefill_chunk(self, req: Request, t0: float):
+        """Resolve + reserve this step's prefill chunk for ``req`` — the
+        host bookkeeping shared row-for-row by the legacy prefill
+        programs and the unified packed step (sharing it is what keeps
+        the two paths' metrics and greedy tokens identical).  Returns
+        ``(ids_full, target, start, n, recompute)``."""
+        rid = req.request_id
+        ids_full = req.prompt_ids + req.output_tokens
+        target = len(ids_full)
+        start = self.kv.seq_len(rid)  # cached fork + earlier chunks
+        n = req._chunk_tokens if req._chunk_tokens else target - start
+        req._chunk_tokens = None
+        recompute = bool(req.output_tokens
+                         and start == req.num_cached_tokens)
+        if req.prefill_start_time is None:
+            # first prefill work for this request: the queue-wait leg of
+            # the SLO breakdown ends here
+            req.prefill_start_time = t0
+            self.metrics.observe_queue_wait(t0 - req.arrival_time)
+        if recompute:
+            self.metrics.count("recompute_prefills")  # first chunk only
+        if not self.kv.allocate(rid, n):
+            raise PoolExhausted(  # scheduler planning guarantees room
+                f"prefill chunk of {n} tokens for {rid!r} after admission")
+        return ids_full, target, start, n, recompute
+
+    def _finish_prefill_chunk(self, req: Request, ids_full, target: int,
+                              start: int, n: int, recompute: bool,
+                              t0: float, logits_row) -> None:
+        """Post-launch bookkeeping for one prefill chunk, shared by both
+        program paths: commit, lifecycle event, counters, prefix-hash
+        registration, and the completion emission (the final chunk's
+        last-position logits ARE the request's next token)."""
+        rid = req.request_id
+        self.kv.commit(rid, n)
+        self._lc(rid, _lc.EV_PREFILL_CHUNK, start=start, tokens=n,
+                 target=target, chunk=bool(start or n != target),
+                 recompute=recompute,
+                 duration_s=round(time.perf_counter() - t0, 6))
+        self.metrics.count("prefill_tokens_computed", n)
+        if self.kv.prefix_cache_enabled:
+            # index the fully-written blocks NOW, so a same-prefix request
+            # admitted next step shares them even mid-prefill
+            self.kv.record_block_hashes(rid, ids_full, start + n)
+        if start + n >= target:
+            self._emit(req, req.sampling.sample(logits_row, req._rng))
+
     def _prefill(self, req: Request) -> None:
         """Run one bucketed prefill program for ``req`` — the whole
         prompt (cold one-shot), or one chunk of it (token-budgeted
@@ -544,23 +659,9 @@ class EngineCore:
         the request's next token only when the prefill completes (the
         final chunk's last-position logits ARE that token)."""
         rid = req.request_id
-        ids = req.prompt_ids + req.output_tokens
-        target = len(ids)
-        start = self.kv.seq_len(rid)  # cached fork + earlier chunks
-        n = req._chunk_tokens if req._chunk_tokens else target - start
-        req._chunk_tokens = None
         t_chunk0 = time.perf_counter()
-        recompute = bool(req.output_tokens and start == req.num_cached_tokens)
-        if req.prefill_start_time is None:
-            # first prefill work for this request: the queue-wait leg of
-            # the SLO breakdown ends here
-            req.prefill_start_time = t_chunk0
-            self.metrics.observe_queue_wait(t_chunk0 - req.arrival_time)
-        if recompute:
-            self.metrics.count("recompute_prefills")  # first chunk only
-        if not self.kv.allocate(rid, n):
-            raise PoolExhausted(  # scheduler planning guarantees room
-                f"prefill chunk of {n} tokens for {rid!r} after admission")
+        ids, target, start, n, recompute = \
+            self._begin_prefill_chunk(req, t_chunk0)
         table = self.kv.table(rid)
         pos = np.arange(start, start + n)
         if start == 0 and n == target:
@@ -649,18 +750,8 @@ class EngineCore:
                             "slot_blocks": blocks, "slot_offsets": offs},
                     requests=[{"id": str(rid),
                                "greedy": req.sampling.temperature == 0.0}])
-        self.kv.commit(rid, n)
-        self._lc(rid, _lc.EV_PREFILL_CHUNK, start=start, tokens=n,
-                 target=target, chunk=bool(start or n != target),
-                 recompute=recompute,
-                 duration_s=round(time.perf_counter() - t_chunk0, 6))
-        self.metrics.count("prefill_tokens_computed", n)
-        if self.kv.prefix_cache_enabled:
-            # index the fully-written blocks NOW, so a same-prefix request
-            # admitted next step shares them even mid-prefill
-            self.kv.record_block_hashes(rid, ids, start + n)
-        if start + n >= target:
-            self._emit(req, req.sampling.sample(logits, req._rng))
+        self._finish_prefill_chunk(req, ids, target, start, n, recompute,
+                                   t_chunk0, logits)
 
     def _decode(self, reqs: List[Request]) -> Dict[object, int]:
         """One bucketed decode step for ``reqs`` (slots already reserved
@@ -739,6 +830,133 @@ class EngineCore:
             result[r.request_id] = tok
         return result
 
+    def _unified_exec(self, prefills: List[Request],
+                      decodes: List[Request]) -> Dict[object, int]:
+        """Pack this step's whole plan — decode rows + prefill chunks —
+        into ONE ragged program launch (``EngineConfig.unified_step``).
+        The token dim buckets on the TOTAL scheduled token count and the
+        row/table arrays are padded to the same bucket, so the compile
+        bound is (token-bucket × table-bucket) for the one family —
+        strictly fewer shapes than the legacy three.  Host bookkeeping
+        (allocation, commits, hash registration, sampling, lifecycle
+        events) matches the legacy paths row-for-row, which is what
+        keeps greedy tokens identical."""
+        rows: List[Dict] = []
+        t0 = time.perf_counter()
+        for r in decodes:
+            p = self.kv.seq_len(r.request_id)
+            rows.append({"req": r, "kind": "decode", "start": p, "n": 1,
+                         "tokens": [r.last_token], "slot": r._slot})
+        for req in prefills:
+            # the SAME pre-launch bookkeeping the legacy programs run
+            # (queue-wait, recompute accounting, all-or-nothing allocate)
+            ids_full, target, start, n, recompute = \
+                self._begin_prefill_chunk(req, t0)
+            rows.append({"req": req, "kind": "chunk", "start": start,
+                         "n": n, "tokens": ids_full[start:start + n],
+                         "target": target, "recompute": recompute,
+                         "ids_full": ids_full})
+        R = len(rows)
+        T = sum(row["n"] for row in rows)
+        Tb = bucket_size(T)
+        width = max(len(self.kv.table(row["req"].request_id))
+                    for row in rows)
+        TWb = bucket_size(width)
+        ids = np.zeros((1, Tb), np.int64)
+        pos = np.zeros((1, Tb), np.int32)
+        # pad tokens route to a pad row (all-null table, kv_len 1); when
+        # R == Tb every row is real and no pad token exists
+        seg = np.full((Tb,), min(R, Tb - 1), np.int32)
+        last_idx = np.zeros((Tb,), np.int32)
+        tables = np.zeros((Tb, TWb), np.int32)
+        lens = np.ones((Tb,), np.int32)   # pad rows: 1 token of null page
+        slot_blocks = np.zeros((Tb,), np.int32)  # pad tokens -> null page
+        slot_offsets = np.zeros((Tb,), np.int32)
+        cursor = 0
+        for i, row in enumerate(rows):
+            req = row["req"]
+            table = self.kv.table(req.request_id)
+            n, start = row["n"], row["start"]
+            ids[0, cursor:cursor + n] = row["tokens"]
+            pp = np.arange(start, start + n)
+            pos[0, cursor:cursor + n] = pp
+            seg[cursor:cursor + n] = i
+            tables[i, :len(table)] = table
+            lens[i] = start + n           # cache length AFTER this step
+            if row["kind"] == "decode":
+                slot_blocks[cursor], slot_offsets[cursor] = row["slot"]
+            else:
+                slot_blocks[cursor:cursor + n] = [
+                    table[x // self.block_size] for x in pp]
+                slot_offsets[cursor:cursor + n] = pp % self.block_size
+            cursor += n
+            last_idx[i] = cursor - 1
+        self.ragged_buckets.add(("ragged", Tb, TWb))
+        self.metrics.count("unified_steps")
+        traces0 = self.ragged_trace_count
+        pre_pools = self.audit.snapshot_pools(self._k_pools,
+                                              self._v_pools)
+        with self.tracer.span("unified_step", cat="serving", tokens=T,
+                              rows=R, token_bucket=Tb, table_bucket=TWb,
+                              requests=",".join(
+                                  str(row["req"].request_id)
+                                  for row in rows)):
+            with StepTimer(self.metrics, "unified_step",
+                           self._collective_phase("ragged")) as st:
+                out, stats, self._k_pools, self._v_pools = \
+                    self._jit_unified(
+                        self._param_vals(), self._k_pools, self._v_pools,
+                        ids, pos, seg, last_idx, tables, lens,
+                        slot_blocks, slot_offsets)
+                out = np.asarray(out, np.float32)
+        if self.ragged_trace_count > traces0:
+            self.stepprof.record_compile("ragged", (Tb, TWb), st.dt)
+        # scheduled = T real tokens (decode rows count 1 each) vs the Tb
+        # token bucket — the same axis the scheduler's tokens_planned
+        # ledger counts, so the PR 8 invariant stays exact in unified
+        # mode.  Table-width padding rides the record as attrs.
+        self.stepprof.record_program(
+            "ragged", (Tb, TWb), scheduled=T, capacity=Tb, wall_s=st.dt,
+            rows=R, table_width=width,
+            requests=",".join(str(row["req"].request_id) for row in rows))
+        if self.audit.enabled:
+            # sentinel over the REAL rows; the shadow oracle re-executes
+            # sampled packed steps through the independently jitted XLA
+            # ragged reference (audit._reference_ragged)
+            self.audit.observe_program(
+                "ragged", np.asarray(stats, np.float32)[:R], (Tb, TWb),
+                logits=out[:R],
+                inputs={"ids": ids, "pos": pos, "seg_ids": seg,
+                        "last_idx": last_idx, "tables": tables,
+                        "lens": lens, "slot_blocks": slot_blocks,
+                        "slot_offsets": slot_offsets},
+                pre_pools=pre_pools,
+                requests=[{"id": str(row["req"].request_id),
+                           "greedy":
+                           row["req"].sampling.temperature == 0.0}
+                          for row in rows])
+        emitted: Dict[object, int] = {}
+        for i, row in enumerate(rows):
+            req = row["req"]
+            rid = req.request_id
+            n, start = row["n"], row["start"]
+            if row["kind"] == "decode":
+                self.kv.commit(rid, 1)
+                tok = req.sampling.sample(out[i], req._rng)
+                self._emit(req, tok)
+                emitted[rid] = tok
+                continue
+            # the SAME post-launch bookkeeping the legacy programs run
+            # (commit, lifecycle event, counters, hash registration,
+            # completion emission)
+            before = len(req.output_tokens)
+            self._finish_prefill_chunk(req, row["ids_full"],
+                                       row["target"], start, n,
+                                       row["recompute"], t0, out[i])
+            if len(req.output_tokens) > before:  # prefill completed
+                emitted[rid] = req.output_tokens[-1]
+        return emitted
+
     def step(self) -> Dict[object, int]:
         """One engine iteration: schedule → prefill(s) → decode batch →
         retire.  Returns {request_id: token} emitted this step."""
@@ -781,16 +999,23 @@ class EngineCore:
                             request=str(req.request_id),
                             trace=req.trace_id, cached_tokens=cached)
                 emitted: Dict[object, int] = {}
-                for req in plan.prefills:
-                    before = len(req.output_tokens)
-                    self._prefill(req)
-                    if len(req.output_tokens) > before:  # prefill done —
-                        # a partial chunk emits nothing yet
-                        emitted[req.request_id] = req.output_tokens[-1]
                 decodes = [r for r in plan.decodes
                            if r.state is RequestState.RUNNING]
-                if decodes:
-                    emitted.update(self._decode(decodes))
+                if self._unified:
+                    # unified ragged step (ISSUE 11): the whole plan —
+                    # decode rows + prefill chunks — is ONE packed launch
+                    if plan.prefills or decodes:
+                        emitted = self._unified_exec(plan.prefills,
+                                                     decodes)
+                else:
+                    for req in plan.prefills:
+                        before = len(req.output_tokens)
+                        self._prefill(req)
+                        if len(req.output_tokens) > before:  # done —
+                            # a partial chunk emits nothing yet
+                            emitted[req.request_id] = req.output_tokens[-1]
+                    if decodes:
+                        emitted.update(self._decode(decodes))
                 for req in list(self.scheduler.running):
                     if req.finished:
                         self._retire(req)

@@ -69,6 +69,10 @@ _COUNTER_NAMES = (
     # carried a per-request slo_ms; slo_good the subset that met it
     "slo",
     "slo_good",
+    # unified ragged step (ISSUE 11): packed program launches + the
+    # in-trace retrace counter of the one collapsed program family
+    "unified_steps",
+    "ragged_jit_traces",
 )
 
 _GAUGE_NAMES = ("queue_depth", "num_running", "kv_pool_occupancy",
@@ -85,6 +89,7 @@ _HISTOGRAM_NAMES = (
     "inter_token_latency",
     "prefill_step",
     "decode_step",
+    "unified_step",   # ISSUE 11: wall time of one packed ragged launch
     "queue_wait",
     "prefill",
     "decode_itl",
@@ -96,8 +101,9 @@ SLO_PHASES = ("queue_wait", "prefill", "decode_itl", "e2e")
 
 # mesh-spanning step phases (ISSUE 5): pre-registered so the
 # serving_collective_seconds series shows on /metrics even before (or
-# without) any multi-chip step running
-_COLLECTIVE_PHASES = ("prefill", "decode")
+# without) any multi-chip step running.  "ragged" is the unified packed
+# step (ISSUE 11) — the one program family that replaces the other two.
+_COLLECTIVE_PHASES = ("prefill", "decode", "ragged")
 
 # every full metric name this module pre-registers, for the README
 # metrics-table lint (tools/check_metrics_docs.py)

@@ -85,6 +85,21 @@ class SchedulerConfig:
                                      # chunks alongside the decode batch
                                      # instead of stalling it.  None =
                                      # unlimited (one-shot prefill).
+    max_tokens_per_step: Optional[int] = None
+                                     # unified ragged packing (ISSUE 11):
+                                     # ONE token budget for the whole
+                                     # step — decode rows (1 token each)
+                                     # claim it first (they are NEVER
+                                     # split across steps), prefill work
+                                     # (continuations + admissions)
+                                     # competes for the remainder.  The
+                                     # packed token bucket is therefore
+                                     # bounded by bucket_size(max(this,
+                                     # max_num_seqs)) — a decode batch
+                                     # larger than the budget still runs
+                                     # whole.  None = no combined cap
+                                     # (prefill still honours its own
+                                     # budget).
 
     def __post_init__(self):
         if (self.max_prefill_tokens_per_step is not None
@@ -94,6 +109,11 @@ class SchedulerConfig:
             raise ValueError(
                 "max_prefill_tokens_per_step must be None or >= 1, got "
                 f"{self.max_prefill_tokens_per_step}")
+        if (self.max_tokens_per_step is not None
+                and self.max_tokens_per_step < 1):
+            raise ValueError(
+                "max_tokens_per_step must be None or >= 1, got "
+                f"{self.max_tokens_per_step}")
 
 
 @dataclass
@@ -184,6 +204,17 @@ class ContinuousBatchingScheduler:
         the waiting queue."""
         budget = self.config.max_prefill_tokens_per_step
         remaining = float("inf") if budget is None else int(budget)
+        total = self.config.max_tokens_per_step
+        if total is not None:
+            # unified packing (ISSUE 11): this step's decode rows (slots
+            # reserved before prefill planning) already claimed one
+            # packed token each — prefill work competes for the rest of
+            # the SINGLE budget, so decode latency is protected.  Decode
+            # rows themselves are never split across steps, so the
+            # packed token count is bounded by max(total, num decode
+            # rows), not by total alone.
+            remaining = min(remaining,
+                            max(0, int(total) - len(out.decodes)))
         promised = 0  # blocks pledged to prefills planned THIS pass: the
                       # engine allocates them only when it runs the chunk,
                       # so kv.num_available alone would double-count

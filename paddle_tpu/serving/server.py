@@ -862,7 +862,8 @@ class CompletionServer:
 
 def _toy_engine(layers: int = 2, num_blocks: int = 64,
                 block_size: int = 4, registry=None,
-                metrics_labels=None, audit=None) -> EngineCore:
+                metrics_labels=None, audit=None,
+                unified: bool = False) -> EngineCore:
     import paddle_tpu as paddle
     from ..models import LlamaConfig, LlamaForCausalLM
     from .engine import EngineConfig
@@ -872,14 +873,15 @@ def _toy_engine(layers: int = 2, num_blocks: int = 64,
     return EngineCore(model,
                       config=EngineConfig(num_blocks=num_blocks,
                                           block_size=block_size,
-                                          audit=audit),
+                                          audit=audit,
+                                          unified_step=unified),
                       registry=registry, metrics_labels=metrics_labels)
 
 
 def _toy_fleet(dp: int = 1, layers: int = 2, num_blocks: int = 64,
                max_queue: int = 64,
                flight_dir: Optional[str] = None,
-               audit=None) -> FleetRouter:
+               audit=None, unified: bool = False) -> FleetRouter:
     """A dp-replica fleet of toy engines on one shared registry: each
     replica gets its OWN model instance (engine threads swap parameter
     values during the traced step — modules must not be shared) with
@@ -888,7 +890,8 @@ def _toy_fleet(dp: int = 1, layers: int = 2, num_blocks: int = 64,
     return FleetRouter.build(
         lambda i, registry: _toy_engine(
             layers=layers, num_blocks=num_blocks, registry=registry,
-            metrics_labels={"replica": str(i)}, audit=audit),
+            metrics_labels={"replica": str(i)}, audit=audit,
+            unified=unified),
         dp=dp, config=FleetConfig(max_queue=max_queue,
                                   flight_dir=flight_dir))
 
@@ -908,15 +911,19 @@ def _http(port: int, method: str, path: str, body: Optional[dict] = None):
     return status, data
 
 
-async def _selftest_async(dp: int = 1, audit_sample: int = 1) -> int:
+async def _selftest_async(dp: int = 1, audit_sample: int = 1,
+                          unified: bool = False) -> int:
     from ..observability.audit import AuditConfig
 
     loop = asyncio.get_running_loop()
     # the selftest always exercises the numerics-audit surface (ISSUE
     # 10): every step sampled by default, so the probe completion runs
-    # with the shadow oracle live and must come back divergence-free
+    # with the shadow oracle live and must come back divergence-free.
+    # --unified routes the probe through the packed ragged step program
+    # (ISSUE 11) under the same audit net.
     fleet = _toy_fleet(dp=dp, audit=AuditConfig(
-        enabled=True, sample_every=max(1, audit_sample)))
+        enabled=True, sample_every=max(1, audit_sample)),
+        unified=unified)
     server = CompletionServer(fleet, ServerConfig(port=0))
     engine = server.engine
     await server.start()
@@ -994,7 +1001,8 @@ async def _serve_cli(args) -> int:
         audit = AuditConfig(enabled=True, sample_every=args.audit_sample)
     fleet = _toy_fleet(dp=args.dp, layers=args.layers,
                        num_blocks=args.blocks, max_queue=args.max_queue,
-                       flight_dir=args.flight_dir, audit=audit)
+                       flight_dir=args.flight_dir, audit=audit,
+                       unified=args.unified)
     server = CompletionServer(fleet, ServerConfig(
         host=args.host, port=args.port,
         max_queue=args.max_queue,
@@ -1075,6 +1083,11 @@ def main(argv=None) -> int:
                         "(NaN/Inf sentinel + logit telemetry on every "
                         "step; .npz repros land in --flight-dir); off "
                         "by default")
+    p.add_argument("--unified", action="store_true",
+                   help="serve through the unified ragged step program "
+                        "(one packed prefill+decode launch per engine "
+                        "step, collapsed bucket set; at mp>1 the Pallas "
+                        "fast path runs mesh-spanning via shard_map)")
     p.add_argument("--selftest", action="store_true",
                    help="boot on an ephemeral port, serve one completion "
                         "against the toy fleet through the router path, "
@@ -1094,7 +1107,8 @@ def main(argv=None) -> int:
         topology.init_mesh(mp=args.mp)
     if args.selftest:
         return asyncio.run(_selftest_async(
-            dp=args.dp, audit_sample=args.audit_sample or 1))
+            dp=args.dp, audit_sample=args.audit_sample or 1,
+            unified=args.unified))
     return asyncio.run(_serve_cli(args))
 
 

@@ -68,6 +68,9 @@ SCAN_FILES = (
     os.path.join(_REPO, "paddle_tpu", "observability", "audit.py"),
     os.path.join(_REPO, "paddle_tpu", "ops", "paged_attention.py"),
     os.path.join(_REPO, "paddle_tpu", "ops", "pallas_paged.py"),
+    # ISSUE 11: the unified ragged kernel sits on the serving hot path
+    # (its module-level last_path is the only state — keep it that way)
+    os.path.join(_REPO, "paddle_tpu", "ops", "ragged_paged.py"),
     os.path.join(_REPO, "paddle_tpu", "parallel", "mp_layers.py"),
     os.path.join(_REPO, "paddle_tpu", "parallel", "utils.py"),
     os.path.join(_REPO, "paddle_tpu", "parallel", "_compat.py"),

@@ -1002,10 +1002,149 @@ def serving_unified_bench() -> dict:
     return result
 
 
+def serving_chaos_bench() -> dict:
+    """Self-healing chaos phase (ISSUE 12): the preempting shared-prefix
+    stream through a dp=2 supervised fleet under a scripted fault plan —
+    one injected engine death (``engine_step_raise``) and one injected
+    audit corruption (``kernel_corrupt`` → quarantine-and-replace) —
+    vs the same stream fault-free.  Asserts greedy token identity for
+    every request across BOTH faults, ZERO lost requests, exactly one
+    restart per cause, and the quarantined replica's auditor back to
+    ``ok``; records recovery times and re-dispatch counts.
+    """
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.observability.audit import AuditConfig
+    from paddle_tpu.serving import (
+        EngineConfig,
+        EngineCore,
+        FaultPlan,
+        FaultSpec,
+        FleetConfig,
+        FleetRouter,
+        FleetSupervisor,
+        SamplingParams,
+        SchedulerConfig,
+        SupervisorConfig,
+    )
+    from paddle_tpu.serving.fleet import affinity_replica_index
+
+    rng = np.random.default_rng(0)
+    prefix = rng.integers(0, 256, 8).tolist()
+    prompts = [prefix + rng.integers(0, 256, 8).tolist() for _ in range(6)]
+    # deterministic targeting (pure preview, computed before any engine
+    # exists): the DEATH hits the replica the shared prefix routes to —
+    # the one with traffic — and the CORRUPTION hits the OTHER replica,
+    # which only starts stepping once the death re-dispatches the
+    # stream onto it (the load-bearing cascade: death → failover →
+    # corrupt survivor → quarantine)
+    target = affinity_replica_index(prompts[0], dp=2, block_size=4)
+    assert target is not None
+
+    def factory(i, registry):
+        paddle.seed(0)
+        model = LlamaForCausalLM(LlamaConfig.tiny(num_hidden_layers=2))
+        # 14 usable blocks of 4 per replica: the stream preempts and
+        # recomputes on the loaded replica, chaos or not
+        return EngineCore(model, config=EngineConfig(
+            num_blocks=15, block_size=4,
+            scheduler=SchedulerConfig(
+                max_num_seqs=4, max_prefill_tokens_per_step=8),
+            audit=AuditConfig(enabled=True, sample_every=1)),
+            registry=registry, metrics_labels={"replica": str(i)})
+
+    def run(plan) -> dict:
+        fleet = FleetRouter.build(factory, dp=2,
+                                  config=FleetConfig(fault_plan=plan))
+        sup = FleetSupervisor(fleet, config=SupervisorConfig(
+            backoff_initial_s=0.02, backoff_max_s=0.5,
+            poll_interval_s=0.01, quarantine_drain_s=10.0)).start()
+        fleet.start()
+        t0 = time.perf_counter()
+        hs = [fleet.submit_request(p, SamplingParams(max_new_tokens=10),
+                                   request_id=f"chaos-{i}",
+                                   retryable=True)
+              for i, p in enumerate(prompts)]
+        fleet.wait(hs, timeout=300)
+        wall = time.perf_counter() - t0
+        # zero lost: every request finished by LENGTH, nothing aborted
+        lost = [h.rid for h in hs if h.finish_reason != "length"]
+        assert not lost, f"requests lost under chaos: {lost}"
+        gen = sum(len(h.output_tokens) for h in hs)
+        if plan is not None:
+            # both recovery loops completed BEFORE the counters are
+            # read: replica restarted after the death, and the corrupted
+            # replica replaced with its auditor back to ok
+            deadline = time.perf_counter() + 60
+            while time.perf_counter() < deadline:
+                if (int(sup._quar_c.value) == 1
+                        and all(r.healthy for r in fleet.replicas)
+                        and all(r.engine.audit.status == "ok"
+                                for r in fleet.replicas)):
+                    break
+                time.sleep(0.02)
+            assert int(sup._quar_c.value) == 1, "quarantine did not fire"
+            assert all(r.engine.audit.status == "ok"
+                       for r in fleet.replicas), \
+                "audit did not return to ok after quarantine"
+        rec = {
+            "wall_s": round(wall, 4),
+            "tokens_per_sec": round(gen / wall, 2),
+            "generated_tokens": gen,
+            "restarts": {c: int(v.value)
+                         for c, v in sup._restarts.items()},
+            "redispatched": int(sup._redis_c.value),
+            "replica_failed": int(sup._failed_c.value),
+            "quarantines": int(sup._quar_c.value),
+            "recovery": {
+                "count": sup._recovery_h.count,
+                "max_s": (round(sup._recovery_h.max, 4)
+                          if sup._recovery_h.count else None),
+                "sum_s": round(sup._recovery_h.sum, 4),
+            },
+            "outputs": [list(h.output_tokens) for h in hs],
+        }
+        fleet.shutdown(drain_timeout=5.0)
+        return rec
+
+    clean = run(None)
+    plan = FaultPlan(faults=(
+        FaultSpec(point="engine_step_raise", step=6, replica=str(target)),
+        FaultSpec(point="kernel_corrupt", step=4,
+                  replica=str(1 - target)),))
+    chaos = run(plan)
+    identical = chaos["outputs"] == clean["outputs"]
+    result = {
+        "metric": "serving_chaos_recovery_max_seconds",
+        "value": chaos["recovery"]["max_s"], "unit": "s",
+        "phase": "serving_chaos",
+        "greedy_token_identical": identical,
+        "requests_lost": 0,
+        "fault_plan": plan.to_obj(),
+        "target_replica": str(target),
+        "clean_tokens_per_sec": clean["tokens_per_sec"],
+        "chaos_tokens_per_sec": chaos["tokens_per_sec"],
+        "restarts": chaos["restarts"],
+        "quarantines": chaos["quarantines"],
+        "redispatched": chaos["redispatched"],
+        "replica_failed": chaos["replica_failed"],
+        "recovery": chaos["recovery"],
+        "clean": clean, "chaos": chaos,
+    }
+    assert identical, \
+        "chaos-run output diverged from the fault-free run under greedy"
+    assert chaos["restarts"]["engine_death"] == 1, chaos["restarts"]
+    assert chaos["restarts"]["quarantine"] == 1, chaos["restarts"]
+    assert chaos["replica_failed"] == 0, chaos
+    return result
+
+
 def serving_main() -> dict:
     """``--serving``: shared-prefix + tensor-parallel + fleet +
-    numerics-audit + unified-ragged phases, combined into one
-    ``BENCH_SERVING.json`` record."""
+    numerics-audit + unified-ragged + self-healing-chaos phases,
+    combined into one ``BENCH_SERVING.json`` record."""
     # must precede the FIRST jax import in this process: the mp phase
     # needs ≥2 host devices.  A pre-set count <2 (e.g. =1 exported for
     # single-device debugging) is raised, not trusted — otherwise
@@ -1039,6 +1178,10 @@ def serving_main() -> dict:
         # checkpoint before the unified phase for the same reason
         json.dump(result, f, indent=1)
     result["unified"] = serving_unified_bench()
+    with open(path, "w") as f:
+        # checkpoint before the chaos phase for the same reason
+        json.dump(result, f, indent=1)
+    result["chaos"] = serving_chaos_bench()
     with open(path, "w") as f:
         json.dump(result, f, indent=1)
     return result

@@ -560,7 +560,7 @@ class NumericsAuditor:
                     trigger, replica=self._replica,
                     detail=json.dumps(entry, default=str))
             except Exception:
-                pass  # telemetry must never take down the engine thread
+                pass  # swallow-ok: telemetry must never take down the engine thread; the divergence itself is already counted + degraded above
 
     def _repro_dir(self) -> Optional[str]:
         if self.cfg.repro_dir is not None:

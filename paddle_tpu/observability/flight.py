@@ -32,6 +32,10 @@ Triggers (``serving_flight_dumps_total{trigger=...}`` counts the dumps):
 ``divergence``            the shadow-oracle re-execution disagreed with the
                           primary program (token or logit divergence); the
                           ``.npz`` repro path rides ``detail``
+``quarantine``            the fleet supervisor quarantined an audit-degraded
+                          replica for replacement (``serving/resilience.py``)
+``crash_loop``            a replica hit its restart cap inside the crash-loop
+                          window and was permanently excluded
 ========================  ====================================================
 
 Boundedness (``tools/check_bounded_metrics.py`` lints this module): each
@@ -58,7 +62,8 @@ from .lifecycle import LifecycleTracker
 from .metrics import MetricsRegistry
 
 TRIGGERS = ("engine_death", "watchdog", "preemption_storm",
-            "rejection_burst", "drain_overrun", "nonfinite", "divergence")
+            "rejection_burst", "drain_overrun", "nonfinite", "divergence",
+            "quarantine", "crash_loop")
 
 # pre-registered metric names this module owns (tools/check_metrics_docs
 # lints that each appears in README's metrics table)
@@ -195,6 +200,17 @@ class FlightRecorder:
                 prev(label, timeout_s)
 
         watchdog.on_timeout = chained
+
+    def reset_once(self, trigger: str, replica: str) -> None:
+        """Re-arm a fired-once trigger key (and clear its cooldown) for
+        one replica.  The fleet supervisor calls this after rebuilding a
+        replica: the NEXT ``engine_death`` of that index is a new
+        incident and must dump its own bundle — exactly one bundle per
+        recovery action, not one per process lifetime."""
+        key = f"{trigger}:{replica}"
+        with self._lock:
+            self._once.discard(key)
+            self._last_dump.pop(key, None)
 
     # --- triggers / bundles -------------------------------------------------
     @property

@@ -393,7 +393,7 @@ class LifecycleTracker:
             try:
                 fn(rid, name, ts, tid, attrs)
             except Exception:
-                pass  # telemetry must never take down the engine thread
+                pass  # swallow-ok: telemetry must never take down the engine thread; a broken listener loses its own mirror, not the timeline
 
     # --- lookup -------------------------------------------------------------
     def _find_recent(self, rid) -> Optional[RequestTimeline]:

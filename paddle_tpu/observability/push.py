@@ -75,7 +75,7 @@ class PushGateway:
             with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
                 ok = 200 <= resp.status < 300
         except (urllib.error.URLError, OSError, ValueError):
-            ok = False
+            ok = False  # swallow-ok: counted just below via push_failures_total + backoff
         if ok:
             self._consecutive_failures = 0
         else:

@@ -483,7 +483,7 @@ class StepProfiler:
 
                     jax.profiler.start_trace(window.log_dir)
                 except Exception:
-                    window.device_trace = False  # already tracing
+                    window.device_trace = False  # swallow-ok: already tracing; the response's deviceTraceDir field reports the downgrade
             self._capture = window
         return window
 
@@ -510,7 +510,7 @@ class StepProfiler:
 
                     jax.profiler.stop_trace()
                 except Exception:
-                    pass
+                    pass  # swallow-ok: no device trace was running (the start raced/failed); nothing to stop is the expected idempotent case
         window.complete = complete
         result = chrome_trace_dict(window.spans,
                                    epoch_offset=self.epoch_offset)

@@ -35,6 +35,12 @@ module docstring and the README's serving sections.
 
 from .engine import EngineConfig, EngineCore  # noqa: F401
 from .entrypoints import LLM, CompletionOutput, stream_generate  # noqa: F401
+from .faultinject import (  # noqa: F401
+    FaultInjector,
+    FaultPlan,
+    FaultSpec,
+    InjectedFault,
+)
 from .fleet import (  # noqa: F401
     EngineReplica,
     FleetConfig,
@@ -43,6 +49,7 @@ from .fleet import (  # noqa: F401
     FleetSaturated,
     SubmitHandle,
 )
+from .resilience import FleetSupervisor, SupervisorConfig  # noqa: F401
 from .kv_manager import KVCacheManager, PoolExhausted  # noqa: F401
 from .metrics import ServingMetrics  # noqa: F401
 from .protocol import (  # noqa: F401

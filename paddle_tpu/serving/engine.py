@@ -225,6 +225,11 @@ class EngineCore:
                 decode_sample=config.decode_event_sample)
         self.requests: Dict[object, Request] = {}
         self._pool_dtype = jnp.dtype(dtype)
+        # deterministic fault injection (ISSUE 12): the fleet router
+        # binds a per-replica FaultInjector; step_seq is the injector's
+        # deterministic clock (counts step() invocations, no wall time)
+        self.step_seq = 0
+        self._fault = None
         # --- tensor-parallel resolution (ISSUE 5) ---------------------------
         mesh = topology.get_mesh()
         from ..parallel.utils import axis_size
@@ -501,6 +506,13 @@ class EngineCore:
         if self._lifecycle_on:
             self.lifecycle.event(rid, name, replica=self._replica_label,
                                  **attrs)
+
+    def set_fault_injector(self, injector) -> None:
+        """Bind a :class:`~paddle_tpu.serving.faultinject.FaultInjector`
+        (ISSUE 12).  The injector is consulted at the named injection
+        points inside :meth:`step`; the fleet router owns the instance
+        so its exactly-once schedule survives supervisor rebuilds."""
+        self._fault = injector
 
     def add_request(self, prompt_ids, sampling: Optional[SamplingParams] = None,
                     request_id=None, priority: int = 0,
@@ -811,10 +823,20 @@ class EngineCore:
         if self.audit.enabled:
             # sentinel over the REAL rows (pad rows attend the null page
             # — their logits are not part of the serving contract), plus
-            # the shadow re-execution when this step is sampled
+            # the shadow re-execution when this step is sampled.
+            # kernel_corrupt (ISSUE 12) corrupts ONLY this audit copy —
+            # the sampler below reads the untouched `out`, so served
+            # tokens stay correct while the divergence net trips.  Only
+            # SAMPLED steps run the shadow compare, so the exactly-once
+            # plan entry must not be consumed by a launch the oracle
+            # never checks.
+            audit_logits = out[:B]
+            if self._fault is not None and self.audit.sampled:
+                audit_logits = self._fault.corrupt_logits(
+                    self.step_seq, audit_logits)
             self.audit.observe_program(
                 "decode", np.asarray(stats, np.float32)[:B], (Bb, Wb),
-                logits=out[:B],
+                logits=audit_logits,
                 inputs={"ids": ids, "pos": poss, "tables": tables,
                         "lens": lens, "slot_blocks": slot_blocks,
                         "slot_offsets": slot_offsets},
@@ -922,10 +944,16 @@ class EngineCore:
         if self.audit.enabled:
             # sentinel over the REAL rows; the shadow oracle re-executes
             # sampled packed steps through the independently jitted XLA
-            # ragged reference (audit._reference_ragged)
+            # ragged reference (audit._reference_ragged).  kernel_corrupt
+            # corrupts only this audit copy, on sampled steps only — see
+            # _decode.
+            audit_logits = out[:R]
+            if self._fault is not None and self.audit.sampled:
+                audit_logits = self._fault.corrupt_logits(
+                    self.step_seq, audit_logits)
             self.audit.observe_program(
                 "ragged", np.asarray(stats, np.float32)[:R], (Tb, TWb),
-                logits=out[:R],
+                logits=audit_logits,
                 inputs={"ids": ids, "pos": pos, "seg_ids": seg,
                         "last_idx": last_idx, "tables": tables,
                         "lens": lens, "slot_blocks": slot_blocks,
@@ -962,11 +990,30 @@ class EngineCore:
         retire.  Returns {request_id: token} emitted this step."""
         remove_timer = (self.metrics.install_dispatch_timer()
                         if self._profile_ops else lambda: None)
+        self.step_seq += 1
         self.stepprof.begin_step()
         self.audit.begin_step()
+        fi = self._fault
         try:
+            if fi is not None:
+                # named injection points (ISSUE 12): slow_step sleeps
+                # here (inside the replica's watchdog-watched section),
+                # engine_step_raise raises (the thread dies through the
+                # real death path — INSIDE this try, so the finally
+                # still unhooks the dispatch timer from the global op
+                # bus), pool_exhaust arms one planning pass of
+                # allocation refusal consumed just below
+                fi.begin_step(self.step_seq)
             with self.tracer.span("engine_step", cat="serving") as sp:
-                plan = self.scheduler.schedule()
+                if fi is not None and fi.pool_exhausted:
+                    self.kv.refuse_allocations = True
+                try:
+                    plan = self.scheduler.schedule()
+                finally:
+                    # refusal applies to PLANNING only: the launches
+                    # below must still allocate the chunks the (starved)
+                    # plan actually contains
+                    self.kv.refuse_allocations = False
                 self.metrics.count("engine_steps")
                 self.metrics.count("preemptions", len(plan.preempted))
                 for req in plan.preempted:

@@ -39,6 +39,17 @@ admission instantly, let in-flight work finish to the deadline, abort
 stragglers through their OWNING replica, stop every engine thread —
 leaving zero pool occupancy on every replica (tested).
 
+**Self-healing (ISSUE 12).**  With a
+:class:`~paddle_tpu.serving.resilience.FleetSupervisor` attached, a
+dead replica's handles are CLAIMED by the supervisor instead of being
+terminally marked (``EngineReplica.supervised``): recoverable requests
+re-dispatch through normal routing and the replica is rebuilt on the
+same index; watchdog-stalled or quarantined replicas carry
+``unhealthy`` (the ``healthy`` property is what routing consults).
+``FleetConfig.fault_plan`` threads a deterministic
+:class:`~paddle_tpu.serving.faultinject.FaultPlan` through every
+replica's engine so the whole failure surface is injectable in tests.
+
 **Observability.**  All replicas share ONE
 :class:`~paddle_tpu.observability.MetricsRegistry`: each engine's
 ``serving_*`` series carries a ``replica="i"`` label
@@ -86,6 +97,7 @@ from ..observability.lifecycle import LifecycleTracker
 from ..observability.metrics import MetricsRegistry
 from ..ops.paged_attention import prefix_chain_hashes
 from .engine import EngineCore
+from .faultinject import FaultInjector, FaultPlan
 from .request import FinishReason, SamplingParams
 
 # pre-registered metric names this module owns (tools/check_metrics_docs
@@ -134,6 +146,11 @@ class FleetConfig:
     flight_dir: Optional[str] = None
     flight: Optional[FlightRecorder] = None  # pre-built recorder wins
                                              # over flight_dir
+    # deterministic fault injection (ISSUE 12): a frozen FaultPlan
+    # schedules named faults by (replica, engine-step); the router
+    # builds one FaultInjector per replica index (surviving supervisor
+    # rebuilds, so each plan entry fires exactly once per chaos run)
+    fault_plan: Optional[FaultPlan] = None
 
 
 def _build_ring(dp: int, vnodes: int) -> List:
@@ -205,17 +222,23 @@ class SubmitHandle:
 
     __slots__ = ("rid", "prompt_ids", "sampling", "priority",
                  "prefix_hashes", "req", "done", "cancel_reason", "event",
-                 "replica", "slo_ms")
+                 "replica", "slo_ms", "retryable")
 
     def __init__(self, rid, prompt_ids: List[int],
                  sampling: Optional[SamplingParams] = None,
                  priority: int = 0, event=None,
-                 slo_ms: Optional[float] = None):
+                 slo_ms: Optional[float] = None,
+                 retryable: bool = False):
         self.rid = rid
         self.prompt_ids = [int(t) for t in prompt_ids]
         self.sampling = sampling or SamplingParams()
         self.priority = priority
         self.slo_ms = slo_ms
+        # ISSUE 12: opt-in transparent retry-from-scratch when the
+        # owning replica dies mid-stream — greedy recompute regenerates
+        # the already-delivered tokens identically, so the supervisor
+        # may re-dispatch instead of failing with replica_failed
+        self.retryable = bool(retryable)
         self.prefix_hashes: Optional[List[bytes]] = None  # router-stamped
         self.req = None                  # engine Request, set by engine thread
         self.done = False                # terminal without admission
@@ -273,6 +296,21 @@ class EngineReplica:
         self.error: Optional[str] = None
         self.flight: Optional[FlightRecorder] = None  # router-stamped
         self._stop = False
+        # --- self-healing surface (ISSUE 12) -------------------------------
+        # supervised: a FleetSupervisor owns this replica's failure
+        # handling — on death the handle set is LEFT IN PLACE for the
+        # supervisor to claim (re-dispatch / replica_failed triage)
+        # instead of being terminally marked here
+        self.supervised = False
+        # unhealthy: excluded from routing while the engine thread is
+        # still alive (watchdog stall, quarantine); `healthy` is the
+        # routing eligibility the router consults
+        self.unhealthy = False
+        self.watchdog = None          # StepWatchdog, supervisor-armed
+        self.steps_done = 0           # completed eng.step() calls — the
+        # stall detector's progress signal (GIL-atomic increments)
+        self.stall = None             # (steps_done, t) stamped by the
+        # watchdog's on-fire handler; cleared when progress resumes
         # notify/on_finish are scoped to THIS replica: the frontend
         # wakes only the handlers whose requests this replica owns (so
         # wakeup work per step stays per-replica instead of dp x
@@ -288,6 +326,12 @@ class EngineReplica:
                 and self.error is None)
 
     @property
+    def healthy(self) -> bool:
+        """Routing eligibility: a live engine thread that is neither
+        watchdog-stalled nor quarantined (ISSUE 12)."""
+        return self.alive and not self.unhealthy
+
+    @property
     def in_flight(self) -> int:
         return len(self.handles)
 
@@ -301,7 +345,8 @@ class EngineReplica:
         """Admit ``handle`` onto this replica, or refuse (cap hit /
         dead).  The handle enters ``handles`` BEFORE the queue so the
         in-flight count can never undercount a queued request."""
-        if not self.alive or self._stop or self.in_flight >= self.max_queue:
+        if not self.healthy or self._stop \
+                or self.in_flight >= self.max_queue:
             return False
         self.handles[handle.rid] = handle
         try:
@@ -333,7 +378,7 @@ class EngineReplica:
         try:
             self.abort_q.put_nowait((rid, reason))
         except queue.Full:
-            pass  # sized to the in-flight bound; a drop only delays cleanup
+            pass  # swallow-ok: sized to 2x the in-flight bound; a drop only delays cleanup until the next abort/drain sweep
         self.wake.set()
 
     def request_stop(self) -> None:
@@ -355,7 +400,18 @@ class EngineReplica:
                 if self._stop and not eng.scheduler.has_work():
                     break
                 if eng.scheduler.has_work():
-                    eng.step()
+                    # local read: FleetSupervisor.close() nulls the
+                    # attribute from its own thread while we step
+                    wd = self.watchdog
+                    if wd is not None:
+                        # supervisor-armed step watchdog (ISSUE 12): a
+                        # wedged step marks this replica unhealthy the
+                        # moment the section expires
+                        with wd.watch(f"engine-step-r{self.index}"):
+                            eng.step()
+                    else:
+                        eng.step()
+                    self.steps_done += 1
                     self._notify()
                 else:
                     self.wake.wait(timeout=0.02)
@@ -373,23 +429,41 @@ class EngineReplica:
                                         replica=str(self.index),
                                         detail=self.error)
                 except Exception:
-                    pass  # telemetry must never mask the death handling
-            for req in list(eng.requests.values()):
-                eng.abort_request(req.request_id)
+                    pass  # swallow-ok: telemetry must never mask the death handling
+            if not (self.supervised and not self._stop):
+                # unsupervised (or draining) death: abort everything so
+                # no block is held.  Under a supervisor the engine is
+                # torn down wholesale and its in-flight requests are
+                # triaged for RE-DISPATCH — an abort here would finish
+                # them out from under the supervisor's claim.
+                for req in list(eng.requests.values()):
+                    eng.abort_request(req.request_id)
         finally:
-            for rid, h in list(self.handles.items()):
-                if self.handles.pop(rid, None) is None:
-                    # a racing try_submit reclaimed it (atomic pop wins
-                    # ownership): it is being re-routed — not ours to end
-                    continue
-                h.done = True
-                if h.req is None:
-                    # never admitted: the engine's finish path will not
-                    # close this timeline — do it here so it moves to
-                    # the tracker's bounded recent ring
-                    eng._lc(rid, _lc.EV_FINISH, reason="abort",
-                            error="engine thread exited before admission")
-                self._on_finish(rid)
+            if self.supervised and self.error is not None \
+                    and not self._stop:
+                # supervised death (ISSUE 12): leave the handle set in
+                # place — the FleetSupervisor claims it (dict.pop is
+                # the atomic ownership rule) and re-dispatches or fails
+                # each request; marking them done here would lose the
+                # queued-but-unstarted work a self-healing fleet must
+                # preserve
+                pass
+            else:
+                for rid, h in list(self.handles.items()):
+                    if self.handles.pop(rid, None) is None:
+                        # a racing try_submit reclaimed it (atomic pop
+                        # wins ownership): it is being re-routed — not
+                        # ours to end
+                        continue
+                    h.done = True
+                    if h.req is None:
+                        # never admitted: the engine's finish path will
+                        # not close this timeline — do it here so it
+                        # moves to the tracker's bounded recent ring
+                        eng._lc(rid, _lc.EV_FINISH, reason="abort",
+                                error="engine thread exited before "
+                                      "admission")
+                    self._on_finish(rid)
             self._notify()
 
     def _drain_submissions(self) -> None:
@@ -397,7 +471,14 @@ class EngineReplica:
             try:
                 h = self.submit_q.get_nowait()
             except queue.Empty:
-                return
+                return  # swallow-ok: Empty IS the loop exit condition, not a fault
+            if self.handles.get(h.rid) is not h:
+                # the supervisor claimed this handle off a stalled/dying
+                # incarnation of this replica (ISSUE 12) — it has been
+                # re-dispatched elsewhere and is no longer ours to admit
+                # OR terminate (presence in ``handles`` is the ownership
+                # rule)
+                continue
             if h.cancel_reason is not None or self._stop:
                 # deadline fired (or drain began) before admission: the
                 # request never enters the scheduler (timeline closed
@@ -420,7 +501,7 @@ class EngineReplica:
             try:
                 rid, reason = self.abort_q.get_nowait()
             except queue.Empty:
-                break
+                break  # swallow-ok: Empty IS the loop exit condition, not a fault
             if self.engine.abort_request(rid, reason):
                 did = True
             else:
@@ -574,6 +655,23 @@ class FleetRouter:
         # .npz repros carry the replica INDEX, matching the flight rings
         for i, e in enumerate(self.engines):
             e.audit.bind_flight(self.flight, replica=str(i))
+        # deterministic fault injection (ISSUE 12): one injector per
+        # replica INDEX, owned here so the exactly-once bookkeeping
+        # survives supervisor engine rebuilds
+        self.fault_injectors: Dict[int, FaultInjector] = {}
+        if self.cfg.fault_plan is not None and self.cfg.fault_plan.faults:
+            for i, eng in enumerate(self.engines):
+                fi = FaultInjector(self.cfg.fault_plan, replica=str(i),
+                                   lifecycle=self.lifecycle,
+                                   registry=self.registry)
+                self.fault_injectors[i] = fi
+                eng.set_fault_injector(fi)
+        # self-healing supervisor (ISSUE 12): attached via
+        # FleetSupervisor(router, ...); None = legacy semantics (a dead
+        # replica stays excluded until an operator acts)
+        self.supervisor = None
+        self._engine_factory = None  # remembered by build() so the
+        # supervisor can rebuild replicas without re-plumbing a factory
         self.replicas: List[EngineReplica] = [
             EngineReplica(i, eng, self.cfg.max_queue,
                           notify=self._notify, on_finish=self._release)
@@ -646,7 +744,12 @@ class FleetRouter:
         registry = (registry if registry is not None
                     else MetricsRegistry(max_series=4096))
         engines = [engine_factory(i, registry) for i in range(dp)]
-        return cls(engines, config=config, registry=registry)
+        router = cls(engines, config=config, registry=registry)
+        # the supervisor rebuilds crashed replicas through this exact
+        # factory (same weights, same config — the factory must be
+        # deterministic, e.g. seed before building the model)
+        router._engine_factory = engine_factory
+        return router
 
     @classmethod
     def from_engine(cls, engine: EngineCore,
@@ -671,6 +774,25 @@ class FleetRouter:
     @property
     def draining(self) -> bool:
         return self._draining
+
+    def attach_supervisor(self, supervisor) -> None:
+        """Bind a :class:`~paddle_tpu.serving.resilience.FleetSupervisor`
+        (called by its constructor).  One supervisor per fleet."""
+        if self.supervisor is not None:
+            raise ValueError("a FleetSupervisor is already attached")
+        self.supervisor = supervisor
+
+    @property
+    def restarting_count(self) -> int:
+        """Replicas currently out of service that the attached
+        supervisor will bring back (dead/unhealthy, not permanently
+        excluded).  0 without a supervisor — the HTTP frontend uses this
+        to distinguish 'restarting, Retry-After' from a hard 503."""
+        sup = self.supervisor
+        if sup is None or self._draining:
+            return 0
+        return sum(1 for r in self.replicas
+                   if not r.healthy and r.index not in sup.excluded)
 
     @property
     def in_flight(self) -> int:
@@ -698,7 +820,11 @@ class FleetRouter:
 
     def stop(self, join_timeout: float = 10.0) -> None:
         """Stop + join every engine thread (each exits once its
-        scheduler runs dry — callers abort stragglers first)."""
+        scheduler runs dry — callers abort stragglers first).  An
+        attached supervisor is closed FIRST so no restart races the
+        teardown."""
+        if self.supervisor is not None:
+            self.supervisor.close()
         for r in self.replicas:
             r.request_stop()
         for r in self.replicas:
@@ -795,7 +921,7 @@ class FleetRouter:
                 # EngineCore.add_request's own check.
                 raise ValueError(
                     f"request id {handle.rid!r} is already in flight")
-            eligible = [r for r in self.replicas if r.alive]
+            eligible = [r for r in self.replicas if r.healthy]
             if not eligible:
                 raise FleetDown("no live engine replica")
             # the timeline starts HERE, on the router/caller thread: a
@@ -840,7 +966,7 @@ class FleetRouter:
                     return r
                 self._owner.pop(handle.rid, None)
                 handle.replica = None
-        if not any(r.alive for r in self.replicas):
+        if not any(r.healthy for r in self.replicas):
             # every refusal was a death race, not a cap: report the
             # fleet as down (HTTP 503), not saturated (429)
             self.lifecycle.event(handle.rid, _lc.EV_ADMISSION_REJECTED,
@@ -855,14 +981,16 @@ class FleetRouter:
     def submit_request(self, prompt_ids,
                        sampling: Optional[SamplingParams] = None,
                        request_id=None, priority: int = 0,
-                       slo_ms: Optional[float] = None) -> SubmitHandle:
+                       slo_ms: Optional[float] = None,
+                       retryable: bool = False) -> SubmitHandle:
         """Convenience for direct (non-HTTP) callers: build a handle,
         route it, return it.  Poll ``handle.finished`` /
         ``handle.output_tokens`` (or use :meth:`wait`)."""
         rid = request_id if request_id is not None else \
             f"fleet-{next(self._ids)}"
         handle = SubmitHandle(rid, list(prompt_ids), sampling=sampling,
-                              priority=priority, slo_ms=slo_ms)
+                              priority=priority, slo_ms=slo_ms,
+                              retryable=retryable)
         self.submit(handle)
         return handle
 

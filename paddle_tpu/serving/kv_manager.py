@@ -59,8 +59,20 @@ class KVCacheManager(BlockPool):
                  enable_prefix_cache: bool = True):
         super().__init__(num_blocks, block_size,
                          enable_prefix_cache=enable_prefix_cache)
+        # fault injection (ISSUE 12): while True, the pool reports zero
+        # available capacity — the `pool_exhaust` injection point.  The
+        # engine arms it for exactly ONE scheduler-planning pass, so the
+        # refusal surfaces as a preemption/deferral scheduling event
+        # (token-identical recompute), never as a failed launch.
+        self.refuse_allocations = False
 
     # --- capacity ----------------------------------------------------------
+    @property
+    def num_available(self) -> int:
+        if self.refuse_allocations:
+            return 0
+        return super().num_available
+
     def occupancy(self) -> float:
         """Fraction of the usable pool currently held by sequences.
         Reuse-LRU blocks (cached content, no owner) count as free capacity

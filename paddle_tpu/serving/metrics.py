@@ -55,6 +55,9 @@ _COUNTER_NAMES = (
     "requests_finished_length",
     "requests_finished_abort",
     "requests_finished_timeout",
+    # ISSUE 12: quarantine-drain stragglers aborted through the live
+    # engine with the supervisor's honest verdict
+    "requests_finished_replica_failed",
     "admission_rejected",
     "preemptions",
     "recompute_prefills",

@@ -62,6 +62,13 @@ class CompletionRequest:
     slo_ms: Optional[float] = None    # per-request latency objective:
                                       # scored into the serving_slo_*
                                       # goodput pair on finish
+    retryable: bool = False           # opt-in transparent retry-from-
+                                      # scratch if the owning replica
+                                      # dies mid-stream (ISSUE 12):
+                                      # greedy recompute re-delivers
+                                      # identical tokens; off = such a
+                                      # request finishes with
+                                      # finish_reason="replica_failed"
 
     def sampling(self) -> SamplingParams:
         return SamplingParams(
@@ -154,6 +161,7 @@ def parse_completion_request(
         timeout=None if timeout is None else float(timeout),
         priority=_typed(obj, "priority", int, 0),
         slo_ms=None if slo_ms is None else float(slo_ms),
+        retryable=_typed(obj, "retryable", bool, False),
     )
 
 

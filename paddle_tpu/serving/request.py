@@ -40,6 +40,10 @@ class FinishReason(Enum):
     LENGTH = "length"    # hit max_new_tokens
     ABORT = "abort"      # caller abort / unservable request
     TIMEOUT = "timeout"  # per-request deadline / drain deadline hit
+    REPLICA_FAILED = "replica_failed"  # the owning fleet replica died
+    # (or was quarantined) mid-flight and the request was not
+    # re-dispatchable (tokens already streamed, retryable not set) —
+    # the supervisor's honest verdict instead of a hang (ISSUE 12)
 
 
 @dataclass

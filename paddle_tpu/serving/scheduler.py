@@ -158,7 +158,7 @@ class ContinuousBatchingScheduler:
         try:
             self.waiting.remove(req)
         except ValueError:
-            pass
+            pass  # swallow-ok: remove() contract is idempotent — "not queued" is a normal state (running, or already removed), not a fault
 
     @property
     def queue_depth(self) -> int:

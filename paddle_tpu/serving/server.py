@@ -151,7 +151,7 @@ class _Handle(SubmitHandle):
                  event: asyncio.Event):
         super().__init__(rid, creq.prompt_ids, sampling=creq.sampling(),
                          priority=creq.priority, event=event,
-                         slo_ms=creq.slo_ms)
+                         slo_ms=creq.slo_ms, retryable=creq.retryable)
         self.creq = creq
 
 
@@ -188,12 +188,8 @@ class CompletionServer:
         else:
             self.fleet = FleetRouter.from_engine(
                 engine, max_queue=self.cfg.max_queue)
-        # replica 0's engine doubles as the single-engine compat surface
-        # (selftest / existing callers poke .engine.mp, .engine.kv, ...)
-        self.engine = self.fleet.replicas[0].engine
         self.registry = (registry if registry is not None
                          else self.fleet.registry)
-        self.tracer = self.engine.tracer
         self._handles: Dict[str, _Handle] = {}
         self._ids = itertools.count(1)
         self._loop: Optional[asyncio.AbstractEventLoop] = None
@@ -207,6 +203,20 @@ class CompletionServer:
         self.port: Optional[int] = None
 
     # --- single-engine compat views (dp=1 tests/tools poke these) -----------
+    @property
+    def engine(self) -> EngineCore:
+        """Replica 0's engine — the single-engine compat surface
+        (selftest / existing callers poke ``.engine.mp``, ``.engine.kv``
+        ...).  A property, not a snapshot: the supervisor (ISSUE 12) may
+        replace replica 0's engine wholesale on restart/quarantine."""
+        return self.fleet.replicas[0].engine
+
+    @property
+    def tracer(self):
+        # follows replica 0's engine like `engine` above — a snapshot
+        # would pin a retired engine's tracer after a supervisor rebuild
+        return self.engine.tracer
+
     @property
     def _engine_thread(self) -> Optional[threading.Thread]:
         return self.fleet.replicas[0].thread
@@ -297,7 +307,22 @@ class CompletionServer:
             try:
                 loop.call_soon_threadsafe(h.event.set)
             except RuntimeError:
-                return  # loop shut down mid-iteration
+                return  # swallow-ok: loop shut down mid-iteration — the handlers it would wake are being torn down with it
+
+    def _unavailable_503(self) -> Tuple[str, Tuple]:
+        """(message, extra headers) for a 503.  A draining server is
+        going away (no retry hint); a fleet whose replicas are all
+        momentarily down while the supervisor restarts them (ISSUE 12)
+        tells the client to come back — 503 **with** ``Retry-After``,
+        matching the 429 path."""
+        if self._draining or self._stop:
+            return "server is draining", ()
+        n = self.fleet.restarting_count
+        if n:
+            return (f"fleet is restarting ({n} replica(s) recovering); "
+                    "retry later",
+                    (("Retry-After", str(self.cfg.retry_after_s)),))
+        return "engine is not running", ()
 
     def _request_abort(self, h: _Handle, reason: FinishReason) -> None:
         h.cancel_reason = reason
@@ -322,7 +347,7 @@ class CompletionServer:
                         timeout=self.cfg.keepalive_timeout_s)
                 except (asyncio.TimeoutError, asyncio.IncompleteReadError,
                         asyncio.LimitOverrunError, ConnectionError):
-                    return  # idle timeout or client closed between requests
+                    return  # swallow-ok: idle timeout / client closed between requests — normal keep-alive connection end, not a fault
                 if len(head) > _MAX_HEADER_BYTES:
                     await self._respond(writer, 431, error_body(
                         "headers too large"))
@@ -367,12 +392,12 @@ class CompletionServer:
                     return
         except (ConnectionError, asyncio.TimeoutError,
                 asyncio.IncompleteReadError):
-            pass  # client went away; per-request cleanup already ran
+            pass  # swallow-ok: client went away; the per-request abort path already freed the engine-side work
         finally:
             try:
                 writer.close()
             except Exception:
-                pass
+                pass  # swallow-ok: socket already dead — close() is best-effort teardown of a connection we are done with
 
     def _count_http(self, route: str, status: int) -> None:
         if route.startswith("/v1/requests"):
@@ -431,11 +456,23 @@ class CompletionServer:
                 audit_ann = (" audit=degraded" if any(
                     r.engine.audit.degraded for r in self.fleet.replicas)
                     else "")
-                msg = (f"ok dp={self.fleet.dp} mp={mp}{audit_ann}\n"
-                       .encode()
-                       if status == 200 else (
-                           b"draining\n" if self._draining
-                           else b"not ready\n"))
+                # replicas the supervisor is bringing back (ISSUE 12):
+                # annotated while the fleet still serves, and the WHOLE
+                # body when every replica is momentarily down but
+                # recovery is underway — probes can tell "restarting"
+                # from "dead" (and clients get Retry-After on POSTs)
+                restarting = self.fleet.restarting_count
+                restart_ann = (f" restarting={restarting}" if restarting
+                               else "")
+                if status == 200:
+                    msg = (f"ok dp={self.fleet.dp} mp={mp}{audit_ann}"
+                           f"{restart_ann}\n").encode()
+                elif self._draining:
+                    msg = b"draining\n"
+                elif restarting:
+                    msg = f"restarting={restarting}\n".encode()
+                else:
+                    msg = b"not ready\n"
                 await self._respond(writer, status, msg, "text/plain",
                                     keep_alive=keep_alive)
             elif path == "/metrics":
@@ -701,16 +738,15 @@ class CompletionServer:
                                  keep_alive: bool = False,
                                  ) -> Tuple[int, bool]:
         """Returns (status, connection-still-open)."""
-        unavailable_msg = ("server is draining"
-                           if self._draining or self._stop
-                           else "engine is not running")
+        unavailable_msg, unavailable_extra = self._unavailable_503()
         if not self.ready:
             # draining OR every engine thread died: either way nobody
             # will ever drain a submit queue, so refuse instead of
-            # hanging
+            # hanging.  A fleet mid-restart (ISSUE 12) answers with
+            # Retry-After — the outage is transient by construction.
             await self._respond(writer, 503, error_body(
                 unavailable_msg, "unavailable_error"),
-                keep_alive=keep_alive)
+                extra=unavailable_extra, keep_alive=keep_alive)
             return 503, keep_alive
         try:
             creq = parse_completion_request(body, tokenize=self.cfg.tokenize)
@@ -752,9 +788,10 @@ class CompletionServer:
                 keep_alive=keep_alive)
             return 429, keep_alive
         except FleetDown:
+            unavailable_msg, unavailable_extra = self._unavailable_503()
             await self._respond(writer, 503, error_body(
                 unavailable_msg, "unavailable_error"),
-                keep_alive=keep_alive)
+                extra=unavailable_extra, keep_alive=keep_alive)
             return 503, keep_alive
         self._handles[rid] = handle
 
@@ -798,7 +835,12 @@ class CompletionServer:
                     reason = (req.finish_reason.value
                               if req.finish_reason else "abort")
                     return tokens, reason
-            elif handle.done:
+            if handle.done and (req is None or not req.finished):
+                # terminal without an engine finish: cancelled before
+                # admission, or the owning replica died and the
+                # supervisor closed the handle (ISSUE 12 — ``req`` may
+                # still hold the dead engine's frozen partial output,
+                # flushed above)
                 reason = (handle.cancel_reason.value
                           if handle.cancel_reason else "abort")
                 return tokens, reason
@@ -814,7 +856,7 @@ class CompletionServer:
             try:
                 await asyncio.wait_for(handle.event.wait(), wait + 1e-3)
             except asyncio.TimeoutError:
-                continue
+                continue  # swallow-ok: the wait IS a poll; timeout means re-check request state, not a fault
             handle.event.clear()
 
     async def _json_response(self, handle: _Handle,
@@ -881,19 +923,23 @@ def _toy_engine(layers: int = 2, num_blocks: int = 64,
 def _toy_fleet(dp: int = 1, layers: int = 2, num_blocks: int = 64,
                max_queue: int = 64,
                flight_dir: Optional[str] = None,
-               audit=None, unified: bool = False) -> FleetRouter:
+               audit=None, unified: bool = False,
+               fault_plan=None) -> FleetRouter:
     """A dp-replica fleet of toy engines on one shared registry: each
     replica gets its OWN model instance (engine threads swap parameter
     values during the traced step — modules must not be shared) with
     per-replica-labeled serving series.  Composes with ``--mp``: build
-    the mesh first and every replica's engine runs mesh-spanning."""
+    the mesh first and every replica's engine runs mesh-spanning.  The
+    factory is deterministic (seed before build), so the supervisor can
+    rebuild a crashed replica with identical weights."""
     return FleetRouter.build(
         lambda i, registry: _toy_engine(
             layers=layers, num_blocks=num_blocks, registry=registry,
             metrics_labels={"replica": str(i)}, audit=audit,
             unified=unified),
         dp=dp, config=FleetConfig(max_queue=max_queue,
-                                  flight_dir=flight_dir))
+                                  flight_dir=flight_dir,
+                                  fault_plan=fault_plan))
 
 
 def _http(port: int, method: str, path: str, body: Optional[dict] = None):
@@ -999,10 +1045,26 @@ async def _serve_cli(args) -> int:
         from ..observability.audit import AuditConfig
 
         audit = AuditConfig(enabled=True, sample_every=args.audit_sample)
+    fault_plan = None
+    if args.fault_plan:
+        from .faultinject import FaultPlan
+
+        fault_plan = FaultPlan.from_json(args.fault_plan)
     fleet = _toy_fleet(dp=args.dp, layers=args.layers,
                        num_blocks=args.blocks, max_queue=args.max_queue,
                        flight_dir=args.flight_dir, audit=audit,
-                       unified=args.unified)
+                       unified=args.unified, fault_plan=fault_plan)
+    supervisor = None
+    if args.max_restarts > 0:
+        # self-healing by default (ISSUE 12): dead replicas restart
+        # under capped exponential backoff, audit-degraded replicas are
+        # quarantined and replaced, wedged steps are watchdogged.
+        # --max-restarts 0 opts out (legacy exclude-forever semantics).
+        from .resilience import FleetSupervisor, SupervisorConfig
+
+        supervisor = FleetSupervisor(fleet, config=SupervisorConfig(
+            max_restarts=args.max_restarts,
+            watchdog_timeout_s=args.watchdog_timeout))
     server = CompletionServer(fleet, ServerConfig(
         host=args.host, port=args.port,
         max_queue=args.max_queue,
@@ -1014,6 +1076,8 @@ async def _serve_cli(args) -> int:
         pusher = PushGateway(args.push_gateway, registry=fleet.registry,
                              interval_s=args.push_interval).start()
     await server.start()
+    if supervisor is not None:
+        supervisor.start()  # closed by fleet.stop() during shutdown
     loop = asyncio.get_running_loop()
     try:
         import signal
@@ -1021,7 +1085,7 @@ async def _serve_cli(args) -> int:
         for sig in (signal.SIGTERM, signal.SIGINT):
             loop.add_signal_handler(sig, server.request_shutdown)
     except (NotImplementedError, RuntimeError):
-        pass
+        pass  # swallow-ok: platform without signal-handler support (Windows/non-main loop); Ctrl-C still raises KeyboardInterrupt
     print(f"serving on http://{server.cfg.host}:{server.port} "
           f"dp={fleet.dp} mp={server.engine.mp} "
           "(POST /v1/completions; GET /healthz /readyz /metrics "
@@ -1072,6 +1136,27 @@ def main(argv=None) -> int:
                         "thread, capped exponential backoff on failure)")
     p.add_argument("--push-interval", type=float, default=15.0,
                    help="push-gateway export interval in seconds")
+    p.add_argument("--fault-plan", default=None, metavar="FILE",
+                   help="JSON fault plan for deterministic chaos runs "
+                        "(serving/faultinject.py): named injection "
+                        "points scheduled by (replica, engine step) — "
+                        "engine_step_raise, pool_exhaust, slow_step, "
+                        "kernel_corrupt; each fires exactly once and is "
+                        "recorded as lifecycle/flight events")
+    p.add_argument("--max-restarts", type=int, default=5, metavar="K",
+                   help="self-healing supervisor: restarts allowed per "
+                        "replica inside the crash-loop window before "
+                        "permanent exclusion (capped exponential "
+                        "backoff between attempts; audit-degraded "
+                        "replicas are quarantined and replaced).  0 "
+                        "disables supervision — a dead replica stays "
+                        "excluded until an operator acts")
+    p.add_argument("--watchdog-timeout", type=float, default=60.0,
+                   metavar="S",
+                   help="per-replica step watchdog: a step exceeding "
+                        "this marks the replica unhealthy (excluded "
+                        "from routing) and escalates to a restart if "
+                        "the stall persists; only with supervision on")
     p.add_argument("--flight-dir", default=None, metavar="DIR",
                    help="write flight-recorder post-mortem bundles "
                         "(engine death, preemption storms, 429 bursts, "
@@ -1097,6 +1182,8 @@ def main(argv=None) -> int:
         p.error(f"--dp must be >= 1, got {args.dp}")
     if args.audit_sample is not None and args.audit_sample < 1:
         p.error(f"--audit-sample must be >= 1, got {args.audit_sample}")
+    if args.max_restarts < 0:
+        p.error(f"--max-restarts must be >= 0, got {args.max_restarts}")
     if args.mp > 1:
         # tensor-parallel serving (ISSUE 5): build the mesh BEFORE any
         # engine (selftest included — the probe must exercise the real

@@ -66,6 +66,11 @@ SCAN_FILES = (
     # ISSUE 10: the numerics auditor's repro-path ring and divergence
     # bookkeeping must stay bounded (deque maxlen= / fired-once keys)
     os.path.join(_REPO, "paddle_tpu", "observability", "audit.py"),
+    # ISSUE 12: the supervisor's restart-history deques / pending
+    # re-dispatch queue and the fault injector's fired-once sets must
+    # stay bounded even if the modules move out of the serving dir
+    os.path.join(_REPO, "paddle_tpu", "serving", "resilience.py"),
+    os.path.join(_REPO, "paddle_tpu", "serving", "faultinject.py"),
     os.path.join(_REPO, "paddle_tpu", "ops", "paged_attention.py"),
     os.path.join(_REPO, "paddle_tpu", "ops", "pallas_paged.py"),
     # ISSUE 11: the unified ragged kernel sits on the serving hot path

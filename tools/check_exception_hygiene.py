@@ -1,0 +1,118 @@
+#!/usr/bin/env python
+"""Exception-hygiene lint for the serving/observability layers
+(ISSUE 12 tooling satellite).
+
+A self-healing fleet is only as good as its failure signals: a bare
+``except Exception: pass`` in the serving stack is a fault the
+supervisor, the flight recorder and the operator will never see.  This
+lint walks every ``except`` handler in ``paddle_tpu/serving/`` and
+``paddle_tpu/observability/`` by AST (no imports — the modules pull in
+jax) and flags **silent swallows**: handlers whose body performs no
+observable action at all.
+
+A handler is considered observable when its body contains ANY call
+expression — incrementing a counter, firing a flight/lifecycle event,
+writing to stderr, re-queueing work — or a ``raise``.  A handler that
+only ``pass``es / ``continue``s / ``return``s / assigns constants is a
+silent swallow and must carry an inline waiver stating why silence is
+correct::
+
+    except queue.Full:
+        pass  # swallow-ok: sized to the in-flight bound; drop only delays cleanup
+
+The waiver token may sit on the ``except`` line or any line of the
+handler body.  The bar for a waiver is the same as
+``check_bounded_metrics.py``'s: state the STRUCTURAL reason the swallow
+cannot hide a fault (e.g. the queue is sized so Full is impossible in
+steady state, or the error is re-detected on the next tick).
+
+Run standalone (exits 1 on violations) or from the test suite
+(``tests/test_zz_resilience.py`` asserts ``scan()`` returns nothing and
+self-tests the rule on synthetic modules).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from typing import List, Tuple
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCAN_DIRS = (
+    os.path.join(_REPO, "paddle_tpu", "serving"),
+    os.path.join(_REPO, "paddle_tpu", "observability"),
+)
+WAIVER = "swallow-ok:"
+
+
+def _has_observable_action(handler: ast.ExceptHandler) -> bool:
+    """True when the handler body contains any call or raise — the
+    minimum bar for 'this failure left a trace somewhere'."""
+    for node in handler.body:
+        for sub in ast.walk(node):
+            if isinstance(sub, (ast.Call, ast.Raise)):
+                return True
+    return False
+
+
+def _waived(handler: ast.ExceptHandler, lines: List[str]) -> bool:
+    """Waiver token on the except line or any body line."""
+    end = max((getattr(n, "end_lineno", n.lineno) for n in handler.body),
+              default=handler.lineno)
+    for lineno in range(handler.lineno, end + 1):
+        if lineno <= len(lines) and WAIVER in lines[lineno - 1]:
+            return True
+    return False
+
+
+def check_file(path: str) -> List[Tuple[str, int, str]]:
+    with open(path) as f:
+        source = f.read()
+    lines = source.splitlines()
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [(path, e.lineno or 0, f"syntax error: {e.msg}")]
+    out: List[Tuple[str, int, str]] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if _has_observable_action(node):
+            continue
+        if _waived(node, lines):
+            continue
+        exc = ("bare except" if node.type is None
+               else f"except {ast.unparse(node.type)}")
+        out.append((path, node.lineno,
+                    f"{exc}: silent swallow — a failure here leaves no "
+                    f"trace (no counter, no flight/lifecycle event, no "
+                    f"log).  Make it observable, or add a "
+                    f"'# {WAIVER} <structural reason>' waiver"))
+    return out
+
+
+def scan(dirs=SCAN_DIRS) -> List[Tuple[str, int, str]]:
+    out: List[Tuple[str, int, str]] = []
+    for d in dirs:
+        for root, _, fns in os.walk(d):
+            for fn in sorted(fns):
+                if fn.endswith(".py"):
+                    out.extend(check_file(os.path.join(root, fn)))
+    return out
+
+
+def main() -> int:
+    violations = scan()
+    for path, lineno, msg in violations:
+        rel = os.path.relpath(path, _REPO)
+        print(f"{rel}:{lineno}: {msg}")
+    if violations:
+        print(f"{len(violations)} silent-swallow violation(s)")
+        return 1
+    print("exception-hygiene lint: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -35,6 +35,8 @@ DECLARING_MODULES = (
     os.path.join(_REPO, "paddle_tpu", "serving", "metrics.py"),
     os.path.join(_REPO, "paddle_tpu", "serving", "fleet.py"),
     os.path.join(_REPO, "paddle_tpu", "serving", "server.py"),
+    os.path.join(_REPO, "paddle_tpu", "serving", "resilience.py"),
+    os.path.join(_REPO, "paddle_tpu", "serving", "faultinject.py"),
     os.path.join(_REPO, "paddle_tpu", "observability", "lifecycle.py"),
     os.path.join(_REPO, "paddle_tpu", "observability", "flight.py"),
     os.path.join(_REPO, "paddle_tpu", "observability", "push.py"),

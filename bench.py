@@ -481,6 +481,40 @@ def _step_profile_report(eng) -> dict:
     return rep
 
 
+def _cache_report(eng, assert_attr: bool = True) -> dict:
+    """Per-phase KV-cache observability report (ISSUE 13): pool-timeline
+    summary, prefix-heat top-K (hit tokens by prefix family — what
+    explains a phase's cached-token ratio), reuse-LRU hit-depth
+    distribution, eviction-cause accounting and per-request attribution.
+    The exact attribution invariant — sum(per-request cached) ==
+    prefix_cache_hit_tokens — is asserted before the report is embedded
+    (the pool invariant free+reuse+allocated == num_blocks was already
+    asserted by every per-step sample the engine took).  ``assert_attr``
+    is off only for supervised chaos runs, where a rebuilt replica's
+    tracker restarts at zero while the shared registry counters carry
+    the pre-death totals."""
+    cs = eng.cachestat
+    snap = cs.snapshot()
+    attr = snap["attribution"]
+    if assert_attr:
+        hit = eng.metrics.counters["prefix_cache_hit_tokens"]
+        assert attr["cached_tokens_total"] == hit, (
+            f"per-request cache attribution broken: rows sum to "
+            f"{attr['cached_tokens_total']}, counter says {hit}")
+    assert snap["timeline"], "no pool samples recorded — cache_stats off?"
+    return {
+        "pool": cs.timeline_summary(),
+        "heat": snap["heat"],
+        "hit_depths": snap["hit_depths"],
+        "evictions": snap["evictions"],
+        "attribution": {
+            "cached_tokens_total": attr["cached_tokens_total"],
+            "computed_tokens_total": attr["computed_tokens_total"],
+            "requests": len(attr["active"]) + len(attr["recent"]),
+        },
+    }
+
+
 def serving_bench() -> dict:
     """Serving phase (ISSUE 4): a shared-prefix workload through the
     continuous-batching engine with the prefix cache ON vs OFF — both
@@ -548,6 +582,9 @@ def serving_bench() -> dict:
             # per-phase bucket-utilization report (ISSUE 9): padding
             # ratio + scheduled-token invariant asserted inside
             "step_profile": _step_profile_report(eng),
+            # per-phase cache report (ISSUE 13): the heat table is what
+            # explains the cached ratio — hit tokens by prefix family
+            "cache": _cache_report(eng),
             # full registry snapshot: serving_* TTFT/ITL histograms ride
             # in the phase record like the train phases embed theirs
             "metrics": eng.metrics.snapshot(),
@@ -626,6 +663,7 @@ def serving_mp_bench() -> dict:
                 "decode_buckets": len(eng.decode_buckets),
                 "slo": eng.metrics.slo_breakdown(),  # ISSUE 8 breakdown
                 "step_profile": _step_profile_report(eng),  # ISSUE 9
+                "cache": _cache_report(eng),  # ISSUE 13
                 "metrics": eng.metrics.snapshot(),
                 "outputs": [list(r.output_tokens) for r in reqs],
             }
@@ -757,6 +795,9 @@ def serving_fleet_bench() -> dict:
                     # per-replica bucket-utilization report (ISSUE 9) —
                     # the scheduled-token invariant holds replica-wise
                     "step_profile": _step_profile_report(r.engine),
+                    # per-replica cache report (ISSUE 13): attribution
+                    # invariant holds replica-wise too
+                    "cache": _cache_report(r.engine),
                 })
             fleet.sample_gauges()
             return {
@@ -864,6 +905,7 @@ def serving_audit_bench() -> dict:
             "preemptions": eng.metrics.counters["preemptions"],
             "prefill_traces": eng.prefill_trace_count,
             "decode_traces": eng.decode_trace_count,
+            "cache": _cache_report(eng),  # ISSUE 13
             "outputs": [list(r.output_tokens) for r in reqs],
         }
         if audit_on:
@@ -964,6 +1006,7 @@ def serving_unified_bench() -> dict:
             "padding_tokens": rep["padding_tokens"],
             "scheduled_tokens": rep["scheduled_tokens"],
             "step_profile": rep,
+            "cache": _cache_report(eng),  # ISSUE 13
             "slo": eng.metrics.slo_breakdown(),
             "metrics": eng.metrics.snapshot(),
             "outputs": [list(r.output_tokens) for r in reqs],
@@ -1104,6 +1147,14 @@ def serving_chaos_bench() -> dict:
                           if sup._recovery_h.count else None),
                 "sum_s": round(sup._recovery_h.sum, 4),
             },
+            # ISSUE 13: per-replica cache reports; attribution is NOT
+            # asserted against the registry counters here — a rebuilt
+            # replica's tracker restarts at zero while the shared
+            # registry carries the pre-death totals
+            "cache": {str(r.index): _cache_report(r.engine,
+                                                  assert_attr=False)
+                      for r in fleet.replicas
+                      if r.engine.cachestat.timeline()},
             "outputs": [list(h.output_tokens) for h in hs],
         }
         fleet.shutdown(drain_timeout=5.0)

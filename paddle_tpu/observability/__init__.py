@@ -48,6 +48,14 @@ re-execution of sampled decode steps through the XLA gather reference
 repro bundles (:func:`replay_repro`) on divergence via the flight
 machinery.
 
+The memory layer (ISSUE 13): :class:`CacheStatTracker`
+(``cachestat.py``) watches the serving block pool — per-step pool
+timelines with the exact ``free + reuse + allocated == num_blocks``
+invariant, decayed prefix-heat tables over the chain hashes, reuse-LRU
+hit-depth / park-lifetime telemetry fed by the pool's event-driven
+hooks, and per-request cache attribution — served at
+``GET /v1/debug/cache``.
+
 Process-wide defaults: :func:`get_tracer` / :func:`get_registry` return
 one shared instance each, so spans from the serving engine, jit compile
 events and watchdog timeouts land in one trace, and compile counters /
@@ -62,6 +70,9 @@ from .audit import (  # noqa: F401
     load_repro,
     logit_stats,
     replay_repro,
+)
+from .cachestat import (  # noqa: F401
+    CacheStatTracker,
 )
 from .export import (  # noqa: F401
     ProfilerResult,

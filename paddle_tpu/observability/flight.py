@@ -108,6 +108,11 @@ class FlightRecorder:
         # the engine was computing (program/bucket/utilization) when it
         # died.  Bounded by the fleet's replica set.
         self._stepprofs: Dict[str, object] = {}
+        # replica -> CacheStatTracker (ISSUE 13): bundles embed the
+        # owning replica's last-K pool-timeline samples, so a post-
+        # mortem shows how free/reuse/allocated evolved into the
+        # anomaly.  Bounded by the fleet's replica set.
+        self._cachestats: Dict[str, object] = {}
         self._dumps = {
             t: (registry.counter(
                 "serving_flight_dumps_total",
@@ -123,6 +128,14 @@ class FlightRecorder:
         StepProfiler}``) — the fleet router calls this at build so
         post-mortem bundles carry each replica's recent step records."""
         self._stepprofs = dict(profilers)
+
+    def bind_cache_trackers(self, trackers: Dict[str, object]) -> None:
+        """Register per-replica cache-stat trackers
+        (``{replica_index_str: CacheStatTracker}``) — the fleet router
+        calls this at build (and the supervisor after a rebuild) so
+        post-mortem bundles carry each replica's recent pool-timeline
+        samples (ISSUE 13)."""
+        self._cachestats = dict(trackers)
 
     def bind_lifecycle(self, lifecycle: LifecycleTracker) -> None:
         """(Re)subscribe this recorder to a tracker — the fleet router
@@ -299,6 +312,15 @@ class FlightRecorder:
             recs = sp.records()
             if recs:
                 step_profile[rep] = recs
+        # last-K pool-timeline samples of the affected replica (ISSUE
+        # 13): free/reuse/allocated block counts leading into the anomaly
+        cache_stats = {}
+        for rep, tr in self._cachestats.items():
+            if replica is not None and str(replica) != rep:
+                continue
+            samples = tr.timeline()
+            if samples:
+                cache_stats[rep] = samples
         return {
             "bundle": "paddle_tpu.flight",
             "trigger": trigger,
@@ -308,6 +330,7 @@ class FlightRecorder:
             "events": events,
             "in_flight_requests": requests,
             "step_profile": step_profile,
+            "cache_stats": cache_stats,
             "metrics": (self.registry.snapshot()
                         if self.registry is not None else {}),
             "threads": threads,

@@ -115,6 +115,17 @@ class BlockPool:
         self._reuse: "OrderedDict" = OrderedDict()  # unbounded-ok: ≤ num_blocks entries (refcount-0 cached blocks, LRU)
         self.reuse_evictions = 0  # monotonic: cached blocks clobbered for allocation
         self.reuse_hits = 0       # monotonic: blocks served from the prefix cache
+        # --- observability hooks (ISSUE 13) --------------------------------
+        # host-side, fired synchronously on the mutating thread; a hook
+        # exception is swallowed — telemetry must never tear the pool's
+        # free-list/refcount bookkeeping mid-mutation.
+        self.on_evict = None   # fn(block, chain_depth, lifetime_steps, cause)
+        self.on_revive = None  # fn(block, chain_depth, lru_depth, lifetime_steps)
+        self.clock = 0         # caller-advanced step clock (the serving
+                               # engine stamps step_seq) — park lifetimes
+                               # are measured in these ticks
+        self._block_depth: dict = {}  # unbounded-ok: ≤ num_blocks entries (block -> chain depth)
+        self._park_step: dict = {}    # unbounded-ok: ≤ num_blocks entries (block -> clock at refcount-0 park)
 
     @property
     def num_free(self) -> int:
@@ -139,33 +150,49 @@ class BlockPool:
     def can_allocate(self, seq_id, num_tokens: int) -> bool:
         return self.blocks_needed(seq_id, num_tokens) <= self.num_available
 
-    def _take_block(self) -> int:
+    def _take_block(self, cause: str = "other") -> int:
         """One block for a fresh allocation: free list first; then evict
         the LRU-oldest reusable cached block (its hash entries die with
-        its content — a later prompt with that prefix just recomputes)."""
+        its content — a later prompt with that prefix just recomputes).
+        An eviction fires :attr:`on_evict` with the clobbered block's
+        chain depth, its park lifetime (in :attr:`clock` ticks), and the
+        ``cause`` of the allocation (ISSUE 13 event-driven accounting —
+        no more per-step counter diffing)."""
         if self._free:
             return self._free.pop()
         b, _ = self._reuse.popitem(last=False)
+        depth = self._block_depth.get(b, 0)
+        lifetime = self.clock - self._park_step.pop(b, self.clock)
         self._drop_hash(b)
         self.reuse_evictions += 1
+        cb = self.on_evict
+        if cb is not None:
+            try:
+                cb(b, depth, lifetime, cause)
+            except Exception:
+                pass  # swallow-ok: telemetry must never tear the pool bookkeeping mid-allocation
         return b
 
     def _drop_hash(self, b: int) -> None:
         h = self._block_hash.pop(b, None)
+        self._block_depth.pop(b, None)
         if h is not None and self._hash_index.get(h) == b:
             del self._hash_index[h]
             self.cache_epoch += 1
 
-    def allocate(self, seq_id, num_tokens: int) -> bool:
+    def allocate(self, seq_id, num_tokens: int,
+                 cause: str = "other") -> bool:
         """All-or-nothing reservation of blocks for ``num_tokens`` more
         tokens; returns False (taking nothing) when the pool can't cover
-        it, so the state stays clean for the caller's preemption/retry."""
+        it, so the state stays clean for the caller's preemption/retry.
+        ``cause`` labels any reuse-LRU eviction this allocation forces
+        (``decode_slot`` / ``prefill_chunk`` / ``other``)."""
         need = self.blocks_needed(seq_id, num_tokens)
         if need > self.num_available:
             return False
         table = self._tables.setdefault(seq_id, [])
         for _ in range(need):
-            b = self._take_block()
+            b = self._take_block(cause)
             self._ref[b] = 1
             table.append(b)
         return True
@@ -203,6 +230,7 @@ class BlockPool:
             returned += 1
             if self.prefix_cache_enabled and b in self._block_hash:
                 self._reuse[b] = self._block_hash[b]
+                self._park_step[b] = self.clock  # lifetime starts here
             else:
                 self._free.append(b)
         self._lens.pop(seq_id, None)
@@ -268,10 +296,26 @@ class BlockPool:
         if blocks:
             self._chain_state[seq_id] = (
                 len(blocks), self._block_hash[blocks[-1]])
-        for b in blocks:
+        cb = self.on_revive
+        # LRU position of each parked block BEFORE any revival mutates
+        # the order: index 0 = the eviction end (would have been
+        # clobbered by the very next allocation) — what the hit-depth
+        # histogram records (ISSUE 13).  Built only when a subscriber
+        # exists and a revive is possible: the O(len(_reuse)) walk must
+        # not tax hook-less pool users or cold-miss forks.
+        lru_order = ({b: i for i, b in enumerate(self._reuse)}
+                     if cb is not None and blocks else None)
+        for i, b in enumerate(blocks):
             if b in self._reuse:
                 del self._reuse[b]
                 self._ref[b] = 1
+                lifetime = self.clock - self._park_step.pop(b, self.clock)
+                if cb is not None:
+                    try:
+                        cb(b, self._block_depth.get(b, i + 1),
+                           lru_order[b], lifetime)
+                    except Exception:
+                        pass  # swallow-ok: telemetry must never tear the pool bookkeeping mid-revive
             else:
                 self._ref[b] = self._ref.get(b, 0) + 1
         self.reuse_hits += len(blocks)
@@ -307,12 +351,24 @@ class BlockPool:
             if b in self._block_hash or h in self._hash_index:
                 continue
             self._block_hash[b] = h
+            self._block_depth[b] = i + 1  # chain depth in blocks
             self._hash_index[h] = b
             added += 1
         self._chain_state[seq_id] = (n_full, h)
         if added:
             self.cache_epoch += 1
         return added
+
+    def block_chain_hash(self, block: int) -> Optional[bytes]:
+        """Chain hash registered for ``block`` (``None`` when unhashed)
+        — the prefix-heat table's key (ISSUE 13): the DEEPEST matched
+        block's hash commits to the whole cached prefix."""
+        return self._block_hash.get(block)
+
+    def block_chain_depth(self, block: int) -> int:
+        """Chain depth (in blocks) ``block`` was registered at; 0 when
+        unhashed."""
+        return self._block_depth.get(block, 0)
 
 
 class BlockKVCache:

@@ -60,6 +60,7 @@ from ..core.tensor import Tensor
 from ..distributed import topology
 from ..observability import lifecycle as _lc
 from ..observability.audit import AuditConfig, NumericsAuditor, logit_stats
+from ..observability.cachestat import CacheStatTracker
 from ..observability.lifecycle import LifecycleTracker
 from ..observability.stepprof import StepProfiler
 from ..ops.paged_attention import (
@@ -76,6 +77,15 @@ from .scheduler import (
     SchedulerConfig,
     bucket_size,
 )
+
+
+# per-step cap on individual prefix_cache_eviction lifecycle events
+# (ISSUE 13): counters/histograms/cause series stay exact per eviction,
+# but a pool-thrash step (one huge prefill clobbering hundreds of parked
+# blocks) must not flood the bounded flight-recorder ring and displace
+# the request-lifecycle events a post-mortem needs — evictions past the
+# cap collapse into one prefix_cache_eviction_burst summary event.
+_EVICT_EVENTS_PER_STEP = 8
 
 
 @dataclass
@@ -135,6 +145,14 @@ class EngineConfig:
     # are computed unconditionally, so audit on vs off is the SAME
     # compiled program — trace counts provably unchanged).
     audit: Optional[AuditConfig] = None
+    # KV-cache & memory observability (ISSUE 13): per-step pool-timeline
+    # sampling (free/reuse/allocated block counts with the exact
+    # free+reuse+allocated == num_blocks invariant asserted every
+    # sample), prefix-heat analytics over the chain hashes, reuse-LRU
+    # hit-depth / park-lifetime telemetry, and per-request cache
+    # attribution — all host-side (CacheStatTracker), so on vs off is
+    # provably the same compiled program.  Served at /v1/debug/cache.
+    cache_stats: bool = True
     # Unified ragged step program (ISSUE 11): every engine step runs ONE
     # packed ragged launch (ops/ragged_paged.py) serving mixed prefill
     # chunks and decode rows together, instead of picking from the three
@@ -204,6 +222,18 @@ class EngineCore:
                                      labels=metrics_labels,
                                      enabled=config.step_profile)
         self.metrics.attach_step_profiler(self.stepprof)
+        # --- KV-cache & memory observability (ISSUE 13) --------------------
+        # pool timeline + prefix heat + reuse-LRU telemetry + per-request
+        # attribution; the pool's event-driven hooks below feed it AND
+        # the legacy prefix_cache_evictions counter / lifecycle event
+        # (which are no longer lag-batched per step)
+        self.cachestat = CacheStatTracker(self.kv,
+                                          registry=self.metrics.registry,
+                                          labels=metrics_labels,
+                                          enabled=config.cache_stats)
+        self._evict_events_step = 0  # per-step lifecycle-event budget
+        self.kv.on_evict = self._on_pool_evict
+        self.kv.on_revive = self._on_pool_revive
         # --- online numerics auditing (ISSUE 10) ---------------------------
         # NaN/Inf sentinel + logit telemetry on every launch, shadow-
         # oracle re-execution of sampled decode steps; the fleet router
@@ -305,7 +335,6 @@ class EngineCore:
         self._jit_unified = jax.jit(self._unified_fn, donate_argnums=donate,
                                     **jit_kw["ragged"])
         self._profile_ops = config.profile_ops
-        self._evictions_seen = 0  # last-synced kv.reuse_evictions value
         model.eval()
 
     def _mesh_jit_shardings(self, mesh, cfg) -> Dict[str, dict]:
@@ -507,6 +536,44 @@ class EngineCore:
             self.lifecycle.event(rid, name, replica=self._replica_label,
                                  **attrs)
 
+    def _on_pool_evict(self, block: int, depth: int, lifetime: int,
+                       cause: str) -> None:
+        """BlockPool eviction hook (ISSUE 13): a reuse-parked cached
+        block was clobbered for an allocation.  Event-driven — the
+        counter, the lifecycle ``prefix_cache_eviction`` event (with the
+        clobbered chain depth and the allocation cause), and the
+        eviction-cause series all fire HERE, at the eviction, instead of
+        being lag-batched by a per-step counter diff."""
+        self.metrics.count("prefix_cache_evictions")
+        self.cachestat.record_eviction(depth, lifetime, cause)
+        # engine-level event (no single owning request): rid=None goes
+        # to the flight-recorder rings only.  Per-step event budget:
+        # counters above stay exact, but eviction N+1.. of one step
+        # collapse into the burst summary _flush_evict_burst emits —
+        # a thrashing step must not wash the flight ring.
+        self._evict_events_step += 1
+        if self._evict_events_step <= _EVICT_EVENTS_PER_STEP:
+            self._lc(None, "prefix_cache_eviction", block=int(block),
+                     depth=int(depth), lifetime_steps=int(lifetime),
+                     cause=cause)
+
+    def _flush_evict_burst(self) -> None:
+        """End-of-step: one summary event for evictions past the
+        per-step lifecycle-event budget, then reset the budget."""
+        suppressed = self._evict_events_step - _EVICT_EVENTS_PER_STEP
+        self._evict_events_step = 0
+        if suppressed > 0:
+            self._lc(None, "prefix_cache_eviction_burst",
+                     suppressed=suppressed,
+                     total=suppressed + _EVICT_EVENTS_PER_STEP)
+
+    def _on_pool_revive(self, block: int, depth: int, lru_depth: int,
+                        lifetime: int) -> None:
+        """BlockPool revive hook (ISSUE 13): a prefix fork revived a
+        reuse-parked block — the LRU position it sat at feeds the
+        hit-depth histogram (the reuse-LRU saturation early-warning)."""
+        self.cachestat.record_revive(lru_depth, lifetime)
+
     def set_fault_injector(self, injector) -> None:
         """Bind a :class:`~paddle_tpu.serving.faultinject.FaultInjector`
         (ISSUE 12).  The injector is consulted at the named injection
@@ -571,6 +638,8 @@ class EngineCore:
         self._lc(req.request_id, _lc.EV_FINISH, reason=reason.value,
                  e2e_s=round(e2e, 6), generated=len(req.output_tokens),
                  preemptions=req.num_preemptions)
+        # park the attribution row in the bounded recent ring (ISSUE 13)
+        self.cachestat.close_request(req.request_id)
 
     def _emit(self, req: Request, tok: int) -> None:
         """Append one sampled token + finish-state bookkeeping."""
@@ -638,7 +707,7 @@ class EngineCore:
             self.metrics.observe_queue_wait(t0 - req.arrival_time)
         if recompute:
             self.metrics.count("recompute_prefills")  # first chunk only
-        if not self.kv.allocate(rid, n):
+        if not self.kv.allocate(rid, n, cause="prefill_chunk"):
             raise PoolExhausted(  # scheduler planning guarantees room
                 f"prefill chunk of {n} tokens for {rid!r} after admission")
         return ids_full, target, start, n, recompute
@@ -991,6 +1060,7 @@ class EngineCore:
         remove_timer = (self.metrics.install_dispatch_timer()
                         if self._profile_ops else lambda: None)
         self.step_seq += 1
+        self.kv.clock = self.step_seq  # park lifetimes tick in steps
         self.stepprof.begin_step()
         self.audit.begin_step()
         fi = self._fault
@@ -1037,14 +1107,39 @@ class EngineCore:
                     self.metrics.count("prefix_cache_hit_tokens", cached)
                     self.metrics.count("prefix_cache_miss_tokens",
                                        total - cached)
+                    if req.prompt_cached_tokens is None:
+                        # FIRST admission (output empty, so cached <=
+                        # prompt): the client-facing usage attribution
+                        req.prompt_cached_tokens = cached
+                    # per-request attribution (ISSUE 13): accumulated at
+                    # the SAME points as the counters above, so
+                    # sum(per-request cached) == prefix_cache_hit_tokens
+                    # exactly (asserted in tests and bench)
+                    self.cachestat.record_admission(
+                        req.request_id, cached, total - cached,
+                        len(req.prompt_ids),
+                        recompute=bool(req.output_tokens))
                     self._lc(req.request_id, _lc.EV_ADMITTED,
                              cached_tokens=cached,
+                             computed_tokens=total - cached,
                              recompute=bool(req.output_tokens))
                     if cached:
                         self.tracer.instant(
                             "prefix_cache_hit", cat="serving",
                             request=str(req.request_id),
                             trace=req.trace_id, cached_tokens=cached)
+                    if cached and self.cachestat.enabled:
+                        # prefix-heat (ISSUE 13): keyed by the DEEPEST
+                        # matched block's chain hash — it commits to the
+                        # whole cached prefix.  Guarded: the table copy
+                        # + hash lookup must cost nothing when the
+                        # tracker is disabled.
+                        depth = cached // self.block_size
+                        table = self.kv.table(req.request_id)
+                        self.cachestat.record_prefix_hit(
+                            self.kv.block_chain_hash(table[depth - 1])
+                            if 0 < depth <= len(table) else None,
+                            depth, cached, self.step_seq)
                 emitted: Dict[object, int] = {}
                 decodes = [r for r in plan.decodes
                            if r.state is RequestState.RUNNING]
@@ -1066,16 +1161,18 @@ class EngineCore:
                 for req in list(self.scheduler.running):
                     if req.finished:
                         self._retire(req)
-                ev = self.kv.reuse_evictions
-                if ev > self._evictions_seen:
-                    self.metrics.count("prefix_cache_evictions",
-                                       ev - self._evictions_seen)
-                    # engine-level event (no single owning request):
-                    # rid=None goes to flight-recorder rings only
-                    self._lc(None, "prefix_cache_eviction",
-                             evicted=ev - self._evictions_seen)
-                    self._evictions_seen = ev
+                # (prefix-cache evictions are event-driven now: the
+                # pool's on_evict hook fires the counter, the lifecycle
+                # event and the cause/depth series at the eviction;
+                # past the per-step event budget they collapse into one
+                # burst summary here)
+                self._flush_evict_burst()
                 self.metrics.set_cached_token_ratio()
+                # pool timeline (ISSUE 13): one sample per engine step,
+                # invariant-checked inside
+                self.cachestat.sample_pool(
+                    self.step_seq,
+                    promised=self.scheduler.promised_blocks)
                 self.metrics.sample_gauges(self.scheduler.queue_depth,
                                            self.scheduler.num_running,
                                            self.kv.occupancy())
@@ -1175,4 +1272,5 @@ class EngineCore:
         if req is not None:
             self.scheduler.remove(req)
             self._lc(request_id, _lc.EV_FINISH, reason="released")
+        self.cachestat.close_request(request_id)
         self.kv.free(request_id)

@@ -112,6 +112,9 @@ METRIC_NAMES = (
     "serving_fleet_replica_in_flight",
     "serving_fleet_replica_occupancy",
     "serving_fleet_replica_queue_depth",
+    # ISSUE 13: max − min per-replica cached-token ratio, sampled per
+    # scrape — the cache-aware rebalancing trigger signal
+    "serving_fleet_cache_imbalance",
 )
 
 
@@ -600,6 +603,15 @@ class FleetRouter:
                 f"decode_event_sample={sorted(samples)} — the fleet "
                 "shares ONE tracker, so every replica must use the "
                 "same EngineConfig knobs")
+        cstats = {e.engine_config.cache_stats for e in self.engines}
+        if len(cstats) != 1:
+            # same failure shape as the gates below: /v1/debug/cache
+            # reports fleet-wide, so a half-tracked fleet would read as
+            # "replica i has no cache pressure"
+            raise ValueError(
+                f"replicas disagree on cache_stats={sorted(cstats)}; "
+                "the cache debug surface reports fleet-wide, so every "
+                "replica must use the same EngineConfig knob")
         sprof = {e.engine_config.step_profile for e in self.engines}
         if len(sprof) != 1:
             # same failure shape as the lifecycle gate: a half-profiled
@@ -651,6 +663,10 @@ class FleetRouter:
         # replica index the flight rings use
         self.flight.bind_step_profilers(
             {str(i): e.stepprof for i, e in enumerate(self.engines)})
+        # cache-stat trackers (ISSUE 13): post-mortem bundles embed the
+        # owning replica's last-K pool-timeline samples, same keying
+        self.flight.bind_cache_trackers(
+            {str(i): e.cachestat for i, e in enumerate(self.engines)})
         # numerics auditors (ISSUE 10): divergence/nonfinite triggers and
         # .npz repros carry the replica INDEX, matching the flight rings
         for i, e in enumerate(self.engines):
@@ -699,6 +715,10 @@ class FleetRouter:
                           "replicas with a live engine thread")
         self._g_in_flight = g("serving_fleet_in_flight",
                               "in-flight requests fleet-wide")
+        self._g_cache_imbalance = g(
+            "serving_fleet_cache_imbalance",
+            "max - min per-replica cached-token ratio (prefix-affinity "
+            "placement imbalance; the cache-aware rebalancing signal)")
         self._affinity_hit = c(
             "serving_fleet_affinity_hit_total",
             "requests routed to their prefix-affinity replica")
@@ -1016,12 +1036,35 @@ class FleetRouter:
                 time.sleep(0.002)
 
     # --- observability ------------------------------------------------------
+    def cached_token_ratios(self) -> Dict[str, Optional[float]]:
+        """Per-replica prefix-cache hit ratio (hit/(hit+computed) over
+        each replica's life; ``None`` before any prefill) — the rows the
+        cache-imbalance gauge and ``/v1/debug/cache``'s fleet view are
+        computed from."""
+        return {str(r.index): r.engine.metrics.cached_token_ratio()
+                for r in self.replicas}
+
+    def cache_imbalance(self) -> Optional[float]:
+        """max − min per-replica cached-token ratio (ISSUE 13): the
+        rebalancing trigger signal — one replica's reuse LRU saturating
+        while another idles shows up as this gap widening.  ``None``
+        until two replicas have prefilled anything (a one-replica fleet
+        reports 0.0 once it has data)."""
+        vals = [v for v in self.cached_token_ratios().values()
+                if v is not None]
+        if not vals:
+            return None
+        return max(vals) - min(vals)
+
     def sample_gauges(self) -> None:
         """Refresh the serving_fleet_* gauges from replica state (the
         HTTP frontend calls this on every /metrics scrape; direct
         callers, whenever they snapshot)."""
         self._g_alive.set(sum(1 for r in self.replicas if r.alive))
         self._g_in_flight.set(len(self._owner))
+        imbalance = self.cache_imbalance()
+        if imbalance is not None:
+            self._g_cache_imbalance.set(imbalance)
         for r in self.replicas:
             self._g_replica_alive[r.index].set(1 if r.alive else 0)
             self._g_replica_in_flight[r.index].set(r.in_flight)

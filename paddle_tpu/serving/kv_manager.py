@@ -86,7 +86,7 @@ class KVCacheManager(BlockPool):
         fresh block on a boundary.  ``None`` on exhaustion — the caller
         preempts and retries.  Does not advance the length: ``commit``
         does, after the model step actually wrote the slot."""
-        if not self.allocate(seq_id, 1):
+        if not self.allocate(seq_id, 1, cause="decode_slot"):
             return None
         pos = self._lens.get(seq_id, 0)
         table = self._tables[seq_id]

@@ -256,15 +256,22 @@ class ServingMetrics:
         (``serving_mp_shards``; 1 = single-chip)."""
         self._gauges["mp_shards"].set(mp)
 
-    def set_cached_token_ratio(self) -> None:
-        """Publish hit / (hit + computed) over the whole process life —
-        the fraction of prefill-bound tokens the prefix cache served for
-        free.  A no-op until any prefill ran."""
+    def cached_token_ratio(self) -> Optional[float]:
+        """hit / (hit + computed) over the whole process life — the
+        fraction of prefill-bound tokens the prefix cache served for
+        free; ``None`` until any prefill ran.  The fleet's
+        ``serving_fleet_cache_imbalance`` gauge (ISSUE 13) is the
+        max−min of this value across replicas."""
         hit = self._counter("prefix_cache_hit_tokens").value
         computed = self._counter("prefill_tokens_computed").value
-        if hit + computed:
-            self._gauges["prefix_cached_token_ratio"].set(
-                hit / (hit + computed))
+        return hit / (hit + computed) if hit + computed else None
+
+    def set_cached_token_ratio(self) -> None:
+        """Publish :meth:`cached_token_ratio` on the gauge.  A no-op
+        until any prefill ran."""
+        ratio = self.cached_token_ratio()
+        if ratio is not None:
+            self._gauges["prefix_cached_token_ratio"].set(ratio)
 
     def sample_gauges(self, queue_depth: int, num_running: int,
                       kv_occupancy: float) -> None:

@@ -18,8 +18,10 @@ SSE wire format (``stream=true``)::
     data: [DONE]\n\n
 
 Each event carries the tokens NEW since the previous event; the final
-data event has empty ``token_ids`` and the request's ``finish_reason``;
-the literal ``[DONE]`` sentinel terminates the stream (the OpenAI
+data event has empty ``token_ids``, the request's ``finish_reason`` and
+a ``usage`` block (prompt/completion totals plus
+``prompt_cached_tokens`` — the prefix-cache saving, ISSUE 13); the
+literal ``[DONE]`` sentinel terminates the stream (the OpenAI
 convention).
 """
 
@@ -167,9 +169,23 @@ def parse_completion_request(
 
 # --- response bodies --------------------------------------------------------
 
+def usage_body(prompt_tokens: int, completion_tokens: int,
+               prompt_cached_tokens: int = 0) -> dict:
+    """The ``usage`` accounting block (ISSUE 13 satellite):
+    ``prompt_cached_tokens`` is how many prompt tokens the prefix cache
+    served for free at admission — the client-visible cache saving."""
+    return {
+        "prompt_tokens": int(prompt_tokens),
+        "completion_tokens": int(completion_tokens),
+        "total_tokens": int(prompt_tokens) + int(completion_tokens),
+        "prompt_cached_tokens": int(prompt_cached_tokens),
+    }
+
+
 def completion_body(request_id: str, model: str, token_ids: List[int],
                     finish_reason: Optional[str], prompt_tokens: int,
-                    error: Optional[str] = None) -> dict:
+                    error: Optional[str] = None,
+                    prompt_cached_tokens: int = 0) -> dict:
     """Non-streaming ``text_completion`` response object."""
     choice = {"index": 0, "token_ids": list(token_ids),
               "finish_reason": finish_reason}
@@ -181,24 +197,28 @@ def completion_body(request_id: str, model: str, token_ids: List[int],
         "created": int(time.time()),
         "model": model,
         "choices": [choice],
-        "usage": {
-            "prompt_tokens": prompt_tokens,
-            "completion_tokens": len(token_ids),
-            "total_tokens": prompt_tokens + len(token_ids),
-        },
+        "usage": usage_body(prompt_tokens, len(token_ids),
+                            prompt_cached_tokens),
     }
 
 
 def chunk_body(request_id: str, model: str, token_ids: List[int],
-               finish_reason: Optional[str]) -> dict:
-    """One streaming ``text_completion.chunk`` event payload."""
-    return {
+               finish_reason: Optional[str],
+               usage: Optional[dict] = None) -> dict:
+    """One streaming ``text_completion.chunk`` event payload.  The FINAL
+    chunk (the one carrying ``finish_reason``) also carries ``usage``
+    with the per-request cache attribution, so SSE clients see the
+    prefix-cache savings too (ISSUE 13 satellite)."""
+    out = {
         "id": request_id,
         "object": "text_completion.chunk",
         "model": model,
         "choices": [{"index": 0, "token_ids": list(token_ids),
                      "finish_reason": finish_reason}],
     }
+    if usage is not None:
+        out["usage"] = usage
+    return out
 
 
 def error_body(message: str, type: str = "invalid_request_error") -> dict:

@@ -98,6 +98,12 @@ class Request:
     # Prefill *progress* has no mirror here — kv.seq_len(request_id) is
     # the single source of truth.
     num_cached_tokens: int = 0
+    # client-facing cache attribution (ISSUE 13): cached tokens at the
+    # FIRST admission — output is empty there, so this is always a count
+    # of PROMPT tokens served for free, the number the completions
+    # ``usage.prompt_cached_tokens`` field reports.  num_cached_tokens
+    # above tracks the LAST admission and resets on preemption.
+    prompt_cached_tokens: Optional[int] = None
     # externally-computed leading-block chain hashes (ISSUE 6): the fleet
     # router hashes the prompt's leading full blocks once for
     # prefix-affinity placement and hands them down, so the scheduler's

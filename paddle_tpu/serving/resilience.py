@@ -594,6 +594,8 @@ class FleetSupervisor:
         router.replicas[index] = new
         router.flight.bind_step_profilers(
             {str(r.index): r.engine.stepprof for r in router.replicas})
+        router.flight.bind_cache_trackers(
+            {str(r.index): r.engine.cachestat for r in router.replicas})
         # re-arm the fired-once engine_death trigger (and its cooldown)
         # for this index: the NEXT death is a new incident and must dump
         # its own bundle — exactly one bundle per recovery action

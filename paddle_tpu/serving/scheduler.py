@@ -146,6 +146,15 @@ class ContinuousBatchingScheduler:
         # and bench assert.
         self.tokens_planned_prefill = 0
         self.tokens_planned_decode = 0
+        # blocks pledged to the MOST RECENT planning pass's prefill
+        # chunks — a planning-pressure indicator the pool-timeline
+        # sampler (ISSUE 13) records per step.  NOTE: the engine
+        # executes the plan within the same step, so by the time the
+        # end-of-step sample reads this the pledged blocks are
+        # typically already materialized into the pool's allocated
+        # count — promised is NOT extra unaccounted capacity and must
+        # not be summed with `allocated`.
+        self.promised_blocks = 0
 
     # --- queue ops ----------------------------------------------------------
     def add(self, req: Request) -> None:
@@ -286,6 +295,7 @@ class ContinuousBatchingScheduler:
             out.prefills.append(req)
             out.admitted.append(req)
             admitted += 1
+        self.promised_blocks = promised
 
     def _preempt(self, victim: Request) -> None:
         """Evict ``victim``: free its blocks (shared prefix blocks stay

@@ -104,13 +104,14 @@ from .protocol import (
     error_body,
     parse_completion_request,
     sse_event,
+    usage_body,
 )
 from .request import FinishReason
 
 _MAX_HEADER_BYTES = 16384
 _ROUTES = ("/v1/completions", "/v1/requests", "/v1/debug/compiles",
-           "/v1/debug/profile", "/v1/debug/audit", "/healthz", "/readyz",
-           "/metrics")
+           "/v1/debug/profile", "/v1/debug/audit", "/v1/debug/cache",
+           "/healthz", "/readyz", "/metrics")
 
 # pre-registered metric names this module owns (tools/check_metrics_docs
 # lints that each appears in README's metrics table)
@@ -641,6 +642,52 @@ class CompletionServer:
                 {"object": "list", "status": status, "data": data},
                 keep_alive=keep_alive)
             return 200
+        if path == "/v1/debug/cache":
+            # KV-cache & memory observability (ISSUE 13): per-replica
+            # pool timelines, prefix-heat tables, hit-depth/eviction
+            # reports and per-request attribution, plus a fleet view —
+            # per-replica cached-token ratios and the max−min imbalance
+            # (the cache-aware rebalancing signal)
+            try:
+                replica = self._debug_int(params, "replica", -1,
+                                          -1, 1 << 30)
+            except ValueError as e:
+                await self._respond(writer, 400, error_body(str(e)),
+                                    keep_alive=keep_alive)
+                return 400
+            if replica >= self.fleet.dp:
+                await self._respond(writer, 404, error_body(
+                    f"no replica {replica} (fleet has dp="
+                    f"{self.fleet.dp})", "not_found"),
+                    keep_alive=keep_alive)
+                return 404
+            reps = (self.fleet.replicas if replica < 0
+                    else [self.fleet.replicas[replica]])
+            data = [dict(r.engine.cachestat.snapshot(),
+                         replica=str(r.index)) for r in reps]
+            # ONE ratio snapshot: the body's imbalance is derived from
+            # the very ratios it reports, so the two fields can never
+            # disagree under concurrent traffic
+            ratios = self.fleet.cached_token_ratios()
+            vals = [v for v in ratios.values() if v is not None]
+            imbalance = max(vals) - min(vals) if vals else None
+            self.fleet.sample_gauges()  # the imbalance gauge tracks it
+            await self._respond(
+                writer, 200,
+                {"object": "list",
+                 "status": ("ok" if any(d["enabled"] for d in data)
+                            else "disabled"),
+                 "fleet": {
+                     "dp": self.fleet.dp,
+                     "cached_token_ratios": {
+                         k: (None if v is None else round(v, 4))
+                         for k, v in ratios.items()},
+                     "cache_imbalance": (None if imbalance is None
+                                         else round(imbalance, 4)),
+                 },
+                 "data": data},
+                keep_alive=keep_alive)
+            return 200
         if path == "/v1/debug/compiles":
             data = []
             totals: Dict[str, Dict] = {}
@@ -859,6 +906,13 @@ class CompletionServer:
                 continue  # swallow-ok: the wait IS a poll; timeout means re-check request state, not a fault
             handle.event.clear()
 
+    @staticmethod
+    def _prompt_cached(handle: _Handle) -> int:
+        """Cached prompt tokens at the request's first admission (the
+        usage attribution, ISSUE 13); 0 when never admitted."""
+        cached = getattr(handle.req, "prompt_cached_tokens", None)
+        return int(cached or 0)
+
     async def _json_response(self, handle: _Handle,
                              timeout: Optional[float],
                              writer: asyncio.StreamWriter,
@@ -868,7 +922,8 @@ class CompletionServer:
         await self._respond(writer, 200, completion_body(
             handle.rid, self.cfg.model_name, tokens, reason,
             len(handle.creq.prompt_ids),
-            error=getattr(req, "error", None)),
+            error=getattr(req, "error", None),
+            prompt_cached_tokens=self._prompt_cached(handle)),
             extra=(("X-Request-Id", handle.rid),), keep_alive=keep_alive)
         return 200
 
@@ -892,9 +947,13 @@ class CompletionServer:
                 handle.rid, self.cfg.model_name, new, None)))
             await writer.drain()
 
-        _, reason = await self._collect(handle, timeout, on_tokens)
+        tokens, reason = await self._collect(handle, timeout, on_tokens)
+        # the FINAL chunk carries the usage block — SSE clients see the
+        # prefix-cache attribution too (ISSUE 13 satellite)
         writer.write(sse_event(chunk_body(
-            handle.rid, self.cfg.model_name, [], reason)))
+            handle.rid, self.cfg.model_name, [], reason,
+            usage=usage_body(len(handle.creq.prompt_ids), len(tokens),
+                             self._prompt_cached(handle)))))
         writer.write(SSE_DONE)
         await writer.drain()
         return 200

@@ -207,7 +207,8 @@ class TestEndpoints:
         assert choice["token_ids"] == ref.token_ids
         assert choice["finish_reason"] == "length"
         assert obj["usage"] == {"prompt_tokens": 4, "completion_tokens": 6,
-                                "total_tokens": 10}
+                                "total_tokens": 10,
+                                "prompt_cached_tokens": 0}
 
     def test_concurrent_sse_streams_token_identical(self, harness_factory):
         """The acceptance criterion: ≥4 concurrent SSE streaming requests

@@ -1,0 +1,696 @@
+"""KV-cache & memory observability (ISSUE 13).
+
+Tentpole coverage (named ``zzz`` so its dots APPEND to the tier-1 run
+after ``test_zz_resilience`` — the suite brushes the tier-1 timeout, so
+new tests must never displace earlier dots):
+
+* direct BlockPool reuse-LRU contract tests: eviction order keeps the
+  shortest prefixes longest, revive-at-depth reports the LRU position
+  the hit-depth histogram records, and the pool invariant
+  ``free + reuse + allocated == num_blocks`` holds under a
+  fork/free/evict churn loop;
+* CacheStatTracker bounds: timeline ring, decayed heat-table eviction,
+  attribution recent ring;
+* engine integration: ``cache_stats`` on vs off is token-identical with
+  EQUAL jit trace counts (and ``/metrics`` free of every
+  ``serving_pool_*`` series when off); per-step pool samples carry the
+  exact invariant; evictions are event-driven (counter == pool truth,
+  lifecycle event carries cause + chain depth); the attribution
+  invariant ``sum(per-request cached) == prefix_cache_hit_tokens``;
+* the completions ``usage`` block (non-stream body AND final SSE chunk)
+  reports ``prompt_cached_tokens`` at dp=1 and dp=2;
+* ``GET /v1/debug/cache``: protocol-clean JSON (400/404, never 500) at
+  dp=1 and dp=2 with per-replica attribution + the fleet view;
+* flight bundles embed the owning replica's last-K pool samples;
+* ``serving_fleet_cache_imbalance`` (max−min per-replica cached-token
+  ratio) on the shared registry;
+* lint coverage: cachestat.py in check_bounded_metrics /
+  check_metrics_docs, and the new check_debug_endpoints lint
+  (self-tested against a synthetic README missing a route).
+"""
+
+import asyncio
+import http.client
+import json
+import os
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.observability import CacheStatTracker, MetricsRegistry
+from paddle_tpu.ops.paged_attention import BlockPool
+from paddle_tpu.serving import (
+    EngineConfig,
+    EngineCore,
+    FleetConfig,
+    FleetRouter,
+    SamplingParams,
+    SchedulerConfig,
+)
+from paddle_tpu.serving.server import CompletionServer, ServerConfig
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(_REPO, "tools"))
+try:
+    import check_bounded_metrics as bounded_lint
+    import check_debug_endpoints as debug_lint
+    import check_metrics_docs as docs_lint
+finally:
+    sys.path.pop(0)
+
+BS = 4
+
+
+def _model(layers=2):
+    paddle.seed(0)
+    return LlamaForCausalLM(LlamaConfig.tiny(num_hidden_layers=layers))
+
+
+def _engine(cache_stats=True, num_blocks=15, max_num_seqs=4,
+            chunk_budget=8, registry=None, metrics_labels=None):
+    """Small pool + chunk budget: concurrent 16+10-token sequences
+    cannot fit, so the run chunks, preempts, recomputes — and the
+    reuse LRU parks, revives and clobbers."""
+    return EngineCore(
+        _model(),
+        config=EngineConfig(
+            num_blocks=num_blocks, block_size=BS,
+            scheduler=SchedulerConfig(
+                max_num_seqs=max_num_seqs,
+                max_prefill_tokens_per_step=chunk_budget),
+            cache_stats=cache_stats),
+        registry=registry, metrics_labels=metrics_labels)
+
+
+def _prompts(n=6, rng_seed=0, prefix_len=8, tail=8):
+    rng = np.random.default_rng(rng_seed)
+    prefix = rng.integers(0, 256, prefix_len).tolist()
+    return [prefix + rng.integers(0, 256, tail).tolist() for _ in range(n)]
+
+
+def _run(eng, prompts, max_new=10):
+    reqs = [eng.add_request(p, SamplingParams(max_new_tokens=max_new))
+            for p in prompts]
+    eng.run(max_steps=4000)
+    assert all(r.finished for r in reqs)
+    return [list(r.output_tokens) for r in reqs]
+
+
+# --------------------------------------------------------------------------
+# BlockPool reuse-LRU contract (satellite: direct pool tests)
+# --------------------------------------------------------------------------
+class TestBlockPoolContract:
+    def _parked_chain(self, num_blocks=8, bs=2, chain_blocks=3):
+        """A pool whose reuse LRU holds one hashed chain of
+        ``chain_blocks`` blocks (depths 1..chain_blocks)."""
+        pool = BlockPool(num_blocks, bs, enable_prefix_cache=True)
+        tokens = list(range(chain_blocks * bs))
+        assert pool.allocate("a", len(tokens))
+        pool._lens["a"] = len(tokens)
+        pool.record_block_hashes("a", tokens)
+        pool.free("a")
+        assert len(pool._reuse) == chain_blocks
+        return pool, tokens
+
+    def test_eviction_order_keeps_shortest_prefixes_longest(self):
+        pool, _ = self._parked_chain()
+        evicted = []
+        pool.on_evict = lambda b, d, life, cause: evicted.append(
+            (d, cause))
+        # drain the free list (4 blocks), then force three evictions
+        assert pool.allocate("b", 4 * pool.block_size)
+        assert pool.num_free == 0
+        assert pool.allocate("c", 3 * pool.block_size, cause="other")
+        # clobber order: deepest chain blocks first — the shortest
+        # (most shareable) prefixes live longest
+        assert [d for d, _ in evicted] == [3, 2, 1]
+        assert pool.reuse_evictions == 3
+
+    def test_revive_depth_matches_hit_depth_report(self):
+        pool, tokens = self._parked_chain()
+        pool.clock = 5  # blocks parked at clock 0 (free() stamped it)
+        revives = []
+        pool.on_revive = lambda b, d, lru, life: revives.append(
+            (d, lru, life))
+        cached = pool.fork_prefix("w", tokens + [99])
+        assert cached == len(tokens)
+        # park order is deepest-first (free() walks the table in
+        # reverse), so chain depth 1 sat FURTHEST from eviction (lru 2)
+        # and depth 3 at the eviction end (lru 0)
+        assert [(d, lru) for d, lru, _ in revives] == [
+            (1, 2), (2, 1), (3, 0)]
+        # lifetimes measured in caller-advanced clock ticks
+        assert all(life == 5 for _, _, life in revives)
+        assert pool.reuse_hits == 3 and not pool._reuse
+
+    def test_pool_invariant_under_churn(self):
+        rng = np.random.default_rng(7)
+        pool = BlockPool(12, 2, enable_prefix_cache=True)
+        prompts = [list(rng.integers(0, 64, 8)) for _ in range(4)]
+        live = {}
+        for step in range(300):
+            pool.clock = step
+            op = rng.integers(0, 4)
+            sid = f"s{step}"
+            if op == 0 and len(live) < 4:          # admit (warm fork +
+                p = prompts[rng.integers(0, len(prompts))]  # uncached tail)
+                cached = pool.fork_prefix(sid, p)
+                need = len(p) - cached
+                if need and not pool.allocate(
+                        sid, need, cause="prefill_chunk"):
+                    pool.free(sid)                  # admission refused
+                else:
+                    pool._lens[sid] = len(p)
+                    pool.record_block_hashes(sid, p)
+                    live[sid] = p
+            elif op == 1 and live:                  # free (park/return)
+                victim = list(live)[rng.integers(0, len(live))]
+                pool.free(victim)
+                live.pop(victim)
+            elif op == 2 and live:                  # decode-ish append
+                owner = list(live)[rng.integers(0, len(live))]
+                if pool.allocate(owner, 1, cause="decode_slot"):
+                    pool._lens[owner] += 1
+            elif op == 3 and live:                  # preemption-ish: free
+                victim = list(live)[rng.integers(0, len(live))]  # under
+                pool.free(victim)                   # pressure, re-admit
+                live.pop(victim)                    # later via op 0
+            # the exact invariant, every iteration: every usable block
+            # is in exactly one of free / reuse / refcounted, plus the
+            # reserved null page
+            free, reuse = pool.num_free, len(pool._reuse)
+            allocated = 1 + len(pool._ref)
+            assert free + reuse + allocated == pool.num_blocks
+            assert pool.num_available == free + reuse
+        assert pool.reuse_evictions > 0 and pool.reuse_hits > 0
+
+
+# --------------------------------------------------------------------------
+# CacheStatTracker unit behaviour (no jax work)
+# --------------------------------------------------------------------------
+class TestCacheStatUnit:
+    def test_timeline_ring_bounded_and_invariant_checked(self):
+        pool = BlockPool(8, 2, enable_prefix_cache=True)
+        cs = CacheStatTracker(pool, registry=MetricsRegistry(),
+                              timeline_len=4)
+        for i in range(10):
+            cs.sample_pool(i + 1, promised=i)
+        tl = cs.timeline()
+        assert len(tl) == 4
+        assert [s["step"] for s in tl] == [7, 8, 9, 10]
+        assert tl[-1]["free"] + tl[-1]["reuse"] + tl[-1]["allocated"] \
+            == pool.num_blocks
+        # a torn pool must fail the sample loudly
+        pool._ref[3] = 1  # block 3 is ALSO on the free list
+        with pytest.raises(AssertionError, match="pool invariant"):
+            cs.sample_pool(11)
+
+    def test_heat_table_bounded_with_decayed_eviction(self):
+        pool = BlockPool(8, 2, enable_prefix_cache=True)
+        cs = CacheStatTracker(pool, heat_entries=3, heat_decay=0.5)
+        hot = b"H" * 32
+        for step in range(6):
+            cs.record_prefix_hit(hot, 2, 100, step)
+        for i in range(5):  # cold one-shot entries force evictions
+            cs.record_prefix_hit(bytes([i]) * 32, 1, 2, i)
+        assert len(cs._heat) <= 3
+        table = cs.heat_table(step=10)
+        assert table[0]["prefix"] == hot.hex()[:16]  # hot entry survives
+        assert table[0]["hit_tokens"] == 600
+        assert table[0]["hits"] == 6
+
+    def test_attribution_rows_and_recent_ring(self):
+        pool = BlockPool(8, 2, enable_prefix_cache=True)
+        cs = CacheStatTracker(pool, recent_requests=2)
+        cs.record_admission("a", 8, 4, 12)
+        cs.record_admission("a", 8, 10, 12, recompute=True)  # recompute
+        for rid in ("b", "c", "d"):
+            cs.record_admission(rid, 0, 6, 6)
+            cs.close_request(rid)
+        attr = cs.attribution()
+        assert attr["cached_tokens_total"] == 16
+        assert attr["computed_tokens_total"] == 32
+        assert [r["id"] for r in attr["active"]] == ["a"]
+        assert attr["active"][0]["admissions"] == 2
+        assert attr["active"][0]["recomputes"] == 1
+        assert [r["id"] for r in attr["recent"]] == ["c", "d"]  # bounded
+
+    def test_disabled_registers_nothing(self):
+        pool = BlockPool(8, 2, enable_prefix_cache=True)
+        reg = MetricsRegistry()
+        cs = CacheStatTracker(pool, registry=reg, enabled=False)
+        cs.sample_pool(1)
+        cs.record_prefix_hit(b"x" * 32, 1, 4, 1)
+        cs.record_revive(0, 1)
+        cs.record_eviction(1, 2, "decode_slot")
+        cs.record_admission("a", 4, 4, 8)
+        assert "serving_pool" not in reg.prometheus_text()
+        assert cs.timeline() == [] and cs.heat_table() == []
+
+
+# --------------------------------------------------------------------------
+# engine integration
+# --------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def churn_engine():
+    """ONE preempting shared-prefix run with cache_stats on, shared by
+    the read-only integration assertions below (engine runs are the
+    expensive part of this file).  Module-scoped fixture, not mutable
+    class state: each test also passes standalone."""
+    eng = _engine(cache_stats=True)
+    outputs = _run(eng, _prompts())
+    return eng, outputs
+
+
+class TestEngineIntegration:
+    def test_on_off_token_identical_equal_traces_and_series_gating(
+            self, churn_engine):
+        eng_on, out_on = churn_engine
+        eng_off = _engine(cache_stats=False)
+        out_off = _run(eng_off, _prompts())
+        assert out_on == out_off
+        assert eng_on.prefill_trace_count == eng_off.prefill_trace_count
+        assert eng_on.decode_trace_count == eng_off.decode_trace_count
+        text_on = eng_on.metrics.registry.prometheus_text()
+        text_off = eng_off.metrics.registry.prometheus_text()
+        for series in ("serving_pool_free_blocks",
+                       "serving_pool_reuse_blocks",
+                       "serving_pool_allocated_blocks",
+                       "serving_reuse_hit_depth",
+                       "serving_block_lifetime_steps",
+                       "serving_pool_evictions_total"):
+            assert series in text_on, series
+            assert series not in text_off, series
+
+    def test_pool_sampled_every_step_with_invariant(self, churn_engine):
+        eng, _ = churn_engine
+        tl = eng.cachestat.timeline()
+        assert tl, "no pool samples"
+        # one sample per engine step (ring holds the last 256)
+        assert len(tl) == min(eng.step_seq, 256)
+        assert [s["step"] for s in tl] == \
+            list(range(eng.step_seq - len(tl) + 1, eng.step_seq + 1))
+        for s in tl:
+            assert s["free"] + s["reuse"] + s["allocated"] \
+                == eng.num_blocks
+
+    def test_attribution_invariant_and_prefix_heat(self, churn_engine):
+        eng, _ = churn_engine
+        c = eng.metrics.counters
+        attr = eng.cachestat.attribution()
+        assert attr["cached_tokens_total"] == \
+            c["prefix_cache_hit_tokens"]
+        assert attr["computed_tokens_total"] == \
+            c["prefix_cache_miss_tokens"]
+        # every request finished: rows parked in the recent ring
+        assert not attr["active"] and attr["recent"]
+        heat = eng.cachestat.heat_table()
+        assert heat, "shared-prefix run recorded no prefix heat"
+        # the hot entry is the 8-token (2-block) shared prefix family
+        top = heat[0]
+        assert top["depth"] == 2
+        assert top["hit_tokens"] == top["hits"] * 8
+
+    def test_evictions_event_driven_with_cause_and_depth(
+            self, churn_engine):
+        eng, _ = churn_engine
+        c = eng.metrics.counters
+        assert c["preemptions"] > 0  # the phase is sized to churn
+        assert eng.kv.reuse_evictions > 0
+        # event-driven counter equals the pool's own monotonic truth
+        assert c["prefix_cache_evictions"] == eng.kv.reuse_evictions
+        rep = eng.cachestat.eviction_report()
+        assert rep["total"] == eng.kv.reuse_evictions
+        assert sum(rep["causes"].values()) == rep["total"]
+        assert set(rep["causes"]) == {"decode_slot", "prefill_chunk",
+                                      "other"}
+        assert all(d >= 1 for d in rep["by_chain_depth"])
+        # revives happened and the hit-depth histogram saw each one
+        assert eng.cachestat.revives > 0
+        assert eng.cachestat._hit_depth_h.count == eng.cachestat.revives
+        assert sum(eng.cachestat.hit_depth_distribution().values()) \
+            == eng.cachestat.revives
+
+    def test_eviction_lifecycle_event_carries_cause_and_depth(self):
+        seen = []
+        eng = _engine(num_blocks=15)
+        eng.lifecycle.add_listener(
+            lambda rid, name, ts, tid, attrs:
+            seen.append(dict(attrs, name=name))
+            if name == "prefix_cache_eviction" else None)
+        _run(eng, _prompts(), max_new=6)
+        assert len(seen) == eng.kv.reuse_evictions > 0
+        for ev in seen:
+            assert ev["cause"] in ("decode_slot", "prefill_chunk")
+            assert ev["depth"] >= 1 and "lifetime_steps" in ev
+
+    def test_eviction_event_burst_capped_per_step(self):
+        """A thrashing step must not wash the flight ring: per-eviction
+        lifecycle events are budgeted per step (counters stay exact),
+        the overflow collapsing into ONE burst summary event.  Uses its
+        OWN never-stepped engine — the injected fake evictions must not
+        skew the shared churn engine's counter truth."""
+        from paddle_tpu.serving.engine import _EVICT_EVENTS_PER_STEP
+
+        eng = _engine(num_blocks=16)
+        seen = []
+        eng.lifecycle.add_listener(
+            lambda rid, name, ts, tid, attrs:
+            seen.append(dict(attrs, name=name))
+            if name.startswith("prefix_cache_eviction") else None)
+        before = eng.metrics.counters["prefix_cache_evictions"]
+        eng._evict_events_step = 0
+        for i in range(_EVICT_EVENTS_PER_STEP + 4):
+            eng._on_pool_evict(3, depth=1, lifetime=2,
+                               cause="decode_slot")
+        eng._flush_evict_burst()
+        events = [e for e in seen if e["name"] == "prefix_cache_eviction"]
+        bursts = [e for e in seen
+                  if e["name"] == "prefix_cache_eviction_burst"]
+        assert len(events) == _EVICT_EVENTS_PER_STEP
+        assert len(bursts) == 1 and bursts[0]["suppressed"] == 4
+        # the counter saw every eviction regardless of the event budget
+        assert eng.metrics.counters["prefix_cache_evictions"] \
+            == before + _EVICT_EVENTS_PER_STEP + 4
+        # budget reset: the next step emits per-event again
+        assert eng._evict_events_step == 0
+
+
+# --------------------------------------------------------------------------
+# HTTP surface: usage attribution + /v1/debug/cache
+# --------------------------------------------------------------------------
+class Harness:
+    """A live CompletionServer on an asyncio loop in a daemon thread."""
+
+    def __init__(self, engine, cfg=None):
+        self.loop = asyncio.new_event_loop()
+        self.thread = threading.Thread(target=self.loop.run_forever,
+                                       daemon=True)
+        self.thread.start()
+        self.server = CompletionServer(engine, cfg or ServerConfig())
+        self.run(self.server.start())
+        self.port = self.server.port
+
+    def run(self, coro, timeout=120):
+        return asyncio.run_coroutine_threadsafe(
+            coro, self.loop).result(timeout)
+
+    def close(self):
+        try:
+            self.run(self.server.shutdown(drain_timeout=1.0), timeout=60)
+        finally:
+            self.loop.call_soon_threadsafe(self.loop.stop)
+            self.thread.join(10)
+            self.loop.close()
+
+
+def _request(port, method, path, body=None, timeout=120):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    payload = None if body is None else json.dumps(body)
+    conn.request(method, path, payload,
+                 {"Content-Type": "application/json"} if payload else {})
+    resp = conn.getresponse()
+    data = resp.read()
+    headers = {k.lower(): v for k, v in resp.getheaders()}
+    conn.close()
+    return resp.status, headers, data
+
+
+def _sse_chunks(raw: bytes):
+    return [json.loads(line[6:])
+            for line in raw.decode().splitlines()
+            if line.startswith("data: ") and line != "data: [DONE]"]
+
+
+@pytest.fixture
+def harness_factory():
+    live = []
+
+    def make(engine, cfg=None):
+        h = Harness(engine, cfg)
+        live.append(h)
+        return h
+
+    yield make
+    for h in live:
+        h.close()
+
+
+def _dp2_fleet(flight_dir=None):
+    def make(i, registry):
+        return _engine(num_blocks=64, registry=registry,
+                       metrics_labels={"replica": str(i)})
+    return FleetRouter.build(
+        make, dp=2, config=FleetConfig(flight_dir=flight_dir))
+
+
+class TestHTTPUsage:
+    PROMPT = list(range(1, 17))  # 4 full blocks; hits cap at 12 cached
+
+    def _assert_usage(self, usage, cached_gt_zero):
+        assert usage["prompt_tokens"] == len(self.PROMPT)
+        assert usage["total_tokens"] == \
+            usage["prompt_tokens"] + usage["completion_tokens"]
+        if cached_gt_zero:
+            assert usage["prompt_cached_tokens"] == 12  # 3 shared blocks
+        else:
+            assert usage["prompt_cached_tokens"] == 0
+
+    def test_usage_cached_tokens_dp1_body_and_final_chunk(
+            self, harness_factory):
+        h = harness_factory(_engine(num_blocks=64))
+        s, _, d = _request(h.port, "POST", "/v1/completions",
+                           {"prompt": self.PROMPT, "max_tokens": 3})
+        assert s == 200
+        self._assert_usage(json.loads(d)["usage"], cached_gt_zero=False)
+        # warm cache: the same prompt's leading full blocks fork free
+        s, _, d = _request(h.port, "POST", "/v1/completions",
+                           {"prompt": self.PROMPT, "max_tokens": 3})
+        assert s == 200
+        self._assert_usage(json.loads(d)["usage"], cached_gt_zero=True)
+        # streaming: the FINAL chunk (the finish_reason bearer) carries
+        # the same usage block; earlier chunks carry none
+        s, _, d = _request(h.port, "POST", "/v1/completions",
+                           {"prompt": self.PROMPT, "max_tokens": 3,
+                            "stream": True})
+        assert s == 200
+        chunks = _sse_chunks(d)
+        final = [c for c in chunks if c["choices"][0]["finish_reason"]]
+        assert len(final) == 1
+        assert all("usage" not in c for c in chunks
+                   if not c["choices"][0]["finish_reason"])
+        usage = final[0]["usage"]
+        assert usage["completion_tokens"] == 3
+        self._assert_usage(usage, cached_gt_zero=True)
+
+    def test_usage_cached_tokens_dp2(self, harness_factory):
+        h = harness_factory(_dp2_fleet())
+        # prefix affinity routes the identical prompt to ONE replica,
+        # whose cache is warm on the second POST
+        s, _, d = _request(h.port, "POST", "/v1/completions",
+                           {"prompt": self.PROMPT, "max_tokens": 3})
+        assert s == 200
+        self._assert_usage(json.loads(d)["usage"], cached_gt_zero=False)
+        s, _, d = _request(h.port, "POST", "/v1/completions",
+                           {"prompt": self.PROMPT, "max_tokens": 3,
+                            "stream": True})
+        assert s == 200
+        final = [c for c in _sse_chunks(d)
+                 if c["choices"][0]["finish_reason"]]
+        self._assert_usage(final[0]["usage"], cached_gt_zero=True)
+
+
+class TestDebugCacheEndpoint:
+    def test_dp1_shape_and_protocol(self, harness_factory):
+        h = harness_factory(_engine(num_blocks=64))
+        prompt = list(range(1, 17))
+        for _ in range(2):
+            _request(h.port, "POST", "/v1/completions",
+                     {"prompt": prompt, "max_tokens": 3})
+        s, headers, d = _request(h.port, "GET", "/v1/debug/cache")
+        assert s == 200
+        assert headers["content-type"] == "application/json"
+        obj = json.loads(d)
+        assert obj["status"] == "ok" and len(obj["data"]) == 1
+        row = obj["data"][0]
+        assert row["replica"] == "0" and row["enabled"] is True
+        assert row["pool"]["free"] + row["pool"]["reuse"] \
+            + row["pool"]["allocated"] == row["num_blocks"]
+        assert row["timeline"] and row["heat"]
+        attr = row["attribution"]
+        assert attr["cached_tokens_total"] == \
+            h.server.engine.metrics.counters["prefix_cache_hit_tokens"]
+        assert obj["fleet"]["dp"] == 1
+        assert obj["fleet"]["cached_token_ratios"]["0"] is not None
+        assert obj["fleet"]["cache_imbalance"] == 0.0
+
+    @pytest.mark.parametrize("query,code", [
+        ("replica=x", 400),
+        ("replica=7", 404),
+    ])
+    def test_bad_params_json_4xx(self, harness_factory, query, code):
+        h = harness_factory(_engine(num_blocks=64))
+        s, headers, d = _request(h.port, "GET",
+                                 f"/v1/debug/cache?{query}")
+        assert s == code, d
+        assert headers["content-type"] == "application/json"
+        assert "error" in json.loads(d)
+
+    def test_disabled_reports_disabled_not_500(self, harness_factory):
+        h = harness_factory(_engine(num_blocks=64, cache_stats=False))
+        s, headers, d = _request(h.port, "GET", "/v1/debug/cache")
+        assert s == 200
+        obj = json.loads(d)
+        assert obj["status"] == "disabled"
+        assert obj["data"][0]["enabled"] is False
+
+    def test_dp2_per_replica_attribution_and_narrowing(
+            self, harness_factory):
+        h = harness_factory(_dp2_fleet())
+        prompt = list(range(1, 17))
+        for _ in range(2):
+            s, _, _ = _request(h.port, "POST", "/v1/completions",
+                               {"prompt": prompt, "max_tokens": 3})
+            assert s == 200
+        s, _, d = _request(h.port, "GET", "/v1/debug/cache")
+        obj = json.loads(d)
+        assert s == 200 and len(obj["data"]) == 2
+        assert {row["replica"] for row in obj["data"]} == {"0", "1"}
+        # both requests landed on the affinity replica: exactly one
+        # replica carries the attribution rows and the cache hits
+        served = [row for row in obj["data"]
+                  if row["attribution"]["recent"]
+                  or row["attribution"]["active"]]
+        assert len(served) == 1
+        assert served[0]["attribution"]["cached_tokens_total"] == 12
+        ratios = obj["fleet"]["cached_token_ratios"]
+        assert ratios[served[0]["replica"]] is not None
+        # narrowing to one replica returns only its row
+        idx = served[0]["replica"]
+        s, _, d = _request(h.port, "GET",
+                           f"/v1/debug/cache?replica={idx}")
+        narrowed = json.loads(d)["data"]
+        assert s == 200 and len(narrowed) == 1
+        assert narrowed[0]["replica"] == idx
+        # the imbalance gauge landed on the shared registry
+        s, _, d = _request(h.port, "GET", "/metrics")
+        assert b"serving_fleet_cache_imbalance" in d
+
+
+# --------------------------------------------------------------------------
+# fleet: imbalance signal, flight embed, config homogeneity
+# --------------------------------------------------------------------------
+class TestFleetCacheSignals:
+    def test_imbalance_is_max_minus_min_ratio(self):
+        fleet = _dp2_fleet()
+        fleet.start()
+        try:
+            prompt = list(range(1, 17))
+            handles = [fleet.submit_request(
+                prompt, SamplingParams(max_new_tokens=3),
+                request_id=f"imb-{i}") for i in range(3)]
+            fleet.wait(handles, timeout=600)
+            ratios = fleet.cached_token_ratios()
+            vals = [v for v in ratios.values() if v is not None]
+            assert vals, ratios
+            assert fleet.cache_imbalance() == pytest.approx(
+                max(vals) - min(vals))
+            fleet.sample_gauges()
+            g = fleet.registry.gauge("serving_fleet_cache_imbalance")
+            assert g.value == pytest.approx(fleet.cache_imbalance())
+        finally:
+            fleet.shutdown(drain_timeout=5.0)
+
+    def test_flight_bundle_embeds_owning_replica_pool_samples(
+            self, tmp_path):
+        fleet = _dp2_fleet(flight_dir=str(tmp_path))
+        fleet.start()
+        try:
+            handles = [fleet.submit_request(
+                p, SamplingParams(max_new_tokens=4), request_id=f"f{i}")
+                for i, p in enumerate(_prompts(n=4))]
+            fleet.wait(handles, timeout=600)
+            active = [r for r in fleet.replicas
+                      if r.engine.cachestat.timeline()]
+            assert active
+            owner = active[0]
+            path = fleet.flight.trigger("engine_death",
+                                        replica=str(owner.index),
+                                        detail="induced by test")
+            assert path is not None
+            bundle = json.loads(open(path).read())
+            cache = bundle["cache_stats"]
+            assert set(cache) == {str(owner.index)}
+            samples = cache[str(owner.index)]
+            assert samples == \
+                owner.engine.cachestat.timeline()[-len(samples):]
+            for s in samples:
+                assert s["free"] + s["reuse"] + s["allocated"] \
+                    == owner.engine.num_blocks
+        finally:
+            fleet.shutdown(drain_timeout=5.0)
+
+    def test_fleet_rejects_heterogeneous_cache_stats(self):
+        def make(i, registry):
+            return _engine(cache_stats=(i == 0), num_blocks=64,
+                           registry=registry,
+                           metrics_labels={"replica": str(i)})
+
+        with pytest.raises(ValueError, match="cache_stats"):
+            FleetRouter.build(make, dp=2)
+
+
+# --------------------------------------------------------------------------
+# lint coverage (satellite tooling)
+# --------------------------------------------------------------------------
+class TestLintCoverage:
+    def test_bounded_metrics_scan_covers_cachestat(self):
+        covered = {os.path.relpath(p, _REPO)
+                   for p in bounded_lint.SCAN_FILES}
+        assert "paddle_tpu/observability/cachestat.py" in covered
+        assert bounded_lint.scan(dirs=(),
+                                 files=bounded_lint.SCAN_FILES) == []
+
+    def test_metrics_docs_lint_covers_cachestat(self):
+        covered = {os.path.relpath(p, _REPO)
+                   for p in docs_lint.DECLARING_MODULES}
+        assert "paddle_tpu/observability/cachestat.py" in covered
+        assert docs_lint.scan() == []
+
+    def test_debug_endpoints_lint_clean_and_resolves_cache_route(self):
+        routes = debug_lint.registered_routes()
+        assert "/v1/debug/cache" in routes
+        assert "/v1/requests" in routes
+        assert debug_lint.scan() == []
+
+    def test_debug_endpoints_lint_self_test(self, tmp_path):
+        """The lint catches (a) a registered route missing from README
+        and (b) a route handled without documentation anywhere in the
+        module — and reports a broken registry instead of passing
+        vacuously."""
+        readme = tmp_path / "README.md"
+        readme.write_text("docs mention /v1/requests and "
+                          "/v1/debug/compiles only\n")
+        violations = debug_lint.scan(readme_path=str(readme))
+        missing = {msg.split("'")[1] for _, msg in violations}
+        assert "/v1/debug/cache" in missing
+        assert "/v1/debug/profile" in missing
+        assert "/v1/requests" not in missing
+        # handler-only literal (no _ROUTES entry) is still collected
+        server = tmp_path / "server.py"
+        server.write_text(
+            'def h(path):\n'
+            '    if path == "/v1/debug/sneaky":\n'
+            '        return 200\n')
+        violations = debug_lint.scan(server_path=str(server),
+                                     readme_path=str(readme))
+        assert any("/v1/debug/sneaky" in msg for _, msg in violations)
+        # an empty module means the lint itself broke — loud, not clean
+        empty = tmp_path / "empty.py"
+        empty.write_text("x = 1\n")
+        assert debug_lint.scan(server_path=str(empty),
+                               readme_path=str(readme))

@@ -66,6 +66,9 @@ SCAN_FILES = (
     # ISSUE 10: the numerics auditor's repro-path ring and divergence
     # bookkeeping must stay bounded (deque maxlen= / fired-once keys)
     os.path.join(_REPO, "paddle_tpu", "observability", "audit.py"),
+    # ISSUE 13: the cache-stat tracker's pool-timeline ring, decayed
+    # prefix-heat table and attribution maps must stay bounded
+    os.path.join(_REPO, "paddle_tpu", "observability", "cachestat.py"),
     # ISSUE 12: the supervisor's restart-history deques / pending
     # re-dispatch queue and the fault injector's fired-once sets must
     # stay bounded even if the modules move out of the serving dir

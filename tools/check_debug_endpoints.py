@@ -1,0 +1,87 @@
+#!/usr/bin/env python
+"""Debug-endpoint documentation lint (ISSUE 13 tooling satellite).
+
+Every ``GET /v1/debug/*`` and ``/v1/requests*`` route the serving
+frontend registers must be documented in README's debug-endpoint table:
+an operator discovering the surface from the README must never find a
+route missing, and a route added to ``serving/server.py`` without docs
+must fail CI.  Same pattern as ``tools/check_metrics_docs.py``: routes
+are collected **by AST** (no imports — the serving modules pull in jax)
+from every string constant in ``server.py`` that matches a debug-route
+shape (this covers both the ``_ROUTES`` tuple and any handler-only
+literal), then each must appear somewhere in README.md.
+
+Run standalone (exits 1 on violations) or from the test suite, which
+also self-tests the lint against a synthetic README missing a route.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+from typing import List, Tuple
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SERVER = os.path.join(_REPO, "paddle_tpu", "serving", "server.py")
+README = os.path.join(_REPO, "README.md")
+
+# a registrable debug route: /v1/debug/<name> or the /v1/requests family
+_ROUTE_RE = re.compile(r"/v1/(?:debug/[a-z_]+|requests)\b")
+
+
+def registered_routes(server_path: str = SERVER) -> List[str]:
+    """Every debug route the frontend knows, statically resolved: the
+    union of debug-shaped string constants anywhere in the module (the
+    ``_ROUTES`` tuple, handler ``path ==`` comparisons, docstrings of
+    real handlers) — so a route wired without a ``_ROUTES`` entry is
+    still caught."""
+    with open(server_path) as f:
+        tree = ast.parse(f.read(), filename=server_path)
+    routes = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            routes.update(_ROUTE_RE.findall(node.value))
+    return sorted(routes)
+
+
+def readme_routes(readme_path: str = README) -> set:
+    with open(readme_path) as f:
+        return set(_ROUTE_RE.findall(f.read()))
+
+
+def scan(server_path: str = SERVER,
+         readme_path: str = README) -> List[Tuple[str, str]]:
+    """Returns ``(server_path, message)`` violations: no resolvable
+    routes at all (the lint itself broke), or a registered route absent
+    from README's debug-endpoint table."""
+    routes = registered_routes(server_path)
+    out: List[Tuple[str, str]] = []
+    if not routes:
+        out.append((server_path, "no debug routes resolvable — did the "
+                                 "route registry move out of server.py?"))
+        return out
+    documented = readme_routes(readme_path)
+    for route in routes:
+        if route not in documented:
+            out.append((server_path,
+                        f"debug endpoint {route!r} is not documented in "
+                        "README's debug-endpoint table"))
+    return out
+
+
+def main() -> int:
+    violations = scan()
+    for path, msg in violations:
+        print(f"{os.path.relpath(path, _REPO)}: {msg}")
+    if violations:
+        print(f"{len(violations)} debug-endpoint documentation "
+              "violation(s)")
+        return 1
+    print("debug-endpoints lint: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -42,6 +42,7 @@ DECLARING_MODULES = (
     os.path.join(_REPO, "paddle_tpu", "observability", "push.py"),
     os.path.join(_REPO, "paddle_tpu", "observability", "stepprof.py"),
     os.path.join(_REPO, "paddle_tpu", "observability", "audit.py"),
+    os.path.join(_REPO, "paddle_tpu", "observability", "cachestat.py"),
 )
 
 _NAME_RE = re.compile(r"\b(?:serving|push)_[a-z0-9_:]+\b")

@@ -515,6 +515,38 @@ def _cache_report(eng, assert_attr: bool = True) -> dict:
     }
 
 
+def _attach_alerts(eng):
+    """Wire a per-engine HistoryStore + AlertEngine (ISSUE 14) onto a
+    bare EngineCore — the single-engine phases get the same history
+    sampling + default-rule evaluation a fleet gets from its router, so
+    every ``BENCH_SERVING.json`` phase embeds an alerts report."""
+    from paddle_tpu.observability.alerts import AlertEngine
+    from paddle_tpu.observability.history import HistoryStore
+
+    hist = HistoryStore(eng.metrics.registry)
+    eng.set_history(hist)
+    return AlertEngine(hist, registry=eng.metrics.registry)
+
+
+def _alerts_report(alerts) -> dict:
+    """Per-phase alerting report (ISSUE 14): rules evaluated, history
+    samples taken, currently-firing rules, and every observed state
+    transition — alert history is part of the bench contract (the chaos
+    phase asserts the restart-churn rule's firing/resolve on it)."""
+    snap = alerts.snapshot()
+    assert snap["evaluations"] > 0, \
+        "no alert evaluations recorded — history sampling off?"
+    transitions = {name: trs for name, trs
+                   in alerts.transitions_report().items() if trs}
+    return {
+        "rules": snap["rules"],
+        "evaluations": snap["evaluations"],
+        "samples": snap["history"]["samples"],
+        "firing": snap["firing"],
+        "transitions": transitions,
+    }
+
+
 def serving_bench() -> dict:
     """Serving phase (ISSUE 4): a shared-prefix workload through the
     continuous-batching engine with the prefix cache ON vs OFF — both
@@ -551,6 +583,7 @@ def serving_bench() -> dict:
             scheduler_config=SchedulerConfig(
                 max_num_seqs=4, max_prefill_tokens_per_step=8),
             prefix_cache=prefix_cache)
+        alerts = _attach_alerts(eng)  # ISSUE 14
         t0 = time.perf_counter()
         # max_new_tokens=6 keeps requests alive long enough that BOTH
         # runs sweep the same decode batch buckets {1,2,4} — the trace
@@ -585,6 +618,9 @@ def serving_bench() -> dict:
             # per-phase cache report (ISSUE 13): the heat table is what
             # explains the cached ratio — hit tokens by prefix family
             "cache": _cache_report(eng),
+            # per-phase alerting report (ISSUE 14): rules evaluated +
+            # transitions observed over the phase's metrics history
+            "alerts": _alerts_report(alerts),
             # full registry snapshot: serving_* TTFT/ITL histograms ride
             # in the phase record like the train phases embed theirs
             "metrics": eng.metrics.snapshot(),
@@ -644,6 +680,7 @@ def serving_mp_bench() -> dict:
                 scheduler_config=SchedulerConfig(
                     max_num_seqs=4, max_prefill_tokens_per_step=8),
                 prefix_cache=True)
+            alerts = _attach_alerts(eng)  # ISSUE 14
             reqs = [eng.add_request(p, SamplingParams(max_new_tokens=10),
                                     slo_ms=60_000.0)
                     for p in prompts]
@@ -664,6 +701,7 @@ def serving_mp_bench() -> dict:
                 "slo": eng.metrics.slo_breakdown(),  # ISSUE 8 breakdown
                 "step_profile": _step_profile_report(eng),  # ISSUE 9
                 "cache": _cache_report(eng),  # ISSUE 13
+                "alerts": _alerts_report(alerts),  # ISSUE 14
                 "metrics": eng.metrics.snapshot(),
                 "outputs": [list(r.output_tokens) for r in reqs],
             }
@@ -802,6 +840,9 @@ def serving_fleet_bench() -> dict:
             fleet.sample_gauges()
             return {
                 "dp": dp, "wall_s": round(wall, 4),
+                # fleet-level alerting report (ISSUE 14): the router's
+                # default-on history + rule set saw the whole phase
+                "alerts": _alerts_report(fleet.alerts),
                 "tokens_per_sec": round(gen / wall, 2),
                 "generated_tokens": gen,
                 "cached_token_ratio": round(
@@ -890,6 +931,7 @@ def serving_audit_bench() -> dict:
                 max_num_seqs=4, max_prefill_tokens_per_step=8),
             audit=(AuditConfig(enabled=True, sample_every=1)
                    if audit_on else None)))
+        alerts = _attach_alerts(eng)  # ISSUE 14
         reqs = [eng.add_request(p, SamplingParams(max_new_tokens=10),
                                 slo_ms=60_000.0)
                 for p in prompts]
@@ -906,6 +948,7 @@ def serving_audit_bench() -> dict:
             "prefill_traces": eng.prefill_trace_count,
             "decode_traces": eng.decode_trace_count,
             "cache": _cache_report(eng),  # ISSUE 13
+            "alerts": _alerts_report(alerts),  # ISSUE 14
             "outputs": [list(r.output_tokens) for r in reqs],
         }
         if audit_on:
@@ -982,6 +1025,7 @@ def serving_unified_bench() -> dict:
                 max_num_seqs=4, max_prefill_tokens_per_step=8,
                 max_tokens_per_step=8 if unified else None),
             unified_step=unified))
+        alerts = _attach_alerts(eng)  # ISSUE 14
         reqs = [eng.add_request(p, SamplingParams(max_new_tokens=10),
                                 slo_ms=60_000.0)
                 for p in prompts]
@@ -1007,6 +1051,7 @@ def serving_unified_bench() -> dict:
             "scheduled_tokens": rep["scheduled_tokens"],
             "step_profile": rep,
             "cache": _cache_report(eng),  # ISSUE 13
+            "alerts": _alerts_report(alerts),  # ISSUE 14
             "slo": eng.metrics.slo_breakdown(),
             "metrics": eng.metrics.snapshot(),
             "outputs": [list(r.output_tokens) for r in reqs],
@@ -1132,6 +1177,16 @@ def serving_chaos_bench() -> dict:
             assert all(r.engine.audit.status == "ok"
                        for r in fleet.replicas), \
                 "audit did not return to ok after quarantine"
+            # alert-history contract (ISSUE 14): the restart-churn rule
+            # must have FIRED on the injected death/quarantine restarts;
+            # the stream is done, so slide its sample-indexed rate
+            # window past the recovery spike — the step-time equivalent
+            # of letting the incident age out — and it must RESOLVE
+            churn_rule = next(
+                r for r in fleet.alerts.rules.rules
+                if r.name == "restart_churn")
+            for _ in range(churn_rule.window + 2):
+                fleet.history.sample()
         rec = {
             "wall_s": round(wall, 4),
             "tokens_per_sec": round(gen / wall, 2),
@@ -1155,6 +1210,10 @@ def serving_chaos_bench() -> dict:
                                                   assert_attr=False)
                       for r in fleet.replicas
                       if r.engine.cachestat.timeline()},
+            # ISSUE 14: the phase's alert history — the chaos run must
+            # show restart_churn pending→firing→resolved (asserted by
+            # the caller), the fault-free run must not
+            "alerts": _alerts_report(fleet.alerts),
             "outputs": [list(h.output_tokens) for h in hs],
         }
         fleet.shutdown(drain_timeout=5.0)
@@ -1189,6 +1248,19 @@ def serving_chaos_bench() -> dict:
     assert chaos["restarts"]["engine_death"] == 1, chaos["restarts"]
     assert chaos["restarts"]["quarantine"] == 1, chaos["restarts"]
     assert chaos["replica_failed"] == 0, chaos
+    # alert history as part of the chaos contract (ISSUE 14): the
+    # restart-churn rule fired during the injected death and resolved
+    # once the rate window slid past recovery; the fault-free run never
+    # saw a restart transition at all
+    churn = chaos["alerts"]["transitions"].get("restart_churn", [])
+    states = [t["state"] for t in churn]
+    assert "firing" in states, (
+        f"restart_churn never fired under injected death: {churn}")
+    assert states[-1] == "resolved", (
+        f"restart_churn did not resolve after recovery: {churn}")
+    assert "restart_churn" not in clean["alerts"]["transitions"], \
+        clean["alerts"]["transitions"]
+    result["alerts_restart_churn"] = churn
     return result
 
 
@@ -1233,6 +1305,27 @@ def serving_main() -> dict:
         # checkpoint before the chaos phase for the same reason
         json.dump(result, f, indent=1)
     result["chaos"] = serving_chaos_bench()
+    with open(path, "w") as f:
+        json.dump(result, f, indent=1)
+    # bench perf-regression gate (ISSUE 14): diff this run against the
+    # committed baseline and embed the verdict in the bench JSON itself
+    # — recorded honestly either way; the test suite runs the gate as
+    # its own failing check
+    sys.path.insert(0, os.path.join(_HERE, "tools"))
+    try:
+        import check_bench_regression as _gate
+
+        if os.path.exists(_gate.BASELINE):
+            with open(_gate.BASELINE) as f:
+                baseline = json.load(f)
+            result["regression"] = _gate.verdict(result, baseline)
+        else:
+            result["regression"] = {
+                "ok": None, "checked": 0, "violations": [],
+                "note": "no committed baseline; run tools/"
+                        "check_bench_regression.py --write-baseline"}
+    finally:
+        sys.path.pop(0)
     with open(path, "w") as f:
         json.dump(result, f, indent=1)
     return result

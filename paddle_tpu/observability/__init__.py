@@ -64,6 +64,12 @@ KV-occupancy gauges land in one Prometheus page.
 
 from __future__ import annotations
 
+from .alerts import (  # noqa: F401
+    AlertEngine,
+    AlertRule,
+    AlertRuleSet,
+    default_rule_set,
+)
 from .audit import (  # noqa: F401
     AuditConfig,
     NumericsAuditor,
@@ -83,6 +89,10 @@ from .export import (  # noqa: F401
 from .flight import (  # noqa: F401
     FlightConfig,
     FlightRecorder,
+)
+from .history import (  # noqa: F401
+    HistoryConfig,
+    HistoryStore,
 )
 from .httpd import (  # noqa: F401
     PROMETHEUS_CONTENT_TYPE,

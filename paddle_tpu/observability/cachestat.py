@@ -55,6 +55,7 @@ from .metrics import MetricsRegistry
 METRIC_NAMES = (
     "serving_pool_free_blocks",
     "serving_pool_reuse_blocks",
+    "serving_pool_available_blocks",
     "serving_pool_allocated_blocks",
     "serving_reuse_hit_depth",
     "serving_block_lifetime_steps",
@@ -125,6 +126,7 @@ class CacheStatTracker:
         self._evict_depths: Dict[int, int] = {}  # unbounded-ok: ≤ one entry per distinct chain depth ≤ num_blocks
         if not enabled or registry is None:
             self._g_free = self._g_reuse = self._g_alloc = None
+            self._g_avail = None
             self._hit_depth_h = self._lifetime_h = None
             self._evict_c = None
             return
@@ -132,6 +134,14 @@ class CacheStatTracker:
         self._g_free = g("serving_pool_free_blocks",
                          "KV-pool blocks on the free list proper",
                          **self.labels)
+        # free + reuse: what the pool can actually serve an allocation
+        # from.  A warm prefix cache parks every refcount-0 block in the
+        # reuse LRU, so the free list alone drains to ~0 on a healthy
+        # fleet — an exhaustion alert must floor on THIS series
+        self._g_avail = g("serving_pool_available_blocks",
+                          "blocks the pool can serve an allocation from "
+                          "(free list + revivable reuse-parked)",
+                          **self.labels)
         self._g_reuse = g("serving_pool_reuse_blocks",
                           "refcount-0 cached blocks parked in the reuse "
                           "LRU (revivable, evictable)", **self.labels)
@@ -154,6 +164,15 @@ class CacheStatTracker:
                 "allocation cause",
                 **dict(self.labels, cause=c))
             for c in EVICTION_CAUSES}
+        # initialize the pool gauges from the REAL pool state: a
+        # replica that has not stepped yet must read as "pool full of
+        # free blocks", not as the gauge default 0.0 — an alert rule
+        # with a free-blocks floor (ISSUE 14) would otherwise fire on
+        # every idle replica at boot
+        self._g_free.set(len(pool._free))
+        self._g_reuse.set(len(pool._reuse))
+        self._g_avail.set(len(pool._free) + len(pool._reuse))
+        self._g_alloc.set(1 + len(pool._ref))
 
     # --- pool timeline (engine thread, once per step) -----------------------
     def sample_pool(self, step: int, promised: int = 0) -> Optional[Dict]:
@@ -196,6 +215,7 @@ class CacheStatTracker:
         if self._g_free is not None:
             self._g_free.set(free)
             self._g_reuse.set(reuse)
+            self._g_avail.set(free + reuse)
             self._g_alloc.set(allocated)
         return rec
 

@@ -36,6 +36,10 @@ Triggers (``serving_flight_dumps_total{trigger=...}`` counts the dumps):
                           replica for replacement (``serving/resilience.py``)
 ``crash_loop``            a replica hit its restart cap inside the crash-loop
                           window and was permanently excluded
+``alert``                 an :class:`~paddle_tpu.observability.alerts
+                          .AlertEngine` rule transitioned to firing; the
+                          bundle's ``alert`` key embeds the rule, the breach
+                          value, and the offending series' history window
 ========================  ====================================================
 
 Boundedness (``tools/check_bounded_metrics.py`` lints this module): each
@@ -63,7 +67,7 @@ from .metrics import MetricsRegistry
 
 TRIGGERS = ("engine_death", "watchdog", "preemption_storm",
             "rejection_burst", "drain_overrun", "nonfinite", "divergence",
-            "quarantine", "crash_loop")
+            "quarantine", "crash_loop", "alert")
 
 # pre-registered metric names this module owns (tools/check_metrics_docs
 # lints that each appears in README's metrics table)
@@ -233,12 +237,19 @@ class FlightRecorder:
             return list(self._bundles)
 
     def trigger(self, trigger: str, replica: Optional[str] = None,
-                detail: Optional[str] = None) -> Optional[str]:
+                detail: Optional[str] = None, key: Optional[str] = None,
+                extra: Optional[Dict] = None) -> Optional[str]:
         """Fire one anomaly trigger; returns the bundle path (``None``
         when deduped/cooling down/disabled/capped).  ``engine_death``
         fires at most once per replica; every trigger key cools down for
-        ``cooldown_s`` between dumps."""
-        key = f"{trigger}:{replica}" if replica is not None else trigger
+        ``cooldown_s`` between dumps.  ``key`` overrides the dedupe/
+        cooldown suffix when the natural key is not a replica (the alert
+        engine passes the rule name — two different rules firing
+        back-to-back must not dedupe each other).  ``extra`` keys are
+        embedded into the bundle (existing bundle fields win)."""
+        key = (f"{trigger}:{key}" if key is not None
+               else f"{trigger}:{replica}" if replica is not None
+               else trigger)
         now = time.perf_counter()
         with self._lock:
             if trigger == "engine_death":
@@ -265,6 +276,9 @@ class FlightRecorder:
                             f"flight_{trigger}_{seq:04d}.json")
         try:
             bundle = self._build_bundle(trigger, replica, detail)
+            if extra:
+                for k, v in extra.items():
+                    bundle.setdefault(k, v)
             os.makedirs(self.cfg.dump_dir, exist_ok=True)
             tmp = path + ".tmp"
             with open(tmp, "w") as f:

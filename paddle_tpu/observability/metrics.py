@@ -18,9 +18,12 @@ values are the classic production-metrics leak.
 
 from __future__ import annotations
 
+import contextlib
 import math
+import sys
 import threading
-from typing import Dict, List, Optional, Tuple
+import traceback
+from typing import Callable, Dict, List, Optional, Tuple
 
 DEFAULT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
                    0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
@@ -256,6 +259,12 @@ def _format(v: float) -> str:
 _KINDS = {"counter": Counter, "gauge": Gauge, "histogram": Histogram}
 
 
+# collect hooks are a small, fixed-purpose set (fleet gauge refresh,
+# maybe a process collector) — a registry accumulating them past this is
+# a leak, not a feature
+_MAX_COLLECT_HOOKS = 16
+
+
 class MetricsRegistry:
     """Get-or-create store of metric series, bounded by ``max_series``."""
 
@@ -265,6 +274,14 @@ class MetricsRegistry:
         self._help: Dict[str, str] = {}
         self._kinds: Dict[str, str] = {}
         self._lock = threading.Lock()
+        # scrape-time collectors (ISSUE 14): gauges that are *derived*
+        # from live object state (fleet replica occupancy, cache
+        # imbalance) register a hook here so EVERY consumer of the
+        # registry — /metrics, the push gateway, JSON snapshots, the
+        # history sampler — observes freshly collected values instead of
+        # whatever the last explicit refresh left behind
+        self._collect_hooks: List[Callable[[], None]] = []  # unbounded-ok: add_collect_hook refuses past _MAX_COLLECT_HOOKS
+        self._collecting = threading.local()
 
     # --- creation -----------------------------------------------------------
     def _get(self, kind: str, name: str, help: str, labels: Dict[str, str],
@@ -303,6 +320,69 @@ class MetricsRegistry:
                   **labels) -> Histogram:
         return self._get("histogram", name, help, labels, buckets=buckets)
 
+    # --- scrape-time collection (ISSUE 14) ----------------------------------
+    def add_collect_hook(self, fn: Callable[[], None]) -> Callable[[], None]:
+        """Register a zero-arg collector run before every rendering of
+        this registry (:meth:`prometheus_text`, :meth:`snapshot`) and by
+        the history sampler.  Bounded (at most ``_MAX_COLLECT_HOOKS``);
+        a hook that raises is reported to stderr and skipped — a broken
+        collector must never take down a scrape.  Returns a zero-arg
+        remover (idempotent)."""
+        with self._lock:
+            if len(self._collect_hooks) >= _MAX_COLLECT_HOOKS:
+                raise RuntimeError(
+                    f"registry already has {_MAX_COLLECT_HOOKS} collect "
+                    "hooks — a hook registered per scrape/request (rather "
+                    "than once per collector object) is a leak")
+            self._collect_hooks.append(fn)
+
+        def remove() -> None:
+            with self._lock:
+                try:
+                    self._collect_hooks.remove(fn)
+                except ValueError:
+                    pass  # swallow-ok: already removed — the remover is idempotent by contract
+
+        return remove
+
+    def run_collect_hooks(self) -> None:
+        """Run every registered collect hook once (exceptions swallowed
+        with a stderr report).  Re-entrancy-guarded per thread: a hook
+        that itself renders the registry (e.g. dumps a snapshot into a
+        flight bundle) must not recurse into the hook list."""
+        if getattr(self._collecting, "active", False):
+            return
+        with self._lock:
+            hooks = tuple(self._collect_hooks)
+        if not hooks:
+            return
+        self._collecting.active = True
+        try:
+            for fn in hooks:
+                try:
+                    fn()
+                except Exception:
+                    # swallow-ok: a broken collector is reported loudly but
+                    # must never take down the scrape/push/sample it rides
+                    sys.stderr.write("[metrics] collect hook failed:\n"
+                                     + traceback.format_exc())
+        finally:
+            self._collecting.active = False
+
+    @contextlib.contextmanager
+    def atomic(self):
+        """Hold the registry lock across a multi-series read or write so
+        related series stay pairwise-consistent — e.g. the SLO goodput
+        pair: the writer increments ``serving_slo_total`` and
+        ``serving_slo_good_total`` inside one ``atomic()`` block, and the
+        burn-rate sampler reads every series value inside another, so a
+        sample can never observe good > total (a transient goodput > 1.0
+        would trip the burn rule spuriously).  Do NOT create series or
+        render the registry inside the block (the lock is not
+        re-entrant)."""
+        with self._lock:
+            yield
+
     # --- inspection ---------------------------------------------------------
     def series(self) -> List[_Metric]:
         with self._lock:
@@ -316,7 +396,10 @@ class MetricsRegistry:
 
     # --- rendering ----------------------------------------------------------
     def prometheus_text(self) -> str:
-        """Text exposition format 0.0.4 (the ``/metrics`` page body)."""
+        """Text exposition format 0.0.4 (the ``/metrics`` page body).
+        Collect hooks run first, so derived gauges are fresh on every
+        scrape AND every push-gateway export (ISSUE 14)."""
+        self.run_collect_hooks()
         lines = []
         for name, members in sorted(self.families().items()):
             help = self._help.get(name, "")
@@ -328,7 +411,9 @@ class MetricsRegistry:
         return "\n".join(lines) + ("\n" if lines else "")
 
     def snapshot(self, kinds: Optional[Tuple[str, ...]] = None) -> Dict:
-        """JSON-able {name or name{labels}: summary} dict."""
+        """JSON-able {name or name{labels}: summary} dict.  Collect
+        hooks run first (see :meth:`prometheus_text`)."""
+        self.run_collect_hooks()
         out = {}
         for m in self.series():
             if kinds is not None and m.kind not in kinds:

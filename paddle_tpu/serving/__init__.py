@@ -33,6 +33,12 @@ Architecture sketch and scheduler invariants: see ``scheduler.py``'s
 module docstring and the README's serving sections.
 """
 
+from ..observability.alerts import (  # noqa: F401
+    AlertRule,
+    AlertRuleSet,
+    default_rule_set,
+)
+from ..observability.history import HistoryConfig, HistoryStore  # noqa: F401
 from .engine import EngineConfig, EngineCore  # noqa: F401
 from .entrypoints import LLM, CompletionOutput, stream_generate  # noqa: F401
 from .faultinject import (  # noqa: F401

@@ -153,6 +153,14 @@ class EngineConfig:
     # attribution — all host-side (CacheStatTracker), so on vs off is
     # provably the same compiled program.  Served at /v1/debug/cache.
     cache_stats: bool = True
+    # Metrics history + alerting (ISSUE 14): each engine step ticks the
+    # fleet's HistoryStore sampler (bounded per-series rings over the
+    # shared registry; the AlertEngine evaluates its threshold / rate /
+    # SLO burn-rate rules after every sample).  Host-side only, like
+    # cache_stats — on vs off is provably the same compiled program.
+    # The store itself is owned by the FleetRouter (one fleet-wide
+    # history at dp>1); this gate controls whether THIS engine ticks it.
+    history: bool = True
     # Unified ragged step program (ISSUE 11): every engine step runs ONE
     # packed ragged launch (ops/ragged_paged.py) serving mixed prefill
     # chunks and decode rows together, instead of picking from the three
@@ -260,6 +268,10 @@ class EngineCore:
         # deterministic clock (counts step() invocations, no wall time)
         self.step_seq = 0
         self._fault = None
+        # metrics history (ISSUE 14): the fleet router binds ONE
+        # HistoryStore across all replicas via set_history; each step
+        # ticks it (gated by EngineConfig.history)
+        self.history = None
         # --- tensor-parallel resolution (ISSUE 5) ---------------------------
         mesh = topology.get_mesh()
         from ..parallel.utils import axis_size
@@ -573,6 +585,15 @@ class EngineCore:
         reuse-parked block — the LRU position it sat at feeds the
         hit-depth histogram (the reuse-LRU saturation early-warning)."""
         self.cachestat.record_revive(lru_depth, lifetime)
+
+    def set_history(self, history) -> None:
+        """Bind a :class:`~paddle_tpu.observability.history.HistoryStore`
+        (ISSUE 14).  The fleet router owns the store (one fleet-wide
+        sampling cadence); each engine step ticks it.  Ignored when
+        ``EngineConfig.history`` is off — the fleet refuses
+        heterogeneous gates, so a half-sampled fleet cannot exist."""
+        if self.engine_config.history:
+            self.history = history
 
     def set_fault_injector(self, injector) -> None:
         """Bind a :class:`~paddle_tpu.serving.faultinject.FaultInjector`
@@ -1176,6 +1197,10 @@ class EngineCore:
                 self.metrics.sample_gauges(self.scheduler.queue_depth,
                                            self.scheduler.num_running,
                                            self.kv.occupancy())
+                if self.history is not None:
+                    # metrics history + alert evaluation (ISSUE 14):
+                    # deterministic engine-step cadence, host-side only
+                    self.history.on_step(self.step_seq)
                 sp.set_attribute(
                     "step", int(self.metrics._counter("engine_steps").value))
                 sp.set_attribute("emitted", len(emitted))

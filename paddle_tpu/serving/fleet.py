@@ -92,7 +92,9 @@ from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Sequence
 
 from ..observability import lifecycle as _lc
+from ..observability.alerts import AlertEngine, AlertRuleSet
 from ..observability.flight import FlightConfig, FlightRecorder
+from ..observability.history import HistoryConfig, HistoryStore
 from ..observability.lifecycle import LifecycleTracker
 from ..observability.metrics import MetricsRegistry
 from ..ops.paged_attention import prefix_chain_hashes
@@ -154,6 +156,14 @@ class FleetConfig:
     # builds one FaultInjector per replica index (surviving supervisor
     # rebuilds, so each plan entry fires exactly once per chaos run)
     fault_plan: Optional[FaultPlan] = None
+    # metrics history + alerting (ISSUE 14): None = defaults.  The
+    # router builds ONE HistoryStore + AlertEngine over the shared
+    # registry when the engines' EngineConfig.history gate is on
+    # (refused when heterogeneous); alert_rules=None evaluates the
+    # default serving rule set (pool exhaustion, goodput burn, compile
+    # storms, restart/quarantine churn, ...)
+    history: Optional[HistoryConfig] = None
+    alert_rules: Optional[AlertRuleSet] = None
 
 
 def _build_ring(dp: int, vnodes: int) -> List:
@@ -748,6 +758,41 @@ class FleetRouter:
             for r in self.replicas}
         self._g_replicas.set(len(self.replicas))
         self.sample_gauges()
+        # --- scrape-time collection + metrics history (ISSUE 14) ------------
+        # the fleet gauges above are DERIVED from live replica state, so
+        # their refresh rides a registry collect hook: /metrics scrapes,
+        # push-gateway exports, JSON snapshots and the history sampler
+        # all observe freshly collected values (previously only the HTTP
+        # /metrics handler refreshed them — the push gateway exported
+        # stale fleet gauges)
+        hist_gates = {e.engine_config.history for e in self.engines}
+        if len(hist_gates) != 1:
+            raise ValueError(
+                f"replicas disagree on history={sorted(hist_gates)}; "
+                "the fleet samples ONE shared history, so every replica "
+                "must use the same EngineConfig knob")
+        self.history: Optional[HistoryStore] = None
+        self.alerts: Optional[AlertEngine] = None
+        if hist_gates.pop():
+            # ONE fleet-wide store: every replica's engine thread ticks
+            # the same sampler, and the alert engine evaluates the
+            # threshold / rate / SLO burn-rate rules after every sample
+            self.history = HistoryStore(self.registry,
+                                        config=self.cfg.history)
+            self.alerts = AlertEngine(
+                self.history, rules=self.cfg.alert_rules,
+                registry=self.registry, lifecycle=self.lifecycle,
+                flight=self.flight)
+            for eng in self.engines:
+                eng.set_history(self.history)
+        # register the hook LAST, after everything above that can raise
+        # (gate validation, history/alert series creation on a shared
+        # registry near its max_series cap): an aborted __init__ never
+        # runs stop(), so a hook registered earlier would keep walking
+        # this half-built router's replicas on every later scrape of a
+        # caller-owned registry
+        self._remove_collect_hook = self.registry.add_collect_hook(
+            self.sample_gauges)
 
     # --- constructors -------------------------------------------------------
     @classmethod
@@ -850,6 +895,12 @@ class FleetRouter:
         for r in self.replicas:
             r.join(join_timeout)
         self.sample_gauges()
+        # stop collecting from (and alerting on) a stopped fleet: the
+        # registry may outlive the router, and a later scrape must not
+        # walk retired replica objects
+        self._remove_collect_hook()
+        if self.alerts is not None:
+            self.alerts.close()
 
     def shutdown(self, drain_timeout: Optional[float] = None) -> None:
         """Synchronous fleet-wide graceful drain (direct/non-HTTP use;

@@ -28,7 +28,7 @@ from __future__ import annotations
 
 import time
 from collections import deque
-from typing import Deque, Dict, Optional
+from typing import Deque, Dict, Optional, Tuple
 
 from ..observability.metrics import (
     Counter,
@@ -130,7 +130,7 @@ class ServingMetrics:
         # /metrics exposes per-replica-labeled serving series side by
         # side without name collisions.
         self.registry = (registry if registry is not None
-                         else MetricsRegistry(max_series=256))
+                         else MetricsRegistry(max_series=512))
         self.tracer = tracer if tracer is not None else get_tracer()
         self.labels: Dict[str, str] = dict(labels or {})
         self._counters: Dict[str, Counter] = {}
@@ -217,12 +217,26 @@ class ServingMetrics:
         """End-to-end latency + the SLO goodput pair: every finished
         request that carried an ``slo_ms`` counts toward
         ``serving_slo_total``; the ones that met it toward
-        ``serving_slo_good_total`` (goodput = good/total)."""
+        ``serving_slo_good_total`` (goodput = good/total).  The pair is
+        incremented under the registry lock so any reader that snapshots
+        under the same lock (:meth:`slo_counts`, the history sampler's
+        burn-rate windows — ISSUE 14) can never observe good > total."""
         self.observe("e2e", e2e_seconds)
         if slo_ms is not None:
-            self.count("slo")
-            if e2e_seconds * 1e3 <= slo_ms:
-                self.count("slo_good")
+            good = e2e_seconds * 1e3 <= slo_ms
+            slo_c, good_c = self._counter("slo"), self._counter("slo_good")
+            with self.registry.atomic():
+                slo_c.inc()
+                if good:
+                    good_c.inc()
+
+    def slo_counts(self) -> Tuple[int, int]:
+        """(good, total) snapshotted under the registry lock — the
+        consistent read side of the goodput pair (a reader interleaving
+        the two bare counter reads could transiently see good > total)."""
+        good_c, slo_c = self._counter("slo_good"), self._counter("slo")
+        with self.registry.atomic():
+            return int(good_c.value), int(slo_c.value)
 
     def slo_breakdown(self) -> Dict[str, Dict]:
         """JSON-able per-phase latency breakdown (the shape ``bench.py``
@@ -238,10 +252,9 @@ class ServingMetrics:
                 "p95_s": _round6(h.quantile(0.95)),
                 "p99_s": _round6(h.quantile(0.99)),
             }
-        total = self._counter("slo").value
-        good = self._counter("slo_good").value
+        good, total = self.slo_counts()  # one consistent pair read
         out["goodput"] = {
-            "slo_total": int(total), "slo_good": int(good),
+            "slo_total": total, "slo_good": good,
             "ratio": round(good / total, 4) if total else None,
         }
         return out
@@ -366,8 +379,7 @@ class ServingMetrics:
                                h.quantile(0.50), h.quantile(0.95),
                                h.quantile(0.99))]
             lines.append(f"{name:16s} {h.count:8d} " + " ".join(cells))
-        total = self._counter("slo").value
-        good = self._counter("slo_good").value
+        good, total = self.slo_counts()
         lines.append(bar)
         lines.append(f"goodput: {int(good)}/{int(total)} requests met "
                      "their slo_ms" if total else

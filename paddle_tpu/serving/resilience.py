@@ -582,6 +582,12 @@ class FleetSupervisor:
         eng = self.factory(index, router.registry)
         eng.set_lifecycle(router.lifecycle, replica=str(index))
         eng.audit.bind_flight(router.flight, replica=str(index))
+        if router.history is not None:
+            # the rebuilt engine keeps ticking the fleet's ONE history
+            # store (ISSUE 14) — its registry counters continue from the
+            # shared totals, so rate windows see no reset here; engine-
+            # local resets are clamped by HistoryStore.increase anyway
+            eng.set_history(router.history)
         fi = router.fault_injectors.get(index)
         if fi is not None:
             eng.set_fault_injector(fi)

@@ -111,6 +111,7 @@ from .request import FinishReason
 _MAX_HEADER_BYTES = 16384
 _ROUTES = ("/v1/completions", "/v1/requests", "/v1/debug/compiles",
            "/v1/debug/profile", "/v1/debug/audit", "/v1/debug/cache",
+           "/v1/debug/alerts", "/v1/debug/history",
            "/healthz", "/readyz", "/metrics")
 
 # pre-registered metric names this module owns (tools/check_metrics_docs
@@ -478,8 +479,10 @@ class CompletionServer:
                                     keep_alive=keep_alive)
             elif path == "/metrics":
                 status = 200
-                # refresh serving_fleet_* replica gauges at scrape time
-                self.fleet.sample_gauges()
+                # serving_fleet_* replica gauges refresh via the
+                # registry collect hook inside prometheus_text (ISSUE
+                # 14) — the same freshness the push gateway and the
+                # history sampler observe
                 await self._respond(writer, status,
                                     metrics_page(self.registry),
                                     PROMETHEUS_CONTENT_TYPE,
@@ -686,6 +689,89 @@ class CompletionServer:
                                          else round(imbalance, 4)),
                  },
                  "data": data},
+                keep_alive=keep_alive)
+            return 200
+        if path == "/v1/debug/alerts":
+            # alert-engine state (ISSUE 14): every rule with its live
+            # pending/firing state + recent transitions, plus engine
+            # totals; ?rule= filters to one rule (unknown -> 404)
+            alerts = self.fleet.alerts
+            if alerts is None:
+                await self._respond(
+                    writer, 200,
+                    {"object": "alerts", "status": "disabled",
+                     "rules": 0, "data": []}, keep_alive=keep_alive)
+                return 200
+            snap = alerts.snapshot()
+            rule = params.get("rule", [None])[0]
+            if rule is not None:
+                rows = [d for d in snap["data"]
+                        if d["rule"]["name"] == rule]
+                if not rows:
+                    await self._respond(writer, 404, error_body(
+                        f"no alert rule {rule!r}", "not_found"),
+                        keep_alive=keep_alive)
+                    return 404
+                # scope status + firing to the queried rule: an
+                # operator asking about an inactive rule must not read
+                # "firing" off some OTHER rule's incident
+                snap = dict(snap, data=rows, firing=[
+                    d["rule"]["name"] for d in rows
+                    if d["state"] == "firing"])
+            status = ("firing" if snap["firing"] else "ok")
+            await self._respond(
+                writer, 200,
+                dict({"object": "alerts", "status": status}, **snap),
+                keep_alive=keep_alive)
+            return 200
+        if path == "/v1/debug/history":
+            # metrics history (ISSUE 14): ?series=<metric name> answers
+            # the per-label-set windows (per-replica view) plus a fleet
+            # aggregate; without ?series= the series index is returned.
+            # ?window=N bounds the returned samples (malformed -> 400,
+            # unknown series -> 404 — protocol-clean like /v1/debug/cache)
+            history = self.fleet.history
+            if history is None:
+                await self._respond(
+                    writer, 200,
+                    {"object": "history", "status": "disabled",
+                     "data": []}, keep_alive=keep_alive)
+                return 200
+            try:
+                window = self._debug_int(params, "window",
+                                         history.cfg.ring_len, 1,
+                                         history.cfg.ring_len)
+            except ValueError as e:
+                await self._respond(writer, 400, error_body(str(e)),
+                                    keep_alive=keep_alive)
+                return 400
+            series = params.get("series", [None])[0]
+            if series is None:
+                await self._respond(
+                    writer, 200,
+                    {"object": "history", "status": "ok",
+                     "stats": history.stats(),
+                     "series": history.names()}, keep_alive=keep_alive)
+                return 200
+            keys = history.match(series)
+            if not keys:
+                await self._respond(writer, 404, error_body(
+                    f"no recorded series {series!r} (see "
+                    "/v1/debug/history for the index)", "not_found"),
+                    keep_alive=keep_alive)
+                return 404
+            rows = [{"key": k, "kind": history.kind(k),
+                     "latest": history.latest(k),
+                     "window": history.window(k, window)}
+                    for k in keys]
+            fleet_view = {"latest_sum": history.name_latest_sum(series)}
+            if all(r["kind"] == "counter" for r in rows):
+                fleet_view["increase"] = history.name_increase(
+                    series, window)
+            await self._respond(
+                writer, 200,
+                {"object": "history", "status": "ok", "series": series,
+                 "window": window, "fleet": fleet_view, "data": rows},
                 keep_alive=keep_alive)
             return 200
         if path == "/v1/debug/compiles":
@@ -983,7 +1069,7 @@ def _toy_fleet(dp: int = 1, layers: int = 2, num_blocks: int = 64,
                max_queue: int = 64,
                flight_dir: Optional[str] = None,
                audit=None, unified: bool = False,
-               fault_plan=None) -> FleetRouter:
+               fault_plan=None, alert_rules=None) -> FleetRouter:
     """A dp-replica fleet of toy engines on one shared registry: each
     replica gets its OWN model instance (engine threads swap parameter
     values during the traced step — modules must not be shared) with
@@ -998,7 +1084,8 @@ def _toy_fleet(dp: int = 1, layers: int = 2, num_blocks: int = 64,
             unified=unified),
         dp=dp, config=FleetConfig(max_queue=max_queue,
                                   flight_dir=flight_dir,
-                                  fault_plan=fault_plan))
+                                  fault_plan=fault_plan,
+                                  alert_rules=alert_rules))
 
 
 def _http(port: int, method: str, path: str, body: Optional[dict] = None):
@@ -1109,10 +1196,16 @@ async def _serve_cli(args) -> int:
         from .faultinject import FaultPlan
 
         fault_plan = FaultPlan.from_json(args.fault_plan)
+    alert_rules = None
+    if args.alert_rules:
+        from ..observability.alerts import AlertRuleSet
+
+        alert_rules = AlertRuleSet.from_json(args.alert_rules)
     fleet = _toy_fleet(dp=args.dp, layers=args.layers,
                        num_blocks=args.blocks, max_queue=args.max_queue,
                        flight_dir=args.flight_dir, audit=audit,
-                       unified=args.unified, fault_plan=fault_plan)
+                       unified=args.unified, fault_plan=fault_plan,
+                       alert_rules=alert_rules)
     supervisor = None
     if args.max_restarts > 0:
         # self-healing by default (ISSUE 12): dead replicas restart
@@ -1149,7 +1242,7 @@ async def _serve_cli(args) -> int:
           f"dp={fleet.dp} mp={server.engine.mp} "
           "(POST /v1/completions; GET /healthz /readyz /metrics "
           "/v1/requests /v1/debug/compiles /v1/debug/profile "
-          "/v1/debug/audit)")
+          "/v1/debug/audit /v1/debug/alerts /v1/debug/history)")
     try:
         await server.serve_forever()
     finally:
@@ -1216,6 +1309,13 @@ def main(argv=None) -> int:
                         "this marks the replica unhealthy (excluded "
                         "from routing) and escalates to a restart if "
                         "the stall persists; only with supervision on")
+    p.add_argument("--alert-rules", default=None, metavar="FILE",
+                   help="JSON alert rule set evaluated over the metrics "
+                        "history (observability/alerts.py): threshold / "
+                        "rate / SLO burn-rate rules with step-indexed "
+                        "windows; omitted = the default serving rule "
+                        "set (pool exhaustion, goodput burn, compile "
+                        "storms, restart/quarantine churn, ...)")
     p.add_argument("--flight-dir", default=None, metavar="DIR",
                    help="write flight-recorder post-mortem bundles "
                         "(engine death, preemption storms, 429 bursts, "

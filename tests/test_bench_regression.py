@@ -1,0 +1,188 @@
+"""Bench perf-regression gate (ISSUE 14 tooling satellite).
+
+``tools/check_bench_regression.py`` is the run-over-run outer loop of
+the alerting tentpole: it diffs ``BENCH_SERVING.json`` against the
+committed ``BENCH_SERVING_BASELINE.json`` with per-metric tolerance
+bands.  This file self-tests the gate (synthetic baseline vs regressed
+JSON must fail with a nonzero exit naming the metric and band) AND runs
+the REAL gate against the committed repo files — a bench regression
+lands red here, not silently in a JSON nobody reads.
+"""
+
+import copy
+import json
+import os
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(_REPO, "tools"))
+try:
+    import check_bench_regression as gate
+finally:
+    sys.path.pop(0)
+
+
+# a miniature bench-JSON shape covering all three check modes
+_CHECKS = (
+    ("a.tokens_per_sec", "higher", 0.5, 0.0),
+    ("a.padding_ratio", "lower", 0.0, 0.02),
+    ("a.traces", "count_max", 0.0, 0.0),
+)
+_BASE = {"a": {"tokens_per_sec": 10.0, "padding_ratio": 0.10,
+               "traces": 6}}
+
+
+class TestCompare:
+    def test_equal_values_pass(self):
+        assert gate.compare(copy.deepcopy(_BASE), _BASE, _CHECKS) == []
+
+    def test_within_band_passes(self):
+        cur = {"a": {"tokens_per_sec": 5.01,   # > 10 * (1 - 0.5)
+                     "padding_ratio": 0.119,   # < 0.10 + 0.02
+                     "traces": 6}}
+        assert gate.compare(cur, _BASE, _CHECKS) == []
+
+    @pytest.mark.parametrize("field,bad,mode", [
+        ("tokens_per_sec", 4.9, "higher"),   # below the 50% floor
+        ("padding_ratio", 0.13, "lower"),    # above the +0.02 ceiling
+        ("traces", 7, "count_max"),          # ONE extra trace fails
+    ])
+    def test_each_mode_fails_naming_metric_and_band(self, field, bad,
+                                                    mode):
+        cur = copy.deepcopy(_BASE)
+        cur["a"][field] = bad
+        violations = gate.compare(cur, _BASE, _CHECKS)
+        assert len(violations) == 1
+        v = violations[0]
+        assert v["metric"] == f"a.{field}"
+        assert v["mode"] == mode
+        assert "band" in v and "baseline" in v["band"]
+
+    def test_missing_metric_is_a_violation_not_a_skip(self):
+        cur = {"a": {"tokens_per_sec": 10.0, "padding_ratio": 0.10}}
+        violations = gate.compare(cur, _BASE, _CHECKS)
+        assert [v["metric"] for v in violations] == ["a.traces"]
+        assert "missing" in violations[0]["reason"]
+        # ... and a metric missing from the BASELINE too
+        violations = gate.compare(_BASE, cur, _CHECKS)
+        assert [v["metric"] for v in violations] == ["a.traces"]
+
+    def test_verdict_shape(self):
+        v = gate.verdict(copy.deepcopy(_BASE), _BASE, _CHECKS)
+        assert v["ok"] is True and v["checked"] == 3
+        bad = copy.deepcopy(_BASE)
+        bad["a"]["traces"] = 9
+        v = gate.verdict(bad, _BASE, _CHECKS)
+        assert v["ok"] is False
+        assert v["violations"][0]["metric"] == "a.traces"
+
+
+class TestCliSelfTest:
+    """The gate as a process contract: synthetic regression -> nonzero
+    exit naming the metric and band on stderr."""
+
+    def _write(self, tmp_path, name, obj):
+        p = tmp_path / name
+        p.write_text(json.dumps(obj))
+        return str(p)
+
+    def test_regressed_json_fails_nonzero_naming_metric(self, tmp_path,
+                                                        capsys):
+        with open(os.path.join(_REPO, "BENCH_SERVING.json")) as f:
+            current = json.load(f)
+        bad = copy.deepcopy(current)
+        bad["unified"]["unified_trace_count"] += 1     # retrace crept in
+        bad["mp"]["mp2"]["tokens_per_sec"] = 0.01      # collapse
+        rc = gate.main(["--current", self._write(tmp_path, "bad.json",
+                                                 bad)])
+        err = capsys.readouterr().err
+        assert rc == 1
+        assert "unified.unified_trace_count" in err
+        assert "mp.mp2.tokens_per_sec" in err
+        assert "violates" in err
+
+    def test_write_baseline_round_trip(self, tmp_path, capsys):
+        with open(os.path.join(_REPO, "BENCH_SERVING.json")) as f:
+            current = json.load(f)
+        cur = self._write(tmp_path, "cur.json", current)
+        base = str(tmp_path / "base.json")
+        assert gate.main(["--current", cur, "--baseline", base,
+                          "--write-baseline"]) == 0
+        # the freshly extracted baseline passes against its own source
+        assert gate.main(["--current", cur, "--baseline", base]) == 0
+        capsys.readouterr()
+        # the extracted file holds exactly the checked metrics
+        with open(base) as f:
+            extracted = json.load(f)
+        for path, _, _, _ in gate.CHECKS:
+            assert gate.get_path(extracted, path) is not None, path
+
+    def test_missing_baseline_is_exit_2(self, tmp_path, capsys):
+        with open(os.path.join(_REPO, "BENCH_SERVING.json")) as f:
+            current = json.load(f)
+        rc = gate.main(["--current",
+                        self._write(tmp_path, "c.json", current),
+                        "--baseline", str(tmp_path / "nope.json")])
+        capsys.readouterr()
+        assert rc == 2
+
+
+class TestRealGate:
+    """The committed repo files must satisfy the gate — this IS the
+    perf-regression check running from the suite."""
+
+    def test_committed_bench_passes_committed_baseline(self, capsys):
+        assert os.path.exists(gate.BASELINE), \
+            "BENCH_SERVING_BASELINE.json must be committed"
+        assert gate.main([]) == 0, capsys.readouterr().err
+
+    def test_bench_json_embeds_regression_verdict(self):
+        with open(os.path.join(_REPO, "BENCH_SERVING.json")) as f:
+            bench = json.load(f)
+        reg = bench["regression"]
+        assert reg["ok"] is True, reg["violations"]
+        assert reg["checked"] == len(gate.CHECKS)
+
+    def test_checks_are_well_formed(self):
+        paths = [c[0] for c in gate.CHECKS]
+        assert len(paths) == len(set(paths)), "duplicate check paths"
+        for path, mode, rel_tol, abs_tol in gate.CHECKS:
+            assert mode in ("higher", "lower", "count_max"), mode
+            assert rel_tol >= 0 and abs_tol >= 0
+
+    def test_every_phase_embeds_alerts_report(self):
+        """ISSUE 14 bench satellite: rules evaluated + transitions
+        observed ride every BENCH_SERVING.json phase record."""
+        with open(os.path.join(_REPO, "BENCH_SERVING.json")) as f:
+            bench = json.load(f)
+        reports = [
+            bench["cache_on"]["alerts"],
+            bench["mp"]["mp1"]["alerts"], bench["mp"]["mp2"]["alerts"],
+            bench["fleet"]["dp1"]["alerts"],
+            bench["fleet"]["dp2"]["alerts"],
+            bench["audit"]["audit_off"]["alerts"],
+            bench["audit"]["audit_on"]["alerts"],
+            bench["unified"]["legacy"]["alerts"],
+            bench["unified"]["unified"]["alerts"],
+            bench["chaos"]["clean"]["alerts"],
+            bench["chaos"]["chaos"]["alerts"],
+        ]
+        for rep in reports:
+            assert rep["evaluations"] > 0
+            assert rep["rules"] > 0
+
+    def test_chaos_phase_alert_contract(self):
+        """The restart-churn rule fired during the injected death and
+        resolved after recovery — alert history as part of the chaos
+        contract; the fault-free run never saw a restart transition."""
+        with open(os.path.join(_REPO, "BENCH_SERVING.json")) as f:
+            bench = json.load(f)
+        churn = bench["chaos"]["chaos"]["alerts"]["transitions"][
+            "restart_churn"]
+        states = [t["state"] for t in churn]
+        assert "firing" in states
+        assert states[-1] == "resolved"
+        clean = bench["chaos"]["clean"]["alerts"]["transitions"]
+        assert "restart_churn" not in clean

@@ -1,0 +1,213 @@
+#!/usr/bin/env python
+"""Bench perf-regression gate (ISSUE 14 tooling tentpole-closer).
+
+``BENCH_SERVING.json`` numbers have been written on every PR and
+compared by *nobody*: a PR that silently halved the fleet's tokens/s or
+doubled the padding waste would land green.  This gate closes the outer
+loop — it diffs the current bench phases against a **committed
+baseline** (``BENCH_SERVING_BASELINE.json``) with per-metric tolerance
+bands and fails loudly, naming the metric and the band, on regression.
+
+Three check modes, each tuned to what the metric can honestly promise
+on shared-CPU CI hardware:
+
+* ``higher`` — throughput-shaped metrics (tokens/s, cached-token
+  ratio).  Wall-clock throughput on CPU is noisy, so the relative bands
+  are deliberately wide: the gate catches *structural* collapses (a
+  retrace storm tanking tokens/s, a routing bug halving the cache
+  ratio), not 5%% scheduling jitter.  Fails when
+  ``current < baseline * (1 - rel_tol) - abs_tol``.
+* ``lower`` — waste-shaped metrics (padding ratio).  Fails when
+  ``current > baseline * (1 + rel_tol) + abs_tol``.
+* ``count_max`` — structural counts (jit trace counts, lost requests).
+  These are DETERMINISTIC on the fixed bench stream, so the band is
+  exact: fails when ``current > baseline + abs_tol`` (abs_tol normally
+  0 — one extra trace IS the regression).
+
+The committed baseline is produced by ``--write-baseline`` (extracts
+exactly the checked metrics from the current ``BENCH_SERVING.json``),
+so re-baselining after an *intentional* perf change is one reviewed
+command, not a hand-edited file.  ``bench.py --serving`` runs the gate
+itself at the end and embeds the verdict as the ``regression`` block of
+the bench JSON; the test suite runs the real gate against the committed
+files AND self-tests that a synthetic regression fails with a nonzero
+exit naming the metric and band.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Optional, Tuple
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CURRENT = os.path.join(_REPO, "BENCH_SERVING.json")
+BASELINE = os.path.join(_REPO, "BENCH_SERVING_BASELINE.json")
+
+# (dotted path into BENCH_SERVING.json, mode, rel_tol, abs_tol)
+# modes: "higher" (floor), "lower" (ceiling), "count_max" (exact cap)
+CHECKS: Tuple[Tuple[str, str, float, float], ...] = (
+    # shared-prefix phase: the cache must keep saving tokens and the
+    # trace counts must not grow (deterministic on the fixed stream)
+    ("cache_on.cached_token_ratio",      "higher",    0.0, 0.05),
+    ("cache_on.prefill_traces",          "count_max", 0.0, 0.0),
+    ("cache_on.decode_traces",           "count_max", 0.0, 0.0),
+    # tensor-parallel phase: throughput floor (wide band — CPU wall
+    # clock) + the mp-invariant trace bound
+    ("mp.mp2.tokens_per_sec",            "higher",    0.5, 0.0),
+    ("mp.mp2.prefill_traces",            "count_max", 0.0, 0.0),
+    ("mp.mp2.decode_traces",             "count_max", 0.0, 0.0),
+    # fleet phase: dp=2 throughput floor and the per-replica warm-cache
+    # contract (affinity must keep concentrating shared prefixes)
+    ("fleet.dp2.tokens_per_sec",         "higher",    0.5, 0.0),
+    ("fleet.dp2.cached_token_ratio",     "higher",    0.0, 0.05),
+    # audit phase: the sample_every=1 shadow-oracle soak must not get
+    # structurally slower relative to its own baseline
+    ("audit.audit_on_tokens_per_sec",    "higher",    0.5, 0.0),
+    # unified ragged phase: the collapsed program family's wins are the
+    # PR 10 headline — padding ratio and trace count must hold
+    ("unified.unified_padding_ratio",    "lower",     0.0, 0.02),
+    ("unified.unified_trace_count",      "count_max", 0.0, 0.0),
+    ("unified.unified_tokens_per_sec",   "higher",    0.5, 0.0),
+    # chaos phase: self-healing must stay lossless and not collapse
+    ("chaos.requests_lost",              "count_max", 0.0, 0.0),
+    ("chaos.chaos_tokens_per_sec",       "higher",    0.5, 0.0),
+)
+
+
+def get_path(obj: Dict, path: str):
+    """Resolve ``a.b.c`` into nested dicts; None when any hop misses."""
+    cur = obj
+    for part in path.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            return None
+        cur = cur[part]
+    return cur
+
+
+def _band(mode: str, baseline: float, rel_tol: float,
+          abs_tol: float) -> Tuple[str, float]:
+    """(human comparator, limit value) for the failure message."""
+    if mode == "higher":
+        return (">=", baseline * (1.0 - rel_tol) - abs_tol)
+    if mode == "lower":
+        return ("<=", baseline * (1.0 + rel_tol) + abs_tol)
+    return ("<=", baseline + abs_tol)  # count_max
+
+
+def compare(current: Dict, baseline: Dict,
+            checks: Tuple = CHECKS) -> List[Dict]:
+    """Evaluate every check; returns the violation list (empty = pass).
+    A metric missing from either side is itself a violation — a gate
+    that silently skips a vanished phase is not a gate."""
+    violations: List[Dict] = []
+    for path, mode, rel_tol, abs_tol in checks:
+        base = get_path(baseline, path)
+        cur = get_path(current, path)
+        if base is None:
+            violations.append({
+                "metric": path, "mode": mode,
+                "reason": "missing from baseline (re-run "
+                          "--write-baseline after adding a check)"})
+            continue
+        if cur is None:
+            violations.append({
+                "metric": path, "mode": mode, "baseline": base,
+                "reason": "missing from current bench JSON (phase "
+                          "vanished or was renamed)"})
+            continue
+        base, cur = float(base), float(cur)
+        cmp_s, limit = _band(mode, base, rel_tol, abs_tol)
+        ok = cur >= limit if mode == "higher" else cur <= limit
+        if not ok:
+            violations.append({
+                "metric": path, "mode": mode,
+                "current": cur, "baseline": base,
+                "band": f"{cmp_s} {round(limit, 6)} (baseline {base}, "
+                        f"rel_tol {rel_tol}, abs_tol {abs_tol})",
+                "reason": f"{cur} violates {cmp_s} {round(limit, 6)}"})
+    return violations
+
+
+def verdict(current: Dict, baseline: Dict,
+            checks: Tuple = CHECKS) -> Dict:
+    """The JSON-able block ``bench.py`` embeds as ``regression``."""
+    violations = compare(current, baseline, checks)
+    return {
+        "ok": not violations,
+        "checked": len(checks),
+        "violations": violations,
+        "baseline_file": os.path.relpath(BASELINE, _REPO),
+    }
+
+
+def extract_baseline(current: Dict,
+                     checks: Tuple = CHECKS) -> Dict:
+    """The committed-baseline shape: exactly the checked metrics,
+    re-nested so ``get_path`` resolves them, plus provenance."""
+    out: Dict = {"_comment": (
+        "Committed bench baseline for tools/check_bench_regression.py. "
+        "Regenerate with: python tools/check_bench_regression.py "
+        "--write-baseline (after an INTENTIONAL perf change, in the "
+        "same PR that explains it).")}
+    for path, _, _, _ in checks:
+        v = get_path(current, path)
+        if v is None:
+            raise SystemExit(f"cannot baseline {path!r}: missing from "
+                             "the current bench JSON")
+        cur = out
+        parts = path.split(".")
+        for part in parts[:-1]:
+            cur = cur.setdefault(part, {})
+        cur[parts[-1]] = v
+    return out
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python tools/check_bench_regression.py",
+        description="diff BENCH_SERVING.json against the committed "
+                    "baseline with per-metric tolerance bands")
+    p.add_argument("--current", default=CURRENT,
+                   help="bench JSON to check (default: BENCH_SERVING.json)")
+    p.add_argument("--baseline", default=BASELINE,
+                   help="committed baseline (default: "
+                        "BENCH_SERVING_BASELINE.json)")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="extract the checked metrics from --current "
+                        "into --baseline and exit (the one sanctioned "
+                        "way to move the bar)")
+    args = p.parse_args(argv)
+    with open(args.current) as f:
+        current = json.load(f)
+    if args.write_baseline:
+        base = extract_baseline(current)
+        with open(args.baseline, "w") as f:
+            json.dump(base, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"baseline written: {args.baseline} "
+              f"({len(CHECKS)} checked metrics)")
+        return 0
+    if not os.path.exists(args.baseline):
+        print(f"no baseline at {args.baseline}; run --write-baseline "
+              "first", file=sys.stderr)
+        return 2
+    with open(args.baseline) as f:
+        baseline = json.load(f)
+    violations = compare(current, baseline)
+    for v in violations:
+        print(f"REGRESSION {v['metric']} [{v['mode']}]: {v['reason']}",
+              file=sys.stderr)
+    if violations:
+        print(f"{len(violations)} bench regression(s) vs "
+              f"{args.baseline}", file=sys.stderr)
+        return 1
+    print(f"bench regression gate: OK ({len(CHECKS)} metrics within "
+          "their bands)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

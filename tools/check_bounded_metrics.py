@@ -69,6 +69,11 @@ SCAN_FILES = (
     # ISSUE 13: the cache-stat tracker's pool-timeline ring, decayed
     # prefix-heat table and attribution maps must stay bounded
     os.path.join(_REPO, "paddle_tpu", "observability", "cachestat.py"),
+    # ISSUE 14: the metrics-history rings are THE memory bound of the
+    # alerting layer (hard max_series x ring_len), and the alert
+    # engine's per-rule transition rings must stay bounded too
+    os.path.join(_REPO, "paddle_tpu", "observability", "history.py"),
+    os.path.join(_REPO, "paddle_tpu", "observability", "alerts.py"),
     # ISSUE 12: the supervisor's restart-history deques / pending
     # re-dispatch queue and the fault injector's fired-once sets must
     # stay bounded even if the modules move out of the serving dir

@@ -43,6 +43,8 @@ DECLARING_MODULES = (
     os.path.join(_REPO, "paddle_tpu", "observability", "stepprof.py"),
     os.path.join(_REPO, "paddle_tpu", "observability", "audit.py"),
     os.path.join(_REPO, "paddle_tpu", "observability", "cachestat.py"),
+    os.path.join(_REPO, "paddle_tpu", "observability", "history.py"),
+    os.path.join(_REPO, "paddle_tpu", "observability", "alerts.py"),
 )
 
 _NAME_RE = re.compile(r"\b(?:serving|push)_[a-z0-9_:]+\b")

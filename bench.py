@@ -1264,10 +1264,255 @@ def serving_chaos_bench() -> dict:
     return result
 
 
+def serving_aot_bench() -> dict:
+    """AOT serving artifacts phase (ISSUE 15): the preempting
+    shared-prefix stream served traced vs from a saved ``jax.export``
+    artifact (``serving/aot.py``).  Asserts greedy token identity with
+    the retrace counters pinned at ZERO on every AOT engine, measures
+    cold boot (lazy StableHLO compiles) and the headline **warm
+    restart** (a second engine on the SAME loaded artifact — the
+    replica-restart shape: everything already compiled) against a
+    traced engine re-tracing from scratch, then reruns the dp=2
+    supervised death-injection chaos both ways: the rebuilt replica
+    must reuse the fleet's artifact with zero post-restart traces,
+    serve a post-restart wave without retracing, and recover in
+    measurably less wall time than the traced baseline.
+    """
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.serving import (
+        AotArtifact,
+        EngineConfig,
+        EngineCore,
+        FaultPlan,
+        FaultSpec,
+        FleetConfig,
+        FleetRouter,
+        FleetSupervisor,
+        SamplingParams,
+        SchedulerConfig,
+        SupervisorConfig,
+    )
+    from paddle_tpu.serving.fleet import affinity_replica_index
+
+    rng = np.random.default_rng(0)
+    prefix = rng.integers(0, 256, 8).tolist()
+    prompts = [prefix + rng.integers(0, 256, 8).tolist() for _ in range(6)]
+
+    def build(aot=None, registry=None, labels=None):
+        paddle.seed(0)
+        model = LlamaForCausalLM(LlamaConfig.tiny(num_hidden_layers=2))
+        # 14 usable blocks of 4: the stream preempts + recomputes and
+        # every prefill chunks under the 8-token budget — the same
+        # program surface the other serving phases measure
+        return EngineCore(model, config=EngineConfig(
+            num_blocks=15, block_size=4,
+            scheduler=SchedulerConfig(
+                max_num_seqs=4, max_prefill_tokens_per_step=8),
+            aot=aot), registry=registry, metrics_labels=labels)
+
+    def traces(eng):
+        return (eng.prefill_trace_count + eng.decode_trace_count
+                + eng.ragged_trace_count)
+
+    def cold(aot) -> dict:
+        """One full cold start: engine build + the whole stream."""
+        t0 = time.perf_counter()
+        eng = build(aot=aot)
+        boot = time.perf_counter() - t0
+        reqs = [eng.add_request(p, SamplingParams(max_new_tokens=10),
+                                slo_ms=60_000.0)
+                for p in prompts]
+        t1 = time.perf_counter()
+        eng.run(max_steps=4000)
+        serve = time.perf_counter() - t1
+        assert all(r.finished for r in reqs)
+        gen = sum(len(r.output_tokens) for r in reqs)
+        return {
+            "boot_s": round(boot, 4), "serve_s": round(serve, 4),
+            "wall_s": round(boot + serve, 4),
+            "tokens_per_sec": round(gen / (boot + serve), 2),
+            "generated_tokens": gen,
+            "preemptions": eng.metrics.counters["preemptions"],
+            "trace_count": traces(eng),
+            "aot": eng.stepprof.aot_snapshot(),
+            "compile_rows": len(eng.stepprof.compile_table()),
+            "outputs": [list(r.output_tokens) for r in reqs],
+        }
+
+    tmp = tempfile.mkdtemp(prefix="bench_aot_")
+    try:
+        t0 = time.perf_counter()
+        saved = AotArtifact.save(build(), tmp)
+        save_wall = time.perf_counter() - t0
+        artifact = AotArtifact.load(tmp)
+        art_bytes = sum(m["bytes"]
+                        for m in artifact.manifest["programs"].values())
+
+        traced1 = cold(None)          # traced cold boot (the baseline)
+        aot_cold = cold(artifact)     # AOT cold: zero traces, lazy
+                                      # compiles of the loaded StableHLO
+        aot_warm = cold(artifact)     # AOT warm: the replica-restart
+                                      # shape — every program compiled
+        traced2 = cold(None)          # a traced "restart" re-traces +
+                                      # re-compiles the whole set
+
+        # --- dp=2 supervised chaos, traced vs AOT ----------------------
+        target = affinity_replica_index(prompts[0], dp=2, block_size=4)
+        assert target is not None
+
+        def chaos(aot) -> dict:
+            plan = FaultPlan(faults=(
+                FaultSpec(point="engine_step_raise", step=6,
+                          replica=str(target)),))
+            fleet = FleetRouter.build(
+                lambda i, registry: build(aot=aot, registry=registry,
+                                          labels={"replica": str(i)}),
+                dp=2, config=FleetConfig(fault_plan=plan))
+            sup = FleetSupervisor(fleet, config=SupervisorConfig(
+                poll_interval_s=0.01, backoff_initial_s=0.02,
+                backoff_max_s=0.5)).start()
+            fleet.start()
+            t0 = time.perf_counter()
+            hs = [fleet.submit_request(
+                p, SamplingParams(max_new_tokens=10),
+                request_id=f"aotc-{i}", retryable=True)
+                for i, p in enumerate(prompts)]
+            fleet.wait(hs, timeout=300)
+            wall = time.perf_counter() - t0
+            lost = [h.rid for h in hs if h.finish_reason != "length"]
+            assert not lost, f"requests lost under chaos: {lost}"
+            # restart completed before the post-restart wave
+            deadline = time.perf_counter() + 60
+            while time.perf_counter() < deadline:
+                if all(r.healthy for r in fleet.replicas) \
+                        and sup._recovery_h.count >= 1:
+                    break
+                time.sleep(0.02)
+            assert sup._recovery_h.count >= 1, "no recovery observed"
+            # post-restart wave: affinity routes the shared-prefix
+            # family BACK onto the rebuilt replica — traced it must
+            # retrace everything, AOT it serves from warm executables
+            t1 = time.perf_counter()
+            hs2 = [fleet.submit_request(
+                p, SamplingParams(max_new_tokens=10),
+                request_id=f"aotw-{i}", retryable=True)
+                for i, p in enumerate(prompts)]
+            fleet.wait(hs2, timeout=300)
+            wave2_wall = time.perf_counter() - t1
+            lost = [h.rid for h in hs2 if h.finish_reason != "length"]
+            assert not lost, f"post-restart requests lost: {lost}"
+            rebuilt = fleet.engines[target]
+            rec = {
+                "wall_s": round(wall, 4),
+                "wave2_wall_s": round(wave2_wall, 4),
+                "recovery_max_s": round(sup._recovery_h.max, 4),
+                "restarts": int(
+                    sup._restarts["engine_death"].value),
+                "rebuilt_traces": traces(rebuilt),
+                "rebuilt_aot": rebuilt.stepprof.aot_snapshot()["loaded"]
+                if aot is not None else False,
+                "outputs": {h.rid: list(h.output_tokens) for h in hs},
+                "wave2_outputs": {h.rid: list(h.output_tokens)
+                                  for h in hs2},
+            }
+            fleet.shutdown(drain_timeout=5.0)
+            return rec
+
+        chaos_traced = chaos(None)
+        chaos_aot = chaos(artifact)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    identical = (aot_cold["outputs"] == traced1["outputs"]
+                 and aot_warm["outputs"] == traced1["outputs"])
+    chaos_identical = (
+        chaos_aot["outputs"] == chaos_traced["outputs"]
+        and chaos_aot["wave2_outputs"] == chaos_traced["wave2_outputs"])
+    aot_trace_count = aot_cold["trace_count"] + aot_warm["trace_count"]
+    result = {
+        "metric": "serving_aot_warm_restart_speedup",
+        "value": round(traced2["wall_s"] / max(aot_warm["wall_s"], 1e-9),
+                       2),
+        "unit": "x", "phase": "serving_aot",
+        "save_wall_s": round(save_wall, 4),
+        "programs": saved.program_count,
+        "artifact_bytes": art_bytes,
+        "load_seconds": round(artifact.load_seconds, 4),
+        "greedy_token_identical": identical,
+        "chaos_token_identical": chaos_identical,
+        "traced_cold_wall_s": traced1["wall_s"],
+        "aot_cold_wall_s": aot_cold["wall_s"],
+        "aot_warm_wall_s": aot_warm["wall_s"],
+        "traced_restart_wall_s": traced2["wall_s"],
+        "traced_trace_count": traced1["trace_count"],
+        "aot_trace_count": aot_trace_count,
+        "aot_tokens_per_sec": aot_warm["tokens_per_sec"],
+        "restart": {
+            "traced_recovery_max_s": chaos_traced["recovery_max_s"],
+            "aot_recovery_max_s": chaos_aot["recovery_max_s"],
+            "traced_wave2_wall_s": chaos_traced["wave2_wall_s"],
+            "aot_wave2_wall_s": chaos_aot["wave2_wall_s"],
+            # recovery_seconds spans detection -> rebuild complete, and
+            # compiles are LAZY — the retrace bill lands on the rebuilt
+            # replica's first served wave, so the honest
+            # "replica back at full service" wall is rebuild + wave2
+            "traced_restoration_s": round(
+                chaos_traced["recovery_max_s"]
+                + chaos_traced["wave2_wall_s"], 4),
+            "aot_restoration_s": round(
+                chaos_aot["recovery_max_s"]
+                + chaos_aot["wave2_wall_s"], 4),
+            "traced_rebuilt_traces": chaos_traced["rebuilt_traces"],
+            "aot_rebuilt_traces": chaos_aot["rebuilt_traces"],
+        },
+        "traced": traced1, "aot_cold": aot_cold, "aot_warm": aot_warm,
+        "traced_restart": traced2,
+        "chaos_traced": chaos_traced, "chaos_aot": chaos_aot,
+    }
+    assert identical, "AOT output diverged from traced under greedy"
+    assert chaos_identical, \
+        "AOT chaos rerun diverged from the traced chaos run"
+    assert aot_trace_count == 0, \
+        f"AOT engines traced {aot_trace_count} program(s)"
+    assert aot_cold["compile_rows"] == 0 and aot_warm["compile_rows"] == 0
+    assert sum(aot_warm["aot"]["hits"].values()) > 0
+    assert traced1["trace_count"] > 0 and traced1["preemptions"] > 0
+    # the robustness payoff, measured: the rebuilt replica reused the
+    # artifact (zero post-restart traces; the traced rebuild re-traced),
+    # served the post-restart wave without the compile bill, and the
+    # recovery itself ran measurably faster than the traced baseline
+    assert chaos_aot["rebuilt_traces"] == 0, chaos_aot
+    assert chaos_aot["rebuilt_aot"], "rebuilt replica lost the artifact"
+    assert chaos_traced["rebuilt_traces"] > 0, \
+        "traced chaos baseline never exercised the rebuilt replica"
+    assert chaos_aot["wave2_wall_s"] < chaos_traced["wave2_wall_s"], (
+        f"post-restart wave not faster under AOT: "
+        f"{chaos_aot['wave2_wall_s']} vs {chaos_traced['wave2_wall_s']}")
+    # detection->rebuild alone is model construction either way (the
+    # compile bill is lazy); full service restoration — rebuild PLUS
+    # the rebuilt replica serving its first wave — must be measurably
+    # faster when the restart reuses the fleet's warm artifact
+    restart = result["restart"]
+    assert restart["aot_restoration_s"] < restart["traced_restoration_s"], (
+        f"service restoration not faster under AOT: "
+        f"{restart['aot_restoration_s']} vs "
+        f"{restart['traced_restoration_s']}")
+    assert aot_warm["wall_s"] < traced2["wall_s"], (
+        f"warm AOT restart not faster than a traced restart: "
+        f"{aot_warm['wall_s']} vs {traced2['wall_s']}")
+    return result
+
+
 def serving_main() -> dict:
     """``--serving``: shared-prefix + tensor-parallel + fleet +
-    numerics-audit + unified-ragged + self-healing-chaos phases,
-    combined into one ``BENCH_SERVING.json`` record."""
+    numerics-audit + unified-ragged + self-healing-chaos + AOT-artifact
+    phases, combined into one ``BENCH_SERVING.json`` record."""
     # must precede the FIRST jax import in this process: the mp phase
     # needs ≥2 host devices.  A pre-set count <2 (e.g. =1 exported for
     # single-device debugging) is raised, not trusted — otherwise
@@ -1305,6 +1550,10 @@ def serving_main() -> dict:
         # checkpoint before the chaos phase for the same reason
         json.dump(result, f, indent=1)
     result["chaos"] = serving_chaos_bench()
+    with open(path, "w") as f:
+        # checkpoint before the aot phase for the same reason
+        json.dump(result, f, indent=1)
+    result["aot"] = serving_aot_bench()
     with open(path, "w") as f:
         json.dump(result, f, indent=1)
     # bench perf-regression gate (ISSUE 14): diff this run against the

@@ -77,6 +77,10 @@ METRIC_NAMES = (
     "serving_bucket_utilization",
     "serving_compile_seconds_total",
     "serving_compiles_total",
+    # ISSUE 15: AOT attribution — registered only once an artifact is
+    # bound (serving/aot.py declares the same names as their owner)
+    "serving_aot_hits_total",
+    "serving_aot_load_seconds",
 )
 
 # utilization lives in (0, 1]: scheduled >= 1 whenever a program runs
@@ -86,6 +90,11 @@ UTILIZATION_BUCKETS = (0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0)
 # program wall times: the serving latency bucket ladder
 _STEP_SECONDS_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
                          0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+# AOT artifact load wall times (disk read + StableHLO deserialize of the
+# whole program set — compiles are lazy and cached in the artifact)
+_AOT_LOAD_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                     1.0, 2.5, 5.0, 10.0)
 
 # safety cap on distinct (program, bucket) aggregate keys / histogram
 # label pairs: the engine's power-of-two bucket sets bound this in the
@@ -168,6 +177,12 @@ class StepProfiler:
         self._cur_t0 = 0.0
         self._capture: Optional[CaptureWindow] = None
         self.last_capture: Optional[CaptureWindow] = None
+        # AOT attribution (ISSUE 15): set once an artifact is bound —
+        # loaded programs count serving_aot_hits_total instead of fake
+        # compile rows, and record_compile flags any LATER trace with
+        # aot=True (a trace after an AOT load is visibly a bug)
+        self._aot_state: Optional[Dict] = None
+        self._aot_hits_c: Optional[Dict[str, object]] = None
         if not enabled or registry is None:
             # disabled: never touch the registry, so /metrics stays free
             # of every serving_step_*/serving_compile_*/serving_padding_*
@@ -331,16 +346,75 @@ class StepProfiler:
             else:
                 self._finalize_capture(finalize, complete=True)
 
+    # --- AOT attribution (ISSUE 15) -----------------------------------------
+    def record_aot_load(self, seconds: float, programs: int,
+                        observe: bool = True) -> None:
+        """An AOT artifact was bound to this engine: ``seconds`` is the
+        artifact's disk-load + deserialize wall, ``programs`` its saved
+        program count.  From here on, launches count
+        ``serving_aot_hits_total{program}`` — the compile table should
+        stay EMPTY, and any row that does land carries ``aot: true``
+        (the visible bug marker).  ``observe=False`` updates the state
+        without sampling the load histogram — a supervisor REBIND of an
+        already-loaded artifact must not record a disk load that never
+        happened (the hits counters still need registering so the
+        rebound engine's launches keep counting)."""
+        with self._lock:
+            # same-profiler double bind also must not double-observe
+            rebind = self._aot_state is not None
+            self._aot_state = {"loaded": True,
+                               "load_seconds": round(seconds, 6),
+                               "programs": int(programs),
+                               "hits": {}}
+        if rebind or not self.enabled or self.registry is None:
+            return
+        if observe:
+            self.registry.histogram(
+                "serving_aot_load_seconds",
+                "AOT artifact load wall (manifest + StableHLO "
+                "deserialize of the whole program set)",
+                buckets=_AOT_LOAD_BUCKETS,
+                **self.labels).observe(seconds)
+        self._aot_hits_c = {
+            p: self.registry.counter(
+                "serving_aot_hits_total",
+                "step launches served from AOT-loaded programs "
+                "(zero traces)",
+                **dict(self.labels, program=p))
+            for p in STEP_PROGRAMS}
+
+    def record_aot_hit(self, program: str) -> None:
+        """One step launch served through a loaded AOT program."""
+        st = self._aot_state
+        if st is None:
+            return
+        with self._lock:
+            st["hits"][program] = st["hits"].get(program, 0) + 1
+        c = self._aot_hits_c
+        if c is not None:
+            c[program].inc()
+
+    def aot_snapshot(self) -> Dict:
+        """``{"loaded": bool, ...}`` for ``GET /v1/debug/compiles``."""
+        with self._lock:
+            if self._aot_state is None:
+                return {"loaded": False}
+            return dict(self._aot_state, hits=dict(self._aot_state["hits"]))
+
     # --- compile attribution ------------------------------------------------
     def record_compile(self, program: str, bucket: Tuple[int, ...],
                        seconds: float) -> None:
         """One observed trace+compile: the engine's in-trace retrace
         counter advanced during this launch, so its wall time IS the
-        trace+compile cost of this (program, bucket)."""
+        trace+compile cost of this (program, bucket).  ``aot`` flags a
+        trace that happened AFTER an artifact load — with AOT bound the
+        counters cannot move, so such a row is a visible bug, never a
+        silent cost."""
         if not self.enabled:
             return
         row = {"program": program, "bucket": _bucket_str(bucket),
                "seconds": round(seconds, 6),
+               "aot": self._aot_state is not None,
                "unix": round(time.time(), 6)}
         with self._lock:
             self._compiles.append(row)
@@ -437,6 +511,7 @@ class StepProfiler:
             "padding_tokens": cap - sched,
             "padding_ratio": round((cap - sched) / cap, 4) if cap else None,
             "compiles": self.compile_totals(),
+            "aot": self.aot_snapshot(),
         }
 
     # --- on-demand capture --------------------------------------------------

@@ -1,12 +1,45 @@
 """jax version-compat shims for SPMD code.
 
-One home for the ``shard_map`` import dance and the ``lax.axis_size``
-polyfill so their users (pipeline, pipeline_1f1b, ring_attention,
-distributed.collective) cannot drift when jax moves the APIs again —
-and so paddle_tpu never monkeypatches the global ``jax`` namespace.
+One home for the ``shard_map`` import dance, the ``lax.axis_size``
+polyfill, and the ``jax.export`` module binding so their users
+(pipeline, pipeline_1f1b, ring_attention, distributed.collective,
+``jit.save``/``static.io``, and the AOT serving artifacts) cannot drift
+when jax moves the APIs again — and so paddle_tpu never monkeypatches
+the global ``jax`` namespace.
 """
 
 import jax
+
+_JAX_EXPORT = None
+
+
+def get_jax_export():
+    """THE import point for the export API (ISSUE 15 satellite): binds
+    ``jax.export`` (jax >= 0.4.30; on jax < 0.6 the attribute hides
+    behind a deprecation ``__getattr__`` that raises at access time, so
+    the submodule import below is the reliable form) or the older
+    ``jax.experimental.export``, once, and caches the module.  Callers
+    — ``serving/aot.py``, ``jit/__init__.py``, ``static/io.py`` — must
+    NOT re-probe the namespaces themselves.  Raises a loud
+    :class:`ImportError` naming the installed jax version when neither
+    binding exists (a truncated/ancient install), instead of letting an
+    ``AttributeError`` surface mid-save as a framework bug."""
+    global _JAX_EXPORT
+    if _JAX_EXPORT is not None:
+        return _JAX_EXPORT
+    try:
+        import jax.export as _m
+    except ImportError:
+        try:
+            from jax.experimental import export as _m  # jax < 0.4.30
+        except ImportError as e:
+            raise ImportError(
+                f"jax {jax.__version__} provides neither jax.export nor "
+                "jax.experimental.export — the AOT artifact path "
+                "(serving/aot.py, jit.save, static.io) needs one of "
+                "them; install jax >= 0.4.30") from e
+    _JAX_EXPORT = _m
+    return _m
 
 try:
     from jax import shard_map
@@ -27,4 +60,4 @@ else:
         # static int inside shard_map/pmap bodies)
         return jax.lax.psum(1, axis_name)
 
-__all__ = ["shard_map", "lax_axis_size"]
+__all__ = ["shard_map", "lax_axis_size", "get_jax_export"]

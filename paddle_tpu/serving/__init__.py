@@ -39,6 +39,12 @@ from ..observability.alerts import (  # noqa: F401
     default_rule_set,
 )
 from ..observability.history import HistoryConfig, HistoryStore  # noqa: F401
+from .aot import (  # noqa: F401
+    AotArtifact,
+    AotBucketMissing,
+    AotError,
+    AotManifestMismatch,
+)
 from .engine import EngineConfig, EngineCore  # noqa: F401
 from .entrypoints import LLM, CompletionOutput, stream_generate  # noqa: F401
 from .faultinject import (  # noqa: F401

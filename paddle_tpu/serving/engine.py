@@ -171,6 +171,19 @@ class EngineConfig:
     # off.  Default off this PR; token-identical to the legacy dispatch
     # under greedy decoding (tested).
     unified_step: bool = False
+    # AOT serving artifacts (ISSUE 15): serve from a pre-lowered
+    # program set instead of tracing at runtime.  ``aot_path`` loads a
+    # saved :class:`~paddle_tpu.serving.aot.AotArtifact` directory at
+    # engine build; ``aot`` binds an already-loaded artifact OBJECT and
+    # wins over the path — a dp fleet (and the supervisor's replica
+    # rebuilds) must share ONE loaded artifact so each program compiles
+    # once fleet-wide.  Any manifest mismatch (mp degree, bucket set,
+    # model hash, jax version, ...) fails loudly at build, and the
+    # in-trace retrace counters provably stay 0 while serving (a bucket
+    # outside the saved universe raises AotBucketMissing instead of
+    # silently retracing).
+    aot_path: Optional[str] = None
+    aot: Optional[object] = None
 
 
 class EngineCore:
@@ -348,6 +361,65 @@ class EngineCore:
                                     **jit_kw["ragged"])
         self._profile_ops = config.profile_ops
         model.eval()
+        # --- AOT serving artifacts (ISSUE 15) -------------------------------
+        # bound LAST: validate() compares against the fully-resolved
+        # engine (mp, pools, unified flag).  A pre-loaded artifact
+        # object (config.aot — the fleet-sharing form) wins over a path.
+        self._aot = None
+        art = config.aot
+        if art is None and config.aot_path:
+            from .aot import AotArtifact
+
+            art = AotArtifact.load(config.aot_path)
+        if art is not None:
+            self.bind_aot(art)
+
+    # --- AOT artifact binding ----------------------------------------------
+    @property
+    def aot_artifact(self):
+        """The bound :class:`~paddle_tpu.serving.aot.AotArtifact`, or
+        ``None`` when this engine traces at runtime."""
+        return self._aot
+
+    def bind_aot(self, artifact, record_load: bool = True) -> None:
+        """Validate + bind an AOT artifact: every step program now
+        dispatches through the artifact's pre-lowered StableHLO instead
+        of the engine's jit entry points — the retrace counters can
+        never move again.  The supervisor calls this on rebuilt replicas
+        (:meth:`FleetSupervisor._rebuild`, with ``record_load=False`` —
+        a rebind reuses an already-loaded artifact, so the load
+        histogram must not re-observe a disk load that never happened).
+        Raises :class:`~paddle_tpu.serving.aot.AotManifestMismatch` on
+        any deployment disagreement."""
+        artifact.validate(self)
+        self._aot = artifact
+        # admission-side guard (the loud backstop stays in
+        # AotArtifact.call): a request whose target length outgrows the
+        # saved universe is rejected honestly at admission instead of
+        # raising AotBucketMissing from the engine thread mid-stream
+        self.scheduler.seq_len_cap = int(artifact.manifest["max_seq_len"])
+        # AOT attribution (ISSUE 15 satellite): /v1/debug/compiles and
+        # /metrics must show "loaded an artifact" instead of fake
+        # compile rows — and flag any later trace as the bug it is.
+        # ONE disk load = ONE serving_aot_load_seconds sample per
+        # registry: the artifact dedups binds of the same loaded object
+        # (dp replicas, rebuild factories that thread it through)
+        sp = self.stepprof
+        observe = record_load
+        if observe and sp.enabled and sp.registry is not None:
+            observe = artifact.mark_load_observed(sp.registry)
+        sp.record_aot_load(artifact.load_seconds,
+                           artifact.program_count, observe=observe)
+
+    def _step_call(self, program: str, bucket, jit_fn, *args):
+        """THE aot-vs-jit dispatch choice, shared by all four step
+        program families: serve from the bound artifact (counting the
+        hit) or fall back to the engine's jit entry point."""
+        if self._aot is None:
+            return jit_fn(*args)
+        out = self._aot.call(program, bucket, *args)
+        self.stepprof.record_aot_hit(program)
+        return out
 
     def _mesh_jit_shardings(self, mesh, cfg) -> Dict[str, dict]:
         """Explicit in/out shardings for the three mesh-spanning jitted
@@ -784,7 +856,8 @@ class EngineCore:
                 with StepTimer(self.metrics, "prefill_step",
                                self._collective_phase("prefill")) as st:
                     last, stats, self._k_pools, self._v_pools = \
-                        self._jit_prefill(
+                        self._step_call(
+                            "prefill", (Tb,), self._jit_prefill,
                             self._param_vals(), self._k_pools,
                             self._v_pools, ids_arr, np.int32(target - 1),
                             blocks, offs)
@@ -832,7 +905,8 @@ class EngineCore:
                 with StepTimer(self.metrics, "prefill_step",
                                self._collective_phase("prefill")) as st:
                     last, stats, self._k_pools, self._v_pools = \
-                        self._jit_chunk_prefill(
+                        self._step_call(
+                            "chunk", (Wb, TWb), self._jit_chunk_prefill,
                             self._param_vals(), self._k_pools,
                             self._v_pools, ids_arr, np.int32(start),
                             np.int32(n - 1), tables, lens, blocks, offs)
@@ -893,7 +967,8 @@ class EngineCore:
             with StepTimer(self.metrics, "decode_step",
                            self._collective_phase("decode")) as st:
                 out, stats, self._k_pools, self._v_pools = \
-                    self._jit_decode(
+                    self._step_call(
+                        "decode", (Bb, Wb), self._jit_decode,
                         self._param_vals(), self._k_pools, self._v_pools,
                         ids, poss, tables, lens, slot_blocks,
                         slot_offsets)
@@ -1016,7 +1091,8 @@ class EngineCore:
             with StepTimer(self.metrics, "unified_step",
                            self._collective_phase("ragged")) as st:
                 out, stats, self._k_pools, self._v_pools = \
-                    self._jit_unified(
+                    self._step_call(
+                        "ragged", (Tb, TWb), self._jit_unified,
                         self._param_vals(), self._k_pools, self._v_pools,
                         ids, pos, seg, last_idx, tables, lens,
                         slot_blocks, slot_offsets)

@@ -641,6 +641,23 @@ class FleetRouter:
                 f"({sorted(repr(a) for a in audits)}); the audit "
                 "surface reports fleet-wide, so every replica must use "
                 "the same EngineConfig.audit")
+        arts = {id(e.aot_artifact) for e in self.engines}
+        if len(arts) != 1:
+            # the compile-once contract (ISSUE 15) is per ARTIFACT
+            # OBJECT: each loaded Exported caches its compiled
+            # executable, so per-replica loads would compile every
+            # program dp times (and a mixed AOT/traced fleet would hide
+            # retraces behind the AOT replicas' zero counters).  Build
+            # every replica with the SAME EngineConfig.aot object.
+            raise ValueError(
+                "replicas disagree on the AOT artifact: a fleet shares "
+                "ONE loaded AotArtifact (load once, pass the same "
+                "EngineConfig.aot object to every replica — not "
+                "per-replica aot_path loads)")
+        # remembered for the supervisor: _rebuild rebinds this artifact
+        # onto replacement engines so a restart reuses the fleet's warm
+        # compiled executables (zero post-restart traces)
+        self.aot_artifact = self.engines[0].aot_artifact
         gate = gates.pop()
         explicit = [e.engine_config.lifecycle for e in self.engines]
         if explicit[0] is not None and \

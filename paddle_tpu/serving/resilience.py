@@ -436,8 +436,8 @@ class FleetSupervisor:
         if time.monotonic() < not_before or self.router.draining:
             return
         del self._restart_at[index]
-        self._rebuild(index, cause)
-        self._recovery_h.observe(time.monotonic() - t0)
+        if self._rebuild(index, cause):
+            self._recovery_h.observe(time.monotonic() - t0)
 
     @staticmethod
     def _recoverable(h) -> bool:
@@ -542,8 +542,8 @@ class FleetSupervisor:
         del self._quarantining[i]
         if self._stop_ev.is_set() or self.router.draining:
             return
-        self._rebuild(i, cause="quarantine")
-        self._recovery_h.observe(time.monotonic() - t0)
+        if self._rebuild(i, cause="quarantine"):
+            self._recovery_h.observe(time.monotonic() - t0)
 
     def _park(self, replica: EngineReplica, rid, h, **event_attrs) -> bool:
         """Claim one recoverable handle off ``replica`` (dict.pop is the
@@ -574,12 +574,49 @@ class FleetSupervisor:
         # the last replica, the next flush fails them honestly
         self._flush_pending()
 
-    def _rebuild(self, index: int, cause: str) -> None:
+    def _rebuild(self, index: int, cause: str) -> bool:
         """Fresh engine + replica + thread on the same index, rewired
         onto the fleet's shared tracker/flight/injector exactly like
-        :meth:`FleetRouter.__init__` wired the original."""
+        :meth:`FleetRouter.__init__` wired the original.  Returns False
+        when the replica was permanently excluded instead (rebuild
+        cannot match the fleet's AOT artifact)."""
+        from .aot import AotError
+
         router = self.router
-        eng = self.factory(index, router.registry)
+        try:
+            eng = self.factory(index, router.registry)
+            if router.aot_artifact is None:
+                if eng.aot_artifact is not None:
+                    # mirror the build-time fleet gate: a traced fleet
+                    # must not gain an AOT replica on rebuild (retraces
+                    # would hide behind its zero counters)
+                    raise AotError(
+                        "rebuild factory bound an AOT artifact but the "
+                        "fleet serves traced — a mixed fleet is refused "
+                        "at build and on rebuild alike")
+            elif eng.aot_artifact is not router.aot_artifact:
+                # the robustness payoff of ISSUE 15: the rebuilt replica
+                # REUSES the fleet's loaded artifact — warm compiled
+                # executables, zero post-restart traces, millisecond
+                # boot — even when the factory forgot to thread it
+                # through (or loaded its own copy).  validate() inside
+                # still fails loudly on a genuine deployment mismatch;
+                # record_load=False: no disk load happened here, so the
+                # load histogram must not gain a phantom sample per
+                # restart.
+                eng.bind_aot(router.aot_artifact, record_load=False)
+        except AotError as e:
+            # deterministic drift between the rebuild factory and the
+            # fleet's artifact (whether raised binding here or inside
+            # the factory's own EngineConfig.aot/aot_path): retrying
+            # would fail the same way forever — exclude permanently and
+            # loudly instead of letting the monitor tick swallow the
+            # raise with the replica dead and unaccounted
+            sys.stderr.write(
+                f"[supervisor] replica {index} rebuild cannot match "
+                f"the fleet's AOT configuration: {e}\n")
+            self._exclude(index, cause=f"aot_mismatch({cause})")
+            return False
         eng.set_lifecycle(router.lifecycle, replica=str(index))
         eng.audit.bind_flight(router.flight, replica=str(index))
         if router.history is not None:
@@ -613,3 +650,4 @@ class FleetSupervisor:
         sys.stderr.write(f"[supervisor] replica {index} restarted "
                          f"(cause: {cause})\n")
         router.sample_gauges()
+        return True

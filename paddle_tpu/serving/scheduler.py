@@ -155,6 +155,16 @@ class ContinuousBatchingScheduler:
         # count — promised is NOT extra unaccounted capacity and must
         # not be summed with `allocated`.
         self.promised_blocks = 0
+        # hard sequence-length cap beyond the pool's own capacity
+        # (ISSUE 15): an AOT-bound engine can only dispatch buckets
+        # inside the artifact's saved universe, so admission must
+        # reject a request whose prompt + max_new_tokens outgrows the
+        # manifest's max_seq_len HONESTLY (finish_reason=abort + error)
+        # instead of letting AotBucketMissing kill the engine thread
+        # mid-stream — in a supervised fleet a re-dispatched oversize
+        # request would otherwise cascade replica deaths.  None = no cap
+        # (traced engines bucket anything the pool holds).
+        self.seq_len_cap: Optional[int] = None
 
     # --- queue ops ----------------------------------------------------------
     def add(self, req: Request) -> None:
@@ -252,6 +262,24 @@ class ContinuousBatchingScheduler:
             req = self.waiting[0]
             ids = req.prompt_ids + req.output_tokens
             prompt_blocks = self.kv.blocks_for(len(ids))
+            target_len = len(req.prompt_ids) + req.sampling.max_new_tokens
+            if self.seq_len_cap is not None \
+                    and target_len > self.seq_len_cap:
+                # outside the AOT artifact's saved bucket universe: the
+                # zero-trace contract can never serve this sequence, so
+                # fail it honestly AT ADMISSION instead of raising
+                # AotBucketMissing from the engine thread mid-stream
+                self.waiting.popleft()
+                req.state = RequestState.FINISHED
+                req.finish_reason = FinishReason.ABORT
+                req.error = (
+                    f"request targets {target_len} tokens (prompt "
+                    f"{len(req.prompt_ids)} + max_new_tokens "
+                    f"{req.sampling.max_new_tokens}) but the AOT "
+                    f"artifact was saved for max_seq_len="
+                    f"{self.seq_len_cap}; re-save with a larger bound")
+                out.aborted.append(req)
+                continue
             if prompt_blocks > self._usable_blocks():
                 # can never fit, even with the whole pool: fail THIS request
                 # honestly rather than live-locking everyone behind it

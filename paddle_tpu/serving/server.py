@@ -777,6 +777,7 @@ class CompletionServer:
         if path == "/v1/debug/compiles":
             data = []
             totals: Dict[str, Dict] = {}
+            aot: Dict[str, Dict] = {}
             for r in self.fleet.replicas:
                 sp = r.engine.stepprof
                 for row in sp.compile_table():
@@ -786,9 +787,14 @@ class CompletionServer:
                         prog, {"seconds": 0.0, "count": 0})
                     agg["seconds"] = round(agg["seconds"] + t["seconds"], 6)
                     agg["count"] += t["count"]
+                # AOT attribution (ISSUE 15): per-replica artifact
+                # state — with an artifact loaded the rows above should
+                # be EMPTY (any row carries aot: true, the bug marker)
+                aot[str(r.index)] = sp.aot_snapshot()
             await self._respond(
                 writer, 200,
                 {"object": "list", "data": data, "totals": totals,
+                 "aot": aot,
                  "step_profile": self.engine.stepprof.enabled},
                 keep_alive=keep_alive)
             return 200
@@ -1050,7 +1056,7 @@ class CompletionServer:
 def _toy_engine(layers: int = 2, num_blocks: int = 64,
                 block_size: int = 4, registry=None,
                 metrics_labels=None, audit=None,
-                unified: bool = False) -> EngineCore:
+                unified: bool = False, aot=None) -> EngineCore:
     import paddle_tpu as paddle
     from ..models import LlamaConfig, LlamaForCausalLM
     from .engine import EngineConfig
@@ -1061,7 +1067,8 @@ def _toy_engine(layers: int = 2, num_blocks: int = 64,
                       config=EngineConfig(num_blocks=num_blocks,
                                           block_size=block_size,
                                           audit=audit,
-                                          unified_step=unified),
+                                          unified_step=unified,
+                                          aot=aot),
                       registry=registry, metrics_labels=metrics_labels)
 
 
@@ -1069,19 +1076,22 @@ def _toy_fleet(dp: int = 1, layers: int = 2, num_blocks: int = 64,
                max_queue: int = 64,
                flight_dir: Optional[str] = None,
                audit=None, unified: bool = False,
-               fault_plan=None, alert_rules=None) -> FleetRouter:
+               fault_plan=None, alert_rules=None,
+               aot=None) -> FleetRouter:
     """A dp-replica fleet of toy engines on one shared registry: each
     replica gets its OWN model instance (engine threads swap parameter
     values during the traced step — modules must not be shared) with
     per-replica-labeled serving series.  Composes with ``--mp``: build
     the mesh first and every replica's engine runs mesh-spanning.  The
     factory is deterministic (seed before build), so the supervisor can
-    rebuild a crashed replica with identical weights."""
+    rebuild a crashed replica with identical weights.  ``aot`` is ONE
+    loaded :class:`~paddle_tpu.serving.aot.AotArtifact` shared by every
+    replica (ISSUE 15) — the fleet refuses per-replica loads."""
     return FleetRouter.build(
         lambda i, registry: _toy_engine(
             layers=layers, num_blocks=num_blocks, registry=registry,
             metrics_labels={"replica": str(i)}, audit=audit,
-            unified=unified),
+            unified=unified, aot=aot),
         dp=dp, config=FleetConfig(max_queue=max_queue,
                                   flight_dir=flight_dir,
                                   fault_plan=fault_plan,
@@ -1104,7 +1114,9 @@ def _http(port: int, method: str, path: str, body: Optional[dict] = None):
 
 
 async def _selftest_async(dp: int = 1, audit_sample: int = 1,
-                          unified: bool = False) -> int:
+                          unified: bool = False,
+                          aot_path: Optional[str] = None,
+                          layers: int = 2, blocks: int = 64) -> int:
     from ..observability.audit import AuditConfig
 
     loop = asyncio.get_running_loop()
@@ -1112,10 +1124,20 @@ async def _selftest_async(dp: int = 1, audit_sample: int = 1,
     # 10): every step sampled by default, so the probe completion runs
     # with the shadow oracle live and must come back divergence-free.
     # --unified routes the probe through the packed ragged step program
-    # (ISSUE 11) under the same audit net.
-    fleet = _toy_fleet(dp=dp, audit=AuditConfig(
-        enabled=True, sample_every=max(1, audit_sample)),
-        unified=unified)
+    # (ISSUE 11) under the same audit net.  --aot-path loads the saved
+    # program set ONCE and the probe must then serve with ZERO traces
+    # (ISSUE 15; the audit net stays live — the in-trace logit stats
+    # are part of the exported programs).
+    aot = None
+    if aot_path:
+        from .aot import AotArtifact
+
+        aot = AotArtifact.load(aot_path)
+    fleet = _toy_fleet(dp=dp, layers=layers, num_blocks=blocks,
+                       audit=AuditConfig(
+                           enabled=True,
+                           sample_every=max(1, audit_sample)),
+                       unified=unified, aot=aot)
     server = CompletionServer(fleet, ServerConfig(port=0))
     engine = server.engine
     await server.start()
@@ -1177,9 +1199,24 @@ async def _selftest_async(dp: int = 1, audit_sample: int = 1,
         # a crashed shadow oracle must not pass as "audited clean"
         assert all(row["oracle_failures"] == 0
                    for row in audit["data"]), audit
+        if aot is not None:
+            # zero-trace contract (ISSUE 15): the probe served entirely
+            # from the loaded artifact — no engine traced anything
+            traces = sum(e.prefill_trace_count + e.decode_trace_count
+                         + e.ragged_trace_count for e in fleet.engines)
+            assert traces == 0, \
+                f"AOT selftest traced {traces} program(s)"
+            status, data = await loop.run_in_executor(
+                None, _http, server.port, "GET", "/v1/debug/compiles",
+                None)
+            obj = json.loads(data)
+            assert status == 200 and not obj["data"], obj
+            assert all(row["loaded"] for row in obj["aot"].values()), obj
         print(f"selftest: OK (port {server.port}, dp={fleet.dp}, "
               f"mp={engine.mp}, tokens {choice['token_ids']}, "
-              f"audited launches {audited})")
+              f"audited launches {audited}"
+              + (f", aot programs {aot.program_count}, zero traces"
+                 if aot is not None else "") + ")")
         return 0
     finally:
         await server.shutdown(drain_timeout=2.0)
@@ -1201,11 +1238,21 @@ async def _serve_cli(args) -> int:
         from ..observability.alerts import AlertRuleSet
 
         alert_rules = AlertRuleSet.from_json(args.alert_rules)
+    aot = None
+    if args.aot_path:
+        # ONE load for the whole fleet (ISSUE 15): every replica — and
+        # every supervisor rebuild — shares this artifact's compiled
+        # executables, so each program compiles once per process
+        from .aot import AotArtifact
+
+        aot = AotArtifact.load(args.aot_path)
+        print(f"aot: loaded {aot.program_count} program(s) from "
+              f"{args.aot_path} in {aot.load_seconds:.3f}s")
     fleet = _toy_fleet(dp=args.dp, layers=args.layers,
                        num_blocks=args.blocks, max_queue=args.max_queue,
                        flight_dir=args.flight_dir, audit=audit,
                        unified=args.unified, fault_plan=fault_plan,
-                       alert_rules=alert_rules)
+                       alert_rules=alert_rules, aot=aot)
     supervisor = None
     if args.max_restarts > 0:
         # self-healing by default (ISSUE 12): dead replicas restart
@@ -1332,6 +1379,26 @@ def main(argv=None) -> int:
                         "(one packed prefill+decode launch per engine "
                         "step, collapsed bucket set; at mp>1 the Pallas "
                         "fast path runs mesh-spanning via shard_map)")
+    p.add_argument("--aot-save", default=None, metavar="DIR",
+                   help="enumerate + jax.export the full bucketed "
+                        "program set of the configured engine "
+                        "(--layers/--blocks/--unified/--mp) into an AOT "
+                        "artifact directory (manifest + StableHLO), "
+                        "then exit — the compile-once build step of "
+                        "ISSUE 15")
+    p.add_argument("--aot-path", default=None, metavar="DIR",
+                   help="serve from a saved AOT artifact: every replica "
+                        "(and every supervisor rebuild) shares one "
+                        "loaded program set and the engines trace "
+                        "NOTHING (manifest mismatches fail loudly at "
+                        "boot; composes with --selftest, which then "
+                        "asserts zero traces)")
+    p.add_argument("--aot-max-seq", type=int, default=128, metavar="T",
+                   help="--aot-save: bound the saved bucket universe to "
+                        "sequences of at most T tokens (default 128; "
+                        "the pool capacity caps it either way — a "
+                        "serving step past the bound fails loudly "
+                        "instead of retracing)")
     p.add_argument("--selftest", action="store_true",
                    help="boot on an ephemeral port, serve one completion "
                         "against the toy fleet through the router path, "
@@ -1351,10 +1418,22 @@ def main(argv=None) -> int:
         from ..distributed import topology
 
         topology.init_mesh(mp=args.mp)
+    if args.aot_save:
+        if args.aot_max_seq < 1:
+            p.error(f"--aot-max-seq must be >= 1, got {args.aot_max_seq}")
+        from .aot import AotArtifact
+
+        eng = _toy_engine(layers=args.layers, num_blocks=args.blocks,
+                          unified=args.unified)
+        art = AotArtifact.save(eng, args.aot_save,
+                               max_seq_len=args.aot_max_seq)
+        print("aot-save: " + json.dumps(art.describe(), indent=1))
+        return 0
     if args.selftest:
         return asyncio.run(_selftest_async(
             dp=args.dp, audit_sample=args.audit_sample or 1,
-            unified=args.unified))
+            unified=args.unified, aot_path=args.aot_path,
+            layers=args.layers, blocks=args.blocks))
     return asyncio.run(_serve_cli(args))
 
 

@@ -74,6 +74,15 @@ CHECKS: Tuple[Tuple[str, str, float, float], ...] = (
     # chaos phase: self-healing must stay lossless and not collapse
     ("chaos.requests_lost",              "count_max", 0.0, 0.0),
     ("chaos.chaos_tokens_per_sec",       "higher",    0.5, 0.0),
+    # aot phase (ISSUE 15): the zero-trace contract is EXACT — one
+    # trace on an AOT engine (cold or supervisor-rebuilt) IS the
+    # regression — and the AOT cold boot must keep beating a traced
+    # rebuild (wall ceiling wide for CPU noise; the structural collapse
+    # it catches is "AOT silently started retracing")
+    ("aot.aot_trace_count",              "count_max", 0.0, 0.0),
+    ("aot.restart.aot_rebuilt_traces",   "count_max", 0.0, 0.0),
+    ("aot.aot_cold_wall_s",              "lower",     1.0, 0.0),
+    ("aot.aot_tokens_per_sec",           "higher",    0.5, 0.0),
 )
 
 

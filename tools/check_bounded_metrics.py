@@ -79,6 +79,10 @@ SCAN_FILES = (
     # stay bounded even if the modules move out of the serving dir
     os.path.join(_REPO, "paddle_tpu", "serving", "resilience.py"),
     os.path.join(_REPO, "paddle_tpu", "serving", "faultinject.py"),
+    # ISSUE 15: the AOT artifact's program map is bounded by the saved
+    # manifest (enumerate_buckets is a finite lattice); pinned so the
+    # loaded-Exported cache stays covered if the module moves
+    os.path.join(_REPO, "paddle_tpu", "serving", "aot.py"),
     os.path.join(_REPO, "paddle_tpu", "ops", "paged_attention.py"),
     os.path.join(_REPO, "paddle_tpu", "ops", "pallas_paged.py"),
     # ISSUE 11: the unified ragged kernel sits on the serving hot path

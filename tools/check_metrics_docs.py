@@ -37,6 +37,9 @@ DECLARING_MODULES = (
     os.path.join(_REPO, "paddle_tpu", "serving", "server.py"),
     os.path.join(_REPO, "paddle_tpu", "serving", "resilience.py"),
     os.path.join(_REPO, "paddle_tpu", "serving", "faultinject.py"),
+    # ISSUE 15: serving/aot.py owns the serving_aot_* names (the
+    # StepProfiler registers them once an artifact is bound)
+    os.path.join(_REPO, "paddle_tpu", "serving", "aot.py"),
     os.path.join(_REPO, "paddle_tpu", "observability", "lifecycle.py"),
     os.path.join(_REPO, "paddle_tpu", "observability", "flight.py"),
     os.path.join(_REPO, "paddle_tpu", "observability", "push.py"),

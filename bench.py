@@ -1509,10 +1509,202 @@ def serving_aot_bench() -> dict:
     return result
 
 
+def serving_procfleet_bench() -> dict:
+    """Cross-process fleet chaos phase (ISSUE 16): the shared-prefix
+    stream through a dp=2 fleet of WORKER PROCESSES (``python -m
+    paddle_tpu.serving.worker`` over the wire protocol), supervised,
+    every worker booted zero-trace off ONE shared AOT artifact — then
+    the same stream with worker 0 ``kill -9``-ed mid-stream.  Asserts
+    ZERO lost requests, greedy token identity with the fault-free run,
+    exactly one ``engine_death`` flight trigger and one worker respawn
+    (onto the SAME artifact, still zero traces); records the service
+    restoration wall (kill → respawned worker healthy, a full process
+    boot included).  Also measures the ``--aot-warm`` satellite: a
+    warm-booted worker's first completion must beat a cold one's
+    (the cold first wave pays the lazy program compiles)."""
+    import signal as _signal
+    import tempfile
+
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.serving import (
+        AotArtifact,
+        EngineConfig,
+        EngineCore,
+        ProcessFleet,
+        ProcessFleetConfig,
+        SamplingParams,
+        SchedulerConfig,
+        SupervisorConfig,
+    )
+    from paddle_tpu.serving.wire import dump_registry
+
+    def _csum(registry, name, **match) -> float:
+        total = 0.0
+        for row in dump_registry(registry):
+            if row["name"] != name:
+                continue
+            lbls = dict(row["labels"])
+            if all(lbls.get(k) == v for k, v in match.items()):
+                total += row.get("value", 0.0)
+        return total
+
+    rng = np.random.default_rng(0)
+    prefix = rng.integers(0, 256, 8).tolist()
+    prompts = [prefix + rng.integers(0, 256, 4).tolist()
+               for _ in range(6)]
+
+    # ONE artifact on disk, shared by every worker boot AND respawn —
+    # saved by an engine with the exact worker engine shape
+    tmp = tempfile.mkdtemp(prefix="paddle_tpu_procfleet_bench_")
+    aot_dir = os.path.join(tmp, "aot")
+    paddle.seed(0)
+    model = LlamaForCausalLM(LlamaConfig.tiny(num_hidden_layers=2))
+    eng = EngineCore(model, config=EngineConfig(
+        num_blocks=32, block_size=4,
+        scheduler=SchedulerConfig(max_num_seqs=4,
+                                  max_prefill_tokens_per_step=8)))
+    art = AotArtifact.save(eng, aot_dir, max_seq_len=32)
+    aot_programs = art.program_count
+    del eng, model, art
+
+    def cfg(dp: int, warm: bool = False) -> ProcessFleetConfig:
+        return ProcessFleetConfig(
+            dp=dp, layers=2, num_blocks=32, block_size=4,
+            max_num_seqs=4, max_prefill_tokens_per_step=8,
+            aot_path=aot_dir, warm_boot=warm)
+
+    def run(kill: bool) -> dict:
+        fleet = ProcessFleet(cfg(dp=2))
+        fleet.supervise(SupervisorConfig(
+            backoff_initial_s=0.02, backoff_max_s=0.5,
+            poll_interval_s=0.01))
+        fleet.start()
+        router = fleet.router
+        t0 = time.perf_counter()
+        hs = [router.submit_request(p, SamplingParams(max_new_tokens=12),
+                                    request_id=f"pf-{i}",
+                                    retryable=True)
+              for i, p in enumerate(prompts)]
+        restoration = None
+        t_kill = None
+        victim = 0
+        if kill:
+            time.sleep(0.15)
+            # kill the replica that OWNS the stream (the shared prefix
+            # is one affinity key, so one replica holds every request)
+            victim = next((r.index for r in router.replicas
+                           if r.in_flight), 0)
+            victim_pid = fleet.worker_pid(victim)
+            t_kill = time.perf_counter()
+            os.kill(victim_pid, _signal.SIGKILL)
+        router.wait(hs, timeout=300)
+        wall = time.perf_counter() - t0
+        lost = [h.rid for h in hs if h.finish_reason != "length"]
+        assert not lost, f"requests lost under process chaos: {lost}"
+        traces = None
+        if kill:
+            # full service restoration: kill -> dead-worker detection ->
+            # supervisor rebuild through the process factory -> fresh
+            # worker booted off the SHARED artifact and healthy again
+            deadline = time.perf_counter() + 120
+            while time.perf_counter() < deadline:
+                if (all(r.healthy for r in router.replicas)
+                        and fleet.worker_pid(victim) != victim_pid):
+                    break
+                time.sleep(0.02)
+            assert all(r.healthy for r in router.replicas), \
+                "fleet did not heal after kill -9"
+            restoration = time.perf_counter() - t_kill
+            desc = fleet.proxy(victim).debug_fetch("describe")
+            assert desc is not None, "respawned worker not reachable"
+            traces = desc["traces"]
+            assert sum(traces.values()) == 0, \
+                f"respawned worker traced programs: {traces}"
+        gen = sum(len(h.output_tokens) for h in hs)
+        rec = {
+            "wall_s": round(wall, 4),
+            "tokens_per_sec": round(gen / wall, 2),
+            "generated_tokens": gen,
+            "engine_death_dumps": int(_csum(
+                router.registry, "serving_flight_dumps_total",
+                trigger="engine_death")),
+            "respawns": int(_csum(
+                router.registry,
+                "serving_fleet_worker_respawns_total")),
+            "heartbeat_timeouts": int(_csum(
+                router.registry,
+                "serving_fleet_heartbeat_timeouts_total")),
+            "restoration_wall_s": (None if restoration is None
+                                   else round(restoration, 4)),
+            "respawned_worker_traces": traces,
+            "outputs": [list(h.output_tokens) for h in hs],
+        }
+        fleet.stop()
+        return rec
+
+    def first_wave(warm: bool) -> dict:
+        fleet = ProcessFleet(cfg(dp=1, warm=warm))
+        fleet.start()
+        t0 = time.perf_counter()
+        h = fleet.router.submit_request(
+            prompts[0], SamplingParams(max_new_tokens=4),
+            request_id="wave-0")
+        fleet.router.wait([h], timeout=300)
+        wave_s = time.perf_counter() - t0
+        rec = {
+            "first_wave_s": round(wave_s, 4),
+            "boot_s": round(fleet.proxy(0).worker.boot_s, 4),
+            "aot_warm_seconds": _csum(
+                fleet.registry, "serving_aot_warm_seconds") or None,
+        }
+        fleet.stop()
+        return rec
+
+    clean = run(kill=False)
+    chaos = run(kill=True)
+    cold = first_wave(warm=False)
+    warm = first_wave(warm=True)
+    identical = chaos["outputs"] == clean["outputs"]
+    result = {
+        "metric": "serving_procfleet_restoration_wall_seconds",
+        "value": chaos["restoration_wall_s"], "unit": "s",
+        "phase": "serving_procfleet",
+        "requests_lost": 0,
+        "greedy_token_identical": identical,
+        "engine_death_bundles": chaos["engine_death_dumps"],
+        "worker_respawns": chaos["respawns"],
+        "restoration_wall_s": chaos["restoration_wall_s"],
+        "procfleet_tokens_per_sec": chaos["tokens_per_sec"],
+        "clean_tokens_per_sec": clean["tokens_per_sec"],
+        "aot_programs": aot_programs,
+        "warm_boot": {"cold": cold, "warm": warm},
+        "clean": clean, "chaos": chaos,
+    }
+    assert identical, \
+        "process-chaos output diverged from the fault-free run"
+    assert chaos["engine_death_dumps"] == 1, chaos
+    assert chaos["respawns"] == 1, chaos
+    assert clean["engine_death_dumps"] == 0, clean
+    # the --aot-warm satellite, measured: a warm-booted worker serves
+    # its first completion without the lazy compile bill
+    assert warm["first_wave_s"] < cold["first_wave_s"], (
+        f"warm first wave not faster: {warm['first_wave_s']} vs "
+        f"{cold['first_wave_s']}")
+    assert warm["aot_warm_seconds"], warm
+    import shutil
+
+    shutil.rmtree(tmp, ignore_errors=True)
+    return result
+
+
 def serving_main() -> dict:
     """``--serving``: shared-prefix + tensor-parallel + fleet +
     numerics-audit + unified-ragged + self-healing-chaos + AOT-artifact
-    phases, combined into one ``BENCH_SERVING.json`` record."""
+    + cross-process-fleet phases, combined into one
+    ``BENCH_SERVING.json`` record."""
     # must precede the FIRST jax import in this process: the mp phase
     # needs ≥2 host devices.  A pre-set count <2 (e.g. =1 exported for
     # single-device debugging) is raised, not trusted — otherwise
@@ -1554,6 +1746,10 @@ def serving_main() -> dict:
         # checkpoint before the aot phase for the same reason
         json.dump(result, f, indent=1)
     result["aot"] = serving_aot_bench()
+    with open(path, "w") as f:
+        # checkpoint before the cross-process phase for the same reason
+        json.dump(result, f, indent=1)
+    result["procfleet"] = serving_procfleet_bench()
     with open(path, "w") as f:
         json.dump(result, f, indent=1)
     # bench perf-regression gate (ISSUE 14): diff this run against the

@@ -61,8 +61,25 @@ from .fleet import (  # noqa: F401
     FleetSaturated,
     SubmitHandle,
 )
+from .procfleet import (  # noqa: F401
+    AutoscalerConfig,
+    CacheRebalancer,
+    FleetAutoscaler,
+    ProcessFleet,
+    ProcessFleetConfig,
+    RebalancerConfig,
+    ScaleDecider,
+    WorkerDied,
+)
 from .resilience import FleetSupervisor, SupervisorConfig  # noqa: F401
 from .kv_manager import KVCacheManager, PoolExhausted  # noqa: F401
+from .wire import (  # noqa: F401
+    ConnectionClosed,
+    FrameError,
+    HandshakeMismatch,
+    RegistryMerger,
+    WireError,
+)
 from .metrics import ServingMetrics  # noqa: F401
 from .protocol import (  # noqa: F401
     CompletionRequest,

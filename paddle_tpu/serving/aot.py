@@ -76,6 +76,9 @@ _PROGRAM_DIR = "programs"
 METRIC_NAMES = (
     "serving_aot_hits_total",
     "serving_aot_load_seconds",
+    # ISSUE 16: wall seconds spent executing every saved program once
+    # (--aot-warm at save time / --warm at worker boot)
+    "serving_aot_warm_seconds",
 )
 
 
@@ -554,3 +557,30 @@ class AotArtifact:
             for x, aval in zip(flat, avals)]
         out = exported.call(*jax.tree_util.tree_unflatten(tree, coerced))
         return out[0], out[1], tuple(out[2]), tuple(out[3])
+
+    def warm(self, registry=None, labels: Optional[Dict] = None) -> float:
+        """Execute every saved program once with zero-filled arguments of
+        the exported shapes (ISSUE 16 warm-boot satellite).  Exported
+        programs compile lazily on first ``call`` — warming moves that
+        cost from the first request wave to boot/save time, and (because
+        this IS the serving-time ``Exported.call`` path, not a jit
+        re-wrap) the XLA executables land in the persistent compilation
+        cache under the exact keys serving will look up.  Returns the
+        wall seconds spent; recorded as ``serving_aot_warm_seconds``
+        when a ``registry`` is given."""
+        t0 = time.perf_counter()
+        for key, exported in sorted(self._programs.items()):
+            flat = [np.zeros(a.shape, a.dtype) for a in exported.in_avals]
+            args, kwargs = jax.tree_util.tree_unflatten(
+                exported.in_tree, flat)
+            out = exported.call(*args, **kwargs)
+            for leaf in jax.tree_util.tree_leaves(out):
+                if hasattr(leaf, "block_until_ready"):
+                    leaf.block_until_ready()
+        wall = time.perf_counter() - t0
+        if registry is not None:
+            registry.gauge(
+                "serving_aot_warm_seconds",
+                "wall seconds executing every saved AOT program once "
+                "(warm boot/save)", **(labels or {})).set(wall)
+        return wall

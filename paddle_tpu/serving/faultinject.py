@@ -255,6 +255,16 @@ class FaultInjector:
         flat[0] = -flat[0]
         return out
 
+    def mark_fired(self, indexes) -> None:
+        """Record plan ``indexes`` as already consumed WITHOUT counting
+        an injection (ISSUE 16): when a worker process is respawned, the
+        router transfers the previous incarnation's fired set into the
+        fresh worker's injector so each plan entry still fires exactly
+        once per chaos run — across process incarnations, not just
+        engine rebuilds."""
+        with self._lock:
+            self._fired.update(int(i) for i in indexes)
+
     # --- inspection ---------------------------------------------------------
     @property
     def fired_count(self) -> int:

@@ -166,15 +166,24 @@ class FleetConfig:
     alert_rules: Optional[AlertRuleSet] = None
 
 
-def _build_ring(dp: int, vnodes: int) -> List:
+def _build_ring(dp: int, vnodes: int,
+                weights: Optional[Dict[int, float]] = None) -> List:
     """Consistent-hash ring: ``vnodes`` points per replica, sorted by
-    the 64-bit prefix of each vnode's SHA-256."""
+    the 64-bit prefix of each vnode's SHA-256.  ``weights`` (ISSUE 16,
+    the cache-aware rebalancing actuator) scales a replica's vnode count
+    — weight 2.0 doubles the key space routed to it, 0.5 halves it;
+    every replica keeps at least one vnode so it never silently leaves
+    the ring.  Vnode hashes depend only on ``(replica, j)``, so
+    reweighting MOVES no surviving vnode: only the added/removed points
+    remap keys."""
+    weights = weights or {}
     return sorted(
         (int.from_bytes(hashlib.sha256(
             f"paddle_tpu.fleet.replica.{i}.{j}".encode()).digest()[:8],
             "big"), i)
         for i in range(dp)
-        for j in range(max(1, vnodes)))
+        for j in range(max(1, int(round(max(1, vnodes)
+                                        * weights.get(i, 1.0))))))
 
 
 def _key_int(hashes: List[bytes]) -> int:
@@ -1123,6 +1132,19 @@ class FleetRouter:
         if not vals:
             return None
         return max(vals) - min(vals)
+
+    def reweight_ring(self, weights: Dict[int, float]) -> None:
+        """Rebuild the consistent-hash ring with per-replica vnode
+        weights (ISSUE 16: the cache-aware rebalancing actuator turns
+        the ``serving_fleet_cache_imbalance`` signal into routing
+        pressure — a cold replica gets more vnodes so affinity keys
+        migrate toward it).  Taken under the submit lock so no router
+        thread ever walks a half-swapped ring; in-flight requests keep
+        their placement (affinity only guides NEW admissions)."""
+        with self._submit_lock:
+            self._ring = _build_ring(len(self.replicas), self.cfg.vnodes,
+                                     weights)
+            self._ring_keys = [k for k, _ in self._ring]
 
     def sample_gauges(self) -> None:
         """Refresh the serving_fleet_* gauges from replica state (the

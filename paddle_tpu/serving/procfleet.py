@@ -1,0 +1,1266 @@
+"""Cross-process serving fleet (ISSUE 16 tentpole (c) + (d)).
+
+The in-process fleet's router and supervisor (PRs 6/12) already contain
+the hard parts of a serving control plane — prefix-affinity routing,
+atomic handle-ownership triage, backoff/quarantine healing, exactly-once
+chaos bookkeeping.  This module makes them run over PROCESS-isolated
+replicas **without forking any of that logic**: the factory handed to
+:meth:`FleetRouter.build` returns a :class:`WorkerEngineProxy` that
+presents the exact ``EngineCore`` surface the router, the supervisor,
+and the stock :class:`~paddle_tpu.serving.fleet.EngineReplica` loop
+drive — but every call crosses the wire (``serving/wire.py``) to a
+``python -m paddle_tpu.serving.worker`` process.
+
+The translation table:
+
+============================  =========================================
+in-process mechanism           cross-process equivalent
+============================  =========================================
+engine construction            worker process spawn (``--aot-path``
+                               boots zero-trace off the SHARED artifact)
+``engine_step_raise``          worker reports ``step_error`` and exits;
+                               ``kill -9`` produces the same death shape
+thread-liveness                heartbeat timeout on the control
+                               connection (``scheduler.has_work()``
+                               raises :class:`WorkerDied` once marked)
+shared-registry metrics        per-step worker registry dump, merged
+                               under the existing ``replica="i"`` labels
+supervisor ``_rebuild``        same code path: the factory closes the
+                               old proxy (killing its process) and
+                               spawns a replacement worker
+============================  =========================================
+
+Because the supervisor's triage/rebuild state machine is untouched, the
+PR 11 chaos contract transfers: ``kill -9`` a worker mid-stream →
+reroute, respawn onto the shared artifact, zero lost requests, greedy
+token identity, exactly one ``engine_death`` flight bundle.
+
+Tentpole (d), the actuator layer the ROADMAP names: the signals
+(``serving_fleet_cache_imbalance``, PR 12) and the rule engine (PR 13)
+were DONE — this module adds what acts on them.
+:class:`FleetAutoscaler` maps AlertEngine rule firings (goodput burn,
+pool exhaustion, restart churn) to bounded scale-up/drain actions on the
+process pool via a pure, replay-deterministic :class:`ScaleDecider`;
+:class:`CacheRebalancer` turns the imbalance gauge into consistent-hash
+vnode re-weighting (:meth:`FleetRouter.reweight_ring`).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import socket
+import subprocess
+import sys
+import threading
+import traceback
+from collections import deque
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from ..observability import lifecycle as _lc
+from ..observability.audit import AuditConfig
+from ..observability.metrics import MetricsRegistry
+from . import wire
+from .engine import EngineConfig
+from .fleet import EngineReplica, FleetConfig, FleetRouter
+from .metrics import ServingMetrics
+from .request import FinishReason, SamplingParams
+from .resilience import FleetSupervisor, SupervisorConfig
+from .wire import CACHE_PREFIX, READY_PREFIX
+
+# metric names this module owns (tools/check_metrics_docs lints that
+# each appears in README's metrics table)
+METRIC_NAMES = (
+    "serving_fleet_scale_events_total",
+    "serving_fleet_worker_respawns_total",
+    "serving_fleet_heartbeat_timeouts_total",
+    "serving_fleet_ring_reweights_total",
+    "serving_fleet_active_workers",
+)
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+
+class WorkerDied(RuntimeError):
+    """The replica's worker process is gone (socket death, heartbeat
+    timeout, reported step failure, or kill -9).  Raised into the stock
+    ``EngineReplica`` loop so the EXISTING death path runs: flight
+    bundle, supervisor triage, re-dispatch, respawn."""
+
+
+class _MirrorRequest:
+    """Router-side mirror of one in-flight request on a worker: the
+    object :meth:`WorkerEngineProxy.add_request` returns, presenting the
+    fields the replica loop, the supervisor's triage
+    (``req.output_tokens`` emptiness = re-dispatchable) and the HTTP
+    handle surface read.  Token frames append to ``output_tokens``;
+    ``step_done``'s finished map closes it."""
+
+    __slots__ = ("request_id", "prompt_ids", "output_tokens", "finished",
+                 "finish_reason")
+
+    def __init__(self, request_id, prompt_ids: List[int]):
+        self.request_id = request_id
+        self.prompt_ids = list(prompt_ids)
+        self.output_tokens: List[int] = []
+        self.finished = False
+        self.finish_reason: Optional[FinishReason] = None
+
+
+class AotManifestHandle:
+    """Manifest-only stand-in for a loaded AOT artifact, shared by every
+    proxy.  The router process never deserializes the programs (only the
+    workers execute them); it needs just (a) ONE object identity so the
+    fleet's same-artifact gate holds across proxies, and (b) the
+    ``model_hash`` the wire handshake pins — a router and a worker
+    booted off different artifacts refuse each other at connect time."""
+
+    def __init__(self, path: str, manifest: Dict):
+        self.path = path
+        self.manifest = manifest
+        self.load_seconds = 0.0
+
+    @classmethod
+    def load(cls, path: str) -> "AotManifestHandle":
+        with open(os.path.join(path, "manifest.json")) as f:
+            return cls(path, json.load(f))
+
+    @property
+    def model_hash(self) -> str:
+        return self.manifest["model_hash"]
+
+    @property
+    def program_count(self) -> int:
+        return len(self.manifest.get("programs", []))
+
+    def mark_load_observed(self, registry) -> bool:
+        return False  # no disk load happened router-side
+
+    def describe(self) -> Dict:
+        m = self.manifest
+        return {
+            "path": self.path, "programs": self.program_count,
+            "mp": m.get("mp"), "dtype": m.get("dtype"),
+            "num_blocks": m.get("num_blocks"),
+            "block_size": m.get("block_size"),
+            "max_seq_len": m.get("max_seq_len"),
+            "model_hash": str(m.get("model_hash", ""))[:16],
+            "jax_version": m.get("jax_version"),
+            "load_seconds": 0.0,
+        }
+
+
+@dataclass
+class ProcessFleetConfig:
+    """Knobs for a process-isolated fleet.  Engine-shape fields mirror
+    the toy-engine factory in ``serving/server.py`` — the SAME spec is
+    sent to every worker (``--spec``) and templates the proxies' gate
+    attributes, so the router's homogeneity gates hold by construction."""
+
+    dp: int = 2
+    layers: int = 2
+    num_blocks: int = 64
+    block_size: int = 4
+    max_num_seqs: int = 4
+    max_prefill_tokens_per_step: Optional[int] = 8
+    unified: bool = False
+    audit_enabled: bool = False
+    audit_sample_every: int = 1
+    seed: int = 0
+    aot_path: Optional[str] = None     # shared artifact every worker
+                                       # boots from (zero-trace, PR 14)
+    compile_cache: Optional[str] = None  # JAX persistent compilation
+    # cache dir: N sibling workers compile each program once machine-wide
+    warm_boot: bool = False            # workers execute every AOT
+    # program once at boot (first request wave pays zero lazy compiles)
+    heartbeat_interval_s: float = 0.25
+    heartbeat_timeout_s: float = 2.0   # silent control conn -> dead
+    boot_timeout_s: float = 180.0
+    python: str = sys.executable
+    fleet: Optional[FleetConfig] = None  # router knobs (fault plan,
+                                         # alert rules, flight dir, ...)
+
+
+class WorkerHandle:
+    """One spawned worker process: ready-line parse, log pump, teardown.
+
+    The worker prints ``PADDLE_TPU_WORKER_READY port=...`` once
+    listening; everything before it is boot logging (captured — the
+    compile-cache line in particular is how the cross-process
+    compile-reuse satellite observes a sibling's cache hits)."""
+
+    def __init__(self, proc: subprocess.Popen, index: int):
+        self.proc = proc
+        self.index = index
+        self.pid = proc.pid
+        self.port: Optional[int] = None
+        self.aot_hash: Optional[str] = None
+        self.boot_s = 0.0
+        self.compile_cache: Optional[Dict] = None  # parsed cache line
+        self.log_tail: deque = deque(maxlen=200)
+        self._pump: Optional[threading.Thread] = None
+
+    @classmethod
+    def spawn(cls, cfg: ProcessFleetConfig, index: int,
+              spec: Dict) -> "WorkerHandle":
+        cmd = [cfg.python, "-m", "paddle_tpu.serving.worker",
+               "--replica", str(index), "--spec", json.dumps(spec)]
+        if cfg.aot_path:
+            cmd += ["--aot-path", cfg.aot_path]
+        if cfg.compile_cache:
+            cmd += ["--compile-cache", cfg.compile_cache]
+        if cfg.warm_boot:
+            cmd += ["--warm"]
+        env = dict(os.environ)
+        env["PYTHONPATH"] = _REPO_ROOT + (
+            os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH")
+            else "")
+        proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                                stderr=subprocess.STDOUT, text=True,
+                                env=env)
+        h = cls(proc, index)
+        # readline has no timeout: a watchdog timer kills a hung boot so
+        # the read loop sees EOF instead of blocking forever
+        killer = threading.Timer(cfg.boot_timeout_s, h._boot_timeout)
+        killer.daemon = True
+        killer.start()
+        try:
+            for line in proc.stdout:
+                line = line.rstrip("\n")
+                h.log_tail.append(line)
+                if line.startswith(CACHE_PREFIX):
+                    kv = dict(p.split("=", 1) for p in line.split()[1:])
+                    h.compile_cache = {
+                        "dir": kv.get("dir"),
+                        "entries_before": int(kv.get("entries_before", 0)),
+                        "entries_after": int(kv.get("entries_after", 0)),
+                    }
+                elif line.startswith(READY_PREFIX):
+                    kv = dict(p.split("=", 1) for p in line.split()[1:])
+                    h.port = int(kv["port"])
+                    h.aot_hash = (None if kv.get("aot_hash") in
+                                  (None, "None") else kv["aot_hash"])
+                    h.boot_s = float(kv.get("boot_s", 0.0))
+                    break
+        finally:
+            killer.cancel()
+        if h.port is None:
+            h.stop(grace_s=0.5)
+            tail = "\n".join(h.log_tail)
+            raise WorkerDied(
+                f"worker {index} (pid {h.pid}) exited/hung before its "
+                f"ready line; log tail:\n{tail}")
+        h._pump = threading.Thread(target=h._pump_output, daemon=True,
+                                   name=f"worker-log-{index}")
+        h._pump.start()
+        return h
+
+    def _boot_timeout(self) -> None:
+        try:
+            self.proc.kill()
+        except OSError:
+            pass  # swallow-ok: the worker already exited; the read loop sees EOF either way
+
+    def _pump_output(self) -> None:
+        try:
+            for line in self.proc.stdout:
+                self.log_tail.append(line.rstrip("\n"))
+        except (OSError, ValueError):
+            pass  # swallow-ok: stdout closed during teardown; the tail captured what there was
+        finally:
+            try:
+                self.proc.stdout.close()
+            except OSError:
+                pass  # swallow-ok: double-close during teardown
+
+    @property
+    def alive(self) -> bool:
+        return self.proc.poll() is None
+
+    def stop(self, grace_s: float = 2.0) -> None:
+        """Terminate (SIGTERM, then SIGKILL past the grace)."""
+        if self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(grace_s)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                self.proc.wait(10)
+        if self._pump is not None:
+            self._pump.join(1.0)
+
+
+class _SchedulerProxy:
+    """The two members the replica loop and the fleet gauges read.
+    ``has_work`` doubles as the death surface: the replica loop polls it
+    every ≤20 ms, so raising here once the heartbeat marks the worker
+    dead routes an IDLE worker's death through the standard
+    engine-thread death path within one poll interval."""
+
+    def __init__(self, proxy: "WorkerEngineProxy"):
+        self._p = proxy
+
+    def has_work(self) -> bool:
+        p = self._p
+        if p._closed:
+            return False  # orderly teardown: let the loop drain out
+        if p._dead.is_set():
+            raise WorkerDied(
+                f"worker {p.index} (pid {p.pid}) is dead: "
+                f"{p._death_detail}")
+        return p._has_work
+
+    @property
+    def queue_depth(self) -> int:
+        return self._p._queue_depth
+
+
+class _KvProxy:
+    def __init__(self, proxy: "WorkerEngineProxy"):
+        self._p = proxy
+        self.num_blocks = proxy.num_blocks
+
+    def occupancy(self) -> float:
+        # cached from the last step reply: registry collect hooks call
+        # this and must NEVER block on the wire
+        return self._p._occupancy
+
+
+class _AuditProxy:
+    """Mirrors the ``NumericsAuditor`` surface the router/supervisor/
+    HTTP layers read.  ``cfg`` is the fleet-shared template (the
+    router's same-config gate compares these by value); ``degraded`` is
+    cached from step replies so the supervisor's quarantine scan stays
+    wire-free; ``snapshot`` fetches live detail over the control
+    connection."""
+
+    def __init__(self, proxy: "WorkerEngineProxy", cfg: AuditConfig):
+        self._p = proxy
+        self.cfg = cfg
+        self.enabled = bool(getattr(cfg, "enabled", False))
+        self._flight = None
+        self._flight_replica: Optional[str] = None
+
+    @property
+    def degraded(self) -> bool:
+        return self._p._degraded
+
+    @property
+    def status(self) -> str:
+        return "degraded" if self.degraded else "ok"
+
+    def snapshot(self) -> Dict:
+        data = self._p.debug_fetch("audit")
+        if not isinstance(data, dict):
+            return {"enabled": self.enabled, "status": "restarting"}
+        return data
+
+    def bind_flight(self, recorder, replica: Optional[str] = None) -> None:
+        # divergence .npz repros live worker-side; the binding is kept
+        # so the fleet wiring sequence is identical either way
+        self._flight = recorder
+        self._flight_replica = replica
+
+
+class _StepProfProxy:
+    def __init__(self, proxy: "WorkerEngineProxy"):
+        self._p = proxy
+        self.enabled = bool(proxy.engine_config.step_profile)
+        self.max_capture_steps = 512  # advertised bound; arm refuses
+
+    def records(self) -> List[Dict]:
+        data = self._p.debug_fetch("records", [])
+        return data if isinstance(data, list) else []
+
+    def compile_table(self) -> List[Dict]:
+        data = self._p.debug_fetch("compile_table", [])
+        return data if isinstance(data, list) else []
+
+    def compile_totals(self) -> Dict:
+        data = self._p.debug_fetch("compile_totals", {})
+        return data if isinstance(data, dict) else {}
+
+    def aot_snapshot(self) -> Dict:
+        data = self._p.debug_fetch("aot", {})
+        return data if isinstance(data, dict) else {}
+
+    def arm_capture(self, steps: int):
+        # RuntimeError -> HTTP 400 on /v1/debug/profile: a capture
+        # window needs the in-process profiler object
+        raise RuntimeError(
+            "step capture is not available over the process wire "
+            "(replica runs out-of-process); use an in-process fleet "
+            "(--dp without --workers) to capture traces")
+
+    def cancel_capture(self) -> None:
+        return None
+
+
+class _CacheStatProxy:
+    def __init__(self, proxy: "WorkerEngineProxy"):
+        self._p = proxy
+        self.enabled = bool(proxy.engine_config.cache_stats)
+
+    def snapshot(self) -> Dict:
+        data = self._p.debug_fetch("cache")
+        if not isinstance(data, dict):
+            return {"enabled": self.enabled, "status": "restarting"}
+        return data
+
+    def timeline(self) -> List[Dict]:
+        data = self._p.debug_fetch("cache_timeline", [])
+        return data if isinstance(data, list) else []
+
+
+class WorkerEngineProxy:
+    """The ``EngineCore`` surface, served by a worker process.
+
+    The stock :class:`~paddle_tpu.serving.fleet.EngineReplica` thread
+    drives ``add_request``/``abort_request``/``step``/``requests`` over
+    the dedicated *engine* connection (strictly serial — it is the only
+    user).  Heartbeats and HTTP debug handlers share the *control*
+    connection under a lock.  State the router reads on hot/collect
+    paths (``has_work``, ``queue_depth``, ``occupancy``, ``degraded``,
+    ``step_seq``) is cached from step replies — never fetched.
+
+    Metrics: ``metrics`` is a REAL :class:`ServingMetrics` on the shared
+    router registry under ``replica=str(index)`` labels (pre-registering
+    the full series family exactly like an in-process replica, which is
+    also what satisfies the router's distinct-labels gate).  Each
+    ``step_done`` carries the worker's full registry dump; a
+    :class:`~paddle_tpu.serving.wire.RegistryMerger` folds the
+    replica-labeled rows in delta-monotonically, so counters survive
+    worker respawns without regressing."""
+
+    def __init__(self, shared: "_SharedState", index: int,
+                 live: bool = True):
+        self._shared = shared
+        cfg = shared.cfg
+        self.index = index
+        # --- fleet-gate surface (shared template objects) -------------------
+        self.engine_config = shared.template_engine_cfg
+        self.block_size = cfg.block_size
+        self.num_blocks = cfg.num_blocks
+        self.mp = 1
+        self.metrics = ServingMetrics(registry=shared.registry,
+                                      labels={"replica": str(index)})
+        # host-side span tracer: the HTTP frontend wraps every request
+        # in `engine.tracer.span(...)` — those are frontend spans, so
+        # the proxy serves the host process tracer (the worker keeps
+        # its own engine tracer in-process)
+        self.tracer = self.metrics.tracer
+        self.audit = _AuditProxy(self, shared.template_audit)
+        self.aot_artifact = shared.aot_handle
+        self.stepprof = _StepProfProxy(self)
+        self.cachestat = _CacheStatProxy(self)
+        self.kv = _KvProxy(self)
+        self.scheduler = _SchedulerProxy(self)
+        self.requests: Dict[object, _MirrorRequest] = {}  # rid ->
+        # mirror; bounded by the replica admission cap, evicted on finish
+        self.lifecycle = None
+        self._replica_label = str(index)
+        self._history = None
+        self._router_fi = None
+        # --- cached worker state (updated from step replies) ----------------
+        self.step_seq = 0
+        self._has_work = False
+        self._queue_depth = 0
+        self._occupancy = 0.0
+        self._degraded = False
+        # --- process/wire state ---------------------------------------------
+        self.worker: Optional[WorkerHandle] = None
+        self.is_live = False     # a process was spawned (vs parked)
+        self._engine_conn: Optional[wire.Connection] = None
+        self._control_conn: Optional[wire.Connection] = None
+        self._control_lock = threading.RLock()
+        self._dead = threading.Event()
+        self._death_detail = ""
+        self._closed = False
+        self._merger: Optional[wire.RegistryMerger] = None
+        self._hb_thread: Optional[threading.Thread] = None
+        self._hb_fail_c = shared.registry.counter(
+            "serving_fleet_heartbeat_timeouts_total",
+            "worker heartbeats that failed/timed out, marking the "
+            "replica dead", replica=str(index))
+        if live:
+            self.spawn()
+
+    @property
+    def pid(self) -> Optional[int]:
+        return self.worker.pid if self.worker is not None else None
+
+    # --- process lifecycle --------------------------------------------------
+    def spawn(self) -> None:
+        shared = self._shared
+        cfg = shared.cfg
+        expect = (shared.aot_handle.model_hash
+                  if shared.aot_handle is not None else None)
+        self.worker = WorkerHandle.spawn(cfg, self.index,
+                                         shared.worker_spec())
+        if self.worker.aot_hash != expect:
+            got = self.worker.aot_hash
+            self.worker.stop(grace_s=0.5)
+            raise WorkerDied(
+                f"worker {self.index} booted artifact hash {got!r} but "
+                f"the fleet shares {expect!r} — artifact drift between "
+                "router and worker")
+        labels = {"replica": str(self.index)}
+        self._engine_conn = wire.connect(
+            "127.0.0.1", self.worker.port, role="engine",
+            aot_hash=expect, registry=shared.registry, labels=labels,
+            side="router")
+        self._control_conn = wire.connect(
+            "127.0.0.1", self.worker.port, role="control",
+            aot_hash=expect, registry=shared.registry, labels=labels,
+            side="router")
+        # fresh merger per incarnation: its delta baselines reset with
+        # the new worker's (zeroed) counters, so shared-registry totals
+        # only ever move forward across respawns
+        self._merger = wire.RegistryMerger(shared.registry,
+                                           str(self.index))
+        self.is_live = True
+        if self._router_fi is not None:
+            self._send_fault_plan()
+        self._hb_thread = threading.Thread(
+            target=self._hb_loop, daemon=True,
+            name=f"worker-heartbeat-{self.index}")
+        self._hb_thread.start()
+
+    def close(self, graceful: bool = True) -> None:
+        """Tear the worker down.  Idempotent; never raises."""
+        if self._closed:
+            return
+        self._closed = True
+        self._dead.set()  # stops the heartbeat; has_work answers False
+        if graceful and self._control_conn is not None \
+                and self.worker is not None and self.worker.alive:
+            try:
+                with self._control_lock:
+                    self._control_conn.settimeout(2.0)
+                    self._control_conn.request({"type": "shutdown"})
+            except (socket.timeout, OSError, wire.WireError):
+                pass  # swallow-ok: best-effort graceful stop; SIGTERM/SIGKILL below is the guarantee
+        for conn in (self._engine_conn, self._control_conn):
+            if conn is not None:
+                conn.close()
+        if self.worker is not None:
+            self.worker.stop()
+
+    def _mark_dead(self, detail: str) -> None:
+        if self._dead.is_set():
+            return
+        self._death_detail = detail
+        self._dead.set()
+        self._shared.update_gauge()
+
+    def _hb_loop(self) -> None:
+        cfg = self._shared.cfg
+        conn = self._control_conn
+        while not self._dead.is_set() and not self._closed:
+            try:
+                with self._control_lock:
+                    conn.settimeout(cfg.heartbeat_timeout_s)
+                    reply = conn.request({"type": "health"})
+                if reply.get("type") != "health_ok":
+                    raise WorkerDied(f"bad health reply: {reply!r}")
+            except (socket.timeout, wire.WireError, WorkerDied,
+                    OSError) as e:
+                if self._closed or self._dead.is_set():
+                    return
+                self._hb_fail_c.inc()
+                self._mark_dead(
+                    f"heartbeat failed after "
+                    f"{cfg.heartbeat_timeout_s}s: {e}")
+                return
+            self._dead.wait(cfg.heartbeat_interval_s)
+
+    def _require_live(self) -> None:
+        if self._dead.is_set() or self._engine_conn is None:
+            raise WorkerDied(
+                f"worker {self.index} is not serving "
+                f"({self._death_detail or 'never spawned (parked)'})")
+
+    # --- EngineCore surface: wiring hooks -----------------------------------
+    def set_lifecycle(self, tracker, replica: Optional[str] = None) -> None:
+        self.lifecycle = tracker
+        if replica is not None:
+            self._replica_label = str(replica)
+
+    def _lc(self, rid, name: str, **attrs) -> None:
+        if self.lifecycle is not None \
+                and self.engine_config.lifecycle_events:
+            self.lifecycle.event(rid, name, replica=self._replica_label,
+                                 **attrs)
+
+    def set_history(self, history) -> None:
+        if self.engine_config.history:
+            self._history = history
+
+    def set_fault_injector(self, injector) -> None:
+        self._router_fi = injector
+        if self.is_live and not self._dead.is_set():
+            self._send_fault_plan()
+
+    def _send_fault_plan(self) -> None:
+        fi = self._router_fi
+        frame: Dict = {"type": "set_fault", "plan": None}
+        if fi is not None:
+            frame["plan"] = fi.plan.to_obj()
+            # transfer the exactly-once bookkeeping: entries already
+            # fired by a previous incarnation must not re-fire in the
+            # respawned worker
+            frame["fired"] = fi.snapshot()["fired_plan_indexes"]
+        try:
+            with self._control_lock:
+                self._control_conn.settimeout(10.0)
+                reply = self._control_conn.request(frame)
+        except (socket.timeout, wire.WireError) as e:
+            self._mark_dead(f"fault-plan push failed: {e}")
+            raise WorkerDied(
+                f"worker {self.index} died during fault-plan push: {e}"
+            ) from e
+        if reply.get("type") != "ok":
+            raise WorkerDied(
+                f"worker {self.index} rejected the fault plan: {reply!r}")
+
+    def bind_aot(self, artifact, record_load: bool = False) -> None:
+        from .aot import AotError
+
+        if artifact is self.aot_artifact:
+            return
+        raise AotError(
+            "a process fleet shares ONE manifest handle; rebinding a "
+            "different artifact object onto a worker proxy is always "
+            "router/worker drift")
+
+    # --- EngineCore surface: request path (engine thread only) --------------
+    def add_request(self, prompt_ids, sampling: Optional[SamplingParams]
+                    = None, request_id=None, priority: int = 0,
+                    trace_id: Optional[str] = None, prefix_hashes=None,
+                    slo_ms: Optional[float] = None) -> _MirrorRequest:
+        self._require_live()
+        sp = sampling if sampling is not None else SamplingParams()
+        frame = {
+            "type": "submit", "rid": request_id,
+            "prompt_ids": [int(t) for t in prompt_ids],
+            "sampling": {
+                "max_new_tokens": sp.max_new_tokens,
+                "temperature": sp.temperature, "top_k": sp.top_k,
+                "eos_token_id": sp.eos_token_id, "seed": sp.seed},
+            "priority": priority, "trace_id": trace_id,
+            "prefix_hashes": ([h.hex() for h in prefix_hashes]
+                              if prefix_hashes else None),
+            "slo_ms": slo_ms,
+        }
+        try:
+            reply = self._engine_conn.request(frame)
+        except wire.WireError as e:
+            self._mark_dead(f"submit failed: {e}")
+            raise WorkerDied(
+                f"worker {self.index} died during submit: {e}") from e
+        if reply.get("type") != "submit_ok":
+            self._mark_dead(f"submit rejected: {reply!r}")
+            raise WorkerDied(
+                f"worker {self.index} refused submit: {reply!r}")
+        mirror = _MirrorRequest(request_id, frame["prompt_ids"])
+        self.requests[request_id] = mirror
+        self._has_work = True
+        self._lc(request_id, _lc.EV_ENQUEUED, trace_id=trace_id,
+                 prompt_tokens=len(mirror.prompt_ids))
+        return mirror
+
+    def abort_request(self, request_id,
+                      reason: FinishReason = FinishReason.ABORT) -> bool:
+        m = self.requests.get(request_id)
+        if m is None:
+            return False
+        ok = True
+        if not self._dead.is_set() and self._engine_conn is not None:
+            try:
+                reply = self._engine_conn.request(
+                    {"type": "abort", "rid": request_id,
+                     "reason": reason.value})
+                ok = bool(reply.get("ok"))
+            except wire.WireError as e:
+                # dead worker: the request dies with it — finish the
+                # mirror locally so no handle waits on a ghost
+                self._mark_dead(f"abort failed: {e}")
+        if ok:
+            m.finished = True
+            m.finish_reason = reason
+            self.requests.pop(request_id, None)
+            self._lc(request_id, _lc.EV_FINISH, reason=reason.value)
+        return ok
+
+    def step(self) -> Dict:
+        """One worker engine step: stream in the token frames, absorb
+        the ``step_done`` state + metrics dump, tick the shared history.
+        Any wire failure or worker-reported step error surfaces as
+        :class:`WorkerDied` — the stock replica death path."""
+        self._require_live()
+        conn = self._engine_conn
+        try:
+            conn.send({"type": "step"})
+            while True:
+                frame = conn.recv()
+                t = frame.get("type")
+                if t == "token":
+                    m = self.requests.get(frame["rid"])
+                    if m is not None:
+                        m.output_tokens.append(int(frame["token"]))
+                elif t == "step_done":
+                    self._absorb_step(frame)
+                    if frame.get("stepped") and self._history is not None:
+                        self._history.on_step(self.step_seq)
+                    return {}
+                elif t == "step_error":
+                    # the worker reported its own engine failure (e.g.
+                    # an injected engine_step_raise) and is exiting;
+                    # absorb the final metrics/fired bookkeeping first
+                    self._absorb_metrics(frame)
+                    self._mark_dead("worker engine step failed")
+                    raise WorkerDied(
+                        f"worker {self.index} engine step failed:\n"
+                        f"{frame.get('error', '')}")
+                else:
+                    self._mark_dead(
+                        f"protocol desync mid-step: {t!r}")
+                    raise WorkerDied(
+                        f"worker {self.index} protocol desync: got "
+                        f"{t!r} during a step")
+        except wire.WireError as e:
+            # includes the kill -9 signature: EOF mid-frame (truncated)
+            self._mark_dead(f"step wire failure: {e}")
+            raise WorkerDied(
+                f"worker {self.index} (pid {self.pid}) died mid-step: "
+                f"{e}") from e
+
+    def _absorb_metrics(self, frame: Dict) -> None:
+        rows = frame.get("metrics")
+        if rows and self._merger is not None:
+            self._merger.merge(rows)
+        fired = frame.get("fired") or []
+        if fired and self._router_fi is not None:
+            self._router_fi.mark_fired(fired)
+
+    def _absorb_step(self, frame: Dict) -> None:
+        self._absorb_metrics(frame)
+        self.step_seq = int(frame.get("step_seq", self.step_seq))
+        self._has_work = bool(frame.get("has_work", False))
+        self._queue_depth = int(frame.get("queue_depth", 0))
+        self._occupancy = float(frame.get("occupancy", 0.0))
+        self._degraded = bool(frame.get("degraded", False))
+        for rid, reason in (frame.get("finished") or {}).items():
+            m = self.requests.pop(rid, None)
+            if m is None:
+                continue
+            m.finish_reason = (FinishReason(reason) if reason else None)
+            m.finished = True
+            self._lc(rid, _lc.EV_FINISH, reason=reason,
+                     tokens=len(m.output_tokens))
+
+    # --- control-plane fetches (any thread) ---------------------------------
+    def debug_fetch(self, what: str, default=None):
+        """Fetch a debug snapshot over the control connection; returns
+        ``default`` when the worker is dead/parked (debug surfaces
+        degrade to 'restarting' rows instead of erroring)."""
+        if self._dead.is_set() or self._control_conn is None:
+            return default
+        try:
+            with self._control_lock:
+                self._control_conn.settimeout(10.0)
+                reply = self._control_conn.request(
+                    {"type": "debug", "what": what})
+        except (socket.timeout, wire.WireError) as e:
+            self._mark_dead(f"debug fetch {what!r} failed: {e}")
+            return default
+        if reply.get("type") != "debug_ok":
+            return default
+        return reply.get("data", default)
+
+
+class _SharedState:
+    """Everything the per-index factory closes over: the config, the
+    shared registry, the template gate objects, the artifact handle, and
+    the live proxy map (index → proxy) through which old workers are
+    reaped when the supervisor respawns an index."""
+
+    def __init__(self, cfg: ProcessFleetConfig,
+                 registry: MetricsRegistry):
+        self.cfg = cfg
+        self.registry = registry
+        # ONE template per fleet: the router's homogeneity gates compare
+        # these across proxies (audit cfg by value, engine knobs by
+        # field), and ONE artifact handle pins the same-artifact gate
+        self.template_audit = (
+            AuditConfig(enabled=True,
+                        sample_every=max(1, cfg.audit_sample_every))
+            if cfg.audit_enabled else AuditConfig())
+        self.template_engine_cfg = EngineConfig(
+            num_blocks=cfg.num_blocks, block_size=cfg.block_size,
+            unified_step=cfg.unified,
+            audit=(self.template_audit if cfg.audit_enabled else None))
+        self.aot_handle: Optional[AotManifestHandle] = None
+        self.active: Dict[int, WorkerEngineProxy] = {}  # index ->
+        # current proxy; bounded by dp
+        self.lock = threading.RLock()
+        self.initial_live = cfg.dp
+        self.built = False  # set once FleetRouter.build returns: later
+        # factory calls are supervisor respawns / scale-ups — always live
+        self._respawn_c = registry.counter(
+            "serving_fleet_worker_respawns_total",
+            "worker processes replaced (supervisor respawn or "
+            "autoscaler churn)")
+        self._g_active = registry.gauge(
+            "serving_fleet_active_workers",
+            "live (spawned, not dead/closed) worker processes")
+
+    def worker_spec(self) -> Dict:
+        cfg = self.cfg
+        return {
+            "layers": cfg.layers, "num_blocks": cfg.num_blocks,
+            "block_size": cfg.block_size,
+            "max_num_seqs": cfg.max_num_seqs,
+            "max_prefill_tokens_per_step":
+                cfg.max_prefill_tokens_per_step,
+            "unified_step": cfg.unified, "seed": cfg.seed,
+            "audit_enabled": cfg.audit_enabled,
+            "audit_sample_every": cfg.audit_sample_every,
+            # worker-local trackers/stores nobody reads: the router owns
+            # the fleet lifecycle timeline and the ONE history store
+            "lifecycle_events": False, "history": False,
+        }
+
+    def factory(self, index: int, registry) -> WorkerEngineProxy:
+        """The ``engine_factory(i, registry)`` handed to
+        :meth:`FleetRouter.build` — and therefore the SAME callable the
+        supervisor's ``_rebuild`` and the autoscaler's provisioning use.
+        Replacing an index closes (kills) the previous incarnation's
+        process first: respawn == in-process engine reconstruction."""
+        with self.lock:
+            old = self.active.pop(index, None)
+            live = True if self.built else index < self.initial_live
+        if old is not None:
+            old.close(graceful=False)
+            if old.is_live:
+                self._respawn_c.inc()
+        proxy = WorkerEngineProxy(self, index, live=live)
+        with self.lock:
+            self.active[index] = proxy
+        self.update_gauge()
+        return proxy
+
+    def update_gauge(self) -> None:
+        with self.lock:
+            n = sum(1 for p in self.active.values()
+                    if p.is_live and not p._closed
+                    and not p._dead.is_set())
+        self._g_active.set(n)
+
+    def close_all(self) -> None:
+        with self.lock:
+            proxies = list(self.active.values())
+        for p in proxies:
+            p.close()
+        self.update_gauge()
+
+
+class ProcessFleet:
+    """A process-isolated dp fleet: the stock :class:`FleetRouter` (and
+    optional :class:`FleetSupervisor`) over :class:`WorkerEngineProxy`
+    replicas.  ``initial_replicas < dp`` parks the tail indexes (no
+    process, no engine thread — routed around via ``healthy=False`` and
+    skipped by the supervisor via ``thread is None``) as the
+    autoscaler's headroom."""
+
+    def __init__(self, config: Optional[ProcessFleetConfig] = None,
+                 registry: Optional[MetricsRegistry] = None,
+                 initial_replicas: Optional[int] = None):
+        self.cfg = config or ProcessFleetConfig()
+        self.registry = (registry if registry is not None
+                         else MetricsRegistry(max_series=4096))
+        self.shared = _SharedState(self.cfg, self.registry)
+        if self.cfg.aot_path:
+            self.shared.aot_handle = AotManifestHandle.load(
+                self.cfg.aot_path)
+        self.shared.initial_live = (
+            self.cfg.dp if initial_replicas is None
+            else max(1, min(int(initial_replicas), self.cfg.dp)))
+        try:
+            self.router = FleetRouter.build(
+                self.shared.factory, dp=self.cfg.dp,
+                config=self.cfg.fleet or FleetConfig(),
+                registry=self.registry)
+        except BaseException:
+            self.shared.close_all()  # no orphan worker processes
+            raise
+        self.shared.built = True
+        self.supervisor: Optional[FleetSupervisor] = None
+        self.autoscaler: Optional["FleetAutoscaler"] = None
+        self.rebalancer: Optional["CacheRebalancer"] = None
+
+    # --- lifecycle ----------------------------------------------------------
+    def supervise(self, config: Optional[SupervisorConfig] = None
+                  ) -> FleetSupervisor:
+        self.supervisor = FleetSupervisor(self.router, config=config)
+        return self.supervisor
+
+    def start(self, notify=None) -> "ProcessFleet":
+        """Start the live replicas' engine threads (parked replicas stay
+        threadless — that is what keeps them out of routing and out of
+        the supervisor's healing scan) and the supervisor if attached."""
+        if notify is not None:
+            self.router._notify_cb = notify
+        for r in self.router.replicas:
+            proxy = self.shared.active.get(r.index)
+            if proxy is not None and proxy.is_live and r.thread is None:
+                r.start()
+        if self.supervisor is not None:
+            self.supervisor.start()
+        self.router.sample_gauges()
+        return self
+
+    def stop(self, join_timeout: float = 10.0) -> None:
+        for actor in (self.autoscaler, self.rebalancer):
+            if actor is not None:
+                actor.close()
+        self.router.stop(join_timeout)
+        self.shared.close_all()
+
+    def shutdown(self, drain_timeout: Optional[float] = None) -> None:
+        for actor in (self.autoscaler, self.rebalancer):
+            if actor is not None:
+                actor.close()
+        self.router.shutdown(drain_timeout)
+        self.shared.close_all()
+
+    # --- actuators ----------------------------------------------------------
+    def enable_autoscaler(self, config: Optional["AutoscalerConfig"]
+                          = None) -> "FleetAutoscaler":
+        self.autoscaler = FleetAutoscaler(self, config=config)
+        return self.autoscaler
+
+    def enable_rebalancer(self, config: Optional["RebalancerConfig"]
+                          = None) -> "CacheRebalancer":
+        self.rebalancer = CacheRebalancer(self.router, config=config,
+                                          registry=self.registry)
+        return self.rebalancer
+
+    # --- inspection (tests/bench) -------------------------------------------
+    def proxy(self, index: int) -> Optional[WorkerEngineProxy]:
+        return self.shared.active.get(index)
+
+    def worker_pid(self, index: int) -> Optional[int]:
+        p = self.shared.active.get(index)
+        return p.pid if p is not None else None
+
+    def live_replica_count(self) -> int:
+        return sum(1 for r in self.router.replicas
+                   if r.thread is not None)
+
+
+@dataclass
+class AutoscalerConfig:
+    """Bounds and pacing for the SLO-driven autoscaling actuator.
+    Cooldowns are measured in HISTORY SAMPLE indexes, not wall time —
+    the decision function consumes only ``(sample_index, firing)``
+    pairs, which is what makes a recorded run replayable bit-for-bit
+    under the frozen rule set."""
+
+    min_replicas: int = 1
+    max_replicas: int = 0  # 0 = the fleet's dp (index space is fixed)
+    scale_up_rules: Tuple[str, ...] = (
+        "goodput_burn", "pool_exhaustion", "restart_churn")
+    cooldown_samples: int = 25   # min samples between any two actions
+    calm_samples: int = 100      # firing-free samples after a breach
+                                 # before draining back down
+
+
+class ScaleDecider:
+    """The pure decision core: feed ``(sample_index, firing-rule set)``
+    pairs in order, get ``"up"`` / ``"down"`` / ``None`` out.  No
+    clocks, no fleet reads, no randomness — state is the tracked replica
+    count and two sample indexes, so replaying a recorded input stream
+    through a fresh instance reproduces the decision sequence exactly."""
+
+    def __init__(self, cfg: AutoscalerConfig, start_replicas: int,
+                 min_replicas: int, max_replicas: int):
+        self.cfg = cfg
+        self.replicas = int(start_replicas)
+        self.min_replicas = int(min_replicas)
+        self.max_replicas = int(max_replicas)
+        self._last_action: Optional[int] = None
+        self._last_breach: Optional[int] = None
+        self.decisions: deque = deque(maxlen=256)
+
+    def decide(self, sample_idx: int, firing) -> Optional[str]:
+        firing = frozenset(firing)
+        breach = any(r in firing for r in self.cfg.scale_up_rules)
+        if breach:
+            self._last_breach = sample_idx
+        cooled = (self._last_action is None
+                  or sample_idx - self._last_action
+                  >= self.cfg.cooldown_samples)
+        direction = None
+        if breach and cooled and self.replicas < self.max_replicas:
+            direction = "up"
+            self.replicas += 1
+        elif (not firing and cooled
+              and self.replicas > self.min_replicas
+              and self._last_breach is not None
+              and sample_idx - self._last_breach
+              >= self.cfg.calm_samples):
+            direction = "down"
+            self.replicas -= 1
+        if direction is not None:
+            self._last_action = sample_idx
+            self.decisions.append({
+                "sample": sample_idx, "direction": direction,
+                "firing": sorted(firing), "replicas": self.replicas})
+        return direction
+
+
+class FleetAutoscaler:
+    """Tentpole (d): AlertEngine firings → bounded scale actions on the
+    process pool.
+
+    Wiring: a history listener registered AFTER the router's AlertEngine
+    (listener order is registration order, so each sample's rule states
+    are already updated when we read them).  The listener runs on an
+    engine thread, so it only *decides* (pure, fast); actuation —
+    spawning/draining worker processes — happens on a dedicated actuator
+    thread.  Scale-up provisions the lowest parked index with the exact
+    wiring sequence ``FleetSupervisor._rebuild`` uses (minus the restart
+    accounting: provisioning is not failure triage); scale-down stops
+    the highest live index only when it has zero in-flight work, closing
+    the submit race under the router's submit lock."""
+
+    def __init__(self, fleet: ProcessFleet,
+                 config: Optional[AutoscalerConfig] = None):
+        router = fleet.router
+        if router.history is None or router.alerts is None:
+            raise ValueError(
+                "the autoscaler consumes alert-rule firings: build the "
+                "fleet with EngineConfig.history=True (the default) so "
+                "the router carries a HistoryStore + AlertEngine")
+        self.fleet = fleet
+        self.cfg = config or AutoscalerConfig()
+        self.min_replicas = max(1, self.cfg.min_replicas)
+        self.max_replicas = (self.cfg.max_replicas or router.dp)
+        self.max_replicas = min(self.max_replicas, router.dp)
+        self.start_replicas = fleet.live_replica_count()
+        self.decider = ScaleDecider(self.cfg, self.start_replicas,
+                                    self.min_replicas, self.max_replicas)
+        self.inputs: deque = deque(maxlen=512)  # (idx, firing) replay log
+        reg = router.registry
+        self._scale_c = {
+            d: reg.counter("serving_fleet_scale_events_total",
+                           "autoscaler actions applied to the process "
+                           "pool", direction=d)
+            for d in ("up", "down")}
+        self._q: "queue.Queue" = queue.Queue(maxsize=8)
+        self._stop_ev = threading.Event()
+        self._thread = threading.Thread(target=self._actuate_loop,
+                                        daemon=True,
+                                        name="fleet-autoscaler")
+        self._thread.start()
+        self._remove = router.history.add_listener(self._on_sample)
+
+    def close(self) -> None:
+        self._remove()
+        self._stop_ev.set()
+        self._thread.join(5.0)
+
+    # --- decision (engine thread; must stay wire-free) ----------------------
+    def _on_sample(self, sample_idx: int, step: int) -> None:
+        firing = tuple(sorted(
+            self.fleet.router.alerts.snapshot()["firing"]))
+        self.inputs.append((sample_idx, firing))
+        direction = self.decider.decide(sample_idx, firing)
+        if direction is not None:
+            try:
+                self._q.put_nowait(direction)
+            except queue.Full:
+                pass  # swallow-ok: an action backlog this deep means the actuator is already reshaping the pool; the next sample re-decides
+
+    def replay(self, inputs=None) -> List[Optional[str]]:
+        """Re-run the frozen decision function over recorded
+        ``(sample_index, firing)`` inputs (default: this instance's own
+        log).  Equality with the live decision sequence is the
+        replay-determinism contract the tests assert."""
+        d = ScaleDecider(self.cfg, self.start_replicas,
+                         self.min_replicas, self.max_replicas)
+        return [d.decide(i, f)
+                for i, f in (self.inputs if inputs is None else inputs)]
+
+    # --- actuation (dedicated thread) ---------------------------------------
+    def _actuate_loop(self) -> None:
+        while not self._stop_ev.is_set():
+            try:
+                direction = self._q.get(timeout=0.1)
+            except queue.Empty:
+                continue  # swallow-ok: Empty IS the stop-flag poll cadence
+            try:
+                if direction == "up":
+                    self._scale_up()
+                else:
+                    self._scale_down()
+            except Exception:
+                sys.stderr.write("[autoscaler] action failed:\n"
+                                 + traceback.format_exc())
+
+    def _scale_up(self) -> None:
+        router = self.fleet.router
+        sup = router.supervisor
+        excluded = sup.excluded if sup is not None else set()
+        target = None
+        for i, r in enumerate(router.replicas):
+            if r.thread is None and i not in excluded:
+                target = i
+                break
+        if target is None:
+            return  # nothing parked: already at the pool's edge
+        self._provision(target)
+        self._scale_c["up"].inc()
+        router.lifecycle.event(
+            None, "scale_event", direction="up", replica=str(target),
+            replicas=self.fleet.live_replica_count())
+        sys.stderr.write(f"[autoscaler] scaled up: provisioned replica "
+                         f"{target}\n")
+
+    def _provision(self, index: int) -> None:
+        """Bring a parked index live: factory (spawns the worker) + the
+        same rewiring sequence ``FleetSupervisor._rebuild`` performs —
+        shared tracker, flight, history, per-index fault injector —
+        WITHOUT the restart counters/lifecycle (this is provisioning,
+        not failure recovery; ``serving_replica_restarts_total`` must
+        not count scale-ups)."""
+        router = self.fleet.router
+        eng = router._engine_factory(index, router.registry)
+        eng.set_lifecycle(router.lifecycle, replica=str(index))
+        eng.audit.bind_flight(router.flight, replica=str(index))
+        if router.history is not None:
+            eng.set_history(router.history)
+        fi = router.fault_injectors.get(index)
+        if fi is not None:
+            eng.set_fault_injector(fi)
+        new = EngineReplica(index, eng, router.cfg.max_queue,
+                            notify=router._notify,
+                            on_finish=router._release)
+        new.flight = router.flight
+        sup = router.supervisor
+        if sup is not None:
+            sup._adopt(new)
+        router.engines[index] = eng
+        router.replicas[index] = new
+        router.flight.bind_step_profilers(
+            {str(r.index): r.engine.stepprof for r in router.replicas})
+        router.flight.bind_cache_trackers(
+            {str(r.index): r.engine.cachestat for r in router.replicas})
+        router.flight.reset_once("engine_death", str(index))
+        new.start()
+        router.sample_gauges()
+
+    def _scale_down(self) -> None:
+        router = self.fleet.router
+        # highest live index with no in-flight work; the submit lock
+        # closes the race where a router thread admits onto the replica
+        # between the idle check and request_stop
+        for r in reversed(router.replicas):
+            if r.thread is None:
+                continue
+            with router._submit_lock:
+                if r.in_flight:
+                    continue
+                r.request_stop()
+            r.join(10.0)
+            r.thread = None  # parked again: invisible to routing and
+            # to the supervisor's healing scan, reclaimable by scale-up
+            proxy = self.fleet.shared.active.get(r.index)
+            if proxy is not None:
+                proxy.close()
+            self._scale_c["down"].inc()
+            router.lifecycle.event(
+                None, "scale_event", direction="down",
+                replica=str(r.index),
+                replicas=self.fleet.live_replica_count())
+            router.sample_gauges()
+            self.fleet.shared.update_gauge()
+            sys.stderr.write(f"[autoscaler] scaled down: drained "
+                             f"replica {r.index}\n")
+            return
+        sys.stderr.write("[autoscaler] scale-down skipped: every live "
+                         "replica busy or at the floor\n")
+
+
+@dataclass
+class RebalancerConfig:
+    """Cache-aware vnode re-weighting knobs."""
+
+    threshold: float = 0.15        # act only past this imbalance
+    min_interval_samples: int = 50  # history samples between reweights
+    min_weight: float = 0.25
+    max_weight: float = 4.0
+
+
+class CacheRebalancer:
+    """The first cache-aware rebalancing ACTUATOR (tentpole (d)): PR 12
+    built the signal (``serving_fleet_cache_imbalance``), this closes
+    the loop.  On each history sample past the threshold, per-replica
+    vnode weights are set inversely to cached-token ratio — a COLD
+    replica (low ratio) gets more ring points, so new affinity keys
+    migrate toward it and warm it up, narrowing the gap instead of
+    letting placement luck compound.  Works over any
+    :class:`FleetRouter` — in-process or :class:`ProcessFleet`."""
+
+    def __init__(self, router: FleetRouter,
+                 config: Optional[RebalancerConfig] = None,
+                 registry: Optional[MetricsRegistry] = None):
+        if router.history is None:
+            raise ValueError(
+                "the rebalancer rides history samples: build the fleet "
+                "with EngineConfig.history=True (the default)")
+        self.router = router
+        self.cfg = config or RebalancerConfig()
+        reg = registry if registry is not None else router.registry
+        self._c = reg.counter(
+            "serving_fleet_ring_reweights_total",
+            "cache-aware consistent-hash vnode reweights applied")
+        self._last: Optional[int] = None
+        self.last_weights: Optional[Dict[int, float]] = None
+        self._remove = router.history.add_listener(self._on_sample)
+
+    def close(self) -> None:
+        self._remove()
+
+    def _on_sample(self, sample_idx: int, step: int) -> None:
+        cfg = self.cfg
+        if self._last is not None \
+                and sample_idx - self._last < cfg.min_interval_samples:
+            return
+        router = self.router
+        imbalance = router.cache_imbalance()
+        if imbalance is None or imbalance < cfg.threshold:
+            return
+        ratios = router.cached_token_ratios()
+        vals = [v for v in ratios.values() if v is not None]
+        if len(vals) < 2:
+            return
+        mean = sum(vals) / len(vals)
+        weights: Dict[int, float] = {}
+        for key, ratio in ratios.items():
+            if ratio is None:
+                continue
+            w = 1.0 + (mean - ratio)  # cold (below mean) -> heavier
+            weights[int(key)] = min(cfg.max_weight,
+                                    max(cfg.min_weight, w))
+        router.reweight_ring(weights)
+        self._c.inc()
+        router.lifecycle.event(
+            None, "ring_reweighted", imbalance=round(imbalance, 4),
+            weights={str(k): round(w, 3) for k, w in weights.items()})
+        self._last = sample_idx
+        self.last_weights = weights

@@ -600,6 +600,27 @@ class CompletionServer:
             raise ValueError(f"{name} must be in [{lo}, {hi}], got {v}")
         return v
 
+    def _replica_rows(self, reps, fetch) -> List[Dict]:
+        """Per-replica debug rows with mid-restart degradation (ISSUE 16
+        satellite bugfix): a replica that is being rebuilt/respawned —
+        unhealthy, or whose snapshot fetch fails during the engine swap
+        / worker respawn window — contributes a
+        ``{"status": "restarting"}`` row instead of 404/500-ing the
+        whole endpoint.  Debug surfaces stay useful DURING incidents,
+        which is exactly when operators hit them."""
+        rows = []
+        for r in reps:
+            if not r.healthy:
+                rows.append({"replica": str(r.index), "enabled": False,
+                             "status": "restarting"})
+                continue
+            try:
+                rows.append(dict(fetch(r), replica=str(r.index)))
+            except Exception:
+                rows.append({"replica": str(r.index), "enabled": False,
+                             "status": "restarting"})
+        return rows
+
     async def _handle_debug(self, path: str, query: str,
                             writer: asyncio.StreamWriter,
                             keep_alive: bool) -> int:
@@ -634,11 +655,11 @@ class CompletionServer:
                 return 404
             reps = (self.fleet.replicas if replica < 0
                     else [self.fleet.replicas[replica]])
-            data = [dict(r.engine.audit.snapshot(), replica=str(r.index))
-                    for r in reps]
-            enabled = [d for d in data if d["enabled"]]
+            data = self._replica_rows(
+                reps, lambda r: r.engine.audit.snapshot())
+            enabled = [d for d in data if d.get("enabled")]
             status = ("disabled" if not enabled else
-                      "degraded" if any(d["status"] == "degraded"
+                      "degraded" if any(d.get("status") == "degraded"
                                         for d in enabled) else "ok")
             await self._respond(
                 writer, 200,
@@ -666,8 +687,8 @@ class CompletionServer:
                 return 404
             reps = (self.fleet.replicas if replica < 0
                     else [self.fleet.replicas[replica]])
-            data = [dict(r.engine.cachestat.snapshot(),
-                         replica=str(r.index)) for r in reps]
+            data = self._replica_rows(
+                reps, lambda r: r.engine.cachestat.snapshot())
             # ONE ratio snapshot: the body's imbalance is derived from
             # the very ratios it reports, so the two fields can never
             # disagree under concurrent traffic
@@ -678,7 +699,7 @@ class CompletionServer:
             await self._respond(
                 writer, 200,
                 {"object": "list",
-                 "status": ("ok" if any(d["enabled"] for d in data)
+                 "status": ("ok" if any(d.get("enabled") for d in data)
                             else "disabled"),
                  "fleet": {
                      "dp": self.fleet.dp,
@@ -779,18 +800,30 @@ class CompletionServer:
             totals: Dict[str, Dict] = {}
             aot: Dict[str, Dict] = {}
             for r in self.fleet.replicas:
-                sp = r.engine.stepprof
-                for row in sp.compile_table():
-                    data.append(dict(row, replica=str(r.index)))
-                for prog, t in sp.compile_totals().items():
+                if not r.healthy:
+                    # mid-restart replica (ISSUE 16 satellite): degrade
+                    # its slot instead of failing the fleet-wide table
+                    aot[str(r.index)] = {"status": "restarting"}
+                    continue
+                try:
+                    sp = r.engine.stepprof
+                    rows = [dict(row, replica=str(r.index))
+                            for row in sp.compile_table()]
+                    tots = list(sp.compile_totals().items())
+                    # AOT attribution (ISSUE 15): per-replica artifact
+                    # state — with an artifact loaded the rows above
+                    # should be EMPTY (any row carries aot: true, the
+                    # bug marker)
+                    aot[str(r.index)] = sp.aot_snapshot()
+                except Exception:
+                    aot[str(r.index)] = {"status": "restarting"}
+                    continue
+                data.extend(rows)
+                for prog, t in tots:
                     agg = totals.setdefault(
                         prog, {"seconds": 0.0, "count": 0})
                     agg["seconds"] = round(agg["seconds"] + t["seconds"], 6)
                     agg["count"] += t["count"]
-                # AOT attribution (ISSUE 15): per-replica artifact
-                # state — with an artifact loaded the rows above should
-                # be EMPTY (any row carries aot: true, the bug marker)
-                aot[str(r.index)] = sp.aot_snapshot()
             await self._respond(
                 writer, 200,
                 {"object": "list", "data": data, "totals": totals,
@@ -1238,21 +1271,47 @@ async def _serve_cli(args) -> int:
         from ..observability.alerts import AlertRuleSet
 
         alert_rules = AlertRuleSet.from_json(args.alert_rules)
-    aot = None
-    if args.aot_path:
-        # ONE load for the whole fleet (ISSUE 15): every replica — and
-        # every supervisor rebuild — shares this artifact's compiled
-        # executables, so each program compiles once per process
-        from .aot import AotArtifact
+    pf = None
+    if args.workers:
+        # cross-process fleet (ISSUE 16): N worker processes behind the
+        # SAME router/supervisor stack, reached over the wire protocol.
+        # The router process never loads program bytes — workers boot
+        # off the shared artifact themselves (--aot-path is forwarded)
+        from .procfleet import ProcessFleet, ProcessFleetConfig
 
-        aot = AotArtifact.load(args.aot_path)
-        print(f"aot: loaded {aot.program_count} program(s) from "
-              f"{args.aot_path} in {aot.load_seconds:.3f}s")
-    fleet = _toy_fleet(dp=args.dp, layers=args.layers,
-                       num_blocks=args.blocks, max_queue=args.max_queue,
-                       flight_dir=args.flight_dir, audit=audit,
-                       unified=args.unified, fault_plan=fault_plan,
-                       alert_rules=alert_rules, aot=aot)
+        pf = ProcessFleet(ProcessFleetConfig(
+            dp=args.workers, layers=args.layers, num_blocks=args.blocks,
+            max_num_seqs=8, max_prefill_tokens_per_step=None,
+            unified=args.unified,
+            audit_enabled=bool(args.audit_sample),
+            audit_sample_every=args.audit_sample or 1,
+            aot_path=args.aot_path, compile_cache=args.compile_cache,
+            warm_boot=args.aot_warm,
+            fleet=FleetConfig(max_queue=args.max_queue,
+                              flight_dir=args.flight_dir,
+                              fault_plan=fault_plan,
+                              alert_rules=alert_rules)))
+        fleet = pf.router
+        for i in range(args.workers):
+            print(f"worker {i}: pid {pf.worker_pid(i)}")
+    else:
+        aot = None
+        if args.aot_path:
+            # ONE load for the whole fleet (ISSUE 15): every replica —
+            # and every supervisor rebuild — shares this artifact's
+            # compiled executables, so each program compiles once per
+            # process
+            from .aot import AotArtifact
+
+            aot = AotArtifact.load(args.aot_path)
+            print(f"aot: loaded {aot.program_count} program(s) from "
+                  f"{args.aot_path} in {aot.load_seconds:.3f}s")
+        fleet = _toy_fleet(dp=args.dp, layers=args.layers,
+                           num_blocks=args.blocks,
+                           max_queue=args.max_queue,
+                           flight_dir=args.flight_dir, audit=audit,
+                           unified=args.unified, fault_plan=fault_plan,
+                           alert_rules=alert_rules, aot=aot)
     supervisor = None
     if args.max_restarts > 0:
         # self-healing by default (ISSUE 12): dead replicas restart
@@ -1295,6 +1354,8 @@ async def _serve_cli(args) -> int:
     finally:
         if pusher is not None:
             pusher.close()
+        if pf is not None:
+            pf.shared.close_all()  # reap the worker processes
     return 0
 
 
@@ -1399,6 +1460,30 @@ def main(argv=None) -> int:
                         "the pool capacity caps it either way — a "
                         "serving step past the bound fails loudly "
                         "instead of retracing)")
+    p.add_argument("--workers", type=int, default=0, metavar="N",
+                   help="cross-process fleet (ISSUE 16): N worker "
+                        "PROCESSES (python -m paddle_tpu.serving.worker)"
+                        " behind the same prefix-affinity router and "
+                        "self-healing supervisor, speaking the length-"
+                        "prefixed JSON wire protocol over localhost — "
+                        "kill -9 a worker and the fleet reroutes, "
+                        "respawns it off the shared --aot-path artifact "
+                        "and loses nothing.  0 = in-process replicas "
+                        "(--dp)")
+    p.add_argument("--compile-cache", default=None, metavar="DIR",
+                   help="JAX persistent compilation cache directory for "
+                        "--workers processes: N sibling workers compile "
+                        "each (AOT or traced) program once machine-wide "
+                        "— every later worker boot hits the cache "
+                        "instead of recompiling")
+    p.add_argument("--aot-warm", action="store_true",
+                   help="with --aot-save: execute every exported "
+                        "program once right after saving (device-warms "
+                        "the artifact and fills --compile-cache); with "
+                        "--workers: each worker warm-executes the "
+                        "loaded artifact at boot so the FIRST request "
+                        "wave pays zero lazy compiles (wall seconds "
+                        "recorded as serving_aot_warm_seconds)")
     p.add_argument("--selftest", action="store_true",
                    help="boot on an ephemeral port, serve one completion "
                         "against the toy fleet through the router path, "
@@ -1406,6 +1491,19 @@ def main(argv=None) -> int:
     args = p.parse_args(argv)
     if args.dp < 1:
         p.error(f"--dp must be >= 1, got {args.dp}")
+    if args.workers < 0:
+        p.error(f"--workers must be >= 0, got {args.workers}")
+    if args.workers:
+        if args.dp > 1:
+            p.error("--workers and --dp are the two fleet modes — pick "
+                    "one (cross-process: --workers N; in-process: "
+                    "--dp N)")
+        if args.mp > 1:
+            p.error("--workers runs single-chip worker processes; "
+                    "--mp > 1 needs the in-process fleet (--dp)")
+        if args.selftest:
+            p.error("--selftest probes the in-process fleet; boot "
+                    "--workers without it and probe over HTTP")
     if args.audit_sample is not None and args.audit_sample < 1:
         p.error(f"--audit-sample must be >= 1, got {args.audit_sample}")
     if args.max_restarts < 0:
@@ -1428,6 +1526,14 @@ def main(argv=None) -> int:
         art = AotArtifact.save(eng, args.aot_save,
                                max_seq_len=args.aot_max_seq)
         print("aot-save: " + json.dumps(art.describe(), indent=1))
+        if args.aot_warm:
+            # pre-compile every exported program at SAVE time (ISSUE 16
+            # satellite): with --compile-cache set via JAX config /
+            # worker flag, this fills the machine-wide persistent cache
+            # so every later worker boot compiles nothing
+            wall = art.warm()
+            print(f"aot-warm: executed {art.program_count} program(s) "
+                  f"in {wall:.3f}s")
         return 0
     if args.selftest:
         return asyncio.run(_selftest_async(

@@ -1,0 +1,502 @@
+"""Cross-process serving worker (ISSUE 16 tentpole (b)).
+
+``python -m paddle_tpu.serving.worker`` wraps ONE
+:class:`~paddle_tpu.serving.EngineCore` behind the fleet wire protocol
+(``serving/wire.py``): the router process drives it through a
+:class:`~paddle_tpu.serving.procfleet.WorkerEngineProxy` exactly the way
+an in-process fleet drives a live engine, so FleetRouter and
+FleetSupervisor transfer unchanged.
+
+Boot protocol: the worker binds an ephemeral localhost port, builds its
+engine (optionally onto a shared ``--aot-path`` artifact — PR 14's
+zero-trace boot), then prints ONE machine-readable ready line to stdout::
+
+    PADDLE_TPU_WORKER_READY port=<p> pid=<pid> aot_hash=<h> boot_s=<s>
+
+The parent reads that line to learn the port; everything after it is
+free-form logging.  With ``--compile-cache DIR`` the worker points JAX's
+persistent compilation cache at ``DIR`` **before** anything compiles, so
+N sibling workers compile each AOT program once machine-wide; the boot
+log reports the cache-entry delta::
+
+    PADDLE_TPU_COMPILE_CACHE dir=<d> entries_before=<a> entries_after=<b>
+
+(``--warm`` executes every loaded program once at boot so the delta —
+and a sibling's hit — is observable at boot time rather than smeared
+over the first request wave.)
+
+Connection model: one ``engine`` connection (submit/abort/step — driven
+by the parent replica's engine thread, strictly serial) plus any number
+of ``control`` connections (health/debug/drain — heartbeats and HTTP
+debug handlers).  Engine state is guarded by one lock; a handshake or
+frame error poisons only its connection (the process survives — that is
+the wire-robustness satellite), while an engine-step failure is fatal by
+design: the worker reports ``step_error`` with its traceback plus any
+newly-fired fault-plan indexes, then exits so the supervisor's rebuild
+respawns a clean process onto the shared artifact.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import sys
+import threading
+import time
+import traceback
+from typing import Dict, Optional
+
+from . import wire
+
+# metric names this module owns (tools/check_metrics_docs lints that
+# each appears in README's metrics table)
+METRIC_NAMES = (
+    "serving_worker_connections_total",
+    "serving_worker_boot_seconds",
+)
+
+from .wire import CACHE_PREFIX, READY_PREFIX  # noqa: F401  (canonical
+# home is wire.py; re-exported here since they are worker protocol)
+
+# engine-spec keys forwarded into EngineConfig (everything else in the
+# spec is scheduler/model shape); a bounded vocabulary so a drifted
+# parent fails loudly instead of silently half-configuring the worker
+_ENGINE_KEYS = ("lifecycle_events", "decode_event_sample", "step_profile",
+                "cache_stats", "history", "unified_step", "prefix_cache")
+_SPEC_KEYS = _ENGINE_KEYS + (
+    "layers", "num_blocks", "block_size", "max_num_seqs",
+    "max_prefill_tokens_per_step", "max_tokens_per_step", "seed",
+    "audit_enabled", "audit_sample_every")
+
+
+def _count_cache_entries(path: Optional[str]) -> int:
+    if not path or not os.path.isdir(path):
+        return 0
+    total = 0
+    for _root, _dirs, files in os.walk(path):
+        total += len(files)
+    return total
+
+
+def build_engine(spec: Dict, replica: int, registry, aot=None):
+    """Deterministic toy-engine factory, mirroring the fleet's
+    ``_toy_fleet`` shape: seed first, one model instance, per-replica
+    metric labels.  The spec is the SAME dict the router's proxies
+    template their gate attributes from, so the heterogeneity gates in
+    ``FleetRouter.__init__`` hold across the process boundary."""
+    unknown = sorted(set(spec) - set(_SPEC_KEYS))
+    if unknown:
+        raise ValueError(f"unknown engine-spec key(s) {unknown} — "
+                         "router/worker version drift")
+    import paddle_tpu as paddle
+
+    from ..models import LlamaConfig, LlamaForCausalLM
+    from ..observability.audit import AuditConfig
+    from .engine import EngineConfig, EngineCore
+    from .scheduler import SchedulerConfig
+
+    paddle.seed(int(spec.get("seed", 0)))
+    model = LlamaForCausalLM(
+        LlamaConfig.tiny(num_hidden_layers=int(spec.get("layers", 2))))
+    audit = None
+    if spec.get("audit_enabled"):
+        audit = AuditConfig(
+            enabled=True,
+            sample_every=max(1, int(spec.get("audit_sample_every", 1))))
+    kwargs = {k: spec[k] for k in _ENGINE_KEYS if k in spec}
+    cfg = EngineConfig(
+        num_blocks=int(spec.get("num_blocks", 64)),
+        block_size=int(spec.get("block_size", 4)),
+        scheduler=SchedulerConfig(
+            max_num_seqs=int(spec.get("max_num_seqs", 4)),
+            max_prefill_tokens_per_step=spec.get(
+                "max_prefill_tokens_per_step"),
+            max_tokens_per_step=spec.get("max_tokens_per_step")),
+        audit=audit, aot=aot, **kwargs)
+    return EngineCore(model, config=cfg, registry=registry,
+                      metrics_labels={"replica": str(replica)})
+
+
+class WorkerHost:
+    """The serving side of the wire: owns the engine, the lock that
+    serializes engine mutation, and the fired-fault bookkeeping the
+    router needs to keep its exactly-once chaos accounting across
+    respawns."""
+
+    def __init__(self, engine, registry, replica: int,
+                 aot_hash: Optional[str], max_frame: int):
+        self.engine = engine
+        self.registry = registry
+        self.replica = int(replica)
+        self.aot_hash = aot_hash
+        self.max_frame = max_frame
+        self.lock = threading.RLock()
+        self.started = time.time()
+        self.draining = False
+        self.dead = threading.Event()  # set => main exits the process
+        self.exit_code = 0
+        self._live: Dict = {}  # rid -> engine Request, evicted on finish
+        self._fired_reported: set = set()  # unbounded-ok: subset of the frozen fault plan's finite index set
+        self._conns = registry.counter(
+            "serving_worker_connections_total",
+            "accepted wire connections by role", role="engine",
+            replica=str(replica))
+        self._conns_ctl = registry.counter(
+            "serving_worker_connections_total",
+            "accepted wire connections by role", role="control",
+            replica=str(replica))
+
+    # --- fault bookkeeping --------------------------------------------------
+    def _fired_delta(self):
+        fi = self.engine._fault
+        if fi is None:
+            return []
+        fired = set(fi.snapshot().get("fired_plan_indexes", []))
+        delta = sorted(fired - self._fired_reported)
+        self._fired_reported |= fired
+        return delta
+
+    # --- frame handlers -----------------------------------------------------
+    def _state(self) -> Dict:
+        eng = self.engine
+        return {
+            "step_seq": int(eng.step_seq),
+            "has_work": bool(eng.scheduler.has_work()),
+            "queue_depth": int(eng.scheduler.queue_depth),
+            "occupancy": float(eng.kv.occupancy()),
+            "degraded": bool(eng.audit.degraded),
+        }
+
+    def handle_submit(self, frame: Dict) -> Dict:
+        from .request import SamplingParams
+
+        if self.draining:
+            return wire.error_frame("protocol",
+                                    "worker is draining; not admitting")
+        sp = frame.get("sampling") or {}
+        sampling = SamplingParams(
+            max_new_tokens=int(sp.get("max_new_tokens", 16)),
+            temperature=float(sp.get("temperature", 0.0)),
+            top_k=int(sp.get("top_k", 0)),
+            eos_token_id=sp.get("eos_token_id"),
+            seed=int(sp.get("seed", 0)))
+        hashes = frame.get("prefix_hashes")
+        if hashes is not None:
+            hashes = [bytes.fromhex(h) for h in hashes]
+        with self.lock:
+            req = self.engine.add_request(
+                [int(t) for t in frame["prompt_ids"]], sampling=sampling,
+                request_id=frame["rid"],
+                priority=int(frame.get("priority", 0)),
+                trace_id=str(frame.get("trace_id", frame["rid"])),
+                prefix_hashes=hashes, slo_ms=frame.get("slo_ms"))
+            self._live[frame["rid"]] = req
+        return {"type": "submit_ok", "rid": frame["rid"]}
+
+    def handle_abort(self, frame: Dict) -> Dict:
+        from .request import FinishReason
+
+        reason = FinishReason(frame.get("reason", "abort"))
+        with self.lock:
+            ok = self.engine.abort_request(frame["rid"], reason)
+            if ok:
+                self._live.pop(frame["rid"], None)
+        return {"type": "abort_ok", "rid": frame["rid"], "ok": bool(ok)}
+
+    def handle_step(self, conn: wire.Connection) -> None:
+        """One engine step, streamed: ``token`` frames for every token
+        the step produced, then ``step_done`` carrying the post-step
+        state + fired-fault delta + a full metrics dump (the router
+        merges it before ticking the shared history, so alert rules see
+        fresh cross-process values deterministically).  A step failure
+        sends ``step_error`` and kills the process — the supervisor's
+        respawn path owns recovery."""
+        with self.lock:
+            eng = self.engine
+            if not eng.scheduler.has_work():
+                conn.send({"type": "step_done", "stepped": False,
+                           "finished": {}, "fired": self._fired_delta(),
+                           "metrics": wire.dump_registry(self.registry),
+                           **self._state()})
+                return
+            before = {rid: len(req.output_tokens)
+                      for rid, req in self._live.items()}
+            try:
+                eng.step()
+            except BaseException:
+                err = traceback.format_exc()
+                try:
+                    conn.send({"type": "step_error", "error": err,
+                               "fired": self._fired_delta(),
+                               "metrics": wire.dump_registry(
+                                   self.registry)})
+                except wire.WireError:
+                    pass  # swallow-ok: the parent's socket died first; its heartbeat/EOF path already reports this death
+                sys.stderr.write(f"[worker {self.replica}] engine step "
+                                 f"failed; exiting for respawn:\n{err}")
+                self.exit_code = 3
+                self.dead.set()
+                return
+            finished: Dict = {}
+            for rid, req in list(self._live.items()):
+                toks = req.output_tokens
+                for tok in toks[before.get(rid, 0):]:
+                    conn.send({"type": "token", "rid": rid,
+                               "token": int(tok)})
+                if req.finished:
+                    finished[rid] = (req.finish_reason.value
+                                     if req.finish_reason else None)
+                    del self._live[rid]
+            conn.send({"type": "step_done", "stepped": True,
+                       "finished": finished,
+                       "fired": self._fired_delta(),
+                       "metrics": wire.dump_registry(self.registry),
+                       **self._state()})
+
+    def handle_debug(self, frame: Dict) -> Dict:
+        what = frame.get("what")
+        eng = self.engine
+        with self.lock:
+            if what == "audit":
+                data = eng.audit.snapshot()
+            elif what == "cache":
+                data = eng.cachestat.snapshot()
+            elif what == "cache_timeline":
+                data = eng.cachestat.timeline()
+            elif what == "compile_table":
+                data = eng.stepprof.compile_table()
+            elif what == "compile_totals":
+                data = eng.stepprof.compile_totals()
+            elif what == "aot":
+                data = eng.stepprof.aot_snapshot()
+            elif what == "records":
+                data = eng.stepprof.records()
+            elif what == "metrics":
+                data = wire.dump_registry(self.registry)
+            elif what == "describe":
+                data = {"pid": os.getpid(), "replica": self.replica,
+                        "aot_hash": self.aot_hash,
+                        "traces": {
+                            "prefill": eng.prefill_trace_count,
+                            "decode": eng.decode_trace_count,
+                            "ragged": eng.ragged_trace_count},
+                        **self._state()}
+            else:
+                return wire.error_frame(
+                    "protocol", f"unknown debug target {what!r}")
+        return {"type": "debug_ok", "what": what, "data": data}
+
+    def handle_set_fault(self, frame: Dict) -> Dict:
+        from .faultinject import FaultInjector, FaultPlan
+
+        plan_obj = frame.get("plan")
+        with self.lock:
+            if not plan_obj:
+                self.engine.set_fault_injector(None)
+                return {"type": "ok"}
+            plan = FaultPlan.from_obj(plan_obj)
+            fi = FaultInjector(plan, replica=str(self.replica),
+                               lifecycle=self.engine.lifecycle,
+                               registry=self.registry)
+            fi.mark_fired(frame.get("fired") or [])
+            self._fired_reported = set(
+                fi.snapshot().get("fired_plan_indexes", []))
+            self.engine.set_fault_injector(fi)
+        return {"type": "ok"}
+
+    # --- connection loops ---------------------------------------------------
+    def serve_connection(self, sock: socket.socket) -> None:
+        labels = {"replica": str(self.replica)}
+        conn = wire.Connection(sock, registry=self.registry,
+                               labels=labels, side="worker",
+                               max_frame=self.max_frame)
+        try:
+            conn.settimeout(60.0)
+            try:
+                hello = conn.recv()
+                role = wire.check_hello(hello, self.aot_hash)
+            except wire.HandshakeMismatch as e:
+                conn.count_error(e.code)
+                conn.send(wire.error_frame(e.code, str(e)))
+                return
+            except wire.FrameError as e:
+                try:
+                    conn.send(wire.error_frame(e.kind, str(e)))
+                except wire.WireError:
+                    pass  # swallow-ok: peer already gone; the frame error itself was counted by recv
+                return
+            except wire.ConnectionClosed:
+                return  # swallow-ok: counted by recv; a port probe, not a peer
+            conn.send({"type": "hello_ok", "version": wire.WIRE_VERSION,
+                       "replica": self.replica, "pid": os.getpid(),
+                       "aot_hash": self.aot_hash})
+            (self._conns if role == "engine" else self._conns_ctl).inc()
+            conn.settimeout(None)
+            while not self.dead.is_set():
+                try:
+                    frame = conn.recv()
+                except wire.ConnectionClosed:
+                    return  # swallow-ok: clean peer disconnect at a frame boundary, counted by recv
+                except wire.FrameError as e:
+                    # per-connection error isolation: answer, close this
+                    # connection, keep the process serving others
+                    try:
+                        conn.send(wire.error_frame(e.kind, str(e)))
+                    except wire.WireError:
+                        pass  # swallow-ok: peer already gone; the frame error itself was counted by recv
+                    return
+                self._dispatch(conn, frame)
+        except wire.WireError:
+            return  # swallow-ok: counted at the Connection layer; connection-scoped by design
+        except Exception:
+            sys.stderr.write(f"[worker {self.replica}] connection "
+                             f"handler failed:\n{traceback.format_exc()}")
+        finally:
+            conn.close()
+
+    def _dispatch(self, conn: wire.Connection, frame: Dict) -> None:
+        t = frame.get("type")
+        if t == "step":
+            self.handle_step(conn)
+        elif t == "submit":
+            conn.send(self.handle_submit(frame))
+        elif t == "abort":
+            conn.send(self.handle_abort(frame))
+        elif t == "health":
+            conn.send({"type": "health_ok", "pid": os.getpid(),
+                       "step_seq": int(self.engine.step_seq),
+                       "draining": self.draining,
+                       "uptime_s": round(time.time() - self.started, 3)})
+        elif t == "debug":
+            conn.send(self.handle_debug(frame))
+        elif t == "set_fault":
+            conn.send(self.handle_set_fault(frame))
+        elif t == "drain":
+            self.draining = True
+            with self.lock:
+                pending = len(self._live)
+            conn.send({"type": "drain_ok", "pending": pending})
+        elif t == "shutdown":
+            conn.send({"type": "ok"})
+            self.dead.set()
+        else:
+            conn.send(wire.error_frame("protocol",
+                                       f"unknown frame type {t!r}"))
+
+
+def main(argv=None) -> int:
+    if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
+        # mirror serving/server.py: the TPU plugin's sitecustomize may
+        # pin the platform; override after import
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    p = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.serving.worker",
+        description="one EngineCore replica behind the fleet wire "
+                    "protocol (spawned by serving/procfleet.py)")
+    p.add_argument("--replica", type=int, default=0)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--spec", default="{}",
+                   help="JSON engine spec (layers/num_blocks/block_size/"
+                        "scheduler caps/audit/unified...) — must match "
+                        "the router's proxy template exactly")
+    p.add_argument("--aot-path", default=None,
+                   help="boot zero-trace from this shared AOT artifact; "
+                        "its manifest model_hash becomes the handshake "
+                        "hash the router must present")
+    p.add_argument("--compile-cache", default=None, metavar="DIR",
+                   help="JAX persistent compilation cache dir: sibling "
+                        "workers compile each program once machine-wide")
+    p.add_argument("--warm", action="store_true",
+                   help="execute every loaded AOT program once at boot "
+                        "(first request wave pays zero lazy compiles; "
+                        "with --compile-cache the compiles land in the "
+                        "shared cache at boot)")
+    p.add_argument("--max-frame", type=int, default=wire.MAX_FRAME_BYTES)
+    args = p.parse_args(argv)
+
+    t0 = time.perf_counter()
+    import jax
+
+    if args.compile_cache:
+        # BEFORE anything compiles: every compile this process performs
+        # lands in (or is served from) the shared machine-wide cache.
+        # The min-compile-time / min-entry-size floors default to values
+        # tuned for real models — the toy programs compile in
+        # milliseconds, so both floors must drop to 0 or nothing would
+        # ever be cached.
+        os.makedirs(args.compile_cache, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", args.compile_cache)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    entries_before = _count_cache_entries(args.compile_cache)
+
+    from ..observability.metrics import MetricsRegistry
+
+    registry = MetricsRegistry()
+    spec = json.loads(args.spec)
+    aot = None
+    aot_hash = None
+    if args.aot_path:
+        from .aot import AotArtifact
+
+        aot = AotArtifact.load(args.aot_path)
+        aot_hash = aot.manifest["model_hash"]
+    engine = build_engine(spec, args.replica, registry, aot=aot)
+    if args.warm and aot is not None:
+        wall = aot.warm(registry=registry,
+                        labels={"replica": str(args.replica)})
+        print(f"[worker {args.replica}] warmed {aot.program_count} "
+              f"program(s) in {wall:.3f}s", flush=True)
+    entries_after = _count_cache_entries(args.compile_cache)
+    if args.compile_cache:
+        print(f"{CACHE_PREFIX} dir={args.compile_cache} "
+              f"entries_before={entries_before} "
+              f"entries_after={entries_after}", flush=True)
+    boot_s = time.perf_counter() - t0
+    registry.gauge("serving_worker_boot_seconds",
+                   "worker process boot wall (imports + engine build + "
+                   "artifact load + optional warm)",
+                   replica=str(args.replica)).set(boot_s)
+
+    host = WorkerHost(engine, registry, args.replica, aot_hash,
+                      args.max_frame)
+    server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    server.bind((args.host, args.port))
+    server.listen(16)
+    port = server.getsockname()[1]
+    print(f"{READY_PREFIX} port={port} pid={os.getpid()} "
+          f"aot_hash={aot_hash} boot_s={boot_s:.3f}", flush=True)
+
+    def _accept_loop() -> None:
+        while not host.dead.is_set():
+            try:
+                sock, _addr = server.accept()
+            except OSError:
+                return  # swallow-ok: listener closed during shutdown
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            threading.Thread(target=host.serve_connection, args=(sock,),
+                             daemon=True).start()
+
+    acceptor = threading.Thread(target=_accept_loop, daemon=True)
+    acceptor.start()
+    try:
+        host.dead.wait()
+    except KeyboardInterrupt:
+        pass  # swallow-ok: Ctrl-C is a normal operator stop; the finally below closes the listener
+    finally:
+        try:
+            server.close()
+        except OSError:
+            pass  # swallow-ok: closing an already-dead listener during shutdown
+    return host.exit_code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -83,6 +83,15 @@ CHECKS: Tuple[Tuple[str, str, float, float], ...] = (
     ("aot.restart.aot_rebuilt_traces",   "count_max", 0.0, 0.0),
     ("aot.aot_cold_wall_s",              "lower",     1.0, 0.0),
     ("aot.aot_tokens_per_sec",           "higher",    0.5, 0.0),
+    # cross-process chaos phase (ISSUE 16): kill -9 a worker process
+    # mid-stream — the zero-lost contract is EXACT (one lost request IS
+    # the regression), and service restoration (death -> respawned
+    # worker serving again, including a full worker boot) must not
+    # structurally blow up (wide wall band — CPU process spawn noise)
+    ("procfleet.requests_lost",          "count_max", 0.0, 0.0),
+    ("procfleet.engine_death_bundles",   "count_max", 0.0, 0.0),
+    ("procfleet.restoration_wall_s",     "lower",     1.0, 5.0),
+    ("procfleet.procfleet_tokens_per_sec", "higher",  0.5, 0.0),
 )
 
 

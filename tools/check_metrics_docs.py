@@ -48,6 +48,10 @@ DECLARING_MODULES = (
     os.path.join(_REPO, "paddle_tpu", "observability", "cachestat.py"),
     os.path.join(_REPO, "paddle_tpu", "observability", "history.py"),
     os.path.join(_REPO, "paddle_tpu", "observability", "alerts.py"),
+    # ISSUE 16: the cross-process fleet's wire/worker/actuator series
+    os.path.join(_REPO, "paddle_tpu", "serving", "wire.py"),
+    os.path.join(_REPO, "paddle_tpu", "serving", "worker.py"),
+    os.path.join(_REPO, "paddle_tpu", "serving", "procfleet.py"),
 )
 
 _NAME_RE = re.compile(r"\b(?:serving|push)_[a-z0-9_:]+\b")

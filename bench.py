@@ -1624,8 +1624,31 @@ def serving_procfleet_bench() -> dict:
             assert sum(traces.values()) == 0, \
                 f"respawned worker traced programs: {traces}"
         gen = sum(len(h.output_tokens) for h in hs)
+        # wire-latency attribution (ISSUE 17): per-replica host/wire/
+        # engine shares plus telemetry mirror-ring drop counts, read off
+        # the LIVE proxies before stop() reaps them.  The fault-free run
+        # must drop ZERO mirrored events (exact gate in the regression
+        # checker).
+        from paddle_tpu.observability.distrib import WireStats
+
+        wire_rows = {}
+        mirror_dropped = 0
+        agg = {"steps": 0, "wire_s": 0.0, "queue_s": 0.0,
+               "engine_s": 0.0, "total_s": 0.0}
+        for i, proxy in sorted(dict(fleet.shared.active).items()):
+            st = proxy.distrib_state()
+            wire_rows[str(i)] = st["wire"]
+            mirror_dropped += int(st["mirror"]["dropped"])
+            mirror_dropped += int((st["merge"] or {}).get(
+                "worker_dropped", 0))
+            for k in agg:
+                agg[k] += st["wire"].get(k, 0) or 0
         rec = {
             "wall_s": round(wall, 4),
+            "wire": {"shares": WireStats._shares(agg),
+                     "steps": agg["steps"],
+                     "per_replica": wire_rows},
+            "mirror_events_dropped": mirror_dropped,
             "tokens_per_sec": round(gen / wall, 2),
             "generated_tokens": gen,
             "engine_death_dumps": int(_csum(
@@ -1679,6 +1702,12 @@ def serving_procfleet_bench() -> dict:
         "restoration_wall_s": chaos["restoration_wall_s"],
         "procfleet_tokens_per_sec": chaos["tokens_per_sec"],
         "clean_tokens_per_sec": clean["tokens_per_sec"],
+        # ISSUE 17: wire overhead share of total step time in the
+        # FAULT-FREE run (chaos walls include the restoration gap), plus
+        # the exact-zero telemetry drop gate
+        "wire_overhead_share": clean["wire"]["shares"]["wire"],
+        "mirror_events_dropped": clean["mirror_events_dropped"],
+        "wire_breakdown": clean["wire"],
         "aot_programs": aot_programs,
         "warm_boot": {"cold": cold, "warm": warm},
         "clean": clean, "chaos": chaos,

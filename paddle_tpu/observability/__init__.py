@@ -56,6 +56,15 @@ hit-depth / park-lifetime telemetry fed by the pool's event-driven
 hooks, and per-request cache attribution — served at
 ``GET /v1/debug/cache``.
 
+The cross-process layer (ISSUE 17): ``distrib.py`` stitches worker
+processes into the router's observability — :class:`TelemetryOutbox`
+streams sequence-numbered worker lifecycle events over piggybacked
+wire deltas, :class:`DeltaMerger` merges them idempotently onto the
+router's tracker (offset-corrected by the NTP-style
+:class:`ClockSync`, mirrored into the bounded :class:`MirrorRing` for
+kill -9 post-mortems), and :class:`WireStats` attributes each step's
+wall to host vs wire vs engine — served at ``GET /v1/debug/wire``.
+
 Process-wide defaults: :func:`get_tracer` / :func:`get_registry` return
 one shared instance each, so spans from the serving engine, jit compile
 events and watchdog timeouts land in one trace, and compile counters /
@@ -79,6 +88,13 @@ from .audit import (  # noqa: F401
 )
 from .cachestat import (  # noqa: F401
     CacheStatTracker,
+)
+from .distrib import (  # noqa: F401
+    ClockSync,
+    DeltaMerger,
+    MirrorRing,
+    TelemetryOutbox,
+    WireStats,
 )
 from .export import (  # noqa: F401
     ProfilerResult,

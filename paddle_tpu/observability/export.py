@@ -36,15 +36,30 @@ def chrome_trace_dict(spans, epoch_offset: float = 0.0) -> Dict:
         "ph": "M", "name": "process_name", "pid": _PID, "tid": 0,
         "args": {"name": "paddle_tpu host"},
     }]
+    # spans merged from another OS process (cross-process telemetry,
+    # observability.distrib) carry a ``chrome_pid`` attr: they render
+    # as their own chrome process row, named once per distinct pid
+    named_pids = {_PID}
     for sp in spans:
         args = {k: _jsonable(v) for k, v in sp.attrs.items()}
         args["id"] = sp.span_id
         if sp.parent_id is not None:
             args["parent"] = sp.parent_id
+        try:
+            pid = int(sp.attrs.get("chrome_pid", _PID))
+        except (TypeError, ValueError):
+            pid = _PID  # swallow-ok: chrome_pid is a free-form span attr — a non-numeric value renders on the local process row instead of failing the export
+        if pid not in named_pids:
+            named_pids.add(pid)
+            events.append({
+                "ph": "M", "name": "process_name", "pid": pid,
+                "tid": 0,
+                "args": {"name": f"paddle_tpu worker pid={pid}"},
+            })
         ev = {
             "name": sp.name,
             "cat": sp.cat,
-            "pid": _PID,
+            "pid": pid,
             "tid": sp.tid,
             "ts": (sp.start + epoch_offset) * 1e6,  # chrome wants us
             "args": args,

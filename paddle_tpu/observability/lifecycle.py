@@ -352,8 +352,21 @@ class LifecycleTracker:
         (engine-level events like a prefix-cache eviction sweep)."""
         if not self.enabled:
             return
-        ts = time.perf_counter()
-        tid = threading.get_ident()
+        self._record(rid, name, time.perf_counter(),
+                     threading.get_ident(), attrs)
+
+    def merge_event(self, rid, name: str, ts: float, tid: int,
+                    **attrs) -> None:
+        """Inject an event with an EXPLICIT timestamp/thread id — the
+        cross-process merge path (``observability.distrib``): a worker's
+        streamed event lands on the router's tracker with its
+        offset-corrected worker timestamp, not the merge time."""
+        if not self.enabled:
+            return
+        self._record(rid, name, float(ts), int(tid), attrs)
+
+    def _record(self, rid, name: str, ts: float, tid: int,
+                attrs: Dict) -> None:
         record_event = True
         if rid is not None:
             with self._lock:

@@ -447,6 +447,14 @@ class StepProfiler:
         with self._lock:
             return [dict(r) for r in self._records]
 
+    def last_record(self) -> Optional[Dict]:
+        """Newest per-step record (``None`` before the first step) —
+        the cross-process worker piggybacks this onto its ``step_done``
+        reply so the router can attribute wire latency per-program
+        (``observability.distrib.WireStats``)."""
+        with self._lock:
+            return dict(self._records[-1]) if self._records else None
+
     def bucket_set(self, program: str) -> set:
         """Distinct bucket strings observed for ``program`` — tests
         compare this against the engine's asserted jit-trace bounds."""

@@ -161,12 +161,21 @@ class ServingMetrics:
         }
         self._host_ops: Optional[HostOpRecorder] = None
         self._stepprof = None  # StepProfiler, attached by the engine
+        self._wire = None      # distrib.WireStats, attached by a
+        # cross-process WorkerEngineProxy (ISSUE 17)
 
     def attach_step_profiler(self, stepprof) -> None:
         """Bind the engine's :class:`~paddle_tpu.observability.stepprof
         .StepProfiler` so :meth:`summary` can render the per-program
         bucket-utilization / padding-waste table (ISSUE 9)."""
         self._stepprof = stepprof
+
+    def attach_wire_stats(self, wire_stats) -> None:
+        """Bind a cross-process replica's
+        :class:`~paddle_tpu.observability.distrib.WireStats` so
+        :meth:`summary` can render the host-vs-wire-vs-engine share of
+        every step's wall time (ISSUE 17)."""
+        self._wire = wire_stats
 
     # --- recording ----------------------------------------------------------
     def _counter(self, name: str) -> Counter:
@@ -414,6 +423,28 @@ class ServingMetrics:
                     for p, t in sorted(comp.items())))
             else:
                 lines.append("compile attribution: no traces observed")
+            lines.append(bar)
+            parts.append("\n".join(lines))
+
+        wire_report = (self._wire.report()
+                       if self._wire is not None
+                       and self._wire.steps else None)
+        if wire_report:
+            shares = wire_report["shares"]
+            header = (f"{'Program':20s} {'Steps':>8s} {'Wire':>7s} "
+                      f"{'Engine':>7s} {'Host':>7s}")
+            bar = "-" * len(header)
+            lines = [bar, "Cross-process step time shares "
+                          "(wire vs engine vs host)", bar, header, bar]
+            lines.append(f"{'ALL':20s} {wire_report['steps']:8d} "
+                         f"{shares['wire']:7.3f} "
+                         f"{shares['engine']:7.3f} "
+                         f"{shares['host']:7.3f}")
+            for prog, row in wire_report["per_program"].items():
+                s = row["shares"]
+                lines.append(f"{prog[:20]:20s} {row['steps']:8d} "
+                             f"{s['wire']:7.3f} {s['engine']:7.3f} "
+                             f"{s['host']:7.3f}")
             lines.append(bar)
             parts.append("\n".join(lines))
 

@@ -54,11 +54,13 @@ import socket
 import subprocess
 import sys
 import threading
+import time
 import traceback
 from collections import deque
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
+from ..observability import distrib
 from ..observability import lifecycle as _lc
 from ..observability.audit import AuditConfig
 from ..observability.metrics import MetricsRegistry
@@ -179,6 +181,15 @@ class ProcessFleetConfig:
     heartbeat_interval_s: float = 0.25
     heartbeat_timeout_s: float = 2.0   # silent control conn -> dead
     boot_timeout_s: float = 180.0
+    # ISSUE 17 cross-process tracing: workers run their engines with
+    # lifecycle events ON and stream sequence-numbered deltas back; the
+    # router merges them into its ONE tracker and mirrors them per
+    # worker so a kill -9 post-mortem still has the engine's last events
+    telemetry: bool = True
+    decode_event_sample: int = 8       # forwarded to the worker engine
+    mirror_ring_events: int = 512      # host-side per-worker mirror
+    stderr_tail_lines: int = 100       # per-worker stderr tail ring
+    clock_window: int = 64             # NTP-style min-RTT filter window
     python: str = sys.executable
     fleet: Optional[FleetConfig] = None  # router knobs (fault plan,
                                          # alert rules, flight dir, ...)
@@ -192,7 +203,8 @@ class WorkerHandle:
     compile-cache line in particular is how the cross-process
     compile-reuse satellite observes a sibling's cache hits)."""
 
-    def __init__(self, proc: subprocess.Popen, index: int):
+    def __init__(self, proc: subprocess.Popen, index: int,
+                 stderr_tail_lines: int = 100):
         self.proc = proc
         self.index = index
         self.pid = proc.pid
@@ -201,7 +213,13 @@ class WorkerHandle:
         self.boot_s = 0.0
         self.compile_cache: Optional[Dict] = None  # parsed cache line
         self.log_tail: deque = deque(maxlen=200)
+        # bounded stderr tail (ISSUE 17 satellite): a worker that dies
+        # in C++/XLA land leaves its last words HERE — the engine_death
+        # / crash_loop flight bundles embed this ring
+        self.stderr_tail: deque = deque(
+            maxlen=max(10, int(stderr_tail_lines)))
         self._pump: Optional[threading.Thread] = None
+        self._pump_err: Optional[threading.Thread] = None
 
     @classmethod
     def spawn(cls, cfg: ProcessFleetConfig, index: int,
@@ -219,9 +237,16 @@ class WorkerHandle:
             os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH")
             else "")
         proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
-                                stderr=subprocess.STDOUT, text=True,
+                                stderr=subprocess.PIPE, text=True,
                                 env=env)
-        h = cls(proc, index)
+        h = cls(proc, index, stderr_tail_lines=cfg.stderr_tail_lines)
+        # stderr pump starts BEFORE the ready-line wait: JAX boot
+        # warnings can fill the stderr pipe and deadlock a worker that
+        # never reaches its ready line if nobody drains it
+        h._pump_err = threading.Thread(target=h._pump_stderr,
+                                       daemon=True,
+                                       name=f"worker-stderr-{index}")
+        h._pump_err.start()
         # readline has no timeout: a watchdog timer kills a hung boot so
         # the read loop sees EOF instead of blocking forever
         killer = threading.Timer(cfg.boot_timeout_s, h._boot_timeout)
@@ -249,7 +274,7 @@ class WorkerHandle:
             killer.cancel()
         if h.port is None:
             h.stop(grace_s=0.5)
-            tail = "\n".join(h.log_tail)
+            tail = "\n".join(list(h.log_tail) + list(h.stderr_tail))
             raise WorkerDied(
                 f"worker {index} (pid {h.pid}) exited/hung before its "
                 f"ready line; log tail:\n{tail}")
@@ -276,6 +301,18 @@ class WorkerHandle:
             except OSError:
                 pass  # swallow-ok: double-close during teardown
 
+    def _pump_stderr(self) -> None:
+        try:
+            for line in self.proc.stderr:
+                self.stderr_tail.append(line.rstrip("\n"))
+        except (OSError, ValueError):
+            pass  # swallow-ok: stderr closed during teardown; the tail captured what there was
+        finally:
+            try:
+                self.proc.stderr.close()
+            except OSError:
+                pass  # swallow-ok: double-close during teardown
+
     @property
     def alive(self) -> bool:
         return self.proc.poll() is None
@@ -291,6 +328,8 @@ class WorkerHandle:
                 self.proc.wait(10)
         if self._pump is not None:
             self._pump.join(1.0)
+        if self._pump_err is not None:
+            self._pump_err.join(1.0)
 
 
 class _SchedulerProxy:
@@ -485,6 +524,32 @@ class WorkerEngineProxy:
             "serving_fleet_heartbeat_timeouts_total",
             "worker heartbeats that failed/timed out, marking the "
             "replica dead", replica=str(index))
+        # --- cross-process telemetry (ISSUE 17) -----------------------------
+        self._telemetry = bool(cfg.telemetry)
+        self.clock = distrib.ClockSync(window=cfg.clock_window)
+        self.mirror = distrib.MirrorRing(capacity=cfg.mirror_ring_events)
+        self.wire_stats = distrib.WireStats(
+            registry=shared.registry, labels={"replica": str(index)})
+        # summary() prints this replica's host/wire/engine share table
+        self.metrics.attach_wire_stats(self.wire_stats)
+        self._delta: Optional[distrib.DeltaMerger] = None  # per spawn
+        self._dropped_seen = 0
+        self._c_streamed = shared.registry.counter(
+            "serving_distrib_events_streamed_total",
+            "worker lifecycle events streamed over the wire and merged "
+            "into the router tracker", replica=str(index))
+        self._c_dropped = shared.registry.counter(
+            "serving_distrib_events_dropped_total",
+            "telemetry events dropped (worker outbox or host mirror "
+            "ring full)", replica=str(index))
+        self._g_clock_off = shared.registry.gauge(
+            "serving_distrib_clock_offset_seconds",
+            "estimated worker-minus-router monotonic clock offset "
+            "(min-RTT NTP sample)", replica=str(index))
+        self._g_clock_rtt = shared.registry.gauge(
+            "serving_distrib_clock_rtt_seconds",
+            "round-trip time of the best clock-sync sample",
+            replica=str(index))
         if live:
             self.spawn()
 
@@ -521,6 +586,14 @@ class WorkerEngineProxy:
         # only ever move forward across respawns
         self._merger = wire.RegistryMerger(shared.registry,
                                            str(self.index))
+        # fresh delta merger per incarnation: the new worker's outbox
+        # restarts its sequence numbers at 0, so the applied-seq
+        # intervals must reset with it (idempotency is per incarnation).
+        # The lifecycle is read through a getter because the router
+        # calls set_lifecycle AFTER the factory returns.
+        self._delta = distrib.DeltaMerger(
+            str(self.index), self.worker.pid, self.clock, self.mirror,
+            lambda: self.lifecycle)
         self.is_live = True
         if self._router_fi is not None:
             self._send_fault_plan()
@@ -561,11 +634,22 @@ class WorkerEngineProxy:
         conn = self._control_conn
         while not self._dead.is_set() and not self._closed:
             try:
+                t0 = time.perf_counter()
                 with self._control_lock:
                     conn.settimeout(cfg.heartbeat_timeout_s)
-                    reply = conn.request({"type": "health"})
+                    reply = conn.request({"type": "health", "t0": t0})
+                t3 = time.perf_counter()
                 if reply.get("type") != "health_ok":
                     raise WorkerDied(f"bad health reply: {reply!r}")
+                # each heartbeat doubles as an NTP-style clock probe
+                # (t0/t3 router clock, t1/t2 echoed worker clock)
+                t1, t2 = reply.get("t1"), reply.get("t2")
+                if reply.get("t0") == t0 and t1 is not None \
+                        and t2 is not None:
+                    self.clock.observe(t0, float(t1), float(t2), t3)
+                    self._g_clock_off.set(self.clock.offset)
+                    self._g_clock_rtt.set(self.clock.rtt)
+                self._absorb_telemetry(reply)
             except (socket.timeout, wire.WireError, WorkerDied,
                     OSError) as e:
                 if self._closed or self._dead.is_set():
@@ -590,10 +674,16 @@ class WorkerEngineProxy:
             self._replica_label = str(replica)
 
     def _lc(self, rid, name: str, **attrs) -> None:
-        if self.lifecycle is not None \
-                and self.engine_config.lifecycle_events:
-            self.lifecycle.event(rid, name, replica=self._replica_label,
-                                 **attrs)
+        if self.lifecycle is None \
+                or not self.engine_config.lifecycle_events:
+            return
+        if self._telemetry:
+            # telemetry streaming replaces the router-synthesized
+            # enqueued/finish stand-ins with the worker engine's REAL
+            # events (correct engine-side timestamps, full attrs)
+            return
+        self.lifecycle.event(rid, name, replica=self._replica_label,
+                             **attrs)
 
     def set_history(self, history) -> None:
         if self.engine_config.history:
@@ -665,6 +755,7 @@ class WorkerEngineProxy:
             self._mark_dead(f"submit rejected: {reply!r}")
             raise WorkerDied(
                 f"worker {self.index} refused submit: {reply!r}")
+        self._absorb_telemetry(reply)
         mirror = _MirrorRequest(request_id, frame["prompt_ids"])
         self.requests[request_id] = mirror
         self._has_work = True
@@ -684,6 +775,7 @@ class WorkerEngineProxy:
                     {"type": "abort", "rid": request_id,
                      "reason": reason.value})
                 ok = bool(reply.get("ok"))
+                self._absorb_telemetry(reply)
             except wire.WireError as e:
                 # dead worker: the request dies with it — finish the
                 # mirror locally so no handle waits on a ghost
@@ -703,6 +795,7 @@ class WorkerEngineProxy:
         self._require_live()
         conn = self._engine_conn
         try:
+            t0 = time.perf_counter()
             conn.send({"type": "step"})
             while True:
                 frame = conn.recv()
@@ -712,6 +805,8 @@ class WorkerEngineProxy:
                     if m is not None:
                         m.output_tokens.append(int(frame["token"]))
                 elif t == "step_done":
+                    t3 = time.perf_counter()
+                    self._absorb_wire(frame, t0, t3)
                     self._absorb_step(frame)
                     if frame.get("stepped") and self._history is not None:
                         self._history.on_step(self.step_seq)
@@ -745,6 +840,67 @@ class WorkerEngineProxy:
         fired = frame.get("fired") or []
         if fired and self._router_fi is not None:
             self._router_fi.mark_fired(fired)
+        self._absorb_telemetry(frame)
+
+    def _absorb_telemetry(self, frame: Dict) -> None:
+        """Merge a piggybacked lifecycle-event delta (idempotent across
+        replay/reorder — see :class:`distrib.DeltaMerger`) and keep the
+        streamed/dropped counters in step."""
+        if self._delta is None:
+            return
+        delta = frame.get("telemetry")
+        if delta:
+            applied = self._delta.merge(delta)
+            if applied:
+                self._c_streamed.inc(applied)
+        dropped = self._delta.worker_dropped + self.mirror.dropped
+        if dropped > self._dropped_seen:
+            self._c_dropped.inc(dropped - self._dropped_seen)
+            self._dropped_seen = dropped
+
+    def _absorb_wire(self, frame: Dict, t0: float, t3: float) -> None:
+        """Fold one step round-trip's timestamps into the wire-latency
+        attribution and the clock estimator (a step IS a valid NTP
+        probe: the RTT formula subtracts worker processing time)."""
+        stamps = frame.get("t")
+        if not stamps:
+            return
+        try:
+            recv, reply = float(stamps["recv"]), float(stamps["reply"])
+        except (KeyError, TypeError, ValueError):
+            return  # swallow-ok: stamps are an OPTIONAL protocol field — an old/partial worker reply just skips wire attribution for this step
+        self.clock.observe(t0, recv, reply, t3)
+        rec = frame.get("step_record")
+        program = None
+        if isinstance(rec, dict):
+            progs = rec.get("programs") or ()
+            program = ",".join(p.get("program", "?")
+                               for p in progs) or None
+        self.wire_stats.observe(t0, t3, stamps, program=program)
+        if isinstance(rec, dict):
+            # mirror the step record next to the lifecycle events: the
+            # engine_death bundle shows what the worker was computing
+            self.mirror.append({
+                "name": "step_record",
+                "ts": self.clock.to_router(reply),
+                "record": rec,
+            })
+
+    def distrib_state(self) -> Dict:
+        """Per-worker cross-process telemetry snapshot: the flight
+        recorder embeds this (via ``bind_distrib``) into post-mortem
+        bundles, and ``/v1/debug/wire`` serves it live."""
+        return {
+            "pid": self.pid,
+            "telemetry": self._telemetry,
+            "clock": self.clock.snapshot(),
+            "merge": (self._delta.snapshot()
+                      if self._delta is not None else None),
+            "mirror": self.mirror.snapshot(),
+            "stderr_tail": (list(self.worker.stderr_tail)
+                            if self.worker is not None else []),
+            "wire": self.wire_stats.report(),
+        }
 
     def _absorb_step(self, frame: Dict) -> None:
         self._absorb_metrics(frame)
@@ -829,9 +985,15 @@ class _SharedState:
             "unified_step": cfg.unified, "seed": cfg.seed,
             "audit_enabled": cfg.audit_enabled,
             "audit_sample_every": cfg.audit_sample_every,
-            # worker-local trackers/stores nobody reads: the router owns
-            # the fleet lifecycle timeline and the ONE history store
-            "lifecycle_events": False, "history": False,
+            # telemetry streaming (ISSUE 17): workers run their engines
+            # with lifecycle events ON and stream deltas back; the
+            # router still owns the ONE merged timeline and the ONE
+            # history store ("history" stays False).  telemetry=False
+            # restores the old dark-worker behavior.
+            "lifecycle_events": bool(cfg.telemetry),
+            "decode_event_sample": cfg.decode_event_sample,
+            "telemetry": bool(cfg.telemetry),
+            "history": False,
         }
 
     def factory(self, index: int, registry) -> WorkerEngineProxy:
@@ -898,9 +1060,20 @@ class ProcessFleet:
             self.shared.close_all()  # no orphan worker processes
             raise
         self.shared.built = True
+        # flight bundles embed the per-worker telemetry mirrors/stderr
+        # tails; a closure over shared.active reads the CURRENT proxies,
+        # so supervisor respawns need no rebind — and at engine_death
+        # time the DEAD proxy is still the active entry, so its mirror
+        # (the dead worker's last events) is exactly what gets dumped
+        self.router.flight.bind_distrib(self._distrib_state)
         self.supervisor: Optional[FleetSupervisor] = None
         self.autoscaler: Optional["FleetAutoscaler"] = None
         self.rebalancer: Optional["CacheRebalancer"] = None
+
+    def _distrib_state(self) -> Dict:
+        with self.shared.lock:
+            proxies = dict(self.shared.active)
+        return {str(i): p.distrib_state() for i, p in proxies.items()}
 
     # --- lifecycle ----------------------------------------------------------
     def supervise(self, config: Optional[SupervisorConfig] = None

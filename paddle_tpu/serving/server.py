@@ -111,7 +111,7 @@ from .request import FinishReason
 _MAX_HEADER_BYTES = 16384
 _ROUTES = ("/v1/completions", "/v1/requests", "/v1/debug/compiles",
            "/v1/debug/profile", "/v1/debug/audit", "/v1/debug/cache",
-           "/v1/debug/alerts", "/v1/debug/history",
+           "/v1/debug/alerts", "/v1/debug/history", "/v1/debug/wire",
            "/healthz", "/readyz", "/metrics")
 
 # pre-registered metric names this module owns (tools/check_metrics_docs
@@ -542,6 +542,7 @@ class CompletionServer:
 
         params = urllib.parse.parse_qs(query)
         lc = self.fleet.lifecycle
+        source, complete = self._timeline_source()
         if path == "/v1/requests":
             state = params.get("state", ["active"])[0]
             if state not in ("active", "recent"):
@@ -552,6 +553,7 @@ class CompletionServer:
             await self._respond(
                 writer, 200,
                 {"object": "list", "state": state,
+                 "source": source, "complete": complete,
                  "data": lc.summaries(state)},
                 keep_alive=keep_alive)
             return 200
@@ -579,9 +581,24 @@ class CompletionServer:
             payload = chrome_trace_dict(tl.chrome_spans(),
                                         epoch_offset=lc.epoch_offset)
         else:
-            payload = dict(tl.to_dict(lc.epoch_offset), object="request")
+            payload = dict(tl.to_dict(lc.epoch_offset), object="request",
+                           source=source, complete=complete)
         await self._respond(writer, 200, payload, keep_alive=keep_alive)
         return 200
+
+    def _timeline_source(self) -> Tuple[str, bool]:
+        """Honesty marker for the timeline endpoints (ISSUE 17
+        satellite): in ``--workers`` mode WITHOUT telemetry streaming
+        the router's tracker holds router-synthesized stand-ins only, so
+        the response must say ``complete: false`` instead of presenting
+        a router-only view as the whole story."""
+        proxies = [r.engine for r in self.fleet.replicas
+                   if hasattr(r.engine, "distrib_state")]
+        if not proxies:
+            return "in-process", True
+        if all(getattr(p, "_telemetry", False) for p in proxies):
+            return "router+workers", True
+        return "router-only", False
 
     # --- step-level introspection routes (ISSUE 9) --------------------------
     def _debug_int(self, params, name: str, default: int,
@@ -829,6 +846,43 @@ class CompletionServer:
                 {"object": "list", "data": data, "totals": totals,
                  "aot": aot,
                  "step_profile": self.engine.stepprof.enabled},
+                keep_alive=keep_alive)
+            return 200
+        if path == "/v1/debug/wire":
+            # ISSUE 17: per-worker wire-latency attribution + clock-sync
+            # + telemetry-merge state.  In-process fleets answer a crisp
+            # "disabled" shape (there is no wire), mirroring the other
+            # debug endpoints' degrade-not-404 discipline.
+            rows: Dict[str, Dict] = {}
+            for r in self.fleet.replicas:
+                eng = r.engine
+                if not hasattr(eng, "distrib_state"):
+                    continue
+                try:
+                    rows[str(r.index)] = eng.distrib_state()
+                except Exception:
+                    rows[str(r.index)] = {"status": "restarting"}
+            if not rows:
+                await self._respond(
+                    writer, 200,
+                    {"object": "wire", "enabled": False,
+                     "reason": "in-process fleet: no process wire to "
+                               "attribute (use --workers)"},
+                    keep_alive=keep_alive)
+                return 200
+            from ..observability.distrib import WireStats
+            agg = {"steps": 0, "wire_s": 0.0, "queue_s": 0.0,
+                   "engine_s": 0.0, "total_s": 0.0}
+            for state in rows.values():
+                w = state.get("wire") or {}
+                for k in agg:
+                    agg[k] += w.get(k, 0) or 0
+            await self._respond(
+                writer, 200,
+                {"object": "wire", "enabled": True,
+                 "shares": WireStats._shares(agg),
+                 "steps": agg["steps"],
+                 "replicas": rows},
                 keep_alive=keep_alive)
             return 200
         if path != "/v1/debug/profile":
@@ -1255,6 +1309,86 @@ async def _selftest_async(dp: int = 1, audit_sample: int = 1,
         await server.shutdown(drain_timeout=2.0)
 
 
+def _build_procfleet(args, fault_plan=None, alert_rules=None):
+    # cross-process fleet (ISSUE 16): N worker processes behind the
+    # SAME router/supervisor stack, reached over the wire protocol.
+    # The router process never loads program bytes — workers boot
+    # off the shared artifact themselves (--aot-path is forwarded)
+    from .procfleet import ProcessFleet, ProcessFleetConfig
+
+    pf = ProcessFleet(ProcessFleetConfig(
+        dp=args.workers, layers=args.layers, num_blocks=args.blocks,
+        max_num_seqs=8, max_prefill_tokens_per_step=None,
+        unified=args.unified,
+        audit_enabled=bool(args.audit_sample),
+        audit_sample_every=args.audit_sample or 1,
+        aot_path=args.aot_path, compile_cache=args.compile_cache,
+        warm_boot=args.aot_warm,
+        fleet=FleetConfig(max_queue=args.max_queue,
+                          flight_dir=args.flight_dir,
+                          fault_plan=fault_plan,
+                          alert_rules=alert_rules)))
+    # ISSUE 17 satellite: the SLO actuators are now one flag away on the
+    # serving CLI instead of library-only calls
+    if getattr(args, "autoscale", False):
+        from .procfleet import AutoscalerConfig
+
+        pf.enable_autoscaler(AutoscalerConfig(
+            min_replicas=args.autoscale_min,
+            max_replicas=args.autoscale_max))
+        print(f"autoscaler: live (min={pf.autoscaler.min_replicas}, "
+              f"max={pf.autoscaler.max_replicas})")
+    if getattr(args, "rebalance", False):
+        pf.enable_rebalancer()
+        print("rebalancer: live")
+    return pf
+
+
+async def _selftest_procfleet_async(args) -> int:
+    loop = asyncio.get_running_loop()
+    pf = _build_procfleet(args)
+    fleet = pf.router
+    server = CompletionServer(fleet, ServerConfig(
+        port=0, max_queue=args.max_queue))
+    await server.start()
+    try:
+        status, data = await loop.run_in_executor(
+            None, _http, server.port, "POST", "/v1/completions",
+            {"prompt": [5, 9, 23, 7], "max_tokens": 4})
+        assert status == 200, f"completions {status}: {data!r}"
+        obj = json.loads(data)
+        choice = obj["choices"][0]
+        assert len(choice["token_ids"]) == 4, choice
+        # honesty markers (ISSUE 17 satellite): --workers mode with
+        # telemetry streaming answers /v1/requests with the full
+        # cross-process story
+        status, data = await loop.run_in_executor(
+            None, _http, server.port, "GET", "/v1/requests?state=recent",
+            None)
+        assert status == 200, f"/v1/requests {status}"
+        listing = json.loads(data)
+        assert listing.get("source") == "router+workers", listing
+        assert listing.get("complete") is True, listing
+        # wire-latency attribution is queryable after one completion
+        status, data = await loop.run_in_executor(
+            None, _http, server.port, "GET", "/v1/debug/wire", None)
+        assert status == 200, f"/v1/debug/wire {status}"
+        wire = json.loads(data)
+        assert wire["enabled"] and wire["steps"] >= 1, wire
+        if args.autoscale:
+            assert pf.autoscaler is not None \
+                and pf.autoscaler._thread.is_alive(), \
+                "autoscaler actuator thread is not live"
+        print(f"selftest: OK (port {server.port}, workers={args.workers},"
+              f" tokens {choice['token_ids']}, wire steps "
+              f"{wire['steps']}"
+              + (", autoscaler live" if args.autoscale else "") + ")")
+        return 0
+    finally:
+        await server.shutdown(drain_timeout=2.0)
+        pf.shared.close_all()
+
+
 async def _serve_cli(args) -> int:
     audit = None
     if args.audit_sample:
@@ -1273,24 +1407,8 @@ async def _serve_cli(args) -> int:
         alert_rules = AlertRuleSet.from_json(args.alert_rules)
     pf = None
     if args.workers:
-        # cross-process fleet (ISSUE 16): N worker processes behind the
-        # SAME router/supervisor stack, reached over the wire protocol.
-        # The router process never loads program bytes — workers boot
-        # off the shared artifact themselves (--aot-path is forwarded)
-        from .procfleet import ProcessFleet, ProcessFleetConfig
-
-        pf = ProcessFleet(ProcessFleetConfig(
-            dp=args.workers, layers=args.layers, num_blocks=args.blocks,
-            max_num_seqs=8, max_prefill_tokens_per_step=None,
-            unified=args.unified,
-            audit_enabled=bool(args.audit_sample),
-            audit_sample_every=args.audit_sample or 1,
-            aot_path=args.aot_path, compile_cache=args.compile_cache,
-            warm_boot=args.aot_warm,
-            fleet=FleetConfig(max_queue=args.max_queue,
-                              flight_dir=args.flight_dir,
-                              fault_plan=fault_plan,
-                              alert_rules=alert_rules)))
+        pf = _build_procfleet(args, fault_plan=fault_plan,
+                              alert_rules=alert_rules)
         fleet = pf.router
         for i in range(args.workers):
             print(f"worker {i}: pid {pf.worker_pid(i)}")
@@ -1348,7 +1466,8 @@ async def _serve_cli(args) -> int:
           f"dp={fleet.dp} mp={server.engine.mp} "
           "(POST /v1/completions; GET /healthz /readyz /metrics "
           "/v1/requests /v1/debug/compiles /v1/debug/profile "
-          "/v1/debug/audit /v1/debug/alerts /v1/debug/history)")
+          "/v1/debug/audit /v1/debug/alerts /v1/debug/history "
+          "/v1/debug/wire)")
     try:
         await server.serve_forever()
     finally:
@@ -1470,6 +1589,22 @@ def main(argv=None) -> int:
                         "respawns it off the shared --aot-path artifact "
                         "and loses nothing.  0 = in-process replicas "
                         "(--dp)")
+    p.add_argument("--autoscale", action="store_true",
+                   help="with --workers: enable the SLO-driven "
+                        "autoscaler (alert firings → bounded worker "
+                        "scale actions).  Bounds via --autoscale-min / "
+                        "--autoscale-max")
+    p.add_argument("--autoscale-min", type=int, default=1, metavar="N",
+                   help="autoscaler floor: never drain below N live "
+                        "workers (default 1)")
+    p.add_argument("--autoscale-max", type=int, default=0, metavar="N",
+                   help="autoscaler ceiling: never provision above N "
+                        "workers (0 = the fleet's --workers count; the "
+                        "index space is fixed at boot)")
+    p.add_argument("--rebalance", action="store_true",
+                   help="with --workers: enable the prefix-cache "
+                        "rebalancer (hot-prefix replication across "
+                        "replicas)")
     p.add_argument("--compile-cache", default=None, metavar="DIR",
                    help="JAX persistent compilation cache directory for "
                         "--workers processes: N sibling workers compile "
@@ -1501,9 +1636,17 @@ def main(argv=None) -> int:
         if args.mp > 1:
             p.error("--workers runs single-chip worker processes; "
                     "--mp > 1 needs the in-process fleet (--dp)")
-        if args.selftest:
-            p.error("--selftest probes the in-process fleet; boot "
-                    "--workers without it and probe over HTTP")
+        if args.autoscale_min < 1:
+            p.error(f"--autoscale-min must be >= 1, got "
+                    f"{args.autoscale_min}")
+        if args.autoscale_max < 0:
+            p.error(f"--autoscale-max must be >= 0, got "
+                    f"{args.autoscale_max}")
+        if args.autoscale_max and args.autoscale_max < args.autoscale_min:
+            p.error("--autoscale-max must be >= --autoscale-min")
+    elif args.autoscale or args.rebalance:
+        p.error("--autoscale/--rebalance act on the cross-process "
+                "worker pool; they require --workers N")
     if args.audit_sample is not None and args.audit_sample < 1:
         p.error(f"--audit-sample must be >= 1, got {args.audit_sample}")
     if args.max_restarts < 0:
@@ -1536,6 +1679,12 @@ def main(argv=None) -> int:
                   f"in {wall:.3f}s")
         return 0
     if args.selftest:
+        if args.workers:
+            # ISSUE 17 satellite: the selftest now covers the cross-
+            # process fleet too — boots N workers, serves one completion
+            # over HTTP, and (with --autoscale) asserts the autoscaler
+            # actuator thread is live
+            return asyncio.run(_selftest_procfleet_async(args))
         return asyncio.run(_selftest_async(
             dp=args.dp, audit_sample=args.audit_sample or 1,
             unified=args.unified, aot_path=args.aot_path,

@@ -30,6 +30,24 @@ Frame vocabulary (see ``serving/worker.py`` for server-side semantics):
 ``step_error``), ``health``/``health_ok``, ``drain``/``drain_ok``,
 ``debug``/``debug_ok``, ``set_fault``/``ok``, ``shutdown``/``ok``,
 ``error``.
+
+Telemetry piggybacking (ISSUE 17, all fields OPTIONAL — a reply
+without them is valid, so mixed router/worker versions interoperate):
+
+* ``step_done``/``step_error``/``submit_ok``/``abort_ok``/``health_ok``
+  may carry ``telemetry`` — a bounded, sequence-numbered delta of the
+  worker engine's lifecycle events (``{"events": [...], "dropped": n}``)
+  the router merges idempotently
+  (:class:`~paddle_tpu.observability.distrib.DeltaMerger`);
+* ``step_done`` may carry ``t`` — worker-clock timestamps
+  ``{"recv","eng0","eng1","reply"}`` feeding the router's
+  host-vs-wire-vs-engine attribution
+  (:class:`~paddle_tpu.observability.distrib.WireStats`) — and
+  ``step_record``, the worker's stepprof record for the step;
+* a ``health`` frame may carry ``t0`` (router clock); the worker echoes
+  it on ``health_ok`` with ``t1`` (receipt) and ``t2`` (just before
+  send), completing an NTP-style ``(t0,t1,t2,t3)`` clock-sync sample
+  (:class:`~paddle_tpu.observability.distrib.ClockSync`).
 """
 
 from __future__ import annotations

@@ -68,7 +68,7 @@ _ENGINE_KEYS = ("lifecycle_events", "decode_event_sample", "step_profile",
 _SPEC_KEYS = _ENGINE_KEYS + (
     "layers", "num_blocks", "block_size", "max_num_seqs",
     "max_prefill_tokens_per_step", "max_tokens_per_step", "seed",
-    "audit_enabled", "audit_sample_every")
+    "audit_enabled", "audit_sample_every", "telemetry")
 
 
 def _count_cache_entries(path: Optional[str]) -> int:
@@ -126,12 +126,23 @@ class WorkerHost:
     respawns."""
 
     def __init__(self, engine, registry, replica: int,
-                 aot_hash: Optional[str], max_frame: int):
+                 aot_hash: Optional[str], max_frame: int,
+                 telemetry: bool = False):
         self.engine = engine
         self.registry = registry
         self.replica = int(replica)
         self.aot_hash = aot_hash
         self.max_frame = max_frame
+        # ISSUE 17 telemetry streaming: buffer this engine's lifecycle
+        # events (sequence-numbered, bounded) and piggyback deltas onto
+        # step/health replies — the router merges them into ITS tracker
+        self.telemetry = bool(telemetry)
+        self.outbox = None
+        if self.telemetry and getattr(engine, "lifecycle", None) is not None:
+            from ..observability.distrib import TelemetryOutbox
+
+            self.outbox = TelemetryOutbox()
+            engine.lifecycle.add_listener(self.outbox.on_event)
         self.lock = threading.RLock()
         self.started = time.time()
         self.draining = False
@@ -156,6 +167,16 @@ class WorkerHost:
         fired = set(fi.snapshot().get("fired_plan_indexes", []))
         delta = sorted(fired - self._fired_reported)
         self._fired_reported |= fired
+        return delta
+
+    def _drain(self, limit: int = 256) -> Optional[Dict]:
+        """Pop a bounded telemetry delta for piggybacking (``None``
+        when streaming is off or there is nothing to report)."""
+        if self.outbox is None:
+            return None
+        delta = self.outbox.drain(limit)
+        if not delta["events"] and not delta["dropped"]:
+            return None
         return delta
 
     # --- frame handlers -----------------------------------------------------
@@ -193,7 +214,8 @@ class WorkerHost:
                 trace_id=str(frame.get("trace_id", frame["rid"])),
                 prefix_hashes=hashes, slo_ms=frame.get("slo_ms"))
             self._live[frame["rid"]] = req
-        return {"type": "submit_ok", "rid": frame["rid"]}
+        return {"type": "submit_ok", "rid": frame["rid"],
+                "telemetry": self._drain(limit=64)}
 
     def handle_abort(self, frame: Dict) -> Dict:
         from .request import FinishReason
@@ -203,33 +225,49 @@ class WorkerHost:
             ok = self.engine.abort_request(frame["rid"], reason)
             if ok:
                 self._live.pop(frame["rid"], None)
-        return {"type": "abort_ok", "rid": frame["rid"], "ok": bool(ok)}
+        return {"type": "abort_ok", "rid": frame["rid"], "ok": bool(ok),
+                "telemetry": self._drain(limit=64)}
 
-    def handle_step(self, conn: wire.Connection) -> None:
+    def handle_step(self, conn: wire.Connection,
+                    t_recv: Optional[float] = None) -> None:
         """One engine step, streamed: ``token`` frames for every token
         the step produced, then ``step_done`` carrying the post-step
         state + fired-fault delta + a full metrics dump (the router
         merges it before ticking the shared history, so alert rules see
-        fresh cross-process values deterministically).  A step failure
-        sends ``step_error`` and kills the process — the supervisor's
-        respawn path owns recovery."""
+        fresh cross-process values deterministically), plus — with
+        telemetry streaming on — the worker-clock timestamps
+        (recv/eng0/eng1/reply) feeding the router's wire-latency
+        attribution, the pending lifecycle-event delta, and the step's
+        stepprof record.  A step failure sends ``step_error`` and kills
+        the process — the supervisor's respawn path owns recovery."""
+        if t_recv is None:
+            t_recv = time.perf_counter()
         with self.lock:
             eng = self.engine
             if not eng.scheduler.has_work():
+                now = time.perf_counter()
                 conn.send({"type": "step_done", "stepped": False,
                            "finished": {}, "fired": self._fired_delta(),
                            "metrics": wire.dump_registry(self.registry),
+                           "telemetry": self._drain(),
+                           "t": {"recv": t_recv, "eng0": now, "eng1": now,
+                                 "reply": time.perf_counter()},
                            **self._state()})
                 return
             before = {rid: len(req.output_tokens)
                       for rid, req in self._live.items()}
+            t_eng0 = time.perf_counter()
             try:
                 eng.step()
             except BaseException:
                 err = traceback.format_exc()
                 try:
+                    # final drain: ship everything buffered so the
+                    # router's mirror holds the events leading into the
+                    # death before this process exits
                     conn.send({"type": "step_error", "error": err,
                                "fired": self._fired_delta(),
+                               "telemetry": self._drain(limit=1024),
                                "metrics": wire.dump_registry(
                                    self.registry)})
                 except wire.WireError:
@@ -239,6 +277,7 @@ class WorkerHost:
                 self.exit_code = 3
                 self.dead.set()
                 return
+            t_eng1 = time.perf_counter()
             finished: Dict = {}
             for rid, req in list(self._live.items()):
                 toks = req.output_tokens
@@ -253,6 +292,11 @@ class WorkerHost:
                        "finished": finished,
                        "fired": self._fired_delta(),
                        "metrics": wire.dump_registry(self.registry),
+                       "telemetry": self._drain(),
+                       "step_record": eng.stepprof.last_record(),
+                       "t": {"recv": t_recv, "eng0": t_eng0,
+                             "eng1": t_eng1,
+                             "reply": time.perf_counter()},
                        **self._state()})
 
     def handle_debug(self, frame: Dict) -> Dict:
@@ -357,18 +401,30 @@ class WorkerHost:
             conn.close()
 
     def _dispatch(self, conn: wire.Connection, frame: Dict) -> None:
+        # dispatch-entry timestamp: the NTP-style clock probe's t1 and
+        # the wire-attribution "recv" stamp (worker monotonic clock)
+        t_recv = time.perf_counter()
         t = frame.get("type")
         if t == "step":
-            self.handle_step(conn)
+            self.handle_step(conn, t_recv)
         elif t == "submit":
             conn.send(self.handle_submit(frame))
         elif t == "abort":
             conn.send(self.handle_abort(frame))
         elif t == "health":
-            conn.send({"type": "health_ok", "pid": os.getpid(),
-                       "step_seq": int(self.engine.step_seq),
-                       "draining": self.draining,
-                       "uptime_s": round(time.time() - self.started, 3)})
+            reply = {"type": "health_ok", "pid": os.getpid(),
+                     "step_seq": int(self.engine.step_seq),
+                     "draining": self.draining,
+                     "uptime_s": round(time.time() - self.started, 3),
+                     "telemetry": self._drain(limit=128)}
+            if frame.get("t0") is not None:
+                # clock-sync probe: echo the router's t0, stamp our
+                # receipt (t1) and just-before-send (t2) so the router
+                # completes the (t0,t1,t2,t3) NTP sample on receipt
+                reply["t0"] = frame["t0"]
+                reply["t1"] = t_recv
+                reply["t2"] = time.perf_counter()
+            conn.send(reply)
         elif t == "debug":
             conn.send(self.handle_debug(frame))
         elif t == "set_fault":
@@ -465,7 +521,8 @@ def main(argv=None) -> int:
                    replica=str(args.replica)).set(boot_s)
 
     host = WorkerHost(engine, registry, args.replica, aot_hash,
-                      args.max_frame)
+                      args.max_frame,
+                      telemetry=bool(spec.get("telemetry", False)))
     server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
     server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
     server.bind((args.host, args.port))

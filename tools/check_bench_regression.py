@@ -92,6 +92,15 @@ CHECKS: Tuple[Tuple[str, str, float, float], ...] = (
     ("procfleet.engine_death_bundles",   "count_max", 0.0, 0.0),
     ("procfleet.restoration_wall_s",     "lower",     1.0, 5.0),
     ("procfleet.procfleet_tokens_per_sec", "higher",  0.5, 0.0),
+    # cross-process tracing (ISSUE 17): the wire+queue share of total
+    # step time in the FAULT-FREE run must not creep up unbounded (wide
+    # band — CPU localhost sockets are noisy but a protocol regression
+    # that doubles framing cost still trips it), and the telemetry
+    # mirror rings must drop EXACTLY zero events when nothing is killed
+    # (one drop in a fault-free run means the bounded rings are sized
+    # wrong or the piggyback drain starved)
+    ("procfleet.wire_overhead_share",    "lower",     1.0, 0.25),
+    ("procfleet.mirror_events_dropped",  "count_max", 0.0, 0.0),
 )
 
 

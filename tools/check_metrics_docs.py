@@ -52,6 +52,9 @@ DECLARING_MODULES = (
     os.path.join(_REPO, "paddle_tpu", "serving", "wire.py"),
     os.path.join(_REPO, "paddle_tpu", "serving", "worker.py"),
     os.path.join(_REPO, "paddle_tpu", "serving", "procfleet.py"),
+    # ISSUE 17: cross-process tracing — wire-latency histograms plus
+    # the telemetry-stream / clock-sync series
+    os.path.join(_REPO, "paddle_tpu", "observability", "distrib.py"),
 )
 
 _NAME_RE = re.compile(r"\b(?:serving|push)_[a-z0-9_:]+\b")

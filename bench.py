@@ -1090,6 +1090,108 @@ def serving_unified_bench() -> dict:
     return result
 
 
+def serving_spec_bench() -> dict:
+    """Speculative decoding phase (ISSUE 18): a decode-heavy stream of
+    cyclic prompts through the unified engine, spec-off vs spec-on
+    (n-gram draft/verify inside the same ragged program family), run
+    greedy AND seeded-sampled.  Asserts EXACT token identity both ways,
+    STRICTLY fewer engine steps with spec on, zero lost requests and no
+    extra jit traces; records the draft accept ratio the gate floors.
+    """
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.serving import (
+        EngineConfig,
+        EngineCore,
+        SamplingParams,
+        SchedulerConfig,
+    )
+    from paddle_tpu.serving.spec import SpecConfig
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    # cyclic prompts are the self-speculative sweet spot (repetitive
+    # continuations the n-gram proposer can actually predict); one
+    # aperiodic stream rides along so rejected/absent drafts are
+    # exercised in the same packed launches
+    rng = np.random.default_rng(0)
+    # (prompt, max_new): the aperiodic stream gets a shorter length
+    # budget so the step-count bottleneck rows are the cyclic streams
+    # the proposer can accelerate — otherwise a no-accept straggler
+    # pins the total step count and hides the saving
+    prompts = [([5, 6, 7, 8] * 3, 24),
+               ([40, 2, 11] * 4, 24),
+               ([5, 6, 7, 8] * 2 + [5, 6, 7], 24),
+               (rng.integers(0, 256, 8).tolist(), 12)]
+    sampled = dict(temperature=0.8, top_k=20, top_p=0.9, seed=1234)
+
+    def run(spec: bool) -> dict:
+        paddle.seed(0)
+        model = LlamaForCausalLM(LlamaConfig.tiny(num_hidden_layers=1))
+        eng = EngineCore(model, config=EngineConfig(
+            num_blocks=64, block_size=4,
+            scheduler=SchedulerConfig(max_num_seqs=4,
+                                      max_tokens_per_step=16),
+            unified_step=True,
+            spec=SpecConfig(k=4) if spec else None))
+        outs, lost = [], 0
+        t0 = time.perf_counter()
+        for sp in (dict(), sampled):  # greedy wave, then sampled wave
+            reqs = [eng.add_request(
+                p, SamplingParams(max_new_tokens=mx, **sp))
+                for p, mx in prompts]
+            eng.run(max_steps=4000)
+            lost += sum(not r.finished for r in reqs)
+            outs.append([list(r.output_tokens) for r in reqs])
+        wall = time.perf_counter() - t0
+        gen = sum(len(t) for wave in outs for t in wave)
+        return {
+            "spec": spec, "wall_s": round(wall, 4),
+            "tokens_per_sec": round(gen / wall, 2),
+            "generated_tokens": gen, "requests_lost": lost,
+            "engine_steps": eng.metrics.counters["engine_steps"],
+            "trace_count": eng.ragged_trace_count,
+            "drafted": (eng.spec.drafted_total if eng.spec else 0),
+            "accepted": (eng.spec.accepted_total if eng.spec else 0),
+            "accept_ratio": round(
+                eng.spec.accept_ratio if eng.spec else 0.0, 4),
+            "outputs": outs,
+            "metrics": eng.metrics.snapshot(),
+        }
+
+    plain, spec = run(False), run(True)
+    mismatches = sum(
+        a != b for pw, sw in zip(plain["outputs"], spec["outputs"])
+        for a, b in zip(pw, sw))
+    result = {
+        "metric": "serving_spec_accept_ratio",
+        "value": spec["accept_ratio"], "unit": "accepted/drafted",
+        "phase": "serving_spec",
+        "token_mismatches": mismatches,
+        "requests_lost": plain["requests_lost"] + spec["requests_lost"],
+        "spec_accept_ratio": spec["accept_ratio"],
+        "spec_drafted": spec["drafted"],
+        "spec_accepted": spec["accepted"],
+        "spec_engine_steps": spec["engine_steps"],
+        "plain_engine_steps": plain["engine_steps"],
+        "steps_saved": plain["engine_steps"] - spec["engine_steps"],
+        "spec_trace_count": spec["trace_count"],
+        "plain_trace_count": plain["trace_count"],
+        "spec_tokens_per_sec": spec["tokens_per_sec"],
+        "plain_tokens_per_sec": plain["tokens_per_sec"],
+        "plain": plain, "spec": spec,
+    }
+    assert mismatches == 0, (
+        f"spec-on diverged from spec-off on {mismatches} stream(s)")
+    assert result["requests_lost"] == 0, "spec phase lost requests"
+    assert spec["engine_steps"] < plain["engine_steps"], (
+        f"spec decoding saved no steps: {spec['engine_steps']} vs "
+        f"plain {plain['engine_steps']}")
+    assert spec["drafted"] > 0 and spec["accepted"] > 0, \
+        "phase sized to draft and accept, but the proposer never fired"
+    return result
+
+
 def serving_chaos_bench() -> dict:
     """Self-healing chaos phase (ISSUE 12): the preempting shared-prefix
     stream through a dp=2 supervised fleet under a scripted fault plan —
@@ -1767,6 +1869,10 @@ def serving_main() -> dict:
         # checkpoint before the unified phase for the same reason
         json.dump(result, f, indent=1)
     result["unified"] = serving_unified_bench()
+    with open(path, "w") as f:
+        # checkpoint before the spec phase for the same reason
+        json.dump(result, f, indent=1)
+    result["spec"] = serving_spec_bench()
     with open(path, "w") as f:
         # checkpoint before the chaos phase for the same reason
         json.dump(result, f, indent=1)

@@ -73,6 +73,7 @@ from .procfleet import (  # noqa: F401
 )
 from .resilience import FleetSupervisor, SupervisorConfig  # noqa: F401
 from .kv_manager import KVCacheManager, PoolExhausted  # noqa: F401
+from .spec import NgramProposer, SpecConfig, SpecDecoder  # noqa: F401
 from .wire import (  # noqa: F401
     ConnectionClosed,
     FrameError,

@@ -66,7 +66,11 @@ import numpy as np
 from ..parallel._compat import get_jax_export
 from .scheduler import bucket_size
 
-ARTIFACT_VERSION = 1
+# v2 (ISSUE 18): every program takes the per-row sampling quartet
+# (temps f32, top_ks i32, top_ps f32, keys u32[...,2]) and returns token
+# ids as output 0 — v1 artifacts predate in-trace sampling and refuse to
+# load rather than serve the wrong signature.
+ARTIFACT_VERSION = 2
 MANIFEST_NAME = "manifest.json"
 _PROGRAM_DIR = "programs"
 
@@ -222,6 +226,14 @@ def _arg_specs(engine, program: str, bucket: Tuple[int, ...]):
     traced program only ever sees int32)."""
     s = jax.ShapeDtypeStruct
     i32 = np.int32
+
+    def sampling(n):
+        # ISSUE 18: the per-row sampling quartet every program family now
+        # consumes as its trailing arguments (SamplingPack.arrays()) —
+        # (temps f32, top_ks i32, top_ps f32, keys u32[n, 2])
+        return (s((n,), np.float32), s((n,), i32), s((n,), np.float32),
+                s((n, 2), np.uint32))
+
     params = tuple(s(np.shape(p._value), np.dtype(p._value.dtype))
                    for p in engine._params)
     pools = tuple(s(tuple(k.shape), np.dtype(k.dtype))
@@ -230,21 +242,22 @@ def _arg_specs(engine, program: str, bucket: Tuple[int, ...]):
     if program == "decode":
         Bb, Wb = bucket
         return head + (s((Bb, 1), i32), s((Bb,), i32), s((Bb, Wb), i32),
-                       s((Bb,), i32), s((Bb,), i32), s((Bb,), i32))
+                       s((Bb,), i32), s((Bb,), i32), s((Bb,), i32)) \
+            + sampling(Bb)
     if program == "prefill":
         (Tb,) = bucket
         return head + (s((1, Tb), i32), s((), i32), s((Tb,), i32),
-                       s((Tb,), i32))
+                       s((Tb,), i32)) + sampling(1)
     if program == "chunk":
         Wb, TWb = bucket
         return head + (s((1, Wb), i32), s((), i32), s((), i32),
                        s((1, TWb), i32), s((1,), i32), s((1, Wb), i32),
-                       s((1, Wb), i32))
+                       s((1, Wb), i32)) + sampling(1)
     if program == "ragged":
         Tb, TWb = bucket
         return head + (s((1, Tb), i32), s((1, Tb), i32), s((Tb,), i32),
                        s((Tb,), i32), s((Tb, TWb), i32), s((Tb,), i32),
-                       s((Tb,), i32), s((Tb,), i32))
+                       s((Tb,), i32), s((Tb,), i32)) + sampling(Tb)
     raise AotError(f"unknown program family {program!r}")
 
 
@@ -388,6 +401,14 @@ class AotArtifact:
                 "max_tokens_per_step": sched.max_tokens_per_step,
             },
             "autotune": _autotune_decisions(engine),
+            # ISSUE 18: recorded for inspection only — deliberately NOT a
+            # validate() mismatch row.  Spec decode packs verify chunks
+            # into the SAME ragged bucket lattice (no new family, no new
+            # axis), so one artifact serves spec-on and spec-off engines
+            # alike; refusing on a spec flip would break that contract.
+            "spec": (engine.spec.config.manifest_dict()
+                     if getattr(engine, "spec", None) is not None
+                     else None),
             "programs": prog_meta,
             "save_seconds": round(time.perf_counter() - t0, 4),
         }
@@ -529,8 +550,8 @@ class AotArtifact:
         canonicalized to the exported int32 avals (the engine builds
         int64 token ids; x64-off tracing saw int32) — ``Exported.call``
         is strict where ``jit`` canonicalizes.  Returns the engine's
-        step-output tuple ``(logits, logit_stats, k_pools, v_pools)``
-        with the pool pytrees coerced back to tuples."""
+        step-output tuple ``(tokens, logits, logit_stats, k_pools,
+        v_pools)`` with the pool pytrees coerced back to tuples."""
         key = (program,) + tuple(int(b) for b in bucket)
         exported = self._programs.get(key)
         if exported is None:
@@ -556,7 +577,7 @@ class AotArtifact:
                 != aval.dtype) else x
             for x, aval in zip(flat, avals)]
         out = exported.call(*jax.tree_util.tree_unflatten(tree, coerced))
-        return out[0], out[1], tuple(out[2]), tuple(out[3])
+        return out[0], out[1], out[2], tuple(out[3]), tuple(out[4])
 
     def warm(self, registry=None, labels: Optional[Dict] = None) -> float:
         """Execute every saved program once with zero-filled arguments of

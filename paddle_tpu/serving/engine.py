@@ -69,9 +69,12 @@ from ..ops.paged_attention import (
     PoolExhausted,
     shard_kv_pool,
 )
+from ..ops.sampling import sample_tokens
 from .kv_manager import KVCacheManager
 from .metrics import ServingMetrics, StepTimer
 from .request import FinishReason, Request, RequestState, SamplingParams
+from .sampling import SamplingPack
+from .sampling import register_metrics as _register_sampling_metrics
 from .scheduler import (
     ContinuousBatchingScheduler,
     SchedulerConfig,
@@ -184,6 +187,15 @@ class EngineConfig:
     # silently retracing).
     aot_path: Optional[str] = None
     aot: Optional[object] = None
+    # Speculative decoding (ISSUE 18): a host-side n-gram proposer
+    # drafts k tokens per decode-resident request and the engine packs
+    # them as short verify chunks into the SAME unified ragged bucket
+    # lattice (no new program family, no new bucket axes) — accepted
+    # runs deliver multiple tokens per engine step.  Requires
+    # ``unified_step=True`` and a ``max_tokens_per_step`` budget (draft
+    # tokens compete for the step's leftover budget).  None = off;
+    # greedy spec-decode is token-identical to baseline (bench-gated).
+    spec: Optional[object] = None  # serving.spec.SpecConfig
 
 
 class EngineCore:
@@ -234,6 +246,11 @@ class EngineCore:
         self.metrics = ServingMetrics(registry=registry,
                                       labels=metrics_labels)
         self.tracer = self.metrics.tracer
+        # in-trace sampling counters (ISSUE 18): every emitted token now
+        # comes off the device already sampled; these attribute them to
+        # the greedy vs sampled row kinds
+        self._sampling_counters = _register_sampling_metrics(
+            self.metrics.registry)
         # --- step-level introspection (ISSUE 9) ----------------------------
         # bucket-utilization/padding accounting + compile attribution +
         # capture windows, on the same registry (replica-labeled under a
@@ -361,6 +378,30 @@ class EngineCore:
                                     **jit_kw["ragged"])
         self._profile_ops = config.profile_ops
         model.eval()
+        # --- speculative decoding (ISSUE 18) --------------------------------
+        # host-side n-gram proposer + verify-row bookkeeping; packs draft
+        # tokens into the unified ragged program as short verify chunks,
+        # so spec on vs off is the SAME program family and bucket lattice
+        self.spec = None
+        if config.spec is not None and \
+                getattr(config.spec, "enabled", True):
+            if not self._unified:
+                raise ValueError(
+                    "EngineConfig.spec requires unified_step=True: draft "
+                    "verification packs into the unified ragged program "
+                    "(there is no legacy-family verify path)")
+            sched_cfg = self.scheduler.config
+            if sched_cfg.max_tokens_per_step is None:
+                raise ValueError(
+                    "EngineConfig.spec requires "
+                    "SchedulerConfig.max_tokens_per_step: draft tokens "
+                    "compete for the step's leftover token budget — an "
+                    "unbounded budget would unbound the packed bucket")
+            from .spec import SpecDecoder
+
+            self.spec = SpecDecoder(config.spec,
+                                    registry=self.metrics.registry,
+                                    labels=metrics_labels)
         # --- AOT serving artifacts (ISSUE 15) -------------------------------
         # bound LAST: validate() compares against the fully-resolved
         # engine (mp, pools, unified flag).  A pre-loaded artifact
@@ -440,26 +481,31 @@ class EngineCore:
         params = tuple(
             NamedSharding(mesh, _fit_spec(param_spec(p), tuple(p.shape), mesh))
             for p in self._params)
-        # logits + audit logit-stats replicated, pools stay sharded
-        out = (repl, repl, pools, pools)
+        # sampled tokens + logits + audit logit-stats replicated, pools
+        # stay sharded.  Every family takes 4 extra replicated inputs —
+        # the per-row sampling quartet (temps, top_ks, top_ps, keys) the
+        # in-trace sampler consumes (ISSUE 18).
+        out = (repl, repl, repl, pools, pools)
         return {
             # (param_vals, k_pools, v_pools, ids, pos, tables, lens,
-            #  slot_blocks, slot_offsets)
-            "decode": {"in_shardings": (params, pools, pools) + (repl,) * 6,
+            #  slot_blocks, slot_offsets, temps, top_ks, top_ps, keys)
+            "decode": {"in_shardings": (params, pools, pools) + (repl,) * 10,
                        "out_shardings": out},
-            # (param_vals, k_pools, v_pools, ids, last_pos, blocks, offs)
-            "prefill": {"in_shardings": (params, pools, pools) + (repl,) * 4,
+            # (param_vals, k_pools, v_pools, ids, last_pos, blocks, offs,
+            #  temps, top_ks, top_ps, keys)
+            "prefill": {"in_shardings": (params, pools, pools) + (repl,) * 8,
                         "out_shardings": out},
             # (param_vals, k_pools, v_pools, ids, start, last_pos, tables,
-            #  lens, slot_blocks, slot_offsets)
-            "chunk": {"in_shardings": (params, pools, pools) + (repl,) * 7,
+            #  lens, slot_blocks, slot_offsets, temps, top_ks, top_ps,
+            #  keys)
+            "chunk": {"in_shardings": (params, pools, pools) + (repl,) * 11,
                       "out_shardings": out},
             # (param_vals, k_pools, v_pools, ids, pos, seg_ids, last_idx,
-            #  tables, lens, slot_blocks, slot_offsets) — the unified
-            # ragged step (ISSUE 11): packed routing metadata replicated,
-            # pools sharded; inside, the ragged kernel re-partitions over
-            # mp via shard_map
-            "ragged": {"in_shardings": (params, pools, pools) + (repl,) * 8,
+            #  tables, lens, slot_blocks, slot_offsets, temps, top_ks,
+            #  top_ps, keys) — the unified ragged step (ISSUE 11):
+            # packed routing metadata replicated, pools sharded; inside,
+            # the ragged kernel re-partitions over mp via shard_map
+            "ragged": {"in_shardings": (params, pools, pools) + (repl,) * 12,
                        "out_shardings": out},
         }
 
@@ -485,9 +531,11 @@ class EngineCore:
                 p._value = v
 
     def _decode_fn(self, param_vals, k_pools, v_pools, ids, pos,
-                   tables, lens, slot_blocks, slot_offsets):
+                   tables, lens, slot_blocks, slot_offsets,
+                   temps, top_ks, top_ps, keys):
         """One batched decode step: write each sequence's token KV into
-        its (block, offset) slot, attend through the block tables, return
+        its (block, offset) slot, attend through the block tables, sample
+        each row's next token in-trace (ISSUE 18) and return tokens +
         last-position logits + updated pools.  Shapes fixed per bucket."""
         self.decode_trace_count += 1
         # host side-effects run only while JAX traces: these count
@@ -504,15 +552,19 @@ class EngineCore:
             caches.append(c)
         logits = self._call_model(ids, caches, pos, param_vals)
         last = logits[:, -1, :].astype(jnp.float32)
+        # in-trace sampling epilogue (ISSUE 18): greedy rows (temp 0,
+        # padding included) reduce to argmax inside the same program —
+        # one compiled program serves greedy and sampled batches
+        tokens = sample_tokens(last, temps, top_ks, top_ps, keys)
         # numerics-audit sentinel (ISSUE 10): tiny in-trace reductions
         # over the output logits ride the launch as one extra output —
         # computed unconditionally so audit on/off is the SAME program
-        return (last, logit_stats(last),
+        return (tokens, last, logit_stats(last),
                 tuple(c.k_pool._value for c in caches),
                 tuple(c.v_pool._value for c in caches))
 
     def _prefill_fn(self, param_vals, k_pools, v_pools, ids, last_pos,
-                    blocks, offs):
+                    blocks, offs, temps, top_ks, top_ps, keys):
         """Bucketed prefill: dense-cache forward over the (padded) prompt,
         then scatter every layer's K/V into the sequence's pages.  Pad
         positions scatter into block 0 (the null page).  Returns the
@@ -532,17 +584,18 @@ class EngineCore:
         ]
         logits = self._call_model(ids, dense, jnp.int32(0), param_vals)
         last = jnp.take(logits[0], last_pos, axis=0).astype(jnp.float32)
+        tokens = sample_tokens(last[None], temps, top_ks, top_ps, keys)
         new_k = tuple(
             kp.at[blocks, offs].set(kb._value[0].astype(kp.dtype))
             for kp, (kb, _) in zip(k_pools, dense))
         new_v = tuple(
             vp.at[blocks, offs].set(vb._value[0].astype(vp.dtype))
             for vp, (_, vb) in zip(v_pools, dense))
-        return last, logit_stats(last), new_k, new_v
+        return tokens, last, logit_stats(last), new_k, new_v
 
     def _chunk_prefill_fn(self, param_vals, k_pools, v_pools, ids, start,
                           last_pos, tables, lens, slot_blocks,
-                          slot_offsets):
+                          slot_offsets, temps, top_ks, top_ps, keys):
         """Chunked/resumed prefill: run ``ids`` (one bucketed chunk of a
         prompt, starting at absolute position ``start``) straight through
         the PAGED pool — the chunk's K/V scatters into its (block, offset)
@@ -562,12 +615,14 @@ class EngineCore:
             caches.append(c)
         logits = self._call_model(ids, caches, start, param_vals)
         last = jnp.take(logits[0], last_pos, axis=0).astype(jnp.float32)
-        return (last, logit_stats(last),
+        tokens = sample_tokens(last[None], temps, top_ks, top_ps, keys)
+        return (tokens, last, logit_stats(last),
                 tuple(c.k_pool._value for c in caches),
                 tuple(c.v_pool._value for c in caches))
 
     def _unified_fn(self, param_vals, k_pools, v_pools, ids, pos, seg_ids,
-                    last_idx, tables, lens, slot_blocks, slot_offsets):
+                    last_idx, tables, lens, slot_blocks, slot_offsets,
+                    temps, top_ks, top_ps, keys):
         """ONE packed ragged step (ISSUE 11): ``ids`` is a flat
         ``[1, Tb]`` token batch mixing decode rows (1 token each) and
         prefill chunks, with per-token absolute positions ``pos``
@@ -594,7 +649,13 @@ class EngineCore:
             caches.append(c)
         logits = self._call_model(ids, caches, pos, param_vals)
         last = jnp.take(logits[0], last_idx, axis=0).astype(jnp.float32)
-        return (last, logit_stats(last),
+        # sample at EVERY packed token position (ISSUE 18): the sampling
+        # quartet is per-TOKEN here, so a spec-decode verify row gets its
+        # per-position target tokens from the very same reduction a plain
+        # decode row's single position uses — no new program family
+        tokens = sample_tokens(logits[0].astype(jnp.float32),
+                               temps, top_ks, top_ps, keys)
+        return (tokens, last, logit_stats(last),
                 tuple(c.k_pool._value for c in caches),
                 tuple(c.v_pool._value for c in caches))
 
@@ -760,6 +821,17 @@ class EngineCore:
         elif len(req.output_tokens) >= req.sampling.max_new_tokens:
             self._finish(req, FinishReason.LENGTH)
 
+    def _emit_device(self, req: Request, tok: int) -> None:
+        """Emit one DEVICE-sampled token (ISSUE 18): the step program
+        already ran the greedy/sampled reduction in-trace; the host only
+        attributes the emission to the right counter.  The request's
+        legacy host RNG is never consumed — the device key is the pure
+        ``(seed, output_position)`` pair, so determinism needs no host
+        stream at all."""
+        kind = "greedy" if req.sampling.temperature == 0.0 else "sampled"
+        self._sampling_counters[kind].inc()
+        self._emit(req, int(tok))
+
     def _retire(self, req: Request) -> None:
         self.scheduler.remove(req)
         self.kv.free(req.request_id)
@@ -807,11 +879,12 @@ class EngineCore:
 
     def _finish_prefill_chunk(self, req: Request, ids_full, target: int,
                               start: int, n: int, recompute: bool,
-                              t0: float, logits_row) -> None:
+                              t0: float, tok: int) -> None:
         """Post-launch bookkeeping for one prefill chunk, shared by both
         program paths: commit, lifecycle event, counters, prefix-hash
-        registration, and the completion emission (the final chunk's
-        last-position logits ARE the request's next token)."""
+        registration, and the completion emission — ``tok`` is the
+        device-sampled token off the final chunk's last-position logits,
+        emitted only when the prefill completes."""
         rid = req.request_id
         self.kv.commit(rid, n)
         self._lc(rid, _lc.EV_PREFILL_CHUNK, start=start, tokens=n,
@@ -824,7 +897,7 @@ class EngineCore:
             # admitted next step shares them even mid-prefill
             self.kv.record_block_hashes(rid, ids_full, start + n)
         if start + n >= target:
-            self._emit(req, req.sampling.sample(logits_row, req._rng))
+            self._emit_device(req, tok)
 
     def _prefill(self, req: Request) -> None:
         """Run one bucketed prefill program for ``req`` — the whole
@@ -838,6 +911,11 @@ class EngineCore:
             self._begin_prefill_chunk(req, t_chunk0)
         table = self.kv.table(rid)
         pos = np.arange(start, start + n)
+        # one sampling quartet row: the final chunk's last-position draw
+        # (output position len(output_tokens) — on recompute the replayed
+        # positions are already in output_tokens and never re-drawn)
+        pack = SamplingPack(1)
+        pack.set_request(0, req)
         if start == 0 and n == target:
             # cold one-shot: dense-cache forward + scatter (the cheapest
             # program when nothing is cached and no budget splits it)
@@ -855,13 +933,14 @@ class EngineCore:
                                   recompute=bool(req.output_tokens)):
                 with StepTimer(self.metrics, "prefill_step",
                                self._collective_phase("prefill")) as st:
-                    last, stats, self._k_pools, self._v_pools = \
+                    toks, last, stats, self._k_pools, self._v_pools = \
                         self._step_call(
                             "prefill", (Tb,), self._jit_prefill,
                             self._param_vals(), self._k_pools,
                             self._v_pools, ids_arr, np.int32(target - 1),
-                            blocks, offs)
+                            blocks, offs, *pack.arrays())
                     logits = np.asarray(last, np.float32)
+                    tok = int(np.asarray(toks, np.int32)[0])
             if self.prefill_trace_count > traces0:
                 # the in-trace counter advanced during THIS launch, so
                 # its wall time is the trace+compile of this bucket
@@ -904,13 +983,15 @@ class EngineCore:
                                   recompute=bool(req.output_tokens)):
                 with StepTimer(self.metrics, "prefill_step",
                                self._collective_phase("prefill")) as st:
-                    last, stats, self._k_pools, self._v_pools = \
+                    toks, last, stats, self._k_pools, self._v_pools = \
                         self._step_call(
                             "chunk", (Wb, TWb), self._jit_chunk_prefill,
                             self._param_vals(), self._k_pools,
                             self._v_pools, ids_arr, np.int32(start),
-                            np.int32(n - 1), tables, lens, blocks, offs)
+                            np.int32(n - 1), tables, lens, blocks, offs,
+                            *pack.arrays())
                     logits = np.asarray(last, np.float32)
+                    tok = int(np.asarray(toks, np.int32)[0])
             if self.prefill_trace_count > traces0:
                 self.stepprof.record_compile("chunk", (Wb, TWb), st.dt)
             self.stepprof.record_program(
@@ -927,7 +1008,7 @@ class EngineCore:
                     requests=[{"id": str(rid),
                                "greedy": req.sampling.temperature == 0.0}])
         self._finish_prefill_chunk(req, ids, target, start, n, recompute,
-                                   t_chunk0, logits)
+                                   t_chunk0, tok)
 
     def _decode(self, reqs: List[Request]) -> Dict[object, int]:
         """One bucketed decode step for ``reqs`` (slots already reserved
@@ -942,6 +1023,7 @@ class EngineCore:
         lens = np.ones((Bb,), np.int32)   # pad rows: 1 token of null page
         slot_blocks = np.zeros((Bb,), np.int32)
         slot_offsets = np.zeros((Bb,), np.int32)
+        pack = SamplingPack(Bb)  # pad rows stay temp=0 → argmax, ignored
         for i, r in enumerate(reqs):
             rid = r.request_id
             t = self.kv.table(rid)
@@ -951,6 +1033,7 @@ class EngineCore:
             tables[i, :len(t)] = t
             lens[i] = p + 1               # cache length AFTER this token
             slot_blocks[i], slot_offsets[i] = r._slot
+            pack.set_request(i, r)
         self.decode_buckets.add(("decode", Bb, Wb))
         traces0 = self.decode_trace_count
         # shadow-oracle capture (ISSUE 10): on sampled audit steps the
@@ -966,13 +1049,14 @@ class EngineCore:
                                               for r in reqs)):
             with StepTimer(self.metrics, "decode_step",
                            self._collective_phase("decode")) as st:
-                out, stats, self._k_pools, self._v_pools = \
+                toks, out, stats, self._k_pools, self._v_pools = \
                     self._step_call(
                         "decode", (Bb, Wb), self._jit_decode,
                         self._param_vals(), self._k_pools, self._v_pools,
                         ids, poss, tables, lens, slot_blocks,
-                        slot_offsets)
+                        slot_offsets, *pack.arrays())
                 out = np.asarray(out, np.float32)
+                toks = np.asarray(toks, np.int32)
         if self.decode_trace_count > traces0:
             self.stepprof.record_compile("decode", (Bb, Wb), st.dt)
         # token/row accounting only: scheduled = B real rows (one token
@@ -1012,13 +1096,14 @@ class EngineCore:
         result = {}
         for i, r in enumerate(reqs):
             self.kv.commit(r.request_id, 1)
-            tok = r.sampling.sample(out[i], r._rng)
-            self._emit(r, tok)
+            tok = int(toks[i])
+            self._emit_device(r, tok)
             result[r.request_id] = tok
         return result
 
     def _unified_exec(self, prefills: List[Request],
-                      decodes: List[Request]) -> Dict[object, int]:
+                      decodes: List[Request],
+                      draft_budget: int = 0) -> Dict[object, int]:
         """Pack this step's whole plan — decode rows + prefill chunks —
         into ONE ragged program launch (``EngineConfig.unified_step``).
         The token dim buckets on the TOTAL scheduled token count and the
@@ -1027,13 +1112,37 @@ class EngineCore:
         strictly fewer shapes than the legacy three.  Host bookkeeping
         (allocation, commits, hash registration, sampling, lifecycle
         events) matches the legacy paths row-for-row, which is what
-        keeps greedy tokens identical."""
+        keeps greedy tokens identical.
+
+        Speculative decoding (ISSUE 18): with ``EngineConfig.spec`` set,
+        decode rows may be upgraded to ``verify`` rows — the n-gram
+        proposer's k draft tokens ride as a short chunk
+        ``[last_token, d1..dk]`` at positions ``p..p+k``, inside the
+        step's leftover ``draft_budget``.  The per-position in-trace
+        sampler yields target tokens T_j at every position; the longest
+        ``d_{j+1} == T_j`` prefix is accepted, ``T_0..T_a`` are emitted
+        (a+1 tokens in ONE engine step) and the KV tail past the last
+        accepted position rolls back via :meth:`KVCacheManager.truncate`
+        (the preemption-recompute slot discipline, pointed at a length
+        instead of zero)."""
         rows: List[Dict] = []
         t0 = time.perf_counter()
         for r in decodes:
             p = self.kv.seq_len(r.request_id)
             rows.append({"req": r, "kind": "decode", "start": p, "n": 1,
                          "tokens": [r.last_token], "slot": r._slot})
+        drafts_packed = 0
+        if self.spec is not None and draft_budget > 0:
+            # upgrade decode rows to verify rows in-place (proposer +
+            # draft-slot allocation; a row whose slots cannot be covered
+            # stays a plain decode row — pool pressure, not an error)
+            drafts_packed = self.spec.plan_drafts(self.kv, rows,
+                                                  draft_budget)
+            if drafts_packed:
+                # keep the scheduled-token ledger exact (ISSUE 9): the
+                # scheduler planned 1 token per decode row; the drafts
+                # the engine packs on top are decode-side work too
+                self.scheduler.tokens_planned_decode += drafts_packed
         for req in prefills:
             # the SAME pre-launch bookkeeping the legacy programs run
             # (queue-wait, recompute accounting, all-or-nothing allocate)
@@ -1059,11 +1168,16 @@ class EngineCore:
         lens = np.ones((Tb,), np.int32)   # pad rows: 1 token of null page
         slot_blocks = np.zeros((Tb,), np.int32)  # pad tokens -> null page
         slot_offsets = np.zeros((Tb,), np.int32)
+        # per-TOKEN sampling quartet (ISSUE 18): pad positions stay
+        # temp=0 (argmax over the null page, discarded); a verify row's
+        # k+1 positions each carry their own output-position draw index
+        pack = SamplingPack(Tb)
         cursor = 0
         for i, row in enumerate(rows):
             req = row["req"]
             table = self.kv.table(req.request_id)
             n, start = row["n"], row["start"]
+            row["cursor"] = cursor
             ids[0, cursor:cursor + n] = row["tokens"]
             pp = np.arange(start, start + n)
             pos[0, cursor:cursor + n] = pp
@@ -1072,10 +1186,21 @@ class EngineCore:
             lens[i] = start + n           # cache length AFTER this step
             if row["kind"] == "decode":
                 slot_blocks[cursor], slot_offsets[cursor] = row["slot"]
+                pack.set_request(cursor, req)
             else:
+                # chunk AND verify rows: every position scatters into its
+                # own table-derived slot (a verify row's draft slots were
+                # just allocated by spec.plan_drafts, so its table covers
+                # start+n like any mid-prefill chunk's does)
                 slot_blocks[cursor:cursor + n] = [
                     table[x // self.block_size] for x in pp]
                 slot_offsets[cursor:cursor + n] = pp % self.block_size
+                if row["kind"] == "verify":
+                    for j in range(n):
+                        pack.set_request(cursor + j, req, offset=j)
+                else:
+                    # only the final chunk's last position is ever read
+                    pack.set_request(cursor + n - 1, req)
             cursor += n
             last_idx[i] = cursor - 1
         self.ragged_buckets.add(("ragged", Tb, TWb))
@@ -1090,13 +1215,14 @@ class EngineCore:
                                   for row in rows)):
             with StepTimer(self.metrics, "unified_step",
                            self._collective_phase("ragged")) as st:
-                out, stats, self._k_pools, self._v_pools = \
+                toks, out, stats, self._k_pools, self._v_pools = \
                     self._step_call(
                         "ragged", (Tb, TWb), self._jit_unified,
                         self._param_vals(), self._k_pools, self._v_pools,
                         ids, pos, seg, last_idx, tables, lens,
-                        slot_blocks, slot_offsets)
+                        slot_blocks, slot_offsets, *pack.arrays())
                 out = np.asarray(out, np.float32)
+                toks = np.asarray(toks, np.int32)
         if self.ragged_trace_count > traces0:
             self.stepprof.record_compile("ragged", (Tb, TWb), st.dt)
         # scheduled = T real tokens (decode rows count 1 each) vs the Tb
@@ -1134,11 +1260,49 @@ class EngineCore:
             req = row["req"]
             rid = req.request_id
             n, start = row["n"], row["start"]
+            c0 = row["cursor"]
             if row["kind"] == "decode":
                 self.kv.commit(rid, 1)
-                tok = req.sampling.sample(out[i], req._rng)
-                self._emit(req, tok)
+                tok = int(toks[c0])
+                self._emit_device(req, tok)
                 emitted[rid] = tok
+                continue
+            if row["kind"] == "verify":
+                # spec accept/rollback (ISSUE 18): position j's target
+                # T_j = toks[c0+j] is exactly the token the plain decode
+                # path would have sampled at that output position (same
+                # logits prefix, same (seed, draw) key) — so exact-match
+                # acceptance keeps spec-on token-identical to spec-off
+                # for greedy AND seeded sampling
+                drafts = row["drafts"]
+                accepted = 0
+                for j, d in enumerate(drafts):
+                    if int(toks[c0 + j]) == int(d):
+                        accepted += 1
+                    else:
+                        break
+                emitted_n = 0
+                for j in range(accepted + 1):
+                    self._emit_device(req, int(toks[c0 + j]))
+                    emitted[rid] = int(toks[c0 + j])
+                    emitted_n += 1
+                    if req.finished:
+                        break  # eos/length mid-run: later targets are
+                        # tokens the plain path would never have drawn
+                # KV valid prefix: the emitted tokens' consumed inputs
+                # (last_token + the accepted drafts actually consumed) —
+                # the newest emitted token's KV is, as ever, written by
+                # the step that consumes it
+                self.kv.commit(rid, emitted_n)
+                if not req.finished:
+                    # roll back the rejected/unconsumed draft tail (the
+                    # preemption-recompute slot discipline, aimed at a
+                    # length): surplus freshly-allocated blocks go back
+                    # to the free list
+                    self.kv.truncate(rid, start + emitted_n)
+                self.spec.record(len(drafts), accepted)
+                self._lc(rid, "spec_verify", drafted=len(drafts),
+                         accepted=accepted, emitted=emitted_n)
                 continue
             # the SAME post-launch bookkeeping the legacy programs run
             # (commit, lifecycle event, counters, hash registration,
@@ -1146,7 +1310,8 @@ class EngineCore:
             before = len(req.output_tokens)
             self._finish_prefill_chunk(req, row["ids_full"],
                                        row["target"], start, n,
-                                       row["recompute"], t0, out[i])
+                                       row["recompute"], t0,
+                                       int(toks[c0 + n - 1]))
             if len(req.output_tokens) > before:  # prefill completed
                 emitted[rid] = req.output_tokens[-1]
         return emitted
@@ -1243,9 +1408,12 @@ class EngineCore:
                 if self._unified:
                     # unified ragged step (ISSUE 11): the whole plan —
                     # decode rows + prefill chunks — is ONE packed launch
+                    # (draft tokens compete for the leftover budget,
+                    # ISSUE 18)
                     if plan.prefills or decodes:
                         emitted = self._unified_exec(plan.prefills,
-                                                     decodes)
+                                                     decodes,
+                                                     plan.draft_budget)
                 else:
                     for req in plan.prefills:
                         before = len(req.output_tokens)

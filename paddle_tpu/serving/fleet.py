@@ -440,18 +440,23 @@ class EngineReplica:
                     self.wake.clear()
         except Exception:
             # fail loudly but leave no handler hanging and no block held
-            self.error = traceback.format_exc()
+            err = traceback.format_exc()
             if self.flight is not None:
                 # post-mortem BEFORE the aborts below: the bundle then
                 # captures the dying requests' timelines while they are
                 # still in flight, plus the last-K events of THIS
-                # replica's ring (fired once per replica)
+                # replica's ring (fired once per replica).  Written
+                # BEFORE ``self.error`` flips ``alive`` False, so a
+                # watcher that observes the death always finds the
+                # bundle already on disk — never a dead replica whose
+                # post-mortem is still being serialized.
                 try:
                     self.flight.trigger("engine_death",
                                         replica=str(self.index),
-                                        detail=self.error)
+                                        detail=err)
                 except Exception:
                     pass  # swallow-ok: telemetry must never mask the death handling
+            self.error = err
             if not (self.supervised and not self._stop):
                 # unsupervised (or draining) death: abort everything so
                 # no block is held.  Under a supervisor the engine is

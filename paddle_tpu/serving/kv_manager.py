@@ -95,6 +95,40 @@ class KVCacheManager(BlockPool):
     def commit(self, seq_id, num_tokens: int = 1):
         self._lens[seq_id] = self._lens.get(seq_id, 0) + num_tokens
 
+    def truncate(self, seq_id, new_len: int) -> int:
+        """Roll the sequence back to ``new_len`` committed tokens,
+        returning surplus tail blocks to the pool (ISSUE 18: spec-decode
+        rejection rollback — the preemption-recompute slot discipline
+        aimed at a length instead of zero).  Tail blocks whose refcount
+        hits 0 go straight to the free list: their content is a
+        rejected-draft suffix, not cacheable prefix material (spec-draft
+        blocks are freshly allocated and never hashed; a still-shared
+        block just drops this owner's reference).  Stale K/V left in the
+        KEPT tail block past ``new_len`` is dead weight the per-row
+        ``lens`` routing never attends to, and the next decode/verify
+        slot overwrites it.  Returns the number of blocks freed."""
+        cur = self._lens.get(seq_id, 0)
+        if new_len > cur:
+            raise ValueError(
+                f"truncate({seq_id!r}, {new_len}) extends past the "
+                f"committed length {cur}")
+        table = self._tables.get(seq_id)
+        freed = 0
+        if table is not None:
+            keep = self.blocks_for(new_len)
+            while len(table) > keep:
+                b = table.pop()
+                n = self._ref.get(b, 1) - 1
+                if n > 0:
+                    self._ref[b] = n
+                    continue
+                self._ref.pop(b, None)
+                self._drop_hash(b)  # no-op for never-hashed draft blocks
+                self._free.append(b)
+                freed += 1
+        self._lens[seq_id] = new_len
+        return freed
+
     # --- views -------------------------------------------------------------
     def table(self, seq_id) -> List[int]:
         return self._tables.get(seq_id, [])

@@ -168,7 +168,18 @@ class ProcessFleetConfig:
     block_size: int = 4
     max_num_seqs: int = 4
     max_prefill_tokens_per_step: Optional[int] = 8
+    max_tokens_per_step: Optional[int] = None
     unified: bool = False
+    # multi-chip workers (ISSUE 18 fleet satellite): each worker process
+    # builds an mp-way mesh before its engine (on CPU the spawn injects
+    # XLA_FLAGS=--xla_force_host_platform_device_count so the child sees
+    # enough devices); the mp degree rides the wire handshake as part of
+    # the deployment identity — a drifted worker answers deploy_mismatch
+    mp: int = 1
+    # speculative decoding (ISSUE 18): JSON-able SpecConfig kwargs dict
+    # forwarded to every worker (requires unified + max_tokens_per_step);
+    # its manifest_dict() also rides the handshake deployment identity
+    spec: Optional[Dict] = None
     audit_enabled: bool = False
     audit_sample_every: int = 1
     seed: int = 0
@@ -236,6 +247,16 @@ class WorkerHandle:
         env["PYTHONPATH"] = _REPO_ROOT + (
             os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH")
             else "")
+        if cfg.mp > 1 and "--xla_force_host_platform_device_count" \
+                not in env.get("XLA_FLAGS", ""):
+            # mp>1 on the forced-host-device CPU backend: the CHILD
+            # process must see >= mp devices before jax initializes —
+            # injecting here (not in the worker) keeps the worker module
+            # backend-agnostic.  Real TPU workers already have their
+            # chips; the guard leaves an operator's explicit flag alone.
+            env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                                " --xla_force_host_platform_device_count"
+                                f"={cfg.mp}").strip()
         proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
                                 stderr=subprocess.PIPE, text=True,
                                 env=env)
@@ -483,7 +504,7 @@ class WorkerEngineProxy:
         self.engine_config = shared.template_engine_cfg
         self.block_size = cfg.block_size
         self.num_blocks = cfg.num_blocks
-        self.mp = 1
+        self.mp = int(cfg.mp)
         self.metrics = ServingMetrics(registry=shared.registry,
                                       labels={"replica": str(index)})
         # host-side span tracer: the HTTP frontend wraps every request
@@ -573,14 +594,15 @@ class WorkerEngineProxy:
                 f"the fleet shares {expect!r} — artifact drift between "
                 "router and worker")
         labels = {"replica": str(self.index)}
+        deploy = shared.deploy()
         self._engine_conn = wire.connect(
             "127.0.0.1", self.worker.port, role="engine",
             aot_hash=expect, registry=shared.registry, labels=labels,
-            side="router")
+            side="router", deploy=deploy)
         self._control_conn = wire.connect(
             "127.0.0.1", self.worker.port, role="control",
             aot_hash=expect, registry=shared.registry, labels=labels,
-            side="router")
+            side="router", deploy=deploy)
         # fresh merger per incarnation: its delta baselines reset with
         # the new worker's (zeroed) counters, so shared-registry totals
         # only ever move forward across respawns
@@ -739,6 +761,7 @@ class WorkerEngineProxy:
             "sampling": {
                 "max_new_tokens": sp.max_new_tokens,
                 "temperature": sp.temperature, "top_k": sp.top_k,
+                "top_p": sp.top_p,
                 "eos_token_id": sp.eos_token_id, "seed": sp.seed},
             "priority": priority, "trace_id": trace_id,
             "prefix_hashes": ([h.hex() for h in prefix_hashes]
@@ -958,6 +981,8 @@ class _SharedState:
         self.template_engine_cfg = EngineConfig(
             num_blocks=cfg.num_blocks, block_size=cfg.block_size,
             unified_step=cfg.unified,
+            mp=(cfg.mp if cfg.mp > 1 else None),
+            spec=self.spec_config(),
             audit=(self.template_audit if cfg.audit_enabled else None))
         self.aot_handle: Optional[AotManifestHandle] = None
         self.active: Dict[int, WorkerEngineProxy] = {}  # index ->
@@ -974,6 +999,25 @@ class _SharedState:
             "serving_fleet_active_workers",
             "live (spawned, not dead/closed) worker processes")
 
+    def spec_config(self):
+        """The fleet's :class:`~paddle_tpu.serving.spec.SpecConfig`, or
+        ``None`` when spec decoding is off.  Built from the SAME kwargs
+        dict each worker receives, so the router's deployment identity
+        and every worker's engine-derived one agree by construction."""
+        if not self.cfg.spec:
+            return None
+        from .spec import SpecConfig
+
+        sc = SpecConfig(**self.cfg.spec)
+        return sc if sc.enabled else None
+
+    def deploy(self) -> Dict:
+        """Deployment identity presented in every wire handshake
+        (ISSUE 18 fleet satellite): mesh-slice shape + spec config."""
+        sc = self.spec_config()
+        return {"mp": int(self.cfg.mp),
+                "spec": (sc.manifest_dict() if sc is not None else None)}
+
     def worker_spec(self) -> Dict:
         cfg = self.cfg
         return {
@@ -982,6 +1026,8 @@ class _SharedState:
             "max_num_seqs": cfg.max_num_seqs,
             "max_prefill_tokens_per_step":
                 cfg.max_prefill_tokens_per_step,
+            "max_tokens_per_step": cfg.max_tokens_per_step,
+            "mp": cfg.mp, "spec": cfg.spec,
             "unified_step": cfg.unified, "seed": cfg.seed,
             "audit_enabled": cfg.audit_enabled,
             "audit_sample_every": cfg.audit_sample_every,

@@ -56,6 +56,7 @@ class CompletionRequest:
     max_tokens: int = 16
     temperature: float = 0.0
     top_k: int = 0
+    top_p: float = 1.0
     seed: int = 0
     eos_token_id: Optional[int] = None
     stream: bool = False
@@ -75,8 +76,8 @@ class CompletionRequest:
     def sampling(self) -> SamplingParams:
         return SamplingParams(
             max_new_tokens=self.max_tokens, temperature=self.temperature,
-            top_k=self.top_k, eos_token_id=self.eos_token_id,
-            seed=self.seed)
+            top_k=self.top_k, top_p=self.top_p,
+            eos_token_id=self.eos_token_id, seed=self.seed)
 
 
 def _typed(obj: dict, key: str, kinds, default, *, none_ok: bool = False):
@@ -140,6 +141,12 @@ def parse_completion_request(
     top_k = _typed(obj, "top_k", int, 0)
     if top_k < 0:
         raise ProtocolError("'top_k' must be >= 0")
+    top_p = float(_typed(obj, "top_p", (int, float), 1.0))
+    # ISSUE 18: NaN compares False against everything, so an unvalidated
+    # NaN would silently disable the nucleus cut inside the traced
+    # sampler; 0 would keep no tokens at all — both are 400s here
+    if not math.isfinite(top_p) or not 0.0 < top_p <= 1.0:
+        raise ProtocolError("'top_p' must be finite and in (0, 1]")
     timeout = _typed(obj, "timeout", (int, float), None, none_ok=True)
     if timeout is not None and (not math.isfinite(float(timeout))
                                 or float(timeout) <= 0):
@@ -157,6 +164,7 @@ def parse_completion_request(
         max_tokens=max_tokens,
         temperature=temperature,
         top_k=top_k,
+        top_p=top_p,
         seed=seed,
         eos_token_id=_typed(obj, "eos_token_id", int, None, none_ok=True),
         stream=_typed(obj, "stream", bool, False),

@@ -53,13 +53,20 @@ class SamplingParams:
     max_new_tokens: int = 16
     temperature: float = 0.0
     top_k: int = 0
+    top_p: float = 1.0
     eos_token_id: Optional[int] = None
     seed: int = 0
 
     def sample(self, logits: np.ndarray, rng: np.random.Generator) -> int:
         """One token from a [vocab] logits row.  Greedy is RNG-free; a
         sampled draw consumes exactly one ``rng`` event, which is what
-        makes recompute resume the stream at the right point."""
+        makes recompute resume the stream at the right point.
+
+        NOTE: since ISSUE 18 the engine samples on device (Gumbel-max
+        keyed by ``(seed, draw_index)`` inside the traced step — see
+        ``ops/sampling.py``); this host implementation stays as the
+        reference semantics (the filtering pipeline matches: temperature
+        scale -> top-k mask -> top-p nucleus mask -> draw)."""
         if self.temperature == 0.0:
             return int(logits.argmax(-1))
         x = logits.astype(np.float64) / max(self.temperature, 1e-6)
@@ -68,6 +75,17 @@ class SamplingParams:
             x = np.where(x < kth, -np.inf, x)
         p = np.exp(x - x.max())
         p /= p.sum()
+        if 0.0 < self.top_p < 1.0:
+            # nucleus filter: keep the smallest prob mass >= top_p.  The
+            # max-prob token always survives (its cumsum entry is first),
+            # so the filtered distribution is never empty.
+            order = np.argsort(-p)
+            csum = np.cumsum(p[order])
+            cut = int(np.argmax(csum >= self.top_p))
+            keep = np.zeros_like(p, dtype=bool)
+            keep[order[:cut + 1]] = True
+            p = np.where(keep, p, 0.0)
+            p /= p.sum()
         return int(rng.choice(p.shape[-1], p=p))
 
 

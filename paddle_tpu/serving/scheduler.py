@@ -128,6 +128,13 @@ class SchedulerOutput:
     decodes: List[Request] = field(default_factory=list)
     preempted: List[Request] = field(default_factory=list)
     aborted: List[Request] = field(default_factory=list)
+    # speculative-decode headroom (ISSUE 18): tokens left of
+    # ``max_tokens_per_step`` after this plan's decode rows + prefill
+    # chunks — the engine may pack at most this many DRAFT tokens into
+    # the unified launch, so the packed token count never outgrows the
+    # same ``max(total, decode rows)`` bucket bound the plain plan has.
+    # 0 when no combined budget is configured (spec requires one).
+    draft_budget: int = 0
 
 
 class ContinuousBatchingScheduler:
@@ -392,6 +399,14 @@ class ContinuousBatchingScheduler:
         self.tokens_planned_prefill += sum(
             r._chunk_tokens or 0 for r in out.prefills)
         self.tokens_planned_decode += len(out.decodes)
+        total = self.config.max_tokens_per_step
+        if total is not None:
+            # leftover of the SINGLE step budget after decode rows and
+            # planned prefill chunks: the spec-decode draft allowance
+            # (the engine ledgers any drafts it actually packs)
+            used = len(out.decodes) + sum(
+                r._chunk_tokens or 0 for r in out.prefills)
+            out.draft_budget = max(0, int(total) - used)
         return out
 
     @property

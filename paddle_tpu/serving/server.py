@@ -1143,18 +1143,27 @@ class CompletionServer:
 def _toy_engine(layers: int = 2, num_blocks: int = 64,
                 block_size: int = 4, registry=None,
                 metrics_labels=None, audit=None,
-                unified: bool = False, aot=None) -> EngineCore:
+                unified: bool = False, aot=None,
+                max_tokens_per_step: Optional[int] = None,
+                spec=None) -> EngineCore:
     import paddle_tpu as paddle
     from ..models import LlamaConfig, LlamaForCausalLM
     from .engine import EngineConfig
+    from .scheduler import SchedulerConfig
 
     paddle.seed(0)
     model = LlamaForCausalLM(LlamaConfig.tiny(num_hidden_layers=layers))
+    scheduler = None
+    if max_tokens_per_step is not None:
+        scheduler = SchedulerConfig(
+            max_tokens_per_step=int(max_tokens_per_step))
     return EngineCore(model,
                       config=EngineConfig(num_blocks=num_blocks,
                                           block_size=block_size,
                                           audit=audit,
                                           unified_step=unified,
+                                          scheduler=scheduler,
+                                          spec=spec,
                                           aot=aot),
                       registry=registry, metrics_labels=metrics_labels)
 
@@ -1164,7 +1173,8 @@ def _toy_fleet(dp: int = 1, layers: int = 2, num_blocks: int = 64,
                flight_dir: Optional[str] = None,
                audit=None, unified: bool = False,
                fault_plan=None, alert_rules=None,
-               aot=None) -> FleetRouter:
+               aot=None, max_tokens_per_step: Optional[int] = None,
+               spec=None) -> FleetRouter:
     """A dp-replica fleet of toy engines on one shared registry: each
     replica gets its OWN model instance (engine threads swap parameter
     values during the traced step — modules must not be shared) with
@@ -1178,7 +1188,8 @@ def _toy_fleet(dp: int = 1, layers: int = 2, num_blocks: int = 64,
         lambda i, registry: _toy_engine(
             layers=layers, num_blocks=num_blocks, registry=registry,
             metrics_labels={"replica": str(i)}, audit=audit,
-            unified=unified, aot=aot),
+            unified=unified, aot=aot,
+            max_tokens_per_step=max_tokens_per_step, spec=spec),
         dp=dp, config=FleetConfig(max_queue=max_queue,
                                   flight_dir=flight_dir,
                                   fault_plan=fault_plan,
@@ -1309,6 +1320,13 @@ async def _selftest_async(dp: int = 1, audit_sample: int = 1,
         await server.shutdown(drain_timeout=2.0)
 
 
+def _spec_dict(args) -> Optional[dict]:
+    """SpecConfig kwargs from the CLI (``None`` = spec decoding off)."""
+    if not getattr(args, "spec_decode", False):
+        return None
+    return {"enabled": True, "k": args.spec_k}
+
+
 def _build_procfleet(args, fault_plan=None, alert_rules=None):
     # cross-process fleet (ISSUE 16): N worker processes behind the
     # SAME router/supervisor stack, reached over the wire protocol.
@@ -1319,6 +1337,11 @@ def _build_procfleet(args, fault_plan=None, alert_rules=None):
     pf = ProcessFleet(ProcessFleetConfig(
         dp=args.workers, layers=args.layers, num_blocks=args.blocks,
         max_num_seqs=8, max_prefill_tokens_per_step=None,
+        max_tokens_per_step=args.max_tokens_per_step,
+        # multi-chip workers (ISSUE 18): each worker process builds its
+        # own mp-way mesh slice; the degree (and the spec-decoding
+        # config) is validated at every wire handshake
+        mp=args.mp, spec=_spec_dict(args),
         unified=args.unified,
         audit_enabled=bool(args.audit_sample),
         audit_sample_every=args.audit_sample or 1,
@@ -1424,12 +1447,20 @@ async def _serve_cli(args) -> int:
             aot = AotArtifact.load(args.aot_path)
             print(f"aot: loaded {aot.program_count} program(s) from "
                   f"{args.aot_path} in {aot.load_seconds:.3f}s")
+        spec = None
+        spec_kwargs = _spec_dict(args)
+        if spec_kwargs:
+            from .spec import SpecConfig
+
+            spec = SpecConfig(**spec_kwargs)
         fleet = _toy_fleet(dp=args.dp, layers=args.layers,
                            num_blocks=args.blocks,
                            max_queue=args.max_queue,
                            flight_dir=args.flight_dir, audit=audit,
                            unified=args.unified, fault_plan=fault_plan,
-                           alert_rules=alert_rules, aot=aot)
+                           alert_rules=alert_rules, aot=aot,
+                           max_tokens_per_step=args.max_tokens_per_step,
+                           spec=spec)
     supervisor = None
     if args.max_restarts > 0:
         # self-healing by default (ISSUE 12): dead replicas restart
@@ -1554,6 +1585,25 @@ def main(argv=None) -> int:
                         "(NaN/Inf sentinel + logit telemetry on every "
                         "step; .npz repros land in --flight-dir); off "
                         "by default")
+    p.add_argument("--max-tokens-per-step", type=int, default=None,
+                   metavar="T",
+                   help="unified ragged packing: per-step token budget "
+                        "shared by decode rows, prefill chunks and "
+                        "(with --spec-decode) draft verification; "
+                        "required by --spec-decode")
+    p.add_argument("--spec-decode", action="store_true",
+                   help="speculative decoding (ISSUE 18): a host-side "
+                        "n-gram proposer drafts tokens per decode-"
+                        "resident request and the engine verifies them "
+                        "as short chunks packed into the unified ragged "
+                        "step — greedy outputs are token-identical with "
+                        "strictly fewer engine steps.  Requires "
+                        "--unified and --max-tokens-per-step; composes "
+                        "with --workers (the spec config rides the wire "
+                        "handshake as deployment identity)")
+    p.add_argument("--spec-k", type=int, default=4, metavar="K",
+                   help="--spec-decode: max draft tokens proposed per "
+                        "request per step (default 4)")
     p.add_argument("--unified", action="store_true",
                    help="serve through the unified ragged step program "
                         "(one packed prefill+decode launch per engine "
@@ -1633,9 +1683,6 @@ def main(argv=None) -> int:
             p.error("--workers and --dp are the two fleet modes — pick "
                     "one (cross-process: --workers N; in-process: "
                     "--dp N)")
-        if args.mp > 1:
-            p.error("--workers runs single-chip worker processes; "
-                    "--mp > 1 needs the in-process fleet (--dp)")
         if args.autoscale_min < 1:
             p.error(f"--autoscale-min must be >= 1, got "
                     f"{args.autoscale_min}")
@@ -1651,11 +1698,23 @@ def main(argv=None) -> int:
         p.error(f"--audit-sample must be >= 1, got {args.audit_sample}")
     if args.max_restarts < 0:
         p.error(f"--max-restarts must be >= 0, got {args.max_restarts}")
-    if args.mp > 1:
+    if args.spec_decode:
+        if not args.unified:
+            p.error("--spec-decode verifies drafts inside the unified "
+                    "ragged step program; it requires --unified")
+        if args.max_tokens_per_step is None:
+            p.error("--spec-decode needs --max-tokens-per-step: drafts "
+                    "compete for the step's leftover token budget")
+        if args.spec_k < 0:
+            p.error(f"--spec-k must be >= 0, got {args.spec_k}")
+    if args.mp > 1 and not args.workers:
         # tensor-parallel serving (ISSUE 5): build the mesh BEFORE any
         # engine (selftest included — the probe must exercise the real
         # degree) so parameters and KV pools land sharded.  On CPU this
         # needs XLA_FLAGS=--xla_force_host_platform_device_count=N.
+        # With --workers the mesh lives in each WORKER process (ISSUE
+        # 18): the router forwards mp through the worker spec and never
+        # builds a mesh of its own.
         from ..distributed import topology
 
         topology.init_mesh(mp=args.mp)

@@ -83,7 +83,8 @@ METRIC_NAMES = (
 
 # bounded error-kind label vocabulary for serving_wire_errors_total
 ERROR_KINDS = ("closed", "truncated", "oversized", "malformed",
-               "version_mismatch", "aot_mismatch", "protocol", "io")
+               "version_mismatch", "aot_mismatch", "deploy_mismatch",
+               "protocol", "io")
 
 
 class WireError(RuntimeError):
@@ -123,12 +124,36 @@ def error_frame(code: str, detail: str) -> Dict:
     return {"type": "error", "code": str(code), "detail": str(detail)[:2000]}
 
 
-def hello_frame(role: str, aot_hash: Optional[str]) -> Dict:
+def hello_frame(role: str, aot_hash: Optional[str],
+                deploy: Optional[Dict] = None) -> Dict:
+    """``deploy`` is the caller's deployment identity (ISSUE 18 fleet
+    satellite): ``{"mp": int, "spec": manifest_dict|None}``.  ``None``
+    means "default single-chip, spec off" — an old peer that never sends
+    the field is indistinguishable from one that runs the defaults,
+    which is exactly the interop we want."""
     return {"type": "hello", "version": WIRE_VERSION, "role": role,
-            "aot_hash": aot_hash}
+            "aot_hash": aot_hash, "deploy": deploy}
 
 
-def check_hello(frame: Dict, aot_hash: Optional[str]) -> str:
+def canonical_deploy(deploy: Optional[Dict]) -> Optional[Dict]:
+    """Normalize a deployment-identity dict for comparison: the default
+    shape (mp=1, spec decoding off) collapses to ``None`` so a peer that
+    predates the field and one that runs the defaults agree."""
+    if not deploy:
+        return None
+    out = {"mp": int(deploy.get("mp", 1) or 1),
+           "spec": deploy.get("spec") or None}
+    if out["mp"] == 1 and out["spec"] is None:
+        return None
+    if out["spec"] is not None:
+        # JSON round-trips must compare equal: coerce the manifest's
+        # values through int (they are all counts/flags by contract)
+        out["spec"] = {str(k): int(v) for k, v in out["spec"].items()}
+    return out
+
+
+def check_hello(frame: Dict, aot_hash: Optional[str],
+                deploy: Optional[Dict] = None) -> str:
     """Worker-side handshake validation: returns the connection role or
     raises :class:`HandshakeMismatch` (the caller answers with
     :func:`error_frame` and closes the connection — never the process)."""
@@ -149,6 +174,18 @@ def check_hello(frame: Dict, aot_hash: Optional[str]) -> str:
             f"peer expects AOT manifest hash {str(theirs)[:16]!r}, this "
             f"worker serves {str(ours)[:16]!r} — the router and worker "
             "must share ONE artifact")
+    their_dep = canonical_deploy(frame.get("deploy"))
+    our_dep = canonical_deploy(deploy)
+    if their_dep != our_dep:
+        # mesh-slice shape (mp) or spec-decoding config drift between
+        # the router and a worker: refuse the CONNECTION, exactly like
+        # an aot_mismatch — a typed, connection-scoped rejection the
+        # supervisor can see, never a poisoned half-configured fleet
+        raise HandshakeMismatch(
+            "deploy_mismatch",
+            f"peer deploys {their_dep!r}, this worker deploys "
+            f"{our_dep!r} — mp degree and spec-decoding config must "
+            "match fleet-wide")
     role = frame.get("role")
     if role not in ("engine", "control"):
         raise HandshakeMismatch(
@@ -278,7 +315,8 @@ class Connection:
 def connect(host: str, port: int, role: str, aot_hash: Optional[str],
             registry=None, labels: Optional[Dict[str, str]] = None,
             side: str = "router", timeout: Optional[float] = 30.0,
-            max_frame: int = MAX_FRAME_BYTES) -> Connection:
+            max_frame: int = MAX_FRAME_BYTES,
+            deploy: Optional[Dict] = None) -> Connection:
     """Dial a worker and complete the client half of the handshake.
     Raises :class:`HandshakeMismatch` when the worker answers with an
     ``error`` frame (version/AOT-hash disagreement)."""
@@ -288,7 +326,7 @@ def connect(host: str, port: int, role: str, aot_hash: Optional[str],
                       max_frame=max_frame)
     conn.settimeout(timeout)
     try:
-        reply = conn.request(hello_frame(role, aot_hash))
+        reply = conn.request(hello_frame(role, aot_hash, deploy=deploy))
     except WireError:
         conn.close()
         raise

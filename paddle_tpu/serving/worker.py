@@ -68,7 +68,7 @@ _ENGINE_KEYS = ("lifecycle_events", "decode_event_sample", "step_profile",
 _SPEC_KEYS = _ENGINE_KEYS + (
     "layers", "num_blocks", "block_size", "max_num_seqs",
     "max_prefill_tokens_per_step", "max_tokens_per_step", "seed",
-    "audit_enabled", "audit_sample_every", "telemetry")
+    "audit_enabled", "audit_sample_every", "telemetry", "mp", "spec")
 
 
 def _count_cache_entries(path: Optional[str]) -> int:
@@ -97,6 +97,21 @@ def build_engine(spec: Dict, replica: int, registry, aot=None):
     from .engine import EngineConfig, EngineCore
     from .scheduler import SchedulerConfig
 
+    mp = int(spec.get("mp", 1) or 1)
+    if mp > 1:
+        # multi-chip worker (ISSUE 18 fleet satellite): build the mesh
+        # BEFORE the model so parameters and KV pools land sharded — the
+        # same ordering serving/server.py enforces for --mp.  On CPU the
+        # parent injects XLA_FLAGS=--xla_force_host_platform_device_count
+        # into this process's environment before spawn.
+        from ..distributed import topology
+
+        topology.init_mesh(mp=mp)
+    spec_decode = None
+    if spec.get("spec"):
+        from .spec import SpecConfig
+
+        spec_decode = SpecConfig(**spec["spec"])
     paddle.seed(int(spec.get("seed", 0)))
     model = LlamaForCausalLM(
         LlamaConfig.tiny(num_hidden_layers=int(spec.get("layers", 2))))
@@ -109,12 +124,13 @@ def build_engine(spec: Dict, replica: int, registry, aot=None):
     cfg = EngineConfig(
         num_blocks=int(spec.get("num_blocks", 64)),
         block_size=int(spec.get("block_size", 4)),
+        mp=mp if mp > 1 else None,
         scheduler=SchedulerConfig(
             max_num_seqs=int(spec.get("max_num_seqs", 4)),
             max_prefill_tokens_per_step=spec.get(
                 "max_prefill_tokens_per_step"),
             max_tokens_per_step=spec.get("max_tokens_per_step")),
-        audit=audit, aot=aot, **kwargs)
+        audit=audit, aot=aot, spec=spec_decode, **kwargs)
     return EngineCore(model, config=cfg, registry=registry,
                       metrics_labels={"replica": str(replica)})
 
@@ -127,12 +143,18 @@ class WorkerHost:
 
     def __init__(self, engine, registry, replica: int,
                  aot_hash: Optional[str], max_frame: int,
-                 telemetry: bool = False):
+                 telemetry: bool = False,
+                 deploy: Optional[Dict] = None):
         self.engine = engine
         self.registry = registry
         self.replica = int(replica)
         self.aot_hash = aot_hash
         self.max_frame = max_frame
+        # deployment identity (ISSUE 18 fleet satellite): mesh-slice
+        # shape + spec-decoding config, validated against every hello —
+        # a router driving a different deployment is refused with a
+        # typed deploy_mismatch, connection-scoped like aot_mismatch
+        self.deploy = deploy
         # ISSUE 17 telemetry streaming: buffer this engine's lifecycle
         # events (sequence-numbered, bounded) and piggyback deltas onto
         # step/health replies — the router merges them into ITS tracker
@@ -201,6 +223,7 @@ class WorkerHost:
             max_new_tokens=int(sp.get("max_new_tokens", 16)),
             temperature=float(sp.get("temperature", 0.0)),
             top_k=int(sp.get("top_k", 0)),
+            top_p=float(sp.get("top_p", 1.0)),
             eos_token_id=sp.get("eos_token_id"),
             seed=int(sp.get("seed", 0)))
         hashes = frame.get("prefix_hashes")
@@ -322,6 +345,7 @@ class WorkerHost:
             elif what == "describe":
                 data = {"pid": os.getpid(), "replica": self.replica,
                         "aot_hash": self.aot_hash,
+                        "deploy": wire.canonical_deploy(self.deploy),
                         "traces": {
                             "prefill": eng.prefill_trace_count,
                             "decode": eng.decode_trace_count,
@@ -360,7 +384,8 @@ class WorkerHost:
             conn.settimeout(60.0)
             try:
                 hello = conn.recv()
-                role = wire.check_hello(hello, self.aot_hash)
+                role = wire.check_hello(hello, self.aot_hash,
+                                        deploy=self.deploy)
             except wire.HandshakeMismatch as e:
                 conn.count_error(e.code)
                 conn.send(wire.error_frame(e.code, str(e)))
@@ -375,7 +400,8 @@ class WorkerHost:
                 return  # swallow-ok: counted by recv; a port probe, not a peer
             conn.send({"type": "hello_ok", "version": wire.WIRE_VERSION,
                        "replica": self.replica, "pid": os.getpid(),
-                       "aot_hash": self.aot_hash})
+                       "aot_hash": self.aot_hash,
+                       "deploy": wire.canonical_deploy(self.deploy)})
             (self._conns if role == "engine" else self._conns_ctl).inc()
             conn.settimeout(None)
             while not self.dead.is_set():
@@ -520,9 +546,13 @@ def main(argv=None) -> int:
                    "artifact load + optional warm)",
                    replica=str(args.replica)).set(boot_s)
 
+    spec_cfg = getattr(engine, "spec", None)
     host = WorkerHost(engine, registry, args.replica, aot_hash,
                       args.max_frame,
-                      telemetry=bool(spec.get("telemetry", False)))
+                      telemetry=bool(spec.get("telemetry", False)),
+                      deploy={"mp": int(engine.mp),
+                              "spec": (spec_cfg.config.manifest_dict()
+                                       if spec_cfg is not None else None)})
     server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
     server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
     server.bind((args.host, args.port))

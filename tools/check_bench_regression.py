@@ -71,6 +71,18 @@ CHECKS: Tuple[Tuple[str, str, float, float], ...] = (
     ("unified.unified_padding_ratio",    "lower",     0.0, 0.02),
     ("unified.unified_trace_count",      "count_max", 0.0, 0.0),
     ("unified.unified_tokens_per_sec",   "higher",    0.5, 0.0),
+    # spec phase (ISSUE 18): token identity and zero-lost are EXACT
+    # (one diverged stream IS the regression), the engine-step count is
+    # deterministic on the fixed stream and must stay strictly below
+    # the plain engine's (the in-phase assert enforces strictness; the
+    # committed cap stops step-count creep), and the n-gram accept
+    # ratio must not collapse (floor wide enough for draft-order
+    # jitter, tight enough to catch a broken verifier)
+    ("spec.token_mismatches",            "count_max", 0.0, 0.0),
+    ("spec.requests_lost",               "count_max", 0.0, 0.0),
+    ("spec.spec_engine_steps",           "count_max", 0.0, 0.0),
+    ("spec.spec_accept_ratio",           "higher",    0.0, 0.05),
+    ("spec.spec_trace_count",            "count_max", 0.0, 0.0),
     # chaos phase: self-healing must stay lossless and not collapse
     ("chaos.requests_lost",              "count_max", 0.0, 0.0),
     ("chaos.chaos_tokens_per_sec",       "higher",    0.5, 0.0),

@@ -104,6 +104,11 @@ SCAN_FILES = (
     # sample windows, seq-interval merge state and per-program wire
     # aggregates must every one stay bounded
     os.path.join(_REPO, "paddle_tpu", "observability", "distrib.py"),
+    # ISSUE 18: the spec-decode proposer must stay stateless (any
+    # per-request draft history would desynchronize on recompute) and
+    # the sampling helpers must not grow per-request key caches
+    os.path.join(_REPO, "paddle_tpu", "serving", "spec.py"),
+    os.path.join(_REPO, "paddle_tpu", "serving", "sampling.py"),
 )
 WAIVER = "unbounded-ok:"
 

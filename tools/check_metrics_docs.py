@@ -55,6 +55,10 @@ DECLARING_MODULES = (
     # ISSUE 17: cross-process tracing — wire-latency histograms plus
     # the telemetry-stream / clock-sync series
     os.path.join(_REPO, "paddle_tpu", "observability", "distrib.py"),
+    # ISSUE 18: speculative decoding (draft/accept counters, accept
+    # ratio/length) and the in-trace sampling path counters
+    os.path.join(_REPO, "paddle_tpu", "serving", "spec.py"),
+    os.path.join(_REPO, "paddle_tpu", "serving", "sampling.py"),
 )
 
 _NAME_RE = re.compile(r"\b(?:serving|push)_[a-z0-9_:]+\b")

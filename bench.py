@@ -1192,6 +1192,112 @@ def serving_spec_bench() -> dict:
     return result
 
 
+def serving_burst_bench() -> dict:
+    """Device-resident decode-burst phase (ISSUE 19): a decode-heavy
+    stream through the plain engine, burst-off vs burst-on (up to 8
+    decode steps per compiled launch), run greedy AND seeded-sampled.
+    Asserts EXACT token identity both ways, STRICTLY fewer engine steps
+    AND host round-trips with bursts on, zero lost requests, and the
+    burst trace count bounded by its two-axis bucket lattice; records
+    the tokens/s the gate floors."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.serving import (
+        EngineConfig,
+        EngineCore,
+        SamplingParams,
+        SchedulerConfig,
+    )
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    # decode-heavy: short prompts, long continuations — after the brief
+    # admission window the running set is a decode-only resident cohort
+    # and every step is burstable; one short stream rides along so the
+    # cohort shrinks mid-run and the row-bucket axis is exercised
+    rng = np.random.default_rng(0)
+    prompts = [(rng.integers(0, 256, 6).tolist(), 24),
+               (rng.integers(0, 256, 6).tolist(), 24),
+               (rng.integers(0, 256, 8).tolist(), 24),
+               (rng.integers(0, 256, 8).tolist(), 12)]
+    sampled = dict(temperature=0.8, top_k=20, top_p=0.9, seed=1234)
+
+    def run(burst: bool) -> dict:
+        paddle.seed(0)
+        model = LlamaForCausalLM(LlamaConfig.tiny(num_hidden_layers=1))
+        eng = EngineCore(model, config=EngineConfig(
+            num_blocks=64, block_size=4,
+            scheduler=SchedulerConfig(max_num_seqs=4),
+            burst_steps=8 if burst else 0))
+        outs, lost = [], 0
+        t0 = time.perf_counter()
+        for sp in (dict(), sampled):  # greedy wave, then sampled wave
+            reqs = [eng.add_request(
+                p, SamplingParams(max_new_tokens=mx, **sp))
+                for p, mx in prompts]
+            eng.run(max_steps=4000)
+            lost += sum(not r.finished for r in reqs)
+            outs.append([list(r.output_tokens) for r in reqs])
+        wall = time.perf_counter() - t0
+        gen = sum(len(t) for wave in outs for t in wave)
+        return {
+            "burst": burst, "wall_s": round(wall, 4),
+            "tokens_per_sec": round(gen / wall, 2),
+            "generated_tokens": gen, "requests_lost": lost,
+            "engine_steps": eng.metrics.counters["engine_steps"],
+            "host_roundtrips": int(
+                eng._burst_counters["roundtrips"].value),
+            "burst_launches": int(
+                eng._burst_counters["launches"].value),
+            "burst_tokens": int(eng._burst_counters["tokens"].value),
+            "trace_count": eng.burst_trace_count,
+            "burst_buckets": sorted(
+                [list(b) for b in eng.burst_buckets]),
+            "outputs": outs,
+            "metrics": eng.metrics.snapshot(),
+        }
+
+    plain, burst = run(False), run(True)
+    mismatches = sum(
+        a != b for pw, bw in zip(plain["outputs"], burst["outputs"])
+        for a, b in zip(pw, bw))
+    result = {
+        "metric": "serving_burst_host_roundtrips",
+        "value": burst["host_roundtrips"], "unit": "launches",
+        "phase": "serving_burst",
+        "token_mismatches": mismatches,
+        "requests_lost": plain["requests_lost"] + burst["requests_lost"],
+        "burst_engine_steps": burst["engine_steps"],
+        "plain_engine_steps": plain["engine_steps"],
+        "burst_roundtrips": burst["host_roundtrips"],
+        "plain_roundtrips": plain["host_roundtrips"],
+        "roundtrips_saved": (plain["host_roundtrips"]
+                             - burst["host_roundtrips"]),
+        "burst_launches": burst["burst_launches"],
+        "burst_tokens": burst["burst_tokens"],
+        "burst_trace_count": burst["trace_count"],
+        "burst_buckets": burst["burst_buckets"],
+        "burst_tokens_per_sec": burst["tokens_per_sec"],
+        "plain_tokens_per_sec": plain["tokens_per_sec"],
+        "plain": plain, "burst": burst,
+    }
+    assert mismatches == 0, (
+        f"burst-on diverged from burst-off on {mismatches} stream(s)")
+    assert result["requests_lost"] == 0, "burst phase lost requests"
+    assert burst["engine_steps"] < plain["engine_steps"], (
+        f"bursts saved no engine steps: {burst['engine_steps']} vs "
+        f"plain {plain['engine_steps']}")
+    assert burst["host_roundtrips"] < plain["host_roundtrips"], (
+        f"bursts saved no host round-trips: {burst['host_roundtrips']} "
+        f"vs plain {plain['host_roundtrips']}")
+    assert burst["burst_launches"] > 0 and burst["burst_tokens"] > 0, \
+        "phase sized to burst, but no burst ever launched"
+    assert burst["trace_count"] <= len(burst["burst_buckets"]), (
+        f"burst retraced beyond its bucket lattice: "
+        f"{burst['trace_count']} traces, {burst['burst_buckets']}")
+    return result
+
+
 def serving_chaos_bench() -> dict:
     """Self-healing chaos phase (ISSUE 12): the preempting shared-prefix
     stream through a dp=2 supervised fleet under a scripted fault plan —
@@ -1881,6 +1987,14 @@ def serving_main() -> dict:
         # checkpoint before the aot phase for the same reason
         json.dump(result, f, indent=1)
     result["aot"] = serving_aot_bench()
+    with open(path, "w") as f:
+        # checkpoint before the burst phase for the same reason
+        # (burst rides AFTER aot so the aot wall-clock floors keep
+        # their historical in-run position — on the 1-core box a
+        # phase's tokens/s is sensitive to accumulated in-process
+        # state from the phases before it)
+        json.dump(result, f, indent=1)
+    result["burst"] = serving_burst_bench()
     with open(path, "w") as f:
         # checkpoint before the cross-process phase for the same reason
         json.dump(result, f, indent=1)

@@ -63,10 +63,11 @@ from .metrics import MetricsRegistry
 from .tracer import Span
 
 # the bucketed program families the engine dispatches: the legacy three
-# (PR 1/4 — one-shot prefill, chunked/resumed prefill, batched decode)
-# plus "ragged", the unified packed prefill+decode program (ISSUE 11)
-# that replaces them under EngineConfig.unified_step
-STEP_PROGRAMS = ("prefill", "chunk", "decode", "ragged")
+# (PR 1/4 — one-shot prefill, chunked/resumed prefill, batched decode),
+# "ragged", the unified packed prefill+decode program (ISSUE 11) that
+# replaces them under EngineConfig.unified_step, and "burst", the
+# device-resident multi-step decode loop (ISSUE 19)
+STEP_PROGRAMS = ("prefill", "chunk", "decode", "ragged", "burst")
 
 # pre-registered metric names this module owns (tools/check_metrics_docs
 # lints that each appears in README's metrics table)

@@ -1,0 +1,148 @@
+"""Device-resident decode-burst loop (ISSUE 19).
+
+``run_burst`` chains up to ``n_steps`` decode steps inside ONE traced
+program with a ``lax.fori_loop`` (traced trip count → lowers to a
+``while_loop``, which ``jax.export`` serializes fine): each iteration
+writes the input token's KV into its pre-routed pool slot, runs the
+model, samples the next token with the ISSUE 18 fused epilogue, and
+feeds that token straight back in as the next iteration's input — the
+host sees only the final ``[B, N]`` token buffer.  This is stage 2 of
+the MPK-style mega-kernel plan (PAPERS.md #5): the host loop does
+bookkeeping only, returning to the device at burst granularity instead
+of token granularity.
+
+Division of labor with the engine:
+
+* **Host-side clamp, device-side EOS masking.**  The engine clamps the
+  burst length so no row can exceed ``max_new_tokens`` or the pool's
+  pre-allocated slots mid-burst; the ONLY in-trace early exit is EOS.
+  A row that samples its EOS token emits it (matching the per-step
+  host path, where the EOS token is appended before the finish), then
+  goes inactive: its remaining iterations write KV to the null page
+  (block 0 — the same sink bucketed padding rows use) and its buffer
+  lanes stay ``-1`` (token ids are argmax indices, always ``>= 0``, so
+  ``-1`` is an unambiguous not-emitted sentinel).
+* **Sampling keys advance in-trace.**  The draw key for iteration ``j``
+  is ``(seed, draw0 + j)`` — an active row emits exactly one token per
+  iteration, so ``draw0 + j`` IS the row's output position, and the
+  burst replays the identical counter-hashed Gumbel sequence the
+  per-step path consumes: burst-on is bit-identical to burst-off for
+  greedy and sampled rows alike.
+* **KV discipline matches per-step decode exactly.**  Iteration ``j``
+  writes the KV of its INPUT token at position ``pos0 + j``; a row that
+  emits ``e`` tokens has written positions ``pos0 .. pos0+e-1`` and its
+  newest emitted token's KV is NOT yet written — precisely the state
+  the host's ``commit(e)`` bookkeeping describes.
+
+Oracle discipline (PR 9/10): :func:`burst_oracle` is the ground-truth
+twin — the same arithmetic as an eager Python loop over the SAME
+``model_step`` callable, no ``fori_loop``, no masking cleverness.  The
+parity sweep in the tests drives both over the full (rows × burst
+length) bucket lattice.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .sampling import sample_tokens
+
+
+def _step_keys(keys, j):
+    """Advance every row's (seed, draw) key pair to iteration ``j``:
+    seed column untouched, draw column ``+ j`` (u32 wrap-around is the
+    counter semantics :func:`_gumbel_from_keys` expects)."""
+    bump = jnp.stack([jnp.uint32(0), jnp.asarray(j).astype(jnp.uint32)])
+    return keys + bump[None, :]
+
+
+def run_burst(model_step, n_steps, vocab, ids, pos, lens, active,
+              eos_ids, slot_blocks, slot_offsets, temps, top_ks,
+              top_ps, keys, k_pools, v_pools):
+    """Run up to ``n_steps`` chained decode steps in-trace.
+
+    Args:
+      model_step: callable ``(ids[B,1], pos[B], lens[B], slot_blocks[B],
+        slot_offsets[B], k_pools, v_pools) -> (last_logits[B,V],
+        k_pools, v_pools)`` — one decode forward writing the input
+        token's KV into the routed slot (the engine closes this over its
+        block tables and traced parameters).
+      n_steps: i32 scalar (traced ok) — actual burst length N ≤ the
+        ``slot_blocks`` width Nb; iterations ``>= n_steps`` never run.
+      vocab: static int — logits width (fixes the carry shape).
+      ids: ``[B, 1]`` i32 — each row's input token (its last emission).
+      pos: ``[B]`` i32 — that token's position (= committed KV length).
+      lens: ``[B]`` i32 — attention length AFTER the slot write
+        (``pos + 1`` for real rows, 1 for padding rows).
+      active: ``[B]`` bool — real rows; padding rows never emit.
+      eos_ids: ``[B]`` i32 — per-row EOS token id, ``-1`` = none.
+      slot_blocks / slot_offsets: ``[B, Nb]`` i32 — iteration ``j``'s
+        KV slot per row, precomputed host-side from the pre-extended
+        block tables (position ``pos + j``).
+      temps / top_ks / top_ps / keys: the ISSUE 18 sampling quartet;
+        ``keys[:, 1]`` holds each row's FIRST draw index.
+      k_pools / v_pools: per-layer pool tensors, threaded through the
+        loop carry so donation holds across all N steps.
+
+    Returns:
+      ``(tokens[B, Nb] i32 with -1 = not emitted, last_logits[B, V]
+      f32, k_pools, v_pools)``.
+    """
+    B, Nb = slot_blocks.shape
+    buf0 = jnp.full((B, Nb), -1, jnp.int32)
+    last0 = jnp.zeros((B, vocab), jnp.float32)
+
+    def body(j, carry):
+        ids_c, pos_c, lens_c, act, buf, last, kp, vp = carry
+        # inactive rows (padding, or already-finished mid-burst) write
+        # into the null page — same sink as bucketed decode padding
+        sb = jnp.where(act, slot_blocks[:, j], 0)
+        so = jnp.where(act, slot_offsets[:, j], 0)
+        logits, kp, vp = model_step(ids_c, pos_c, lens_c, sb, so, kp, vp)
+        # inactive rows sample greedy (temp 0) — cheap, discarded
+        toks = sample_tokens(logits, jnp.where(act, temps, 0.0),
+                             top_ks, top_ps, _step_keys(keys, j))
+        buf = buf.at[:, j].set(jnp.where(act, toks, -1))
+        last = jnp.where(act[:, None], logits, last)
+        # EOS is EMITTED then deactivates the row (per-step parity:
+        # the host appends the EOS token before finishing the request)
+        still = act & (toks != eos_ids)
+        ids_c = jnp.where(still[:, None], toks[:, None], ids_c)
+        pos_c = jnp.where(still, pos_c + 1, pos_c)
+        lens_c = jnp.where(still, lens_c + 1, lens_c)
+        return ids_c, pos_c, lens_c, still, buf, last, kp, vp
+
+    carry = (ids, pos, lens, active, buf0, last0, k_pools, v_pools)
+    carry = jax.lax.fori_loop(jnp.int32(0), n_steps, body, carry)
+    _, _, _, _, buf, last, k_out, v_out = carry
+    return buf, last, k_out, v_out
+
+
+def burst_oracle(model_step, n_steps, vocab, ids, pos, lens, active,
+                 eos_ids, slot_blocks, slot_offsets, temps, top_ks,
+                 top_ps, keys, k_pools, v_pools):
+    """Ground-truth twin of :func:`run_burst`: an eager Python loop over
+    the SAME ``model_step``, one decode step at a time, no traced
+    control flow — the reference the interpret-mode parity sweep holds
+    the fast path to (PR 9/10 oracle discipline)."""
+    B, Nb = slot_blocks.shape
+    buf = jnp.full((B, Nb), -1, jnp.int32)
+    last = jnp.zeros((B, vocab), jnp.float32)
+    act = active
+    n = int(n_steps)
+    for j in range(n):
+        sb = jnp.where(act, slot_blocks[:, j], 0)
+        so = jnp.where(act, slot_offsets[:, j], 0)
+        logits, k_pools, v_pools = model_step(
+            ids, pos, lens, sb, so, k_pools, v_pools)
+        toks = sample_tokens(logits, jnp.where(act, temps, 0.0),
+                             top_ks, top_ps, _step_keys(keys, j))
+        buf = buf.at[:, j].set(jnp.where(act, toks, -1))
+        last = jnp.where(act[:, None], logits, last)
+        still = act & (toks != eos_ids)
+        ids = jnp.where(still[:, None], toks[:, None], ids)
+        pos = jnp.where(still, pos + 1, pos)
+        lens = jnp.where(still, lens + 1, lens)
+        act = still
+    return buf, last, k_pools, v_pools

@@ -70,7 +70,11 @@ from .scheduler import bucket_size
 # (temps f32, top_ks i32, top_ps f32, keys u32[...,2]) and returns token
 # ids as output 0 — v1 artifacts predate in-trace sampling and refuse to
 # load rather than serve the wrong signature.
-ARTIFACT_VERSION = 2
+# v3 (ISSUE 19): the "burst" family (device-resident multi-step decode,
+# bucketed on (rows, burst-length)) joins the saved universe when
+# ``EngineConfig.burst_steps >= 2``, and the manifest records
+# ``burst_steps`` — v2 artifacts predate the family and refuse to load.
+ARTIFACT_VERSION = 3
 MANIFEST_NAME = "manifest.json"
 _PROGRAM_DIR = "programs"
 
@@ -140,6 +144,20 @@ def enumerate_buckets(engine, max_seq_len: Optional[int] = None,
     # table width covers the whole sequence: ceil(max_seq / block_size)
     widths = _pow2_upto((max_seq + bs - 1) // bs)
     out: List[Tuple[str, Tuple[int, ...]]] = []
+    # decode-burst family (ISSUE 19): a bounded two-axis lattice —
+    # (decode-rows bucket, burst-length bucket) — independent of the
+    # unified flag (the burst path runs in both dispatch modes).  The
+    # table width is NOT an axis: burst programs pin it to the one
+    # max_seq-derived width bucket (engine._burst_width), so bursts
+    # never change shape as rows cross block boundaries mid-loop.
+    # Length buckets start at 2: the engine never launches a 1-step
+    # burst (that is just decode with padding).
+    burst_steps = int(getattr(engine, "_burst_steps", 0) or 0)
+    if burst_steps >= 2:
+        for b in _pow2_upto(sched.max_num_seqs):
+            for n in _pow2_upto(burst_steps):
+                if n >= 2:
+                    out.append(("burst", (b, n)))
     pf_budget = sched.max_prefill_tokens_per_step
     if getattr(engine, "_unified", False):
         # unified ragged family (PR 10): ONE packed launch per step.
@@ -258,6 +276,18 @@ def _arg_specs(engine, program: str, bucket: Tuple[int, ...]):
         return head + (s((1, Tb), i32), s((1, Tb), i32), s((Tb,), i32),
                        s((Tb,), i32), s((Tb, TWb), i32), s((Tb,), i32),
                        s((Tb,), i32), s((Tb,), i32)) + sampling(Tb)
+    if program == "burst":
+        # (ids, pos, tables, lens, slot_blocks, slot_offsets, n_steps,
+        #  active, eos_ids) + sampling quartet — ISSUE 19.  The table
+        # width is the engine's pinned burst width (max_seq-derived;
+        # save() aligns it with the manifest's max_seq_len before
+        # lowering, bind_aot() re-derives the same value at load).
+        Bb, Nb = bucket
+        W = engine._burst_width
+        return head + (s((Bb, 1), i32), s((Bb,), i32), s((Bb, W), i32),
+                       s((Bb,), i32), s((Bb, Nb), i32), s((Bb, Nb), i32),
+                       s((), i32), s((Bb,), np.bool_), s((Bb,), i32)) \
+            + sampling(Bb)
     raise AotError(f"unknown program family {program!r}")
 
 
@@ -265,7 +295,8 @@ def _jit_for(engine, program: str):
     return {"decode": engine._jit_decode,
             "prefill": engine._jit_prefill,
             "chunk": engine._jit_chunk_prefill,
-            "ragged": engine._jit_unified}[program]
+            "ragged": engine._jit_unified,
+            "burst": engine._jit_burst}[program]
 
 
 class AotArtifact:
@@ -327,6 +358,7 @@ class AotArtifact:
             "num_blocks": m["num_blocks"], "block_size": m["block_size"],
             "max_seq_len": m["max_seq_len"],
             "unified_step": m["autotune"]["unified_step"],
+            "burst_steps": m.get("burst_steps", 0),
             "model_hash": m["model_hash"][:16],
             "jax_version": m["jax_version"],
             "load_seconds": round(self.load_seconds, 4),
@@ -346,6 +378,13 @@ class AotArtifact:
         t0 = time.perf_counter()
         sched = engine.scheduler.config
         max_seq = _max_seq_cap(engine, max_seq_len)
+        # burst programs (ISSUE 19) pin their table width to ONE
+        # max_seq-derived bucket; align the builder engine's width with
+        # the universe being saved so the lowered shapes match what
+        # bind_aot() re-derives from the manifest at load.  (The builder
+        # is a compile host — narrowing its launch width is fine.)
+        engine._burst_width = bucket_size(
+            max(1, (max_seq + engine.block_size - 1) // engine.block_size))
         buckets = enumerate_buckets(engine, max_seq)
         # the whole artifact is STAGED next to its destination and
         # swapped in only after the manifest commit: a re-save that dies
@@ -400,6 +439,11 @@ class AotArtifact:
                     sched.max_prefill_tokens_per_step,
                 "max_tokens_per_step": sched.max_tokens_per_step,
             },
+            # ISSUE 19: the burst-length cap the lattice was enumerated
+            # under.  Not a validate() mismatch row — a burst-off engine
+            # may load a burst-on artifact (superset), and an engine
+            # with a LARGER burst_steps fails the bucket-coverage check.
+            "burst_steps": int(getattr(engine, "_burst_steps", 0) or 0),
             "autotune": _autotune_decisions(engine),
             # ISSUE 18: recorded for inspection only — deliberately NOT a
             # validate() mismatch row.  Spec decode packs verify chunks

@@ -69,7 +69,10 @@ from ..ops.paged_attention import (
     PoolExhausted,
     shard_kv_pool,
 )
+from ..ops.decode_burst import run_burst
 from ..ops.sampling import sample_tokens
+from .burst import burst_eligible, clamp_burst
+from .burst import register_metrics as _register_burst_metrics
 from .kv_manager import KVCacheManager
 from .metrics import ServingMetrics, StepTimer
 from .request import FinishReason, Request, RequestState, SamplingParams
@@ -196,6 +199,17 @@ class EngineConfig:
     # tokens compete for the step's leftover budget).  None = off;
     # greedy spec-decode is token-identical to baseline (bench-gated).
     spec: Optional[object] = None  # serving.spec.SpecConfig
+    # Device-resident decode bursts (ISSUE 19): when the running set is
+    # a decode-only resident cohort (no pending admissions, prefill
+    # continuations, or spec drafts), launch ONE compiled program that
+    # runs up to this many decode steps on-device (in-trace KV slot
+    # append, per-row position advance, fused sampling, per-row EOS
+    # masking) — only the ``[B, N]`` token buffer crosses back to the
+    # host.  The launch clamp (serving/burst.py) shrinks N below this
+    # cap per launch; 0/1 = off (per-step decode).  Burst-on is
+    # token-identical to burst-off for greedy AND sampled rows (the
+    # draw keys advance in-trace along the same output positions).
+    burst_steps: int = 0
 
 
 class EngineCore:
@@ -358,15 +372,27 @@ class EngineCore:
         self.decode_trace_count = 0
         self.prefill_trace_count = 0
         self.ragged_trace_count = 0
+        self.burst_trace_count = 0
         self.decode_buckets = set()
         self.prefill_buckets = set()
         self.ragged_buckets = set()
+        self.burst_buckets = set()
+        # --- device-resident decode bursts (ISSUE 19) -----------------------
+        # the burst program's block-table width is pinned to ONE value
+        # (the full pool's width bucket; bind_aot narrows it to the
+        # artifact's max_seq_len) so the burst lattice stays two-axis —
+        # (rows bucket, burst-length bucket) — with no mid-burst width
+        # drift as rows cross block boundaries
+        self._burst_steps = max(0, int(config.burst_steps or 0))
+        self._burst_width = bucket_size(max(1, num_blocks - 1))
+        self._burst_counters = _register_burst_metrics(
+            self.metrics.registry, labels=self.metrics.labels)
         donate = (1, 2) if jax.default_backend() == "tpu" else ()
         if self.mp > 1:
             jit_kw = self._mesh_jit_shardings(mesh, cfg)
         else:
             jit_kw = {"decode": {}, "prefill": {}, "chunk": {},
-                      "ragged": {}}
+                      "ragged": {}, "burst": {}}
         self._jit_decode = jax.jit(self._decode_fn, donate_argnums=donate,
                                    **jit_kw["decode"])
         self._jit_prefill = jax.jit(self._prefill_fn, donate_argnums=donate,
@@ -376,6 +402,8 @@ class EngineCore:
                                           **jit_kw["chunk"])
         self._jit_unified = jax.jit(self._unified_fn, donate_argnums=donate,
                                     **jit_kw["ragged"])
+        self._jit_burst = jax.jit(self._burst_fn, donate_argnums=donate,
+                                  **jit_kw["burst"])
         self._profile_ops = config.profile_ops
         model.eval()
         # --- speculative decoding (ISSUE 18) --------------------------------
@@ -439,6 +467,13 @@ class EngineCore:
         # saved universe is rejected honestly at admission instead of
         # raising AotBucketMissing from the engine thread mid-stream
         self.scheduler.seq_len_cap = int(artifact.manifest["max_seq_len"])
+        # burst programs (ISSUE 19) were exported with the table width
+        # derived from the artifact's max_seq_len; the seq_len_cap set
+        # above guarantees no admitted sequence can outgrow it, so the
+        # launch-side arrays must build at the SAME width
+        cap = self.scheduler.seq_len_cap
+        self._burst_width = bucket_size(
+            max(1, (cap + self.block_size - 1) // self.block_size))
         # AOT attribution (ISSUE 15 satellite): /v1/debug/compiles and
         # /metrics must show "loaded an artifact" instead of fake
         # compile rows — and flag any later trace as the bug it is.
@@ -453,9 +488,13 @@ class EngineCore:
                            artifact.program_count, observe=observe)
 
     def _step_call(self, program: str, bucket, jit_fn, *args):
-        """THE aot-vs-jit dispatch choice, shared by all four step
+        """THE aot-vs-jit dispatch choice, shared by all five step
         program families: serve from the bound artifact (counting the
-        hit) or fall back to the engine's jit entry point."""
+        hit) or fall back to the engine's jit entry point.  Every call
+        is exactly one host->device round trip — the denominator of the
+        burst saving (ISSUE 19), counted here so per-step and burst
+        launches share one ledger."""
+        self._burst_counters["roundtrips"].inc()
         if self._aot is None:
             return jit_fn(*args)
         out = self._aot.call(program, bucket, *args)
@@ -507,6 +546,14 @@ class EngineCore:
             # the ragged kernel re-partitions over mp via shard_map
             "ragged": {"in_shardings": (params, pools, pools) + (repl,) * 12,
                        "out_shardings": out},
+            # (param_vals, k_pools, v_pools, ids, pos, tables, lens,
+            #  slot_blocks, slot_offsets, n_steps, active, eos_ids,
+            #  temps, top_ks, top_ps, keys) — the decode burst
+            # (ISSUE 19): the same decode shape looped in-trace; all
+            # routing (including the [B, Nb] per-iteration slot arrays
+            # and the scalar trip count) replicated, pools sharded
+            "burst": {"in_shardings": (params, pools, pools) + (repl,) * 13,
+                      "out_shardings": out},
         }
 
     # --- functional model step (traced) ------------------------------------
@@ -562,6 +609,42 @@ class EngineCore:
         return (tokens, last, logit_stats(last),
                 tuple(c.k_pool._value for c in caches),
                 tuple(c.v_pool._value for c in caches))
+
+    def _burst_fn(self, param_vals, k_pools, v_pools, ids, pos, tables,
+                  lens, slot_blocks, slot_offsets, n_steps, active,
+                  eos_ids, temps, top_ks, top_ps, keys):
+        """Device-resident decode burst (ISSUE 19): up to ``n_steps``
+        chained decode steps in ONE program via
+        :func:`~paddle_tpu.ops.decode_burst.run_burst` — each iteration
+        is exactly the ``_decode_fn`` body (route → forward → fused
+        sampling), with the sampled token fed straight back as the next
+        input and only the ``[B, Nb]`` token buffer crossing to the
+        host.  Output tuple matches the other families (tokens, last
+        logits, logit stats, pools) so ``_step_call``/AOT dispatch is
+        unchanged."""
+        self.burst_trace_count += 1
+        self.metrics.count("burst_jit_traces")
+        self.tracer.instant("burst_jit_trace", cat="jit",
+                            batch=int(ids.shape[0]),
+                            burst_bucket=int(slot_blocks.shape[1]))
+
+        def model_step(ids_j, pos_j, lens_j, sb, so, kp, vp):
+            caches = []
+            for k, v in zip(kp, vp):
+                c = PagedCache(Tensor(k), Tensor(v))
+                c.route(tables, lens_j, sb, so)
+                c.use_pallas = self._use_pallas
+                caches.append(c)
+            logits = self._call_model(ids_j, caches, pos_j, param_vals)
+            return (logits[:, -1, :].astype(jnp.float32),
+                    tuple(c.k_pool._value for c in caches),
+                    tuple(c.v_pool._value for c in caches))
+
+        buf, last, k_out, v_out = run_burst(
+            model_step, n_steps, self.model.config.vocab_size, ids, pos,
+            lens, active, eos_ids, slot_blocks, slot_offsets, temps,
+            top_ks, top_ps, keys, k_pools, v_pools)
+        return buf, last, logit_stats(last), k_out, v_out
 
     def _prefill_fn(self, param_vals, k_pools, v_pools, ids, last_pos,
                     blocks, offs, temps, top_ks, top_ps, keys):
@@ -1101,6 +1184,119 @@ class EngineCore:
             result[r.request_id] = tok
         return result
 
+    def _burst_exec(self, reqs: List[Request],
+                    n_steps: int) -> Dict[object, int]:
+        """Launch ONE device-resident burst covering ``n_steps`` decode
+        steps for a decode-only resident cohort (ISSUE 19).  The host
+        pre-extends every row's block table to its worst-case burst
+        length (the clamp guaranteed the pool can back it), launches the
+        looped program, then reconciles the whole burst after the fact:
+        per-token emission through the normal ``_emit`` bookkeeping
+        (stream cursor, lifecycle decode_token events, ITL aggregates),
+        KV commit of what was actually written, and truncation of the
+        unused pre-allocated tail."""
+        B = len(reqs)
+        Bb = bucket_size(B)
+        Nb = bucket_size(n_steps)
+        W = self._burst_width
+        starts: Dict[object, int] = {}
+        for r in reqs:
+            rid = r.request_id
+            starts[rid] = self.kv.seq_len(rid)
+            # positions p..p+n-1 all get slots up front (the decode slot
+            # reservation already covers p); exact need is <= the
+            # conservative per-row bound burst_capacity promised, so
+            # failure here means the shared accessor broke — fail loudly
+            if not self.kv.allocate(rid, n_steps, cause="burst"):
+                raise PoolExhausted(
+                    f"burst pre-allocation failed for {rid!r}: "
+                    f"burst_capacity promised {n_steps} steps "
+                    f"x {B} rows")
+        ids = np.zeros((Bb, 1), np.int64)
+        poss = np.zeros((Bb,), np.int32)
+        tables = np.zeros((Bb, W), np.int32)
+        lens = np.ones((Bb,), np.int32)   # pad rows: 1 token of null page
+        slot_blocks = np.zeros((Bb, Nb), np.int32)
+        slot_offsets = np.zeros((Bb, Nb), np.int32)
+        active = np.zeros((Bb,), np.bool_)
+        eos_ids = np.full((Bb,), -1, np.int32)
+        pack = SamplingPack(Bb)
+        bs = self.block_size
+        for i, r in enumerate(reqs):
+            rid = r.request_id
+            t = self.kv.table(rid)
+            p = starts[rid]
+            ids[i, 0] = r.last_token
+            poss[i] = p
+            tables[i, :len(t)] = t
+            lens[i] = p + 1
+            for j in range(n_steps):
+                q = p + j
+                slot_blocks[i, j] = t[q // bs]
+                slot_offsets[i, j] = q % bs
+            active[i] = True
+            if r.sampling.eos_token_id is not None:
+                eos_ids[i] = int(r.sampling.eos_token_id)
+            pack.set_request(i, r)
+        self.burst_buckets.add(("burst", Bb, Nb))
+        traces0 = self.burst_trace_count
+        with self.tracer.span("burst_step", cat="serving", batch=B,
+                              batch_bucket=Bb, burst_len=n_steps,
+                              burst_bucket=Nb,
+                              requests=",".join(str(r.request_id)
+                                                for r in reqs),
+                              traces=",".join(str(r.trace_id)
+                                              for r in reqs)):
+            with StepTimer(self.metrics, "burst_step",
+                           self._collective_phase("burst")) as st:
+                buf, _out, _stats, self._k_pools, self._v_pools = \
+                    self._step_call(
+                        "burst", (Bb, Nb), self._jit_burst,
+                        self._param_vals(), self._k_pools, self._v_pools,
+                        ids, poss, tables, lens, slot_blocks,
+                        slot_offsets, np.int32(n_steps), active,
+                        eos_ids, *pack.arrays())
+                buf = np.asarray(buf, np.int32)
+        if self.burst_trace_count > traces0:
+            self.stepprof.record_compile("burst", (Bb, Nb), st.dt)
+        result = {}
+        emitted_total = 0
+        for i, r in enumerate(reqs):
+            rid = r.request_id
+            e = 0
+            for j in range(n_steps):
+                tok = int(buf[i, j])
+                if tok < 0:   # -1 sentinel: row went inactive (EOS)
+                    break
+                self._emit_device(r, tok)
+                result[rid] = tok
+                e += 1
+                if r.finished:
+                    break
+            emitted_total += e
+            # iteration j wrote the KV of its input token at p+j, so e
+            # emissions committed e positions — identical to e per-step
+            # decode commits; unfinished rows hand back the unused
+            # pre-allocated tail (finished rows free wholesale in retire)
+            self.kv.commit(rid, e)
+            if not r.finished:
+                self.kv.truncate(rid, starts[rid] + e)
+        # scheduled-token ledger (ISSUE 9): the scheduler planned one
+        # decode token per row; the burst's extra emissions are decode
+        # work the ENGINE added — mirror them into the ledger so the
+        # EXACT invariant (profiler scheduled == scheduler planned)
+        # holds when one launch covers N steps
+        self.scheduler.tokens_planned_decode += emitted_total - B
+        self.stepprof.record_program(
+            "burst", (Bb, Nb), scheduled=emitted_total, capacity=Bb * Nb,
+            wall_s=st.dt, burst_len=n_steps,
+            requests=",".join(str(r.request_id) for r in reqs))
+        c = self._burst_counters
+        c["launches"].inc()
+        c["tokens"].inc(emitted_total)
+        c["length"].observe(float(n_steps))
+        return result
+
     def _unified_exec(self, prefills: List[Request],
                       decodes: List[Request],
                       draft_budget: int = 0) -> Dict[object, int]:
@@ -1405,7 +1601,20 @@ class EngineCore:
                 emitted: Dict[object, int] = {}
                 decodes = [r for r in plan.decodes
                            if r.state is RequestState.RUNNING]
-                if self._unified:
+                # device-resident decode burst (ISSUE 19): a decode-only
+                # resident cohort with a clamped horizon >= 2 runs ONE
+                # looped launch covering N steps; any pending admission,
+                # prefill continuation or spec drafting falls through to
+                # the normal per-step paths (host decisions stay at
+                # burst boundaries)
+                burst_n = 0
+                if self._burst_steps >= 2 and burst_eligible(
+                        self.scheduler, plan, decodes, self.spec):
+                    burst_n = clamp_burst(self._burst_steps, decodes,
+                                          plan.burst_capacity)
+                if burst_n >= 2:
+                    emitted = self._burst_exec(decodes, burst_n)
+                elif self._unified:
                     # unified ragged step (ISSUE 11): the whole plan —
                     # decode rows + prefill chunks — is ONE packed launch
                     # (draft tokens compete for the leftover budget,

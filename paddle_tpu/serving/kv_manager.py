@@ -80,6 +80,24 @@ class KVCacheManager(BlockPool):
         usable = self.num_blocks - 1
         return (usable - self.num_available) / usable if usable else 0.0
 
+    def burst_capacity(self, rows: int) -> int:
+        """Largest per-row decode-burst length N the pool can promise
+        ``rows`` concurrent decode rows (ISSUE 19).  Called AFTER the
+        scheduler reserved each row's next-token slot (``append_slot``),
+        so a row holding blocks for ``p+1`` tokens needs at most
+        ``ceil((N-1)/block_size)`` additional blocks for N total burst
+        tokens, even when every row sits on the worst-case block
+        boundary.  The closed form below is exactly that bound inverted:
+        giving each row ``num_available // rows`` whole extra blocks
+        supports ``(num_available // rows) * block_size + 1`` tokens.
+
+        ONE accessor shared by the scheduler's plan and the engine's
+        launch clamp — the PR 1 promised-blocks lesson: two copies of
+        headroom math WILL disagree one preemption later."""
+        if rows <= 0:
+            return 0
+        return (self.num_available // rows) * self.block_size + 1
+
     # --- allocation --------------------------------------------------------
     def append_slot(self, seq_id) -> Optional[Tuple[int, int]]:
         """(block, offset) slot for the sequence's NEXT token, allocating a

@@ -76,6 +76,9 @@ _COUNTER_NAMES = (
     # in-trace retrace counter of the one collapsed program family
     "unified_steps",
     "ragged_jit_traces",
+    # device-resident decode bursts (ISSUE 19): the burst family's own
+    # in-trace retrace counter (bounded by the burst bucket lattice)
+    "burst_jit_traces",
 )
 
 _GAUGE_NAMES = ("queue_depth", "num_running", "kv_pool_occupancy",
@@ -93,6 +96,7 @@ _HISTOGRAM_NAMES = (
     "prefill_step",
     "decode_step",
     "unified_step",   # ISSUE 11: wall time of one packed ragged launch
+    "burst_step",     # ISSUE 19: wall time of one N-step decode burst
     "queue_wait",
     "prefill",
     "decode_itl",
@@ -106,7 +110,7 @@ SLO_PHASES = ("queue_wait", "prefill", "decode_itl", "e2e")
 # serving_collective_seconds series shows on /metrics even before (or
 # without) any multi-chip step running.  "ragged" is the unified packed
 # step (ISSUE 11) — the one program family that replaces the other two.
-_COLLECTIVE_PHASES = ("prefill", "decode", "ragged")
+_COLLECTIVE_PHASES = ("prefill", "decode", "ragged", "burst")
 
 # every full metric name this module pre-registers, for the README
 # metrics-table lint (tools/check_metrics_docs.py)

@@ -180,6 +180,10 @@ class ProcessFleetConfig:
     # forwarded to every worker (requires unified + max_tokens_per_step);
     # its manifest_dict() also rides the handshake deployment identity
     spec: Optional[Dict] = None
+    # device-resident decode bursts (ISSUE 19): forwarded to every
+    # worker engine; the step_done emission batch already carries
+    # multi-token rows, so a burst costs one wire round-trip
+    burst_steps: int = 0
     audit_enabled: bool = False
     audit_sample_every: int = 1
     seed: int = 0
@@ -811,10 +815,14 @@ class WorkerEngineProxy:
         return ok
 
     def step(self) -> Dict:
-        """One worker engine step: stream in the token frames, absorb
-        the ``step_done`` state + metrics dump, tick the shared history.
-        Any wire failure or worker-reported step error surfaces as
-        :class:`WorkerDied` — the stock replica death path."""
+        """One worker engine step, one wire round-trip: the ``step_done``
+        frame carries the step's full emission batch (``emitted``:
+        rid -> [tokens] — a decode burst ships all N tokens per row in
+        this one frame) plus state + metrics dump; absorb it, tick the
+        shared history.  Legacy per-token ``token`` frames are still
+        absorbed for mixed-version fleets.  Any wire failure or
+        worker-reported step error surfaces as :class:`WorkerDied` — the
+        stock replica death path."""
         self._require_live()
         conn = self._engine_conn
         try:
@@ -932,6 +940,12 @@ class WorkerEngineProxy:
         self._queue_depth = int(frame.get("queue_depth", 0))
         self._occupancy = float(frame.get("occupancy", 0.0))
         self._degraded = bool(frame.get("degraded", False))
+        # emission batch BEFORE the finished map: a finishing request's
+        # EV_FINISH token count must include this step's (burst) tokens
+        for rid, toks in (frame.get("emitted") or {}).items():
+            m = self.requests.get(rid)
+            if m is not None:
+                m.output_tokens.extend(int(t) for t in toks)
         for rid, reason in (frame.get("finished") or {}).items():
             m = self.requests.pop(rid, None)
             if m is None:
@@ -981,6 +995,7 @@ class _SharedState:
         self.template_engine_cfg = EngineConfig(
             num_blocks=cfg.num_blocks, block_size=cfg.block_size,
             unified_step=cfg.unified,
+            burst_steps=cfg.burst_steps,
             mp=(cfg.mp if cfg.mp > 1 else None),
             spec=self.spec_config(),
             audit=(self.template_audit if cfg.audit_enabled else None))
@@ -1028,6 +1043,7 @@ class _SharedState:
                 cfg.max_prefill_tokens_per_step,
             "max_tokens_per_step": cfg.max_tokens_per_step,
             "mp": cfg.mp, "spec": cfg.spec,
+            "burst_steps": cfg.burst_steps,
             "unified_step": cfg.unified, "seed": cfg.seed,
             "audit_enabled": cfg.audit_enabled,
             "audit_sample_every": cfg.audit_sample_every,

@@ -135,6 +135,12 @@ class SchedulerOutput:
     # same ``max(total, decode rows)`` bucket bound the plain plan has.
     # 0 when no combined budget is configured (spec requires one).
     draft_budget: int = 0
+    # decode-burst headroom (ISSUE 19): the largest per-row burst length
+    # the pool can back for THIS plan's decode rows, from the ONE
+    # `KVCacheManager.burst_capacity` accessor — the engine's launch
+    # clamp reads this field instead of re-deriving headroom, so the
+    # planning math and the clamp can never disagree.
+    burst_capacity: int = 0
 
 
 class ContinuousBatchingScheduler:
@@ -396,6 +402,9 @@ class ContinuousBatchingScheduler:
         out = SchedulerOutput()
         self._reserve_decode_slots(out)
         self._plan_prefills(out)
+        # burst headroom (ISSUE 19): computed AFTER slot reservation and
+        # chunk planning, so it reflects the pool this plan leaves behind
+        out.burst_capacity = self.kv.burst_capacity(len(out.decodes))
         self.tokens_planned_prefill += sum(
             r._chunk_tokens or 0 for r in out.prefills)
         self.tokens_planned_decode += len(out.decodes)

@@ -1145,7 +1145,7 @@ def _toy_engine(layers: int = 2, num_blocks: int = 64,
                 metrics_labels=None, audit=None,
                 unified: bool = False, aot=None,
                 max_tokens_per_step: Optional[int] = None,
-                spec=None) -> EngineCore:
+                spec=None, burst_steps: int = 0) -> EngineCore:
     import paddle_tpu as paddle
     from ..models import LlamaConfig, LlamaForCausalLM
     from .engine import EngineConfig
@@ -1164,6 +1164,7 @@ def _toy_engine(layers: int = 2, num_blocks: int = 64,
                                           unified_step=unified,
                                           scheduler=scheduler,
                                           spec=spec,
+                                          burst_steps=burst_steps,
                                           aot=aot),
                       registry=registry, metrics_labels=metrics_labels)
 
@@ -1174,7 +1175,7 @@ def _toy_fleet(dp: int = 1, layers: int = 2, num_blocks: int = 64,
                audit=None, unified: bool = False,
                fault_plan=None, alert_rules=None,
                aot=None, max_tokens_per_step: Optional[int] = None,
-               spec=None) -> FleetRouter:
+               spec=None, burst_steps: int = 0) -> FleetRouter:
     """A dp-replica fleet of toy engines on one shared registry: each
     replica gets its OWN model instance (engine threads swap parameter
     values during the traced step — modules must not be shared) with
@@ -1189,7 +1190,8 @@ def _toy_fleet(dp: int = 1, layers: int = 2, num_blocks: int = 64,
             layers=layers, num_blocks=num_blocks, registry=registry,
             metrics_labels={"replica": str(i)}, audit=audit,
             unified=unified, aot=aot,
-            max_tokens_per_step=max_tokens_per_step, spec=spec),
+            max_tokens_per_step=max_tokens_per_step, spec=spec,
+            burst_steps=burst_steps),
         dp=dp, config=FleetConfig(max_queue=max_queue,
                                   flight_dir=flight_dir,
                                   fault_plan=fault_plan,
@@ -1342,6 +1344,7 @@ def _build_procfleet(args, fault_plan=None, alert_rules=None):
         # own mp-way mesh slice; the degree (and the spec-decoding
         # config) is validated at every wire handshake
         mp=args.mp, spec=_spec_dict(args),
+        burst_steps=args.burst,
         unified=args.unified,
         audit_enabled=bool(args.audit_sample),
         audit_sample_every=args.audit_sample or 1,
@@ -1460,7 +1463,7 @@ async def _serve_cli(args) -> int:
                            unified=args.unified, fault_plan=fault_plan,
                            alert_rules=alert_rules, aot=aot,
                            max_tokens_per_step=args.max_tokens_per_step,
-                           spec=spec)
+                           spec=spec, burst_steps=args.burst)
     supervisor = None
     if args.max_restarts > 0:
         # self-healing by default (ISSUE 12): dead replicas restart
@@ -1601,6 +1604,19 @@ def main(argv=None) -> int:
                         "--unified and --max-tokens-per-step; composes "
                         "with --workers (the spec config rides the wire "
                         "handshake as deployment identity)")
+    p.add_argument("--burst", type=int, default=0, metavar="N",
+                   help="device-resident decode bursts (ISSUE 19): when "
+                        "the running set is a decode-only resident "
+                        "cohort, ONE compiled program runs up to N "
+                        "decode steps on-device (in-trace KV append + "
+                        "sampling + EOS masking) and ships the [B, N] "
+                        "token buffer back in one host round-trip; "
+                        "token streams are bit-identical to per-step "
+                        "decode.  0 disables; mutually inert with "
+                        "--spec-decode (spec drafting wins).  Composes "
+                        "with --workers (forwarded through the worker "
+                        "spec) and --aot-save (the burst bucket lattice "
+                        "is enumerated into the artifact)")
     p.add_argument("--spec-k", type=int, default=4, metavar="K",
                    help="--spec-decode: max draft tokens proposed per "
                         "request per step (default 4)")
@@ -1707,6 +1723,8 @@ def main(argv=None) -> int:
                     "compete for the step's leftover token budget")
         if args.spec_k < 0:
             p.error(f"--spec-k must be >= 0, got {args.spec_k}")
+    if args.burst < 0:
+        p.error(f"--burst must be >= 0, got {args.burst}")
     if args.mp > 1 and not args.workers:
         # tensor-parallel serving (ISSUE 5): build the mesh BEFORE any
         # engine (selftest included — the probe must exercise the real
@@ -1724,7 +1742,7 @@ def main(argv=None) -> int:
         from .aot import AotArtifact
 
         eng = _toy_engine(layers=args.layers, num_blocks=args.blocks,
-                          unified=args.unified)
+                          unified=args.unified, burst_steps=args.burst)
         art = AotArtifact.save(eng, args.aot_save,
                                max_seq_len=args.aot_max_seq)
         print("aot-save: " + json.dumps(art.describe(), indent=1))

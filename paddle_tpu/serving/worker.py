@@ -64,7 +64,8 @@ from .wire import CACHE_PREFIX, READY_PREFIX  # noqa: F401  (canonical
 # spec is scheduler/model shape); a bounded vocabulary so a drifted
 # parent fails loudly instead of silently half-configuring the worker
 _ENGINE_KEYS = ("lifecycle_events", "decode_event_sample", "step_profile",
-                "cache_stats", "history", "unified_step", "prefix_cache")
+                "cache_stats", "history", "unified_step", "prefix_cache",
+                "burst_steps")
 _SPEC_KEYS = _ENGINE_KEYS + (
     "layers", "num_blocks", "block_size", "max_num_seqs",
     "max_prefill_tokens_per_step", "max_tokens_per_step", "seed",
@@ -253,8 +254,10 @@ class WorkerHost:
 
     def handle_step(self, conn: wire.Connection,
                     t_recv: Optional[float] = None) -> None:
-        """One engine step, streamed: ``token`` frames for every token
-        the step produced, then ``step_done`` carrying the post-step
+        """One engine step, ONE reply: ``step_done`` carries the step's
+        full emission batch (``emitted``: rid -> [tokens], possibly many
+        per row when the engine ran a decode burst — the wire cost of a
+        burst is one round-trip regardless of N), the post-step
         state + fired-fault delta + a full metrics dump (the router
         merges it before ticking the shared history, so alert rules see
         fresh cross-process values deterministically), plus — with
@@ -302,16 +305,18 @@ class WorkerHost:
                 return
             t_eng1 = time.perf_counter()
             finished: Dict = {}
+            emitted: Dict = {}
             for rid, req in list(self._live.items()):
                 toks = req.output_tokens
-                for tok in toks[before.get(rid, 0):]:
-                    conn.send({"type": "token", "rid": rid,
-                               "token": int(tok)})
+                fresh = toks[before.get(rid, 0):]
+                if fresh:
+                    emitted[rid] = [int(tok) for tok in fresh]
                 if req.finished:
                     finished[rid] = (req.finish_reason.value
                                      if req.finish_reason else None)
                     del self._live[rid]
             conn.send({"type": "step_done", "stepped": True,
+                       "emitted": emitted,
                        "finished": finished,
                        "fired": self._fired_delta(),
                        "metrics": wire.dump_registry(self.registry),
@@ -349,7 +354,8 @@ class WorkerHost:
                         "traces": {
                             "prefill": eng.prefill_trace_count,
                             "decode": eng.decode_trace_count,
-                            "ragged": eng.ragged_trace_count},
+                            "ragged": eng.ragged_trace_count,
+                            "burst": eng.burst_trace_count},
                         **self._state()}
             else:
                 return wire.error_frame(

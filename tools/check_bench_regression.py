@@ -83,6 +83,19 @@ CHECKS: Tuple[Tuple[str, str, float, float], ...] = (
     ("spec.spec_engine_steps",           "count_max", 0.0, 0.0),
     ("spec.spec_accept_ratio",           "higher",    0.0, 0.05),
     ("spec.spec_trace_count",            "count_max", 0.0, 0.0),
+    # burst phase (ISSUE 19): token identity and zero-lost are EXACT
+    # (a burst that diverges from per-step decode IS the regression),
+    # the burst engine-step count is deterministic on the fixed stream
+    # and must stay strictly below the plain engine's (in-phase assert
+    # enforces strictness; the committed cap stops creep), the trace
+    # count is bounded by the two-axis bucket lattice, and the burst
+    # throughput must not collapse (floor wide for CPU wall noise)
+    ("burst.token_mismatches",           "count_max", 0.0, 0.0),
+    ("burst.requests_lost",              "count_max", 0.0, 0.0),
+    ("burst.burst_engine_steps",         "count_max", 0.0, 0.0),
+    ("burst.burst_roundtrips",           "count_max", 0.0, 0.0),
+    ("burst.burst_trace_count",          "count_max", 0.0, 0.0),
+    ("burst.burst_tokens_per_sec",       "higher",    0.5, 0.0),
     # chaos phase: self-healing must stay lossless and not collapse
     ("chaos.requests_lost",              "count_max", 0.0, 0.0),
     ("chaos.chaos_tokens_per_sec",       "higher",    0.5, 0.0),

@@ -88,6 +88,10 @@ SCAN_FILES = (
     # ISSUE 11: the unified ragged kernel sits on the serving hot path
     # (its module-level last_path is the only state — keep it that way)
     os.path.join(_REPO, "paddle_tpu", "ops", "ragged_paged.py"),
+    # ISSUE 19: the decode-burst device loop sits on the serving hot
+    # path (stateless by design — keep it that way; the host half's
+    # burst-bucket set is bounded by the AOT lattice)
+    os.path.join(_REPO, "paddle_tpu", "ops", "decode_burst.py"),
     os.path.join(_REPO, "paddle_tpu", "parallel", "mp_layers.py"),
     os.path.join(_REPO, "paddle_tpu", "parallel", "utils.py"),
     os.path.join(_REPO, "paddle_tpu", "parallel", "_compat.py"),

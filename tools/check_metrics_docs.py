@@ -59,6 +59,9 @@ DECLARING_MODULES = (
     # ratio/length) and the in-trace sampling path counters
     os.path.join(_REPO, "paddle_tpu", "serving", "spec.py"),
     os.path.join(_REPO, "paddle_tpu", "serving", "sampling.py"),
+    # ISSUE 19: decode-burst launch/token/length series plus the
+    # host-round-trip counter every step-program launch increments
+    os.path.join(_REPO, "paddle_tpu", "serving", "burst.py"),
 )
 
 _NAME_RE = re.compile(r"\b(?:serving|push)_[a-z0-9_:]+\b")

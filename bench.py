@@ -1298,6 +1298,400 @@ def serving_burst_bench() -> dict:
     return result
 
 
+def serving_disagg_bench() -> dict:
+    """Prefill/decode disaggregation phase (ISSUE 20): the same
+    workloads through two dp=2 deployments — UNIFIED (two role-less
+    replicas) vs DISAGGREGATED (prefill:1,decode:1 with the first-token
+    KV hand-off) — in two waves.
+
+    * **long-prompt interference**: four decode-heavy victims admit
+      first, then SIXTEEN 184-token prefill-only jobs
+      (``max_new_tokens=1`` — they finish at their first token, so
+      they never hand off) queue behind them against the per-replica
+      seq cap.  All prompts are affinity-previewed to SPLIT EVENLY
+      over the unified dp=2 ring, so both configurations keep both
+      engines busy (equal host contention — a co-located workload
+      would leave the unified sibling idle, a free-CPU artifact on
+      small hosts) and the one structural difference is WHERE chunk
+      work runs: each unified replica co-schedules 64-token chunk
+      launches of its 184-token backlog between its victims' decode
+      steps for the whole measured window — each chunk is a full
+      64-token model pass, an order of magnitude more compute than a
+      decode step — while disaggregated victims migrate to the
+      decode specialist at their first token and decode
+      interference-free.  Before
+      each wave EVERY (program, bucket) shape in the replicas' bucket
+      lattice is traced + compiled eagerly, so the measured window is
+      compile-free BY CONSTRUCTION whatever the preemption timing does
+      (asserted via trace-counter deltas).  Asserts steady-state decode
+      ITL p99 STRICTLY better disaggregated (host-clocked per-token
+      gaps, the first two gaps per request excluded — they carry
+      prefill/hand-off latency, which TTFT owns).
+    * **decode-heavy burst synergy**: six spread-affinity prompts with
+      long continuations, decode specialist at ``burst_steps=8`` vs the
+      same burst budget unified, plus a trickle of prefill-only noise
+      jobs mid-decode.  Every noise prefill chunk costs the unified
+      fleet host round-trips between its burst windows; the decode
+      specialist never sees them.  Asserts the decode specialist emits
+      its tokens in STRICTLY fewer host round-trips per token than the
+      unified fleet achieves.
+
+    Both waves assert EXACT greedy token identity unified vs
+    disaggregated, ZERO lost requests, hand-offs actually firing, the
+    pool invariant on every replica after every hand-off, and ZERO jit
+    traces inside the measured windows (every shape was pre-compiled:
+    a trace there is a shape outside the lattice — a bug)."""
+    import threading
+
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.serving import (
+        EngineConfig,
+        EngineCore,
+        FleetConfig,
+        FleetRouter,
+        SamplingParams,
+        SchedulerConfig,
+    )
+    from paddle_tpu.serving.fleet import affinity_replica_index
+
+    rng = np.random.default_rng(7)
+
+    # affinity-previewed prompts (pure ring math, no engines): on the
+    # unified dp=2 fleet BOTH configurations keep both replicas busy —
+    # victims and interferers split evenly across the ring — so the
+    # only structural difference the disaggregated fleet introduces is
+    # WHERE the chunk-prefill work runs, not how many engines contend
+    # for the host.  (A shared-prefix workload would park the whole
+    # unified stream on one replica with an idle sibling — a free-CPU
+    # artifact that reverses the comparison on small hosts.)
+    def routed(n, length, want):
+        out = []
+        while len(out) < n:
+            p = rng.integers(0, 256, length).tolist()
+            if affinity_replica_index(p, dp=2, block_size=4) \
+                    == want[len(out)]:
+                out.append(p)
+        return out
+
+    victims = routed(4, 12, [0, 1, 0, 1])
+    interferers = routed(16, 184, [i % 2 for i in range(16)])
+    # burst-wave prefill noise: no shared prefix, never decoded
+    burst_noise = [rng.integers(0, 256, 100).tolist() for _ in range(4)]
+    # burst wave: spread-affinity prompts, deterministically half per
+    # replica on the unified dp=2 ring (preview — no engines), so the
+    # unified fleet bursts two half-size cohorts while the decode
+    # specialist bursts one full-size cohort
+    spread, want = [], [0, 0, 1, 1, 0, 1]
+    while len(spread) < 6:
+        p = rng.integers(0, 256, 10).tolist()
+        if affinity_replica_index(p, dp=2, block_size=4) \
+                == want[len(spread)]:
+            spread.append(p)
+
+    def factory_for(roles, burst):
+        def make(i, registry):
+            paddle.seed(0)  # identical weights on every replica
+            role = roles[i] if roles else "unified"
+            return EngineCore(
+                model=LlamaForCausalLM(
+                    LlamaConfig.tiny(num_hidden_layers=2)),
+                config=EngineConfig(
+                    num_blocks=144, block_size=4, role=role,
+                    burst_steps=(8 if burst and role != "prefill"
+                                 else 0),
+                    scheduler=SchedulerConfig(
+                        max_num_seqs=8,
+                        max_prefill_tokens_per_step=64)),
+                registry=registry, metrics_labels={"replica": str(i)})
+        return make
+
+    def pool_check(fleet):
+        for r in fleet.replicas:
+            pool = r.engine.kv.pool if hasattr(r.engine.kv, "pool") \
+                else r.engine.kv
+            free, reuse = len(pool._free), len(pool._reuse)
+            held = len(pool._ref)
+            assert free + reuse + held + 1 == pool.num_blocks, (
+                f"pool invariant broken on replica {r.index}: "
+                f"{free}+{reuse}+{held}+1 != {pool.num_blocks}")
+
+    def trace_counts(fleet):
+        return {str(r.index): {
+            f: getattr(r.engine, f"{f}_trace_count")
+            for f in ("prefill", "decode", "ragged", "burst")}
+            for r in fleet.replicas}
+
+    def warm_lattice(fleet):
+        # trace + compile EVERY (program, bucket) shape each replica
+        # can dispatch for this workload, before any request exists,
+        # through the engine's own jit entry points with arguments
+        # built EXACTLY like the dispatch sites build them (the real
+        # resident params + pools, int64 ids, np.int32 scalars — the
+        # jit cache keys on pre-canonicalization dtype and placement,
+        # so a look-alike numpy pytree would warm a DIFFERENT entry).
+        # Rows write only the null page (tables/slots all zero, no row
+        # active), and the donated pools round-trip back into the
+        # engine like any real step.  After this no dispatch can
+        # trace, whatever the preemption/routing timing does — the
+        # measured window is compile-free BY CONSTRUCTION, asserted
+        # via trace-count deltas below.
+        from paddle_tpu.serving import aot as aot_mod
+        from paddle_tpu.serving.sampling import SamplingPack
+
+        for r in fleet.replicas:
+            eng = r.engine
+            for prog, bucket in aot_mod.enumerate_buckets(eng, 256):
+                jit_fn = aot_mod._jit_for(eng, prog)
+                head = (eng._param_vals(), eng._k_pools, eng._v_pools)
+                i32 = np.int32
+                if prog == "prefill":
+                    (Tb,) = bucket
+                    args = (np.zeros((1, Tb), np.int64), np.int32(0),
+                            np.zeros((Tb,), i32), np.zeros((Tb,), i32),
+                            *SamplingPack(1).arrays())
+                elif prog == "chunk":
+                    Wb, TWb = bucket
+                    args = (np.zeros((1, Wb), np.int64), np.int32(0),
+                            np.int32(0), np.zeros((1, TWb), i32),
+                            np.ones((1,), i32), np.zeros((1, Wb), i32),
+                            np.zeros((1, Wb), i32),
+                            *SamplingPack(1).arrays())
+                elif prog == "decode":
+                    Bb, Wb = bucket
+                    args = (np.zeros((Bb, 1), np.int64),
+                            np.zeros((Bb,), i32), np.zeros((Bb, Wb), i32),
+                            np.ones((Bb,), i32), np.zeros((Bb,), i32),
+                            np.zeros((Bb,), i32),
+                            *SamplingPack(Bb).arrays())
+                elif prog == "burst":
+                    Bb, Nb = bucket
+                    W = eng._burst_width
+                    args = (np.zeros((Bb, 1), np.int64),
+                            np.zeros((Bb,), i32), np.zeros((Bb, W), i32),
+                            np.ones((Bb,), i32), np.zeros((Bb, Nb), i32),
+                            np.zeros((Bb, Nb), i32), np.int32(0),
+                            np.zeros((Bb,), np.bool_),
+                            np.full((Bb,), -1, i32),
+                            *SamplingPack(Bb).arrays())
+                else:  # ragged — not dispatched by these legacy engines
+                    continue
+                out = jit_fn(*head, *args)
+                eng._k_pools, eng._v_pools = out[-2], out[-1]
+
+    def assert_compile_free(fleet, base, what):
+        now = trace_counts(fleet)
+        grew = {k: {f: (base[k][f], n) for f, n in fams.items()
+                    if n != base[k][f]}
+                for k, fams in now.items()}
+        grew = {k: v for k, v in grew.items() if v}
+        assert not grew, (
+            f"jit traces INSIDE the measured {what} window (the "
+            f"lattice warm-up missed a shape): {grew}")
+        return now
+
+    def run_interference(roles) -> dict:
+        fleet = FleetRouter.build(
+            factory_for(roles, burst=False), dp=2,
+            config=FleetConfig(roles=roles)).start()
+        try:
+            # measurement must time scheduling, not XLA compile
+            warm_lattice(fleet)
+            base = trace_counts(fleet)
+
+            # host-clocked per-token gaps: a sampler thread watches each
+            # victim's output growth at ~1ms resolution
+            stamps = {i: [] for i in range(len(victims))}
+            hs, stop = [], threading.Event()
+
+            def sampler():
+                while not stop.is_set():
+                    now = time.perf_counter()
+                    for i, h in enumerate(hs):
+                        req = h.req
+                        n = len(req.output_tokens) if req is not None \
+                            else 0
+                        seen = stamps[i]
+                        while len(seen) < n:
+                            seen.append(now)
+                    time.sleep(0.001)
+
+            t0 = time.perf_counter()
+            # victims FIRST: they are the oldest arrivals (never
+            # preempted), admit immediately and decode through the
+            # whole window.  The 16 interferers queue behind them
+            # against the per-replica seq cap, so each unified replica
+            # keeps 64-token chunk launches of its 184-token backlog
+            # co-scheduled with its victims' decode steps for the full
+            # measured window — every chunk launch (a full 64-token
+            # model pass, far more compute than a decode step) sits
+            # between two victim tokens.
+            # Disaggregated, the victims migrated to the decode
+            # specialist at their first token and never see one (the
+            # prefill specialist absorbs the whole chunk backlog).
+            hs = [fleet.submit_request(
+                p, SamplingParams(max_new_tokens=40, temperature=0.0),
+                request_id=f"victim-{i}")
+                for i, p in enumerate(victims)]
+            time.sleep(0.05)
+            ihs = [fleet.submit_request(
+                p, SamplingParams(max_new_tokens=1, temperature=0.0),
+                request_id=f"interferer-{i}")
+                for i, p in enumerate(interferers)]
+            thr = threading.Thread(target=sampler, daemon=True)
+            thr.start()
+            fleet.wait(hs + ihs, timeout=600)
+            traces = assert_compile_free(fleet, base, "interference")
+            stop.set()
+            thr.join(5.0)
+            wall = time.perf_counter() - t0
+            lost = [h.rid for h in hs + ihs
+                    if h.finish_reason != "length"]
+            assert not lost, f"requests lost: {lost}"
+            # steady-state decode gaps: drop the first two per victim
+            # (prefill latency and the one-time hand-off stall — TTFT's
+            # budget, not ITL's)
+            gaps = [b - a for seen in stamps.values()
+                    for a, b in zip(seen[2:], seen[3:])]
+            gaps.sort()
+            qt = (lambda q: gaps[min(len(gaps) - 1,
+                                     int(q * len(gaps)))]) if gaps \
+                else (lambda q: None)
+            p99 = qt(0.99)
+            pool_check(fleet)
+            snap = fleet.registry.snapshot()
+            return {
+                "wall_s": round(wall, 4),
+                "outputs": [list(h.output_tokens) for h in hs + ihs],
+                "itl_p50_s": round(qt(0.50), 6),
+                "itl_p90_s": round(qt(0.90), 6),
+                "itl_p99_s": round(p99, 6),
+                "itl_max_s": round(gaps[-1], 6),
+                "itl_samples": len(gaps),
+                "handoffs": snap.get("serving_handoff_total",
+                                     {}).get("value", 0.0),
+                "handoff_seconds": snap.get("serving_handoff_seconds"),
+                "handoff_blocks": snap.get("serving_handoff_blocks"),
+                "preemptions": snap.get("serving_preemptions_total",
+                                        {}).get("value", 0.0),
+                "recompute_prefills": snap.get(
+                    "serving_recompute_prefills_total",
+                    {}).get("value", 0.0),
+                "traces": traces,
+            }
+        finally:
+            fleet.shutdown(drain_timeout=5.0)
+
+    def run_burst(roles) -> dict:
+        fleet = FleetRouter.build(
+            factory_for(roles, burst=True), dp=2,
+            config=FleetConfig(roles=roles)).start()
+        try:
+            warm_lattice(fleet)
+            base = trace_counts(fleet)
+            t0 = time.perf_counter()
+            hs = [fleet.submit_request(
+                p, SamplingParams(max_new_tokens=32, temperature=0.0),
+                request_id=f"burst-{i}")
+                for i, p in enumerate(spread)]
+            # prefill-only noise mid-decode: chunk launches the unified
+            # fleet pays between bursts, invisible to the specialist
+            nhs = []
+            for i, p in enumerate(burst_noise):
+                time.sleep(0.25)
+                nhs.append(fleet.submit_request(
+                    p, SamplingParams(max_new_tokens=1, temperature=0.0),
+                    request_id=f"noise-{i}"))
+            fleet.wait(hs + nhs, timeout=600)
+            wall = time.perf_counter() - t0
+            lost = [h.rid for h in hs + nhs
+                    if h.finish_reason != "length"]
+            assert not lost, f"requests lost: {lost}"
+            pool_check(fleet)
+            gen = sum(len(h.output_tokens) for h in hs)
+            per_engine = {}
+            for r in fleet.replicas:
+                eng = r.engine
+                per_engine[str(r.index)] = {
+                    "role": r.role,
+                    "roundtrips": int(
+                        eng._burst_counters["roundtrips"].value),
+                    "burst_launches": int(
+                        eng._burst_counters["launches"].value),
+                    "burst_tokens": int(
+                        eng._burst_counters["tokens"].value),
+                }
+            snap = fleet.registry.snapshot()
+            return {
+                "wall_s": round(wall, 4),
+                "generated_tokens": gen,
+                "noise_tokens": sum(len(h.output_tokens) for h in nhs),
+                "outputs": [list(h.output_tokens) for h in hs + nhs],
+                "engines": per_engine,
+                "roundtrips_total": sum(e["roundtrips"]
+                                        for e in per_engine.values()),
+                "handoffs": snap.get("serving_handoff_total",
+                                     {}).get("value", 0.0),
+                "traces": assert_compile_free(fleet, base, "burst"),
+            }
+        finally:
+            fleet.shutdown(drain_timeout=5.0)
+
+    uni_i = run_interference(None)
+    dis_i = run_interference(["prefill", "decode"])
+    itl_mismatches = sum(a != b for a, b in zip(uni_i["outputs"],
+                                                dis_i["outputs"]))
+    uni_b = run_burst(None)
+    dis_b = run_burst(["prefill", "decode"])
+    burst_mismatches = sum(a != b for a, b in zip(uni_b["outputs"],
+                                                  dis_b["outputs"]))
+    # decode-specialist round-trips per token it emitted (everything
+    # but each request's first token; noise never reaches it) vs the
+    # unified fleet's round-trips per token it emitted (noise included
+    # — those chunk launches are exactly the co-location cost)
+    dec = dis_b["engines"]["1"]
+    dec_tokens = dis_b["generated_tokens"] - len(spread)
+    dec_rpt = dec["roundtrips"] / dec_tokens
+    uni_rpt = uni_b["roundtrips_total"] / (
+        uni_b["generated_tokens"] + uni_b["noise_tokens"])
+    result = {
+        "metric": "serving_disagg_itl_p99",
+        "value": dis_i["itl_p99_s"], "unit": "seconds",
+        "phase": "serving_disagg",
+        "token_mismatches": itl_mismatches + burst_mismatches,
+        "requests_lost": 0,  # the in-wave asserts above are the gate
+        "unified_itl_p99_s": uni_i["itl_p99_s"],
+        "disagg_itl_p99_s": dis_i["itl_p99_s"],
+        "itl_p99_improvement": round(
+            uni_i["itl_p99_s"] / dis_i["itl_p99_s"], 3),
+        "handoffs_interference": dis_i["handoffs"],
+        "handoffs_burst": dis_b["handoffs"],
+        "unified_roundtrips_per_token": round(uni_rpt, 5),
+        "decode_specialist_roundtrips_per_token": round(dec_rpt, 5),
+        "decode_specialist_burst_launches": dec["burst_launches"],
+        "interference": {"unified": uni_i, "disagg": dis_i},
+        "burst": {"unified": uni_b, "disagg": dis_b},
+    }
+    assert itl_mismatches == 0 and burst_mismatches == 0, (
+        f"disaggregated outputs diverged from unified: "
+        f"{itl_mismatches} + {burst_mismatches} stream(s)")
+    assert dis_i["handoffs"] > 0 and dis_b["handoffs"] > 0, \
+        "disaggregated fleet never handed off"
+    assert uni_i["handoffs"] == 0 and uni_b["handoffs"] == 0, \
+        "unified fleet handed off"
+    assert dis_i["itl_p99_s"] < uni_i["itl_p99_s"], (
+        f"disaggregation did not improve decode ITL p99: "
+        f"{dis_i['itl_p99_s']}s vs unified {uni_i['itl_p99_s']}s")
+    assert dec_rpt < uni_rpt, (
+        f"decode specialist saved no host round-trips per token: "
+        f"{dec_rpt:.5f} vs unified {uni_rpt:.5f}")
+    assert dec["burst_launches"] > 0, \
+        "decode specialist never burst"
+    return result
+
+
 def serving_chaos_bench() -> dict:
     """Self-healing chaos phase (ISSUE 12): the preempting shared-prefix
     stream through a dp=2 supervised fleet under a scripted fault plan —
@@ -1995,6 +2389,10 @@ def serving_main() -> dict:
         # state from the phases before it)
         json.dump(result, f, indent=1)
     result["burst"] = serving_burst_bench()
+    with open(path, "w") as f:
+        # checkpoint before the disaggregation phase for the same reason
+        json.dump(result, f, indent=1)
+    result["disagg"] = serving_disagg_bench()
     with open(path, "w") as f:
         # checkpoint before the cross-process phase for the same reason
         json.dump(result, f, indent=1)

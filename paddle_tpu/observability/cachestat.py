@@ -331,6 +331,40 @@ class CacheStatTracker:
         rows.sort(key=lambda r: (-r["score"], r["prefix"]))
         return rows[:k]
 
+    def hot_prefixes(self, top_k: Optional[int] = None,
+                     step: Optional[int] = None) -> List[Dict]:
+        """Actuator view over the heat table (hot-prefix migration,
+        ISSUE 20): top-K rows hot first, each carrying the FULL deepest
+        chain digest (``chain``, hex — :meth:`heat_table` only exposes
+        a display prefix) plus the chain's leading digests root-first
+        (``lead``, hex) so a router can recompute the ring key without
+        the prompt tokens.  Rows whose chain broke in the pool (an
+        ancestor was evicted) are dropped — they are not migratable.
+        Engine-thread callers only: the chain walk reads live pool
+        indexes."""
+        if not self.enabled:
+            return []
+        k = self.heat_top_k if top_k is None else int(top_k)
+        with self._lock:
+            rows = []
+            for h, e in self._heat.items():
+                score = e["score"]
+                if step is not None:
+                    score *= self.heat_decay \
+                        ** max(0, int(step) - e["last_hit_step"])
+                rows.append((h, e["depth"], score))
+        rows.sort(key=lambda r: (-r[2], r[0]))
+        out: List[Dict] = []
+        walk = getattr(self.pool, "chain_lead", None)
+        for h, depth, score in rows[:k]:
+            lead = walk(h) if walk is not None else None
+            if not lead:
+                continue
+            out.append({"chain": h.hex(), "depth": int(depth),
+                        "score": round(score, 3),
+                        "lead": [x.hex() for x in lead]})
+        return out
+
     # --- per-request cache attribution --------------------------------------
     def record_admission(self, rid, cached_tokens: int,
                          computed_tokens: int, prompt_tokens: int,

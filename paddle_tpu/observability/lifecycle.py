@@ -56,6 +56,7 @@ EV_PREFILL_CHUNK = "prefill_chunk"  # one bucketed prefill program ran
 EV_FIRST_TOKEN = "first_token"
 EV_DECODE_TOKEN = "decode_token"    # sampled; aggregates cover all
 EV_PREEMPTED = "preempted"
+EV_KV_HANDOFF = "kv_handoff"        # prefill→decode migration (ISSUE 20)
 EV_FINISH = "finish"
 
 # pre-registered metric names this module owns (tools/check_metrics_docs

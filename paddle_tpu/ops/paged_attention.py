@@ -25,7 +25,7 @@ from __future__ import annotations
 import hashlib
 import math
 from collections import OrderedDict
-from typing import List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -126,6 +126,13 @@ class BlockPool:
                                # are measured in these ticks
         self._block_depth: dict = {}  # unbounded-ok: ≤ num_blocks entries (block -> chain depth)
         self._park_step: dict = {}    # unbounded-ok: ≤ num_blocks entries (block -> clock at refcount-0 park)
+        # --- block transfer (ISSUE 20) -------------------------------------
+        # per-registered-block content identity: the tokens the chain hash
+        # committed to, and the parent digest — what export_blocks /
+        # export_chain serialize so a RECIPIENT pool can re-verify the
+        # chain from _HASH_ROOT before admitting foreign KV content
+        self._block_tokens: dict = {}  # unbounded-ok: ≤ num_blocks entries (block -> token tuple)
+        self._block_parent: dict = {}  # unbounded-ok: ≤ num_blocks entries (block -> parent chain hash)
 
     @property
     def num_free(self) -> int:
@@ -176,6 +183,8 @@ class BlockPool:
     def _drop_hash(self, b: int) -> None:
         h = self._block_hash.pop(b, None)
         self._block_depth.pop(b, None)
+        self._block_tokens.pop(b, None)
+        self._block_parent.pop(b, None)
         if h is not None and self._hash_index.get(h) == b:
             del self._hash_index[h]
             self.cache_epoch += 1
@@ -346,12 +355,16 @@ class BlockPool:
         bs = self.block_size
         added = 0
         for i in range(done, n_full):
+            parent = h
             h = _hash_block(h, token_ids[i * bs:(i + 1) * bs])
             b = table[i]
             if b in self._block_hash or h in self._hash_index:
                 continue
             self._block_hash[b] = h
             self._block_depth[b] = i + 1  # chain depth in blocks
+            self._block_tokens[b] = tuple(
+                int(t) for t in token_ids[i * bs:(i + 1) * bs])
+            self._block_parent[b] = parent
             self._hash_index[h] = b
             added += 1
         self._chain_state[seq_id] = (n_full, h)
@@ -369,6 +382,125 @@ class BlockPool:
         """Chain depth (in blocks) ``block`` was registered at; 0 when
         unhashed."""
         return self._block_depth.get(block, 0)
+
+    # --- block transfer (ISSUE 20) ------------------------------------------
+    def export_blocks(self, hashes) -> Optional[List[dict]]:
+        """Serialize the pool-side metadata of the chain addressed by
+        ``hashes`` (leading chain digests, root-first — the shape
+        :func:`prefix_chain_hashes` produces).  Returns one record per
+        block — ``{"hash", "depth", "tokens", "block"}`` — or ``None``
+        when any hash is unindexed (nothing to transfer; the recipient
+        just recomputes).  Pure read: no pool mutation, no refcount
+        change — the caller gathers the device payload at the returned
+        ``block`` indices while the donor keeps serving."""
+        records: List[dict] = []
+        for h in hashes:
+            b = self._hash_index.get(h)
+            if b is None:
+                return None
+            tokens = self._block_tokens.get(b)
+            if tokens is None:
+                return None
+            records.append({"hash": h, "depth": self._block_depth.get(b, 0),
+                            "tokens": tokens, "block": b})
+        return records
+
+    def export_chain(self, chain_hash: bytes) -> Optional[List[dict]]:
+        """Like :meth:`export_blocks` but addressed by the DEEPEST chain
+        digest alone (the prefix-heat table's key): walks parent links
+        back to the root and returns the full leading chain, root-first.
+        ``None`` when the chain is broken (an ancestor was evicted)."""
+        out: List[dict] = []
+        h = chain_hash
+        while h != _HASH_ROOT:
+            b = self._hash_index.get(h)
+            if b is None:
+                return None
+            tokens = self._block_tokens.get(b)
+            parent = self._block_parent.get(b)
+            if tokens is None or parent is None:
+                return None
+            out.append({"hash": h, "depth": self._block_depth.get(b, 0),
+                        "tokens": tokens, "block": b})
+            h = parent
+        out.reverse()
+        return out
+
+    def chain_lead(self, chain_hash: bytes) -> Optional[List[bytes]]:
+        """Leading chain digests, root-first, of the indexed chain
+        ending at ``chain_hash`` — the affinity-key material a router
+        needs to recompute ring placement for a cached prefix without
+        the prompt tokens.  ``None`` when the chain is broken (an
+        ancestor was evicted).  Pure read."""
+        out: List[bytes] = []
+        h = chain_hash
+        while h != _HASH_ROOT:
+            b = self._hash_index.get(h)
+            if b is None:
+                return None
+            parent = self._block_parent.get(b)
+            if parent is None:
+                return None
+            out.append(h)
+            h = parent
+        out.reverse()
+        return out
+
+    def import_blocks(self, records) -> Optional[Dict[bytes, int]]:
+        """Admit a foreign block run (the :meth:`export_blocks` record
+        shape, root-first) into THIS pool's prefix cache.  The chain is
+        re-verified from ``_HASH_ROOT`` over the shipped tokens before
+        anything mutates — a digest mismatch raises ``ValueError`` and
+        the pool is untouched (content addressing must never trust the
+        sender).  Atomic all-or-nothing: returns ``None`` (no mutation)
+        when the fresh blocks outnumber ``num_available``; otherwise
+        every fresh block is taken, registered, and parked in the reuse
+        LRU (refcount 0, revivable by :meth:`fork_prefix` exactly like a
+        locally-computed prefix), and the ``{hash: block}`` placement map
+        is returned so the caller scatters the KV payload into those
+        pages.  Already-indexed hashes are skipped (idempotent).
+
+        Pool invariants hold throughout: blocks move free→reuse only, so
+        ``free + reuse + allocated == num_blocks`` is preserved.  Known
+        benign edge: under pressure, taking a block may evict a reuse-LRU
+        ancestor of this very chain — the imported deeper blocks then sit
+        unreachable until re-imported (wasted space, never corruption)."""
+        if not self.prefix_cache_enabled:
+            raise ValueError("import_blocks needs the prefix cache enabled")
+        h = _HASH_ROOT
+        parent_of: Dict[bytes, bytes] = {}
+        for i, rec in enumerate(records):
+            tokens = tuple(int(t) for t in rec["tokens"])
+            if len(tokens) != self.block_size:
+                raise ValueError(
+                    f"imported block {i} carries {len(tokens)} tokens; "
+                    f"this pool's block_size is {self.block_size}")
+            parent = h
+            h = _hash_block(h, tokens)
+            if h != rec["hash"]:
+                raise ValueError(
+                    f"imported block {i} (depth {i + 1}) fails chain-hash "
+                    "verification: content does not match its digest")
+            parent_of[h] = parent
+        fresh = [rec for rec in records
+                 if rec["hash"] not in self._hash_index]
+        if len(fresh) > self.num_available:
+            return None
+        placed: Dict[bytes, int] = {}
+        taken = [self._take_block("kv_import") for _ in fresh]
+        for b, rec in zip(taken, fresh):
+            hh = rec["hash"]
+            self._block_hash[b] = hh
+            self._block_depth[b] = int(rec["depth"])
+            self._block_tokens[b] = tuple(int(t) for t in rec["tokens"])
+            self._block_parent[b] = parent_of[hh]
+            self._hash_index[hh] = b
+            self._reuse[b] = hh
+            self._park_step[b] = self.clock
+            placed[hh] = b
+        if placed:
+            self.cache_epoch += 1
+        return placed
 
 
 class BlockKVCache:

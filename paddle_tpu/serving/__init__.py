@@ -60,7 +60,9 @@ from .fleet import (  # noqa: F401
     FleetRouter,
     FleetSaturated,
     SubmitHandle,
+    parse_roles,
 )
+from .handoff import HandoffError  # noqa: F401
 from .procfleet import (  # noqa: F401
     AutoscalerConfig,
     CacheRebalancer,

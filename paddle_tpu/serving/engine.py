@@ -210,6 +210,15 @@ class EngineConfig:
     # token-identical to burst-off for greedy AND sampled rows (the
     # draw keys advance in-trace along the same output positions).
     burst_steps: int = 0
+    # Prefill/decode disaggregation (ISSUE 20): the replica's ROLE in a
+    # role-aware fleet.  Pure routing policy — any engine can execute
+    # anything (the unified fallback depends on that), so role is NOT
+    # part of the fleet's homogeneity gates.  ``prefill`` specialists
+    # take admissions and compute prompt KV; at the first-token boundary
+    # the router migrates the request plus its computed KV blocks to a
+    # ``decode`` specialist (serving/handoff.py); ``unified`` replicas
+    # do both (the default, and the single-replica fallback).
+    role: str = "unified"
 
 
 class EngineCore:
@@ -242,6 +251,10 @@ class EngineCore:
                 prefix_cache=prefix_cache, profile_ops=profile_ops,
                 scheduler=scheduler_config, use_pallas_paged=use_pallas_paged)
         self.engine_config = config
+        if config.role not in ("unified", "prefill", "decode"):
+            raise ValueError(
+                f"EngineConfig.role must be 'unified', 'prefill' or "
+                f"'decode'; got {config.role!r}")
         num_blocks, block_size = config.num_blocks, config.block_size
         dtype = config.dtype if config.dtype is not None else jnp.float32
         cfg = model.config
@@ -822,7 +835,8 @@ class EngineCore:
                     request_id=None, priority: int = 0,
                     trace_id: Optional[str] = None,
                     prefix_hashes: Optional[List[bytes]] = None,
-                    slo_ms: Optional[float] = None) -> Request:
+                    slo_ms: Optional[float] = None,
+                    resume_tokens: Optional[List[int]] = None) -> Request:
         """Enqueue a request (admission happens inside ``step``).
 
         ``trace_id`` (defaults to ``str(request_id)``) is attached to every
@@ -834,7 +848,14 @@ class EngineCore:
         router already computed for prefix-affinity placement
         (``ops.paged_attention.prefix_chain_hashes`` over THIS prompt and
         THIS engine's block size); the admission probe reuses them
-        instead of re-hashing the same blocks."""
+        instead of re-hashing the same blocks.
+
+        ``resume_tokens`` (ISSUE 20) seeds already-emitted output tokens
+        for a request migrating IN mid-stream (prefill→decode hand-off):
+        the prefill target becomes prompt+outputs and the recompute
+        discipline continues the stream from the next position — with
+        the donor's KV imported first, the seeded tail is a cache hit,
+        not a recompute."""
         req = Request(prompt_ids=list(np.asarray(prompt_ids).reshape(-1)),
                       sampling=sampling or SamplingParams(),
                       request_id=request_id, priority=priority,
@@ -842,6 +863,8 @@ class EngineCore:
                       slo_ms=slo_ms)
         if req.request_id in self.requests:
             raise ValueError(f"request id {req.request_id!r} already exists")
+        if resume_tokens:
+            req.output_tokens.extend(int(t) for t in resume_tokens)
         req.arrival_time = time.perf_counter()
         self.requests[req.request_id] = req
         self.scheduler.add(req)
@@ -1752,3 +1775,51 @@ class EngineCore:
             self._lc(request_id, _lc.EV_FINISH, reason="released")
         self.cachestat.close_request(request_id)
         self.kv.free(request_id)
+
+    # --- KV hand-off (ISSUE 20) ---------------------------------------------
+    def export_kv_run(self, request_id):
+        """Serialize ``request_id``'s computed prompt KV (its hashed
+        leading blocks) as a hand-off run; ``None`` when nothing is
+        transferable.  Pure read — the request keeps running here until
+        :meth:`detach_request`."""
+        from . import handoff
+
+        return handoff.export_request_run(self, request_id)
+
+    def export_prefix_chain(self, chain_hash, max_blocks=None):
+        """Serialize the cached prefix chain addressed by its deepest
+        digest (hot-prefix migration); ``None`` on a broken chain."""
+        from . import handoff
+
+        return handoff.export_prefix_run(self, chain_hash,
+                                         max_blocks=max_blocks)
+
+    def hot_prefixes(self, top_k=None):
+        """Heat-table-hot cached prefixes with full chain digests
+        (hot-prefix migration; see
+        :meth:`~paddle_tpu.observability.cachestat.CacheStatTracker.hot_prefixes`).
+        Engine-thread callers only."""
+        return self.cachestat.hot_prefixes(top_k)
+
+    def import_kv_run(self, run):
+        """Admit a hand-off run into this engine's pool (verified,
+        atomic; see :func:`~paddle_tpu.serving.handoff.import_run`).
+        Returns fresh-block count, or ``None`` on capacity refusal."""
+        from . import handoff
+
+        return handoff.import_run(self, run)
+
+    def detach_request(self, request_id) -> bool:
+        """Drop a request WITHOUT finishing it — the donor half of a
+        hand-off: the request migrates (same rid, open timeline) to
+        another replica, so no finish event fires here.  Its blocks are
+        freed; with the prefix cache on, the hashed prompt blocks park
+        WARM in the reuse LRU — a failed migration that re-admits here
+        revives them at zero recompute."""
+        req = self.requests.pop(request_id, None)
+        if req is None:
+            return False
+        self.scheduler.remove(req)
+        self.cachestat.close_request(request_id)
+        self.kv.free(request_id)
+        return True

@@ -100,6 +100,7 @@ from ..observability.metrics import MetricsRegistry
 from ..ops.paged_attention import prefix_chain_hashes
 from .engine import EngineCore
 from .faultinject import FaultInjector, FaultPlan
+from .handoff import register_handoff_metrics
 from .request import FinishReason, SamplingParams
 
 # pre-registered metric names this module owns (tools/check_metrics_docs
@@ -164,6 +165,41 @@ class FleetConfig:
     # storms, restart/quarantine churn, ...)
     history: Optional[HistoryConfig] = None
     alert_rules: Optional[AlertRuleSet] = None
+    # prefill/decode disaggregation (ISSUE 20): the EXPECTED per-replica
+    # role list (``["prefill", "decode", ...]`` — parse_roles builds it
+    # from the ``--roles prefill:N,decode:M`` CLI form).  Roles live on
+    # each engine's EngineConfig.role; this field is the deployment
+    # assertion — a mismatch against the engines actually built fails
+    # loudly at router construction instead of silently mis-routing.
+    # None = accept whatever the engines declare (all-unified legacy).
+    roles: Optional[Sequence[str]] = None
+
+
+def parse_roles(spec: str) -> List[str]:
+    """Parse the ``--roles`` CLI form: ``"prefill:1,decode:2"`` →
+    ``["prefill", "decode", "decode"]`` (replica index order follows the
+    spec left to right).  Accepts ``unified`` counts too."""
+    out: List[str] = []
+    for part in str(spec).split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, count = part.partition(":")
+        name = name.strip()
+        if name not in ("unified", "prefill", "decode"):
+            raise ValueError(
+                f"unknown role {name!r} in --roles (expected "
+                "unified|prefill|decode)")
+        try:
+            n = int(count) if count.strip() else 1
+        except ValueError:
+            raise ValueError(f"bad replica count in --roles part {part!r}")
+        if n < 0:
+            raise ValueError(f"negative replica count in --roles {part!r}")
+        out.extend([name] * n)
+    if not out:
+        raise ValueError(f"--roles {spec!r} names no replicas")
+    return out
 
 
 def _build_ring(dp: int, vnodes: int,
@@ -244,7 +280,8 @@ class SubmitHandle:
 
     __slots__ = ("rid", "prompt_ids", "sampling", "priority",
                  "prefix_hashes", "req", "done", "cancel_reason", "event",
-                 "replica", "slo_ms", "retryable")
+                 "replica", "slo_ms", "retryable", "kv_run",
+                 "resume_tokens", "arrival")
 
     def __init__(self, rid, prompt_ids: List[int],
                  sampling: Optional[SamplingParams] = None,
@@ -267,6 +304,14 @@ class SubmitHandle:
         self.cancel_reason: Optional[FinishReason] = None
         self.event = event
         self.replica: Optional["EngineReplica"] = None
+        # prefill→decode migration state (ISSUE 20), router-stamped at
+        # the hand-off: the exported KV run the recipient imports before
+        # re-admission, the already-emitted tokens that seed the new
+        # engine Request, and the original arrival stamp (so e2e latency
+        # spans the WHOLE request, not just its post-migration life)
+        self.kv_run = None
+        self.resume_tokens: Optional[List[int]] = None
+        self.arrival: Optional[float] = None
 
     @property
     def finished(self) -> bool:
@@ -314,6 +359,12 @@ class EngineReplica:
         self.handles: Dict[object, SubmitHandle] = {}  # rid -> handle;
         # bounded by max_queue (try_submit refuses past the cap) and
         # evicted on finish by the engine thread
+        # engine-thread task inbox (ISSUE 20): callables other threads
+        # post() to run ON this replica's engine thread — the pool and
+        # device tensors are engine-thread-only, so cross-replica work
+        # (hot-prefix migration exports/imports) rides this queue
+        # instead of touching the engine from a foreign thread
+        self.task_q: "queue.Queue" = queue.Queue(maxsize=64)
         self.thread: Optional[threading.Thread] = None
         self.error: Optional[str] = None
         self.flight: Optional[FlightRecorder] = None  # router-stamped
@@ -352,6 +403,28 @@ class EngineReplica:
         """Routing eligibility: a live engine thread that is neither
         watchdog-stalled nor quarantined (ISSUE 12)."""
         return self.alive and not self.unhealthy
+
+    @property
+    def role(self) -> str:
+        """The replica's disaggregation role (ISSUE 20): ``prefill`` /
+        ``decode`` specialist or ``unified`` (the default).  Read from
+        the engine's config so supervisor rebuilds (same factory, same
+        config) keep the role automatically."""
+        cfg = getattr(self.engine, "engine_config", None)
+        return getattr(cfg, "role", "unified") or "unified"
+
+    def post(self, fn: Callable[[], None]) -> bool:
+        """Enqueue ``fn`` to run on this replica's engine thread (next
+        loop iteration).  False when the bounded inbox is full — posted
+        work is best-effort by contract (callers re-post or drop)."""
+        try:
+            self.task_q.put_nowait(fn)
+        except queue.Full:  # swallow-ok: surfaced as the False return —
+            # the documented best-effort contract (callers re-post or
+            # drop and count on their side)
+            return False
+        self.wake.set()
+        return True
 
     @property
     def in_flight(self) -> int:
@@ -418,6 +491,7 @@ class EngineReplica:
             while True:
                 self._drain_submissions()
                 self._drain_aborts()
+                self._drain_tasks()
                 self._evict_finished()
                 if self._stop and not eng.scheduler.has_work():
                     break
@@ -517,10 +591,46 @@ class EngineReplica:
                             else FinishReason.TIMEOUT.value))
                 self._notify()
                 continue
-            h.req = self.engine.add_request(
+            if h.kv_run is not None:
+                # prefill→decode migration (ISSUE 20): admit the donor's
+                # exported KV into this pool BEFORE re-admission, so the
+                # scheduler's prefix probe finds the whole computed
+                # prompt cached.  Best-effort by contract: a refused or
+                # failed import degrades to re-prefill — the prompt
+                # tokens always travel with the handle.
+                try:
+                    self.engine.import_kv_run(h.kv_run)
+                except Exception:
+                    pass  # swallow-ok: import failure degrades to re-prefill; losing the request here would be the real bug
+                h.kv_run = None
+            req = self.engine.add_request(
                 h.prompt_ids, sampling=h.sampling, request_id=h.rid,
                 priority=h.priority, trace_id=str(h.rid),
-                prefix_hashes=h.prefix_hashes, slo_ms=h.slo_ms)
+                prefix_hashes=h.prefix_hashes, slo_ms=h.slo_ms,
+                resume_tokens=h.resume_tokens)
+            if h.arrival is not None:
+                # the migrated request's e2e span starts at its ORIGINAL
+                # arrival, not at re-admission (perf_counter is
+                # CLOCK_MONOTONIC machine-wide, so the stamp transfers
+                # across localhost worker processes too)
+                req.arrival_time = h.arrival
+                h.arrival = None
+            h.resume_tokens = None
+            h.req = req
+
+    def _drain_tasks(self) -> None:
+        """Run posted engine-thread tasks (ISSUE 20 hot-prefix
+        migration).  Best-effort: a failing task must not kill the
+        engine thread that serves live traffic."""
+        while True:
+            try:
+                fn = self.task_q.get_nowait()
+            except queue.Empty:
+                return  # swallow-ok: Empty IS the loop exit condition, not a fault
+            try:
+                fn()
+            except Exception:
+                pass  # swallow-ok: posted tasks are best-effort cache work; a failure must never tear down the serving thread
 
     def _drain_aborts(self) -> None:
         did = False
@@ -736,6 +846,27 @@ class FleetRouter:
         ]
         for r in self.replicas:
             r.flight = self.flight
+        # --- prefill/decode disaggregation (ISSUE 20) ------------------------
+        # roles are a ROUTING policy, deliberately NOT one of the
+        # homogeneity gates above: a mixed prefill/decode fleet is the
+        # point.  FleetConfig.roles (when set) is a deployment
+        # assertion — it must match what the engines actually declare.
+        self.roles: List[str] = [r.role for r in self.replicas]
+        if self.cfg.roles is not None:
+            declared = [str(x) for x in self.cfg.roles]
+            if declared != self.roles:
+                raise ValueError(
+                    f"FleetConfig.roles={declared} does not match the "
+                    f"engines' declared roles {self.roles}; the role an "
+                    "engine was built with (EngineConfig.role) is "
+                    "authoritative — fix the factory or the fleet spec")
+        if "decode" in self.roles and \
+                not any(x in ("prefill", "unified") for x in self.roles):
+            raise ValueError(
+                "a fleet of only decode specialists can never admit a "
+                "request (admission routes to prefill/unified replicas); "
+                "add at least one prefill or unified replica")
+        self._handoff_metrics = register_handoff_metrics(self.registry)
         self._owner: Dict[object, EngineReplica] = {}  # rid -> replica;
         # bounded by dp * max_queue (entries exist only while the request
         # is in flight on its replica) — evicted on finish/death
@@ -959,7 +1090,109 @@ class FleetRouter:
 
     # --- routing ------------------------------------------------------------
     def _notify(self, replica: Optional[EngineReplica] = None) -> None:
+        # prefill/decode disaggregation (ISSUE 20): each replica calls
+        # this from ITS engine thread right after every step, so this is
+        # the safe (and rebuild-surviving — the supervisor constructs
+        # replacement replicas with notify=self._notify) point to sweep
+        # a prefill specialist for requests that just crossed the
+        # first-token boundary and hand them to a decode specialist
+        if replica is not None:
+            self._migrate_first_tokens(replica)
         self._notify_cb(replica)
+
+    def _migrate_first_tokens(self, donor: EngineReplica) -> None:
+        """Sweep a prefill specialist for in-flight requests that have
+        produced their first token and hand each off to a decode
+        specialist.  Runs on the DONOR's engine thread (between steps),
+        so reading/detaching its engine state is race-free."""
+        if donor.role != "prefill" or not donor.healthy or self._draining:
+            return
+        for h in list(donor.handles.values()):
+            req = h.req
+            if (req is None or h.done or req.finished
+                    or h.cancel_reason is not None
+                    or req.first_token_time is None):
+                continue
+            self._handoff(donor, h)
+
+    def _handoff(self, donor: EngineReplica, h: SubmitHandle) -> None:
+        """Migrate one first-token request off ``donor``: export its
+        computed prompt KV, detach it, and re-submit (run + generated
+        tokens + original arrival stamp riding the handle) to the
+        least-loaded healthy decode specialist.  Unified fallback: with
+        no healthy decode specialist the request simply KEEPS decoding
+        on the donor — a hand-off is an optimization, never a
+        prerequisite.  If every specialist refuses admission the request
+        is re-admitted on the donor with its KV still resident (the
+        hashed prompt blocks park warm across detach), so no path loses
+        the request."""
+        targets = [r for r in self.replicas
+                   if r is not donor and r.healthy and r.role == "decode"]
+        if not targets:
+            return
+        targets.sort(key=lambda r: r.in_flight)
+        rid = h.rid
+        req = h.req
+        t0 = time.perf_counter()
+        try:
+            run = donor.engine.export_kv_run(rid)
+        except Exception:  # pragma: no cover - defensive
+            run = None  # swallow-ok: an export failure degrades the hand-off to re-prefill at the destination; the request itself must still migrate or stay
+        # atomic claim: if the donor's own sweep (finish/abort/death)
+        # got here first, the handle is no longer ours to move
+        if donor.handles.pop(rid, None) is not h:
+            return
+        h.resume_tokens = list(req.output_tokens)
+        h.arrival = req.arrival_time
+        h.kv_run = run
+        # h.req deliberately KEEPS pointing at the detached (now frozen)
+        # request object: pollers reading handle.req.output_tokens
+        # mid-transit see the tokens generated so far; the recipient's
+        # admission overwrites h.req with the live resumed request
+        donor.engine.detach_request(rid)
+        placed = None
+        with self._submit_lock:
+            for target in targets:
+                h.replica = target
+                self._owner[rid] = target
+                if target.try_submit(h):
+                    placed = target
+                    break
+                self._owner.pop(rid, None)
+                h.replica = None
+        if placed is None:
+            # every decode specialist is at its admission cap: re-admit
+            # on the donor.  We ARE the donor's engine thread, so this
+            # is a direct re-add (its KV is still warm — resume is
+            # near-free); known accepted race: an abort() arriving in
+            # the claim→rewrite window is dropped and retried by the
+            # caller's timeout path.
+            with self._submit_lock:
+                self._owner[rid] = donor
+            h.replica = donor
+            donor.handles[rid] = h
+            h.req = donor.engine.add_request(
+                h.prompt_ids, sampling=h.sampling, request_id=rid,
+                priority=h.priority, trace_id=str(rid),
+                prefix_hashes=h.prefix_hashes, slo_ms=h.slo_ms,
+                resume_tokens=h.resume_tokens)
+            if h.arrival is not None:
+                h.req.arrival_time = h.arrival
+            h.kv_run = None
+            h.resume_tokens = None
+            h.arrival = None
+            return
+        dt = time.perf_counter() - t0
+        nblocks = len(run["blocks"]) if run else 0
+        nbytes = int(run["payload"].nbytes) if run else 0
+        self._handoff_metrics["total"].inc()
+        self._handoff_metrics["seconds"].observe(dt)
+        if nblocks:
+            self._handoff_metrics["blocks"].observe(float(nblocks))
+        self.lifecycle.event(
+            rid, _lc.EV_KV_HANDOFF, src=str(donor.index),
+            dst=str(placed.index), blocks=nblocks, bytes=nbytes,
+            duration_ms=round(dt * 1000.0, 3))
 
     def _release(self, rid, replica: Optional[EngineReplica] = None) -> None:
         """Evict an owner-map entry.  A replica-side eviction names its
@@ -1026,6 +1259,28 @@ class FleetRouter:
             eligible = [r for r in self.replicas if r.healthy]
             if not eligible:
                 raise FleetDown("no live engine replica")
+            # role-aware admission (ISSUE 20): new requests prefill, so
+            # they route to prefill specialists (and unified replicas);
+            # decode specialists only receive work via the first-token
+            # hand-off.  A handle carrying resume_tokens is PAST its
+            # first token (a supervisor re-dispatch recovered it mid-
+            # hand-off or off a dead decode specialist): it routes to
+            # decode/unified replicas — NEVER a prefill specialist.
+            # When none is healthy it saturates instead of falling
+            # back, so a supervised re-dispatch stays pending until the
+            # restarted decode replica rejoins.  Fresh admissions DO
+            # fall back to whatever is healthy (role is routing policy,
+            # not capability — every engine runs the full pipeline).
+            want = (("decode", "unified") if handle.resume_tokens
+                    else ("prefill", "unified"))
+            pool = [r for r in eligible if r.role in want]
+            if not pool:
+                if handle.resume_tokens:
+                    raise FleetSaturated(
+                        "no healthy decode/unified replica for a mid-"
+                        "decode resume (prefill specialists are never "
+                        "eligible)")
+                pool = eligible
             # the timeline starts HERE, on the router/caller thread: a
             # per-request trace shows routing before any engine thread
             # touches the request.  Terminal rejects below finish the
@@ -1038,10 +1293,10 @@ class FleetRouter:
             handle.prefix_hashes = hashes
             target = None
             if hashes is not None:
-                target = self._ring_target(_key_int(hashes), eligible)
+                target = self._ring_target(_key_int(hashes), pool)
             order: List[EngineReplica] = \
                 [target] if target is not None else []
-            order += [r for r in sorted(eligible,
+            order += [r for r in sorted(pool,
                                         key=lambda r: r.in_flight)
                       if r is not target]
             for r in order:
@@ -1077,7 +1332,7 @@ class FleetRouter:
         self.lifecycle.event(handle.rid, _lc.EV_ADMISSION_REJECTED,
                              reason="saturated")
         raise FleetSaturated(
-            f"all {len(eligible)} eligible replica(s) at their "
+            f"all {len(pool)} eligible replica(s) at their "
             f"{self.cfg.max_queue}-request admission cap")
 
     def submit_request(self, prompt_ids,

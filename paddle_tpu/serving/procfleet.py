@@ -57,7 +57,7 @@ import threading
 import time
 import traceback
 from collections import deque
-from dataclasses import dataclass
+from dataclasses import dataclass, replace as _dc_replace
 from typing import Dict, List, Optional, Tuple
 
 from ..observability import distrib
@@ -66,7 +66,7 @@ from ..observability.audit import AuditConfig
 from ..observability.metrics import MetricsRegistry
 from . import wire
 from .engine import EngineConfig
-from .fleet import EngineReplica, FleetConfig, FleetRouter
+from .fleet import EngineReplica, FleetConfig, FleetRouter, _key_int
 from .metrics import ServingMetrics
 from .request import FinishReason, SamplingParams
 from .resilience import FleetSupervisor, SupervisorConfig
@@ -79,6 +79,7 @@ METRIC_NAMES = (
     "serving_fleet_worker_respawns_total",
     "serving_fleet_heartbeat_timeouts_total",
     "serving_fleet_ring_reweights_total",
+    "serving_fleet_prefix_migrations_total",
     "serving_fleet_active_workers",
 )
 
@@ -102,7 +103,7 @@ class _MirrorRequest:
     ``step_done``'s finished map closes it."""
 
     __slots__ = ("request_id", "prompt_ids", "output_tokens", "finished",
-                 "finish_reason")
+                 "finish_reason", "first_token_time", "arrival_time")
 
     def __init__(self, request_id, prompt_ids: List[int]):
         self.request_id = request_id
@@ -110,6 +111,11 @@ class _MirrorRequest:
         self.output_tokens: List[int] = []
         self.finished = False
         self.finish_reason: Optional[FinishReason] = None
+        # first-token boundary marker (ISSUE 20): the router's
+        # prefill→decode migration sweep triggers on this going
+        # non-None, exactly like the in-process Request field
+        self.first_token_time: Optional[float] = None
+        self.arrival_time: float = time.perf_counter()
 
 
 class AotManifestHandle:
@@ -184,6 +190,12 @@ class ProcessFleetConfig:
     # worker engine; the step_done emission batch already carries
     # multi-token rows, so a burst costs one wire round-trip
     burst_steps: int = 0
+    # prefill/decode disaggregation (ISSUE 20): per-index replica roles
+    # (length dp, e.g. ["prefill", "decode"] or serving.fleet.parse_roles
+    # output).  None = every worker unified.  Each worker's role rides
+    # its --spec AND its handshake deployment identity, so a drifted
+    # worker answers deploy_mismatch at connect time.
+    roles: Optional[List[str]] = None
     audit_enabled: bool = False
     audit_sample_every: int = 1
     seed: int = 0
@@ -505,7 +517,7 @@ class WorkerEngineProxy:
         cfg = shared.cfg
         self.index = index
         # --- fleet-gate surface (shared template objects) -------------------
-        self.engine_config = shared.template_engine_cfg
+        self.engine_config = shared.engine_cfg_for(index)
         self.block_size = cfg.block_size
         self.num_blocks = cfg.num_blocks
         self.mp = int(cfg.mp)
@@ -589,7 +601,7 @@ class WorkerEngineProxy:
         expect = (shared.aot_handle.model_hash
                   if shared.aot_handle is not None else None)
         self.worker = WorkerHandle.spawn(cfg, self.index,
-                                         shared.worker_spec())
+                                         shared.worker_spec(self.index))
         if self.worker.aot_hash != expect:
             got = self.worker.aot_hash
             self.worker.stop(grace_s=0.5)
@@ -598,7 +610,7 @@ class WorkerEngineProxy:
                 f"the fleet shares {expect!r} — artifact drift between "
                 "router and worker")
         labels = {"replica": str(self.index)}
-        deploy = shared.deploy()
+        deploy = shared.deploy(self.index)
         self._engine_conn = wire.connect(
             "127.0.0.1", self.worker.port, role="engine",
             aot_hash=expect, registry=shared.registry, labels=labels,
@@ -756,7 +768,9 @@ class WorkerEngineProxy:
     def add_request(self, prompt_ids, sampling: Optional[SamplingParams]
                     = None, request_id=None, priority: int = 0,
                     trace_id: Optional[str] = None, prefix_hashes=None,
-                    slo_ms: Optional[float] = None) -> _MirrorRequest:
+                    slo_ms: Optional[float] = None,
+                    resume_tokens: Optional[List[int]] = None
+                    ) -> _MirrorRequest:
         self._require_live()
         sp = sampling if sampling is not None else SamplingParams()
         frame = {
@@ -771,6 +785,8 @@ class WorkerEngineProxy:
             "prefix_hashes": ([h.hex() for h in prefix_hashes]
                               if prefix_hashes else None),
             "slo_ms": slo_ms,
+            "resume_tokens": ([int(t) for t in resume_tokens]
+                              if resume_tokens else None),
         }
         try:
             reply = self._engine_conn.request(frame)
@@ -784,6 +800,10 @@ class WorkerEngineProxy:
                 f"worker {self.index} refused submit: {reply!r}")
         self._absorb_telemetry(reply)
         mirror = _MirrorRequest(request_id, frame["prompt_ids"])
+        if resume_tokens:
+            # migrated request (ISSUE 20): the mirror's stream includes
+            # the donor-side tokens — the worker only emits FRESH ones
+            mirror.output_tokens.extend(int(t) for t in resume_tokens)
         self.requests[request_id] = mirror
         self._has_work = True
         self._lc(request_id, _lc.EV_ENQUEUED, trace_id=trace_id,
@@ -814,6 +834,112 @@ class WorkerEngineProxy:
             self._lc(request_id, _lc.EV_FINISH, reason=reason.value)
         return ok
 
+    # --- KV hand-off (ISSUE 20; engine thread only) -------------------------
+    def _kv_export(self, req_frame: Dict):
+        """Send one ``kv_export`` request frame and reassemble the
+        streamed ``kv_run_begin``/``kv_run_chunk`` reply.  ``None`` when
+        the worker answers empty/refusal (the caller re-prefills);
+        :class:`WorkerDied` on wire death."""
+        from . import handoff
+
+        self._require_live()
+        conn = self._engine_conn
+        try:
+            conn.send(req_frame)
+            begin = conn.recv()
+            t = begin.get("type")
+            if t in ("kv_export_ok", "error"):
+                return None  # untransferable / typed refusal: re-prefill
+            if t != "kv_run_begin":
+                self._mark_dead(f"protocol desync on kv export: {t!r}")
+                raise WorkerDied(
+                    f"worker {self.index} protocol desync: got {t!r} "
+                    "during a kv export")
+            declared = max(0, min(int(begin.get("chunks", 0) or 0), 4096))
+            chunks = [conn.recv() for _ in range(declared)]
+        except wire.WireError as e:
+            self._mark_dead(f"kv export failed: {e}")
+            raise WorkerDied(
+                f"worker {self.index} died during kv export: {e}") from e
+        return handoff.run_from_frames(begin, chunks)
+
+    def export_kv_run(self, request_id):
+        """Fetch the worker-side KV run for ``request_id``; ``None``
+        when nothing is transferable."""
+        return self._kv_export({"type": "kv_export", "rid": request_id})
+
+    def export_prefix_chain(self, chain_hash, max_blocks=None):
+        """Fetch the worker-side cached prefix chain addressed by its
+        deepest digest (hot-prefix migration); ``None`` on a broken
+        chain or refusal."""
+        return self._kv_export({
+            "type": "kv_export", "chain": bytes(chain_hash).hex(),
+            "max_blocks": max_blocks})
+
+    def hot_prefixes(self, top_k=None):
+        """Worker-side heat-table-hot prefixes with full chain digests
+        (see :meth:`EngineCore.hot_prefixes`)."""
+        self._require_live()
+        try:
+            reply = self._engine_conn.request(
+                {"type": "hot_prefixes", "k": top_k})
+        except wire.WireError as e:
+            self._mark_dead(f"hot_prefixes failed: {e}")
+            raise WorkerDied(
+                f"worker {self.index} died listing hot prefixes: {e}"
+            ) from e
+        if reply.get("type") != "hot_prefixes_ok":
+            return []
+        return list(reply.get("rows") or [])
+
+    def import_kv_run(self, run):
+        """Stream a KV run to the worker as block-stream frames and
+        admit it.  Mirrors ``EngineCore.import_kv_run``: placed-count on
+        success, ``None`` on a capacity refusal,
+        :class:`~paddle_tpu.serving.handoff.HandoffError` when the
+        worker answers a typed refusal (the caller degrades to
+        re-prefill), :class:`WorkerDied` on wire death."""
+        from . import handoff
+
+        self._require_live()
+        conn = self._engine_conn
+        try:
+            for frame in handoff.run_to_frames(run):
+                conn.send(frame)
+            reply = conn.recv()
+        except wire.WireError as e:
+            self._mark_dead(f"kv import failed: {e}")
+            raise WorkerDied(
+                f"worker {self.index} died during kv import: {e}") from e
+        t = reply.get("type")
+        if t == "kv_import_ok":
+            placed = reply.get("placed")
+            return None if placed is None else int(placed)
+        if t == "error":
+            raise handoff.HandoffError(
+                f"worker {self.index} refused the kv run "
+                f"({reply.get('code')}): {reply.get('detail')}")
+        self._mark_dead(f"protocol desync on kv import: {t!r}")
+        raise WorkerDied(
+            f"worker {self.index} protocol desync: got {t!r} during a "
+            "kv import")
+
+    def detach_request(self, request_id) -> bool:
+        """Drop ``request_id`` from the worker WITHOUT a finish event
+        (its hashed prompt blocks park warm) — the donor half of a
+        hand-off.  The mirror is popped so no step reply resurrects
+        it."""
+        m = self.requests.pop(request_id, None)
+        self._require_live()
+        try:
+            reply = self._engine_conn.request(
+                {"type": "kv_detach", "rid": request_id})
+        except wire.WireError as e:
+            self._mark_dead(f"kv detach failed: {e}")
+            raise WorkerDied(
+                f"worker {self.index} died during kv detach: {e}") from e
+        return bool(reply.get("ok")) and m is not None
+
     def step(self) -> Dict:
         """One worker engine step, one wire round-trip: the ``step_done``
         frame carries the step's full emission batch (``emitted``:
@@ -835,6 +961,8 @@ class WorkerEngineProxy:
                     m = self.requests.get(frame["rid"])
                     if m is not None:
                         m.output_tokens.append(int(frame["token"]))
+                        if m.first_token_time is None:
+                            m.first_token_time = time.perf_counter()
                 elif t == "step_done":
                     t3 = time.perf_counter()
                     self._absorb_wire(frame, t0, t3)
@@ -946,6 +1074,10 @@ class WorkerEngineProxy:
             m = self.requests.get(rid)
             if m is not None:
                 m.output_tokens.extend(int(t) for t in toks)
+                if m.first_token_time is None and toks:
+                    # first-token boundary (ISSUE 20): the migration
+                    # sweep keys off this, same as in-process Request
+                    m.first_token_time = time.perf_counter()
         for rid, reason in (frame.get("finished") or {}).items():
             m = self.requests.pop(rid, None)
             if m is None:
@@ -999,6 +1131,11 @@ class _SharedState:
             mp=(cfg.mp if cfg.mp > 1 else None),
             spec=self.spec_config(),
             audit=(self.template_audit if cfg.audit_enabled else None))
+        if cfg.roles is not None and len(cfg.roles) != cfg.dp:
+            raise ValueError(
+                f"ProcessFleetConfig.roles has {len(cfg.roles)} "
+                f"entrie(s) for dp={cfg.dp}; give one role per replica "
+                "index (serving.fleet.parse_roles builds the list)")
         self.aot_handle: Optional[AotManifestHandle] = None
         self.active: Dict[int, WorkerEngineProxy] = {}  # index ->
         # current proxy; bounded by dp
@@ -1026,16 +1163,38 @@ class _SharedState:
         sc = SpecConfig(**self.cfg.spec)
         return sc if sc.enabled else None
 
-    def deploy(self) -> Dict:
+    def role_for(self, index: int) -> str:
+        """Replica ``index``'s role (ISSUE 20): ``unified`` unless the
+        fleet config assigns specialists."""
+        if self.cfg.roles is None:
+            return "unified"
+        return str(self.cfg.roles[index])
+
+    def engine_cfg_for(self, index: int) -> EngineConfig:
+        """The proxy's gate-surface EngineConfig: the shared template,
+        with the per-index role folded in (roles are deliberately NOT a
+        homogeneity gate, so per-index copies are safe — audit/spec/aot
+        members stay the SAME objects the gates compare)."""
+        role = self.role_for(index)
+        if role == "unified":
+            return self.template_engine_cfg
+        return _dc_replace(self.template_engine_cfg, role=role)
+
+    def deploy(self, index: Optional[int] = None) -> Dict:
         """Deployment identity presented in every wire handshake
-        (ISSUE 18 fleet satellite): mesh-slice shape + spec config."""
+        (ISSUE 18 fleet satellite): mesh-slice shape + spec config +
+        (ISSUE 20) the replica's role."""
         sc = self.spec_config()
         return {"mp": int(self.cfg.mp),
-                "spec": (sc.manifest_dict() if sc is not None else None)}
+                "spec": (sc.manifest_dict() if sc is not None else None),
+                "role": (self.role_for(index)
+                         if index is not None else "unified")}
 
-    def worker_spec(self) -> Dict:
+    def worker_spec(self, index: Optional[int] = None) -> Dict:
         cfg = self.cfg
+        spec = {"role": self.role_for(index)} if index is not None else {}
         return {
+            **spec,
             "layers": cfg.layers, "num_blocks": cfg.num_blocks,
             "block_size": cfg.block_size,
             "max_num_seqs": cfg.max_num_seqs,
@@ -1439,6 +1598,14 @@ class RebalancerConfig:
     min_interval_samples: int = 50  # history samples between reweights
     min_weight: float = 0.25
     max_weight: float = 4.0
+    # hot-prefix migration (ISSUE 20): after a reweight, heat-table-hot
+    # prefix chains whose ring key now routes AWAY from the replica
+    # holding them warm are copied to the new target over the hand-off
+    # block streams, so the first affinity-routed request there hits
+    # the prefix cache instead of recomputing
+    migrate_prefixes: bool = True
+    migrate_top_k: int = 4          # hot chains considered per donor
+    migrate_max_blocks: int = 16    # block budget per donor per reweight
 
 
 class CacheRebalancer:
@@ -1464,6 +1631,10 @@ class CacheRebalancer:
         self._c = reg.counter(
             "serving_fleet_ring_reweights_total",
             "cache-aware consistent-hash vnode reweights applied")
+        self._mig_c = reg.counter(
+            "serving_fleet_prefix_migrations_total",
+            "heat-table-hot prefix chains copied to their post-reweight "
+            "ring target over the hand-off block streams")
         self._last: Optional[int] = None
         self.last_weights: Optional[Dict[int, float]] = None
         self._remove = router.history.add_listener(self._on_sample)
@@ -1499,3 +1670,66 @@ class CacheRebalancer:
             weights={str(k): round(w, 3) for k, w in weights.items()})
         self._last = sample_idx
         self.last_weights = weights
+        self._migrate_hot_prefixes()
+
+    # --- hot-prefix migration (ISSUE 20) ------------------------------------
+    def _migrate_hot_prefixes(self) -> None:
+        """Schedule one bounded hot-prefix sweep per healthy replica.
+        All pool and wire work rides the replicas' own engine threads
+        (:meth:`EngineReplica.post`): the heat walk and export run on
+        the donor's thread, the import on the recipient's — the
+        rebalancer thread only enqueues."""
+        if not self.cfg.migrate_prefixes:
+            return
+        for donor in list(self.router.replicas):
+            if donor.healthy:
+                donor.post(lambda d=donor: self._donor_sweep(d))
+
+    def _donor_sweep(self, donor: EngineReplica) -> None:
+        """On ``donor``'s engine thread: walk its heat table hot-first
+        and export any chain whose ring key now routes elsewhere, within
+        the per-donor block budget.  Prefix hits matter at PREFILL, so
+        ring targets are computed over the same prefill/unified pool
+        admissions route through."""
+        cfg, router = self.cfg, self.router
+        rows = donor.engine.hot_prefixes(cfg.migrate_top_k)
+        budget = cfg.migrate_max_blocks
+        pool = [r for r in router.replicas
+                if r.healthy and r.role in ("prefill", "unified")] \
+            or [r for r in router.replicas if r.healthy]
+        for row in rows:
+            if budget <= 0:
+                break
+            lead = row.get("lead")
+            if not lead:
+                continue
+            key_depth = min(router.cfg.affinity_blocks, len(lead))
+            key = _key_int([bytes.fromhex(lead[key_depth - 1])])
+            target = router._ring_target(key, pool)
+            if target is None or target is donor:
+                continue
+            run = donor.engine.export_prefix_chain(
+                bytes.fromhex(str(row["chain"])), max_blocks=budget)
+            if not run or not run.get("blocks"):
+                continue
+            budget -= len(run["blocks"])
+            if not target.post(
+                    lambda t=target, d=donor, r=run:
+                    self._import_migrated(d, t, r)):
+                budget += len(run["blocks"])  # recipient queue full
+
+    def _import_migrated(self, donor: EngineReplica,
+                         target: EngineReplica, run: Dict) -> None:
+        """On ``target``'s engine thread: admit one migrated prefix run
+        (content-verified, atomic).  A refusal or typed error just
+        degrades to recompute-on-miss — posted tasks are best-effort."""
+        try:
+            placed = target.engine.import_kv_run(run)
+        except Exception:
+            return  # swallow-ok: a refused/failed import degrades to recompute-on-miss at the target; the donor copy is untouched
+        if placed:
+            self._mig_c.inc()
+            self.router.lifecycle.event(
+                None, "prefix_migrated", src=str(donor.index),
+                dst=str(target.index), blocks=len(run["blocks"]),
+                placed=int(placed))

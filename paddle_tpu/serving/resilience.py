@@ -341,6 +341,18 @@ class FleetSupervisor:
                          reason=h.cancel_reason.value)
                 continue
             if self._recoverable(h) and not terminal:
+                if (req is not None and req.output_tokens
+                        and h.resume_tokens is None):
+                    # mid-decode death (ISSUE 20): carry the emitted
+                    # tokens so re-dispatch RESUMES instead of replaying
+                    # — and so FleetRouter.submit routes this handle to
+                    # a same-role/unified replica, never a prefill
+                    # specialist.  The KV itself is unexportable (the
+                    # engine thread is dead); the recipient recomputes
+                    # the prompt+resume tail, which preserves greedy
+                    # token identity.
+                    h.resume_tokens = [int(t) for t in req.output_tokens]
+                    h.arrival = req.arrival_time
                 h.req = None
                 lc.event(rid, "redispatch", replica=rep,
                          had_output=bool(req and req.output_tokens))

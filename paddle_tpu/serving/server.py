@@ -1145,7 +1145,8 @@ def _toy_engine(layers: int = 2, num_blocks: int = 64,
                 metrics_labels=None, audit=None,
                 unified: bool = False, aot=None,
                 max_tokens_per_step: Optional[int] = None,
-                spec=None, burst_steps: int = 0) -> EngineCore:
+                spec=None, burst_steps: int = 0,
+                role: str = "unified") -> EngineCore:
     import paddle_tpu as paddle
     from ..models import LlamaConfig, LlamaForCausalLM
     from .engine import EngineConfig
@@ -1165,7 +1166,8 @@ def _toy_engine(layers: int = 2, num_blocks: int = 64,
                                           scheduler=scheduler,
                                           spec=spec,
                                           burst_steps=burst_steps,
-                                          aot=aot),
+                                          aot=aot,
+                                          role=role),
                       registry=registry, metrics_labels=metrics_labels)
 
 
@@ -1175,7 +1177,8 @@ def _toy_fleet(dp: int = 1, layers: int = 2, num_blocks: int = 64,
                audit=None, unified: bool = False,
                fault_plan=None, alert_rules=None,
                aot=None, max_tokens_per_step: Optional[int] = None,
-               spec=None, burst_steps: int = 0) -> FleetRouter:
+               spec=None, burst_steps: int = 0,
+               roles=None) -> FleetRouter:
     """A dp-replica fleet of toy engines on one shared registry: each
     replica gets its OWN model instance (engine threads swap parameter
     values during the traced step — modules must not be shared) with
@@ -1191,11 +1194,13 @@ def _toy_fleet(dp: int = 1, layers: int = 2, num_blocks: int = 64,
             metrics_labels={"replica": str(i)}, audit=audit,
             unified=unified, aot=aot,
             max_tokens_per_step=max_tokens_per_step, spec=spec,
-            burst_steps=burst_steps),
+            burst_steps=burst_steps,
+            role=(roles[i] if roles else "unified")),
         dp=dp, config=FleetConfig(max_queue=max_queue,
                                   flight_dir=flight_dir,
                                   fault_plan=fault_plan,
-                                  alert_rules=alert_rules))
+                                  alert_rules=alert_rules,
+                                  roles=roles))
 
 
 def _http(port: int, method: str, path: str, body: Optional[dict] = None):
@@ -1350,6 +1355,7 @@ def _build_procfleet(args, fault_plan=None, alert_rules=None):
         audit_sample_every=args.audit_sample or 1,
         aot_path=args.aot_path, compile_cache=args.compile_cache,
         warm_boot=args.aot_warm,
+        roles=getattr(args, "roles_list", None),
         fleet=FleetConfig(max_queue=args.max_queue,
                           flight_dir=args.flight_dir,
                           fault_plan=fault_plan,
@@ -1463,7 +1469,8 @@ async def _serve_cli(args) -> int:
                            unified=args.unified, fault_plan=fault_plan,
                            alert_rules=alert_rules, aot=aot,
                            max_tokens_per_step=args.max_tokens_per_step,
-                           spec=spec, burst_steps=args.burst)
+                           spec=spec, burst_steps=args.burst,
+                           roles=getattr(args, "roles_list", None))
     supervisor = None
     if args.max_restarts > 0:
         # self-healing by default (ISSUE 12): dead replicas restart
@@ -1655,6 +1662,14 @@ def main(argv=None) -> int:
                         "respawns it off the shared --aot-path artifact "
                         "and loses nothing.  0 = in-process replicas "
                         "(--dp)")
+    p.add_argument("--roles", default=None, metavar="SPEC",
+                   help="prefill/decode disaggregation (ISSUE 20): "
+                        "per-replica role counts, e.g. "
+                        "'prefill:1,decode:2'.  Counts must sum to the "
+                        "fleet size (--dp or --workers).  Admissions "
+                        "route to prefill specialists; each request "
+                        "migrates (with its computed prompt KV) to a "
+                        "decode specialist at its first-token boundary")
     p.add_argument("--autoscale", action="store_true",
                    help="with --workers: enable the SLO-driven "
                         "autoscaler (alert firings → bounded worker "
@@ -1710,6 +1725,18 @@ def main(argv=None) -> int:
     elif args.autoscale or args.rebalance:
         p.error("--autoscale/--rebalance act on the cross-process "
                 "worker pool; they require --workers N")
+    args.roles_list = None
+    if args.roles:
+        from .fleet import parse_roles
+
+        try:
+            args.roles_list = parse_roles(args.roles)
+        except ValueError as e:
+            p.error(f"--roles: {e}")
+        size = args.workers if args.workers else args.dp
+        if len(args.roles_list) != size:
+            p.error(f"--roles names {len(args.roles_list)} replica(s) "
+                    f"but the fleet has {size} (--workers/--dp)")
     if args.audit_sample is not None and args.audit_sample < 1:
         p.error(f"--audit-sample must be >= 1, got {args.audit_sample}")
     if args.max_restarts < 0:

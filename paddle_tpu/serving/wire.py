@@ -52,6 +52,7 @@ without them is valid, so mixed router/worker versions interoperate):
 
 from __future__ import annotations
 
+import base64
 import json
 import math
 import socket
@@ -137,13 +138,19 @@ def hello_frame(role: str, aot_hash: Optional[str],
 
 def canonical_deploy(deploy: Optional[Dict]) -> Optional[Dict]:
     """Normalize a deployment-identity dict for comparison: the default
-    shape (mp=1, spec decoding off) collapses to ``None`` so a peer that
-    predates the field and one that runs the defaults agree."""
+    shape (mp=1, spec decoding off, unified role) collapses to ``None``
+    so a peer that predates the field and one that runs the defaults
+    agree.  ``role`` (ISSUE 20) rides the same rule: ``"unified"`` (or
+    absent) drops out of the dict, so a role-less old peer and a
+    unified-role new peer still shake hands."""
     if not deploy:
         return None
     out = {"mp": int(deploy.get("mp", 1) or 1),
            "spec": deploy.get("spec") or None}
-    if out["mp"] == 1 and out["spec"] is None:
+    role = str(deploy.get("role") or "unified")
+    if role != "unified":
+        out["role"] = role
+    if out["mp"] == 1 and out["spec"] is None and "role" not in out:
         return None
     if out["spec"] is not None:
         # JSON round-trips must compare equal: coerce the manifest's
@@ -342,6 +349,71 @@ def connect(host: str, port: int, role: str, aot_hash: Optional[str],
                          f"expected hello_ok, got {reply.get('type')!r}")
     conn.settimeout(None)
     return conn
+
+
+# --- KV block-stream frames (ISSUE 20) --------------------------------------
+# A KV run (serving/handoff.py) ships as one ``kv_run_begin`` frame —
+# block metadata (chain-hash hex, depth, tokens), payload digest, byte
+# count, chunk count — followed by exactly ``chunks`` base64
+# ``kv_run_chunk`` frames.  Raw chunks are capped well under
+# MAX_FRAME_BYTES so the base64 expansion (4/3) plus JSON overhead never
+# trips the oversized guard.
+KV_CHUNK_BYTES = 4 << 20
+
+
+def kv_run_frames(meta: Dict, blocks: List, payload: bytes,
+                  digest_hex: str) -> List[Dict]:
+    """Frame a serialized KV run for the wire: ``meta`` is the pool
+    compatibility header, ``blocks`` the JSON-able block records
+    (``[hash_hex, depth, [tokens...]]`` rows), ``payload`` the raw
+    gathered KV bytes."""
+    chunks = [payload[i:i + KV_CHUNK_BYTES]
+              for i in range(0, len(payload), KV_CHUNK_BYTES)] or [b""]
+    frames: List[Dict] = [{
+        "type": "kv_run_begin", "meta": dict(meta), "blocks": blocks,
+        "digest": str(digest_hex), "bytes": len(payload),
+        "chunks": len(chunks)}]
+    for i, c in enumerate(chunks):
+        frames.append({"type": "kv_run_chunk", "seq": i,
+                       "data": base64.b64encode(c).decode("ascii")})
+    return frames
+
+
+def kv_run_assemble(begin: Dict, chunks: List[Dict]) -> bytes:
+    """Reassemble a KV run's payload bytes from its frames, validating
+    the chunk protocol: mistyped/misordered chunks raise
+    :class:`FrameError` kind ``protocol``, undecodable base64 kind
+    ``malformed``, and a byte-count shortfall kind ``truncated`` — the
+    same typed vocabulary every other frame failure uses, so the worker
+    answers with a typed error and SURVIVES."""
+    if begin.get("type") != "kv_run_begin":
+        raise FrameError(
+            "protocol",
+            f"expected kv_run_begin, got {begin.get('type')!r}")
+    want = int(begin.get("chunks", 0))
+    if len(chunks) != want:
+        raise FrameError(
+            "truncated",
+            f"kv run carries {len(chunks)} of {want} chunk frame(s)")
+    parts: List[bytes] = []
+    for i, fr in enumerate(chunks):
+        if fr.get("type") != "kv_run_chunk" or int(fr.get("seq", -1)) != i:
+            raise FrameError(
+                "protocol",
+                f"kv run chunk {i} is mistyped or out of order")
+        try:
+            parts.append(base64.b64decode(fr.get("data", ""),
+                                          validate=True))
+        except (ValueError, TypeError) as e:
+            raise FrameError(
+                "malformed", f"kv run chunk {i} is not valid base64: {e}")
+    payload = b"".join(parts)
+    if len(payload) != int(begin.get("bytes", -1)):
+        raise FrameError(
+            "truncated",
+            f"kv run payload is {len(payload)} bytes, the header "
+            f"promised {begin.get('bytes')}")
+    return payload
 
 
 # --- registry dump/merge shapes ---------------------------------------------

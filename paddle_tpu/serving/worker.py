@@ -65,7 +65,7 @@ from .wire import CACHE_PREFIX, READY_PREFIX  # noqa: F401  (canonical
 # parent fails loudly instead of silently half-configuring the worker
 _ENGINE_KEYS = ("lifecycle_events", "decode_event_sample", "step_profile",
                 "cache_stats", "history", "unified_step", "prefix_cache",
-                "burst_steps")
+                "burst_steps", "role")
 _SPEC_KEYS = _ENGINE_KEYS + (
     "layers", "num_blocks", "block_size", "max_num_seqs",
     "max_prefill_tokens_per_step", "max_tokens_per_step", "seed",
@@ -230,13 +230,22 @@ class WorkerHost:
         hashes = frame.get("prefix_hashes")
         if hashes is not None:
             hashes = [bytes.fromhex(h) for h in hashes]
+        resume = frame.get("resume_tokens")
         with self.lock:
             req = self.engine.add_request(
                 [int(t) for t in frame["prompt_ids"]], sampling=sampling,
                 request_id=frame["rid"],
                 priority=int(frame.get("priority", 0)),
                 trace_id=str(frame.get("trace_id", frame["rid"])),
-                prefix_hashes=hashes, slo_ms=frame.get("slo_ms"))
+                prefix_hashes=hashes, slo_ms=frame.get("slo_ms"),
+                resume_tokens=([int(t) for t in resume]
+                               if resume else None))
+            if frame.get("arrival") is not None:
+                # migrated request (ISSUE 20): its e2e span starts at
+                # the ORIGINAL arrival stamp (perf_counter is
+                # CLOCK_MONOTONIC machine-wide, so the donor worker's
+                # stamp is valid in this process too)
+                req.arrival_time = float(frame["arrival"])
             self._live[frame["rid"]] = req
         return {"type": "submit_ok", "rid": frame["rid"],
                 "telemetry": self._drain(limit=64)}
@@ -326,6 +335,75 @@ class WorkerHost:
                              "eng1": t_eng1,
                              "reply": time.perf_counter()},
                        **self._state()})
+
+    # --- KV hand-off (ISSUE 20) ---------------------------------------------
+    def handle_kv_export(self, conn: wire.Connection, frame: Dict) -> None:
+        """Serialize a request's computed prompt KV (or a hot prefix
+        chain, when ``chain`` is given) and stream it back as
+        ``kv_run_begin`` + chunked ``kv_run_chunk`` frames.  An empty /
+        untransferable run answers one ``kv_export_ok empty`` frame —
+        the router falls back to re-prefill."""
+        from . import handoff
+
+        with self.lock:
+            try:
+                if frame.get("chain") is not None:
+                    mb = frame.get("max_blocks")
+                    run = handoff.export_prefix_run(
+                        self.engine, bytes.fromhex(str(frame["chain"])),
+                        max_blocks=(int(mb) if mb is not None else None))
+                else:
+                    run = handoff.export_request_run(self.engine,
+                                                     frame["rid"])
+            except Exception as e:
+                conn.send(wire.error_frame("protocol",
+                                           f"kv export failed: {e}"))
+                return
+        if run is None:
+            conn.send({"type": "kv_export_ok", "empty": True})
+            return
+        for out in handoff.run_to_frames(run):
+            conn.send(out)
+
+    def handle_kv_import(self, conn: wire.Connection, begin: Dict) -> None:
+        """Assemble a streamed KV run (the chunk frames follow ``begin``
+        on this same strictly-serial connection) and admit it into the
+        pool.  Corrupt/truncated streams answer the usual TYPED wire
+        errors and the process keeps serving — frame boundaries stay
+        intact because the declared chunk count is always consumed."""
+        from . import handoff
+
+        chunks = []
+        declared = max(0, min(int(begin.get("chunks", 0) or 0), 4096))
+        try:
+            for _ in range(declared):
+                chunks.append(conn.recv())
+        except wire.FrameError as e:
+            try:
+                conn.send(wire.error_frame(e.kind, str(e)))
+            except wire.WireError:
+                pass  # swallow-ok: peer already gone; recv counted the error
+            raise  # connection is desynced mid-stream: let the caller close it
+        try:
+            run = handoff.run_from_frames(begin, chunks)
+            with self.lock:
+                placed = handoff.import_run(self.engine, run)
+        except wire.FrameError as e:
+            conn.send(wire.error_frame(e.kind, str(e)))
+            return
+        except handoff.HandoffError as e:
+            conn.send(wire.error_frame("malformed", str(e)))
+            return
+        conn.send({"type": "kv_import_ok",
+                   "placed": (None if placed is None else int(placed))})
+
+    def handle_kv_detach(self, frame: Dict) -> Dict:
+        with self.lock:
+            ok = self.engine.detach_request(frame["rid"])
+            if ok:
+                self._live.pop(frame["rid"], None)
+        return {"type": "kv_detach_ok", "rid": frame["rid"],
+                "ok": bool(ok)}
 
     def handle_debug(self, frame: Dict) -> Dict:
         what = frame.get("what")
@@ -457,6 +535,18 @@ class WorkerHost:
                 reply["t1"] = t_recv
                 reply["t2"] = time.perf_counter()
             conn.send(reply)
+        elif t == "kv_export":
+            self.handle_kv_export(conn, frame)
+        elif t == "hot_prefixes":
+            k = frame.get("k")
+            with self.lock:
+                rows = self.engine.hot_prefixes(
+                    int(k) if k is not None else None)
+            conn.send({"type": "hot_prefixes_ok", "rows": rows})
+        elif t == "kv_run_begin":
+            self.handle_kv_import(conn, frame)
+        elif t == "kv_detach":
+            conn.send(self.handle_kv_detach(frame))
         elif t == "debug":
             conn.send(self.handle_debug(frame))
         elif t == "set_fault":
@@ -558,7 +648,8 @@ def main(argv=None) -> int:
                       telemetry=bool(spec.get("telemetry", False)),
                       deploy={"mp": int(engine.mp),
                               "spec": (spec_cfg.config.manifest_dict()
-                                       if spec_cfg is not None else None)})
+                                       if spec_cfg is not None else None),
+                              "role": engine.engine_config.role})
     server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
     server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
     server.bind((args.host, args.port))

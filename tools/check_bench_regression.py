@@ -126,6 +126,21 @@ CHECKS: Tuple[Tuple[str, str, float, float], ...] = (
     # wrong or the piggyback drain starved)
     ("procfleet.wire_overhead_share",    "lower",     1.0, 0.25),
     ("procfleet.mirror_events_dropped",  "count_max", 0.0, 0.0),
+    # prefill/decode disaggregation (ISSUE 20): token identity vs the
+    # unified deployment and zero-lost are EXACT (one diverged or lost
+    # request IS the regression); the steady-state decode ITL p99 win
+    # must not collapse (wide floor — host-clocked gaps on CPU); the
+    # hand-off counts are deterministic on the fixed streams (every
+    # request migrates exactly once — creep means double migration);
+    # and the decode specialist's round-trips-per-token must stay
+    # below the ceiling (a broken burst cohort would blow it up)
+    ("disagg.token_mismatches",          "count_max", 0.0, 0.0),
+    ("disagg.requests_lost",             "count_max", 0.0, 0.0),
+    ("disagg.itl_p99_improvement",       "higher",    0.5, 0.0),
+    ("disagg.handoffs_interference",     "count_max", 0.0, 0.0),
+    ("disagg.handoffs_burst",            "count_max", 0.0, 0.0),
+    ("disagg.decode_specialist_roundtrips_per_token",
+                                         "lower",     0.5, 0.0),
 )
 
 

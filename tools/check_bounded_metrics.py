@@ -83,6 +83,10 @@ SCAN_FILES = (
     # manifest (enumerate_buckets is a finite lattice); pinned so the
     # loaded-Exported cache stays covered if the module moves
     os.path.join(_REPO, "paddle_tpu", "serving", "aot.py"),
+    # ISSUE 20: the KV hand-off path assembles whole runs in memory —
+    # its chunk buffers are bounded by the declared chunk cap and the
+    # donor pool size; pinned so that stays covered if the module moves
+    os.path.join(_REPO, "paddle_tpu", "serving", "handoff.py"),
     os.path.join(_REPO, "paddle_tpu", "ops", "paged_attention.py"),
     os.path.join(_REPO, "paddle_tpu", "ops", "pallas_paged.py"),
     # ISSUE 11: the unified ragged kernel sits on the serving hot path

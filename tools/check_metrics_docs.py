@@ -62,6 +62,9 @@ DECLARING_MODULES = (
     # ISSUE 19: decode-burst launch/token/length series plus the
     # host-round-trip counter every step-program launch increments
     os.path.join(_REPO, "paddle_tpu", "serving", "burst.py"),
+    # ISSUE 20: prefill/decode disaggregation — the KV hand-off
+    # counter/histograms the router registers for every fleet
+    os.path.join(_REPO, "paddle_tpu", "serving", "handoff.py"),
 )
 
 _NAME_RE = re.compile(r"\b(?:serving|push)_[a-z0-9_:]+\b")
